@@ -1,0 +1,33 @@
+"""dlrover_tpu: a TPU-native elastic distributed training framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of DLRover
+(elastic master/agent control plane, flash checkpoint, auto-parallelism)
+for TPU pod slices.  See SURVEY.md at the repo root for the blueprint.
+
+Layering (mirrors the reference architecture roles, not its code):
+
+- ``dlrover_tpu.common``   -- constants, node model, message envelope, IPC,
+  checkpoint storage (reference: ``dlrover/python/common``).
+- ``dlrover_tpu.master``   -- per-job master: rendezvous, dynamic data
+  sharding, node supervision, autoscaling (reference:
+  ``dlrover/python/master``).
+- ``dlrover_tpu.agent``    -- per-host elastic agent: process supervision,
+  master-backed rendezvous, async checkpoint saver, TPU health checks
+  (reference: ``dlrover/python/elastic_agent``).
+- ``dlrover_tpu.trainer``  -- user-facing API: ``dlrover-tpu-run`` CLI,
+  ElasticTrainer, flash-checkpoint Checkpointer (reference:
+  ``dlrover/trainer``).
+- ``dlrover_tpu.parallel`` -- mesh / named-axis parallelism: DP, FSDP, TP,
+  Ulysses + ring sequence parallel, MoE expert parallel, pipeline
+  (reference: ``atorch/distributed`` + ``atorch/modules``).
+- ``dlrover_tpu.accel``    -- ``auto_accelerate``-style strategy engine
+  emitting sharding plans (reference: ``atorch/auto``).
+- ``dlrover_tpu.models``   -- flagship model families (llama-style
+  transformer, MoE) built on the parallel layer.
+- ``dlrover_tpu.ops``      -- Pallas kernels (flash attention, ring
+  attention, grouped GEMM) with XLA fallbacks.
+- ``dlrover_tpu.optim``    -- optimizers (AGD, WSAM, low-bit states)
+  as optax transforms (reference: ``atorch/optimizers``).
+"""
+
+__version__ = "0.1.0"

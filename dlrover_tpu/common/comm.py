@@ -1,0 +1,158 @@
+"""gRPC control-plane transport: two bytes-in/bytes-out unary RPCs.
+
+Reference parity: ``dlrover/proto/elastic_training.proto:26-29`` (the
+``Master`` service exposes exactly ``report`` and ``get``) and the channel
+helpers in ``dlrover/python/common/grpc.py``.  Instead of protoc codegen
+we register the same two methods through grpc's generic handler API with
+identity serializers; the payload is the pickled ``Envelope`` from
+``dlrover_tpu.common.messages``.
+"""
+
+import socket
+import time
+from concurrent import futures
+from typing import Callable, Optional
+
+import grpc
+
+from dlrover_tpu.common.constants import GRPC
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.messages import (
+    BoolResponse,
+    Envelope,
+    Message,
+    deserialize_message,
+    serialize_message,
+)
+
+_CHANNEL_OPTIONS = [
+    ("grpc.max_send_message_length", GRPC.MAX_SEND_MESSAGE_LENGTH),
+    ("grpc.max_receive_message_length", GRPC.MAX_RECEIVE_MESSAGE_LENGTH),
+    ("grpc.enable_retries", 1),
+]
+
+
+def addr_connectable(addr: str, timeout: float = 1.0) -> bool:
+    """True if a TCP connect to "host:port" succeeds."""
+    if not addr or ":" not in addr:
+        return False
+    host, _, port = addr.rpartition(":")
+    try:
+        with socket.create_connection((host, int(port)), timeout=timeout):
+            return True
+    except (OSError, ValueError):
+        return False
+
+
+def build_master_server(
+    port: int,
+    report_fn: Callable[[Envelope], BoolResponse],
+    get_fn: Callable[[Envelope], Optional[Message]],
+    max_workers: int = 64,
+    host: str = "0.0.0.0",
+) -> grpc.Server:
+    """Create (not start) the master gRPC server.
+
+    ``report_fn``/``get_fn`` receive the deserialized ``Envelope`` and
+    return a ``Message`` (or None); transport (de)serialization is
+    handled here.
+    """
+
+    def _report(request: bytes, _ctx) -> bytes:
+        envelope = deserialize_message(request)
+        response = report_fn(envelope)
+        return serialize_message(response)
+
+    def _get(request: bytes, _ctx) -> bytes:
+        envelope = deserialize_message(request)
+        response = get_fn(envelope)
+        return serialize_message(response)
+
+    handlers = {
+        GRPC.REPORT_METHOD: grpc.unary_unary_rpc_method_handler(_report),
+        GRPC.GET_METHOD: grpc.unary_unary_rpc_method_handler(_get),
+    }
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=_CHANNEL_OPTIONS,
+    )
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(GRPC.SERVICE_NAME, handlers),)
+    )
+    server.add_insecure_port(f"{host}:{port}")
+    return server
+
+
+class MasterChannel:
+    """Client side of the 2-RPC protocol with retry.
+
+    Reference parity: ``elastic_agent/master_client.py:28`` —
+    ``retry_grpc_request``.
+    """
+
+    def __init__(
+        self,
+        addr: str,
+        node_id: int = 0,
+        node_type: str = "worker",
+        timeout: float = 10.0,
+        max_retry: int = 3,
+    ):
+        self._addr = addr
+        self._node_id = node_id
+        self._node_type = node_type
+        self._timeout = timeout
+        self._max_retry = max_retry
+        self._channel = grpc.insecure_channel(addr, options=_CHANNEL_OPTIONS)
+        prefix = f"/{GRPC.SERVICE_NAME}/"
+        self._report = self._channel.unary_unary(
+            prefix + GRPC.REPORT_METHOD,
+            # registered_method is only supported on newer grpcio; skip.
+        )
+        self._get = self._channel.unary_unary(prefix + GRPC.GET_METHOD)
+
+    @property
+    def addr(self) -> str:
+        return self._addr
+
+    def close(self):
+        self._channel.close()
+
+    def _wrap(self, message: Message) -> bytes:
+        return serialize_message(
+            Envelope(
+                node_id=self._node_id,
+                node_type=self._node_type,
+                data=serialize_message(message),
+            )
+        )
+
+    def _call_with_retry(self, rpc, payload: bytes, timeout: float):
+        err: Optional[Exception] = None
+        for attempt in range(self._max_retry):
+            try:
+                return rpc(payload, timeout=timeout)
+            except grpc.RpcError as e:  # pragma: no cover - network flake
+                err = e
+                logger.warning(
+                    "master rpc to %s failed (attempt %d/%d): %s",
+                    self._addr,
+                    attempt + 1,
+                    self._max_retry,
+                    e,
+                )
+                time.sleep(min(2**attempt, 5))
+        raise ConnectionError(f"master at {self._addr} unreachable: {err}")
+
+    def report(self, message: Message, timeout: Optional[float] = None) -> bool:
+        raw = self._call_with_retry(
+            self._report, self._wrap(message), timeout or self._timeout
+        )
+        response = deserialize_message(raw)
+        return bool(response and response.success)
+
+    def get(self, message: Message, timeout: Optional[float] = None):
+        raw = self._call_with_retry(
+            self._get, self._wrap(message), timeout or self._timeout
+        )
+        return deserialize_message(raw)

@@ -1,0 +1,68 @@
+"""Environment helpers for node/process identity.
+
+Reference parity: ``dlrover/python/common/env_utils.py``.
+"""
+
+import os
+
+from dlrover_tpu.common.constants import NodeEnv
+
+
+def _get_int(name: str, default: int = 0) -> int:
+    value = os.getenv(name, "")
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        return default
+
+
+def get_node_id() -> int:
+    return _get_int(NodeEnv.NODE_ID, 0)
+
+
+def get_node_rank() -> int:
+    return _get_int(NodeEnv.NODE_RANK, get_node_id())
+
+
+def get_node_num() -> int:
+    return _get_int(NodeEnv.NODE_NUM, 1)
+
+
+def get_node_type() -> str:
+    return os.getenv(NodeEnv.NODE_TYPE, "worker")
+
+
+def get_process_rank() -> int:
+    return _get_int(NodeEnv.PROCESS_RANK, 0)
+
+
+def get_process_count() -> int:
+    return _get_int(NodeEnv.PROCESS_COUNT, 1)
+
+
+def get_local_rank() -> int:
+    return _get_int(NodeEnv.LOCAL_RANK, 0)
+
+
+def get_local_process_count() -> int:
+    return _get_int(NodeEnv.LOCAL_PROCESS_COUNT, 1)
+
+
+def get_master_addr() -> str:
+    return os.getenv(NodeEnv.MASTER_ADDR, "")
+
+
+def get_job_name() -> str:
+    return os.getenv(NodeEnv.JOB_NAME, "local-job")
+
+
+def get_restart_count() -> int:
+    return _get_int(NodeEnv.RESTART_COUNT, 0)
+
+
+def get_free_port(host: str = "127.0.0.1") -> int:
+    import socket
+
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as sock:
+        sock.bind((host, 0))
+        return sock.getsockname()[1]

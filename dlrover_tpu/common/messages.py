@@ -1,0 +1,440 @@
+"""Control-plane message dataclasses + serialization envelope.
+
+Reference parity: ``dlrover/python/common/grpc.py:150-496`` — the whole
+agent<->master protocol is two RPCs (``report`` fire-and-forget with a
+bool ack, ``get`` request/response) carrying serialized dataclasses in an
+envelope ``Message{node_id, node_type, data}``
+(``dlrover/proto/elastic_training.proto:19-29``).  The full dispatch
+tables are reproduced in SURVEY.md Appendix A; every request/report type
+there has an equivalent here (TF-PS-only types are kept for parity since
+the master-side services are cheap).
+
+Serialization is pickle restricted to the classes registered in this
+module (the reference pickles arbitrarily; we at least pin the class
+table).
+"""
+
+import io
+import pickle
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+class Message:
+    """Base class; every control-plane dataclass derives from it."""
+
+    def serialize(self) -> bytes:
+        return pickle.dumps(self)
+
+
+#: builtins actually needed to unpickle our dataclasses (container and
+#: scalar constructors only — never eval/exec/getattr).
+_SAFE_BUILTINS = {
+    "set",
+    "frozenset",
+    "bytearray",
+    "complex",
+    "slice",
+    "range",
+}
+_ALLOWED_MODULE_PREFIXES = ("dlrover_tpu.", "collections")
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        if module == "builtins":
+            if name in _SAFE_BUILTINS:
+                return super().find_class(module, name)
+        elif module.startswith(_ALLOWED_MODULE_PREFIXES):
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"forbidden class in control-plane message: {module}.{name}"
+        )
+
+
+def serialize_message(message: Optional[Message]) -> bytes:
+    if message is None:
+        return b""
+    return pickle.dumps(message)
+
+
+def deserialize_message(data: bytes):
+    if not data:
+        return None
+    return _RestrictedUnpickler(io.BytesIO(data)).load()
+
+
+@dataclass
+class Envelope(Message):
+    """The on-wire unit: who sent it + the payload message."""
+
+    node_id: int = 0
+    node_type: str = ""
+    data: bytes = b""
+
+
+@dataclass
+class BoolResponse(Message):
+    success: bool = False
+    reason: str = ""
+
+
+# --------------------------------------------------------------------------
+# `get` requests (master/servicer get-dispatch parity)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class TaskRequest(Message):
+    dataset_name: str = ""
+
+
+@dataclass
+class DataShard(Message):
+    name: str = ""
+    start: int = 0
+    end: int = 0
+    record_indices: Optional[List[int]] = None
+
+
+@dataclass
+class Task(Message):
+    task_id: int = -1
+    task_type: str = ""  # TRAINING / EVALUATION / WAIT / NONE
+    shard: DataShard = field(default_factory=DataShard)
+
+    @property
+    def is_empty(self) -> bool:
+        return self.task_id < 0 and self.task_type != TaskType.WAIT
+
+
+class TaskType:
+    NONE = "none"
+    TRAINING = "training"
+    EVALUATION = "evaluation"
+    PREDICTION = "prediction"
+    WAIT = "wait"
+
+
+@dataclass
+class ShardCheckpointRequest(Message):
+    dataset_name: str = ""
+
+
+@dataclass
+class ShardCheckpoint(Message):
+    dataset_name: str = ""
+    content: str = ""  # JSON from DatasetSplitter.checkpoint()
+
+
+@dataclass
+class RunningNodesRequest(Message):
+    pass
+
+
+@dataclass
+class RunningNodes(Message):
+    nodes: List = field(default_factory=list)
+
+
+@dataclass
+class JoinRendezvousRequest(Message):
+    node_id: int = 0
+    node_rank: int = 0
+    local_world_size: int = 1
+    rdzv_name: str = ""
+    node_ip: str = ""
+
+
+@dataclass
+class RendezvousState(Message):
+    round: int = 0
+    waiting_num: int = 0
+
+
+@dataclass
+class WaitingNodeNumRequest(Message):
+    rdzv_name: str = ""
+
+
+@dataclass
+class WaitingNodeNum(Message):
+    waiting_num: int = 0
+
+
+@dataclass
+class NetworkReadyRequest(Message):
+    pass
+
+
+@dataclass
+class NetworkCheckResult(Message):
+    nodes: List[int] = field(default_factory=list)
+    reason: str = ""
+
+
+@dataclass
+class StragglerExistRequest(Message):
+    pass
+
+
+@dataclass
+class CommWorldRequest(Message):
+    node_id: int = 0
+    rdzv_name: str = ""
+
+
+@dataclass
+class CommWorld(Message):
+    rdzv_name: str = ""
+    round: int = 0
+    group: int = 0
+    world: Dict[int, int] = field(default_factory=dict)  # node_rank -> lws
+
+
+@dataclass
+class KeyValuePair(Message):
+    key: str = ""
+    value: bytes = b""
+
+
+@dataclass
+class KeyValuePairs(Message):
+    kvs: Dict[str, bytes] = field(default_factory=dict)
+
+
+@dataclass
+class PsNodesRequest(Message):
+    pass
+
+
+@dataclass
+class PsNodes(Message):
+    nodes: List = field(default_factory=list)
+    new_ps_ready: bool = False
+    ps_failure: bool = False
+
+
+@dataclass
+class TrainingStatusRequest(Message):
+    pass
+
+
+@dataclass
+class TrainingStatus(Message):
+    status: int = 3  # TrainingLoopStatus.PENDING
+
+
+@dataclass
+class ParallelConfigRequest(Message):
+    pass
+
+
+@dataclass
+class DataLoaderConfig(Message):
+    dataloader_name: str = ""
+    batch_size: int = 0
+    num_workers: int = 0
+    prefetch_count: int = 0
+
+
+@dataclass
+class OptimizerConfig(Message):
+    learning_rate: float = 0.0
+    micro_batch_size: int = 0
+
+
+@dataclass
+class ParallelConfig(Message):
+    dataloader: DataLoaderConfig = field(default_factory=DataLoaderConfig)
+    optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
+    mesh_shape: Dict[str, int] = field(default_factory=dict)
+    restart: bool = False
+
+
+@dataclass
+class CheckHardwareResetRequest(Message):
+    pass
+
+
+@dataclass
+class ClusterVersionRequest(Message):
+    task_type: str = ""
+    task_id: int = 0
+    version_type: str = ""
+
+
+@dataclass
+class ClusterVersion(Message):
+    version: int = 0
+
+
+@dataclass
+class ElasticRunConfigRequest(Message):
+    pass
+
+
+@dataclass
+class ElasticRunConfig(Message):
+    configs: Dict[str, str] = field(default_factory=dict)
+
+
+# --------------------------------------------------------------------------
+# `report` messages (master/servicer report-dispatch parity)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class DatasetShardParams(Message):
+    batch_size: int = 0
+    num_epochs: int = 1
+    dataset_size: int = 0
+    shuffle: bool = False
+    num_minibatches_per_shard: int = 2
+    dataset_name: str = ""
+    task_type: str = TaskType.TRAINING
+    storage_type: str = "table"
+
+
+@dataclass
+class ResourceStats(Message):
+    cpu_percent: float = 0.0
+    memory_mb: int = 0
+    tpu_stats: List[Dict] = field(default_factory=list)  # per-chip stats
+
+
+@dataclass
+class ModelInfo(Message):
+    num_params: int = 0
+    flops_per_step: float = 0.0
+    hidden_size: int = 0
+    num_layers: int = 0
+    seq_len: int = 0
+    extra: Dict = field(default_factory=dict)
+
+
+@dataclass
+class GlobalStep(Message):
+    step: int = 0
+    timestamp: float = 0.0
+    elapsed_time_per_step: float = 0.0
+
+
+@dataclass
+class TaskResult(Message):
+    dataset_name: str = ""
+    task_id: int = 0
+    err_message: str = ""
+
+
+@dataclass
+class NodeAddress(Message):
+    addr: str = ""
+    node_type: str = ""
+    node_id: int = 0
+
+
+@dataclass
+class NetworkStatus(Message):
+    node_rank: int = 0
+    succeeded: bool = False
+    elapsed_time: float = 0.0
+
+
+@dataclass
+class NodeEventMessage(Message):
+    event_type: str = ""
+    node_type: str = ""
+    node_id: int = 0
+    reason: str = ""
+
+
+@dataclass
+class SyncJoin(Message):
+    sync_name: str = ""
+    worker_type: str = ""
+    worker_id: int = 0
+
+
+@dataclass
+class SyncFinish(Message):
+    sync_name: str = ""
+
+
+@dataclass
+class SyncBarrier(Message):
+    barrier_name: str = ""
+    notify: bool = False
+
+
+@dataclass
+class NodeFailure(Message):
+    error_data: str = ""
+    level: str = ""
+    restart_count: int = 0
+
+
+@dataclass
+class RendezvousParams(Message):
+    min_nodes: int = 1
+    max_nodes: int = 1
+    waiting_timeout: int = 600
+    node_unit: int = 1
+    joint_timeout: int = 600
+
+
+@dataclass
+class PsReady(Message):
+    pass
+
+
+@dataclass
+class HeartBeat(Message):
+    timestamp: float = 0.0
+
+
+@dataclass
+class NodeCheckpointState(Message):
+    step: int = 0
+
+
+@dataclass
+class DiagnosisReportData(Message):
+    data_cls: str = ""
+    data_content: str = ""
+    node_rank: int = -1
+
+
+@dataclass
+class Event(Message):
+    event_type: str = ""
+    instance: str = ""
+    action: str = ""
+    msg: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class SucceededRequest(Message):
+    pass
+
+
+# --------------------------------------------------------------------------
+# scale plans (master -> scaler; also CRD-shaped for the k8s path)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class ScalePlan(Message):
+    node_group_resources: Dict = field(default_factory=dict)
+    launch_nodes: List = field(default_factory=list)
+    remove_nodes: List = field(default_factory=list)
+    migrate_nodes: Dict = field(default_factory=dict)
+
+    def is_empty(self) -> bool:
+        return not (
+            self.node_group_resources
+            or self.launch_nodes
+            or self.remove_nodes
+            or self.migrate_nodes
+        )

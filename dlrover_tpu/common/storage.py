@@ -1,0 +1,176 @@
+"""Checkpoint storage abstraction.
+
+Reference parity: ``dlrover/python/common/storage.py:24,128,203,231,258``
+(CheckpointStorage ABC, PosixDiskStorage, deletion strategies).  A GCS
+backend slot exists for TPU deployments (gated: the bare image has no
+``google-cloud-storage``; POSIX paths cover GCS-Fuse mounts, the common
+TPU-VM setup).
+"""
+
+import json
+import os
+import shutil
+from abc import ABCMeta, abstractmethod
+from typing import Callable, List, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class CheckpointDeletionStrategy(metaclass=ABCMeta):
+    @abstractmethod
+    def clean_up(self, step: int, delete_func: Callable[[str], None]):
+        """Decide which old checkpoint dirs to delete after ``step`` was
+        persisted; call ``delete_func(path)`` for each victim."""
+
+
+class KeepStepIntervalStrategy(CheckpointDeletionStrategy):
+    """Keep only checkpoints whose step % keep_interval == 0."""
+
+    def __init__(self, keep_interval: int, checkpoint_dir: str):
+        self._keep_interval = keep_interval
+        self._checkpoint_dir = checkpoint_dir
+
+    def clean_up(self, step: int, delete_func):
+        if step % self._keep_interval == 0:
+            return
+        path = os.path.join(self._checkpoint_dir, f"checkpoint-{step}")
+        try:
+            delete_func(path)
+        except Exception as e:  # noqa: BLE001
+            logger.warning("fail to clean up %s: %s", path, e)
+
+
+class KeepLatestStepStrategy(CheckpointDeletionStrategy):
+    """Keep at most ``max_to_keep`` newest checkpoints."""
+
+    def __init__(self, max_to_keep: int, checkpoint_dir: str):
+        self._max_to_keep = max(max_to_keep, 1)
+        self._checkpoint_dir = checkpoint_dir
+        self._steps: List[int] = []
+
+    def clean_up(self, step: int, delete_func):
+        self._steps.append(step)
+        while len(self._steps) > self._max_to_keep:
+            victim = self._steps.pop(0)
+            path = os.path.join(self._checkpoint_dir, f"checkpoint-{victim}")
+            try:
+                delete_func(path)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("fail to clean up %s: %s", path, e)
+
+
+class CheckpointStorage(metaclass=ABCMeta):
+    """Byte/file-level IO used by the async saver and the load path."""
+
+    @abstractmethod
+    def write(self, content, path: str):
+        ...
+
+    @abstractmethod
+    def read(self, path: str, mode: str = "r"):
+        ...
+
+    @abstractmethod
+    def safe_rmtree(self, dir_path: str):
+        ...
+
+    @abstractmethod
+    def safe_remove(self, path: str):
+        ...
+
+    @abstractmethod
+    def safe_makedirs(self, dir_path: str):
+        ...
+
+    @abstractmethod
+    def safe_move(self, src: str, dst: str):
+        ...
+
+    @abstractmethod
+    def exists(self, path: str) -> bool:
+        ...
+
+    @abstractmethod
+    def listdir(self, path: str) -> List[str]:
+        ...
+
+    def write_json(self, obj, path: str):
+        self.write(json.dumps(obj), path)
+
+    def read_json(self, path: str) -> Optional[dict]:
+        content = self.read(path)
+        if not content:
+            return None
+        try:
+            return json.loads(content)
+        except json.JSONDecodeError:
+            return None
+
+
+class PosixDiskStorage(CheckpointStorage):
+    def write(self, content, path: str):
+        mode = "wb" if isinstance(content, (bytes, bytearray, memoryview)) else "w"
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, mode) as f:
+            f.write(content)
+            f.flush()
+            os.fsync(f.fileno())
+
+    def read(self, path: str, mode: str = "r"):
+        if not os.path.exists(path):
+            return "" if "b" not in mode else b""
+        with open(path, mode) as f:
+            return f.read()
+
+    def safe_rmtree(self, dir_path: str):
+        shutil.rmtree(dir_path, ignore_errors=True)
+
+    def safe_remove(self, path: str):
+        if os.path.exists(path):
+            os.remove(path)
+
+    def safe_makedirs(self, dir_path: str):
+        os.makedirs(dir_path, exist_ok=True)
+
+    def safe_move(self, src: str, dst: str):
+        if os.path.exists(src) and not os.path.exists(dst):
+            shutil.move(src, dst)
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def listdir(self, path: str) -> List[str]:
+        if not os.path.isdir(path):
+            return []
+        return sorted(os.listdir(path))
+
+
+class PosixStorageWithDeletion(PosixDiskStorage):
+    """POSIX storage that applies a deletion strategy after each commit
+    of a persisted step (reference: ``common/storage.py:258``)."""
+
+    def __init__(self, tracker_file: str, deletion_strategy):
+        super().__init__()
+        self._tracker_file = tracker_file
+        self._deletion_strategy = deletion_strategy
+
+    def write(self, content, path: str):
+        # committing the tracker file marks a persisted step
+        if os.path.basename(path) == os.path.basename(self._tracker_file):
+            try:
+                prev = self.read(path)
+                if prev:
+                    self._deletion_strategy.clean_up(
+                        int(prev), self.safe_rmtree
+                    )
+            except (ValueError, OSError) as e:
+                logger.warning("deletion strategy failed: %s", e)
+        super().write(content, path)
+
+
+def get_checkpoint_storage(
+    deletion_strategy=None, tracker_file: str = ""
+) -> CheckpointStorage:
+    if deletion_strategy:
+        return PosixStorageWithDeletion(tracker_file, deletion_strategy)
+    return PosixDiskStorage()

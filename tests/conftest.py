@@ -1,0 +1,42 @@
+"""Test harness configuration.
+
+Tests run on an 8-device virtual CPU mesh
+(``--xla_force_host_platform_device_count=8``), mirroring the reference's
+strategy of never needing real multi-node hardware in CI (SURVEY.md §4).
+
+The image's sitecustomize pre-imports jax against the axon TPU plugin, so
+plain env vars are read too late; ``jax.config.update`` still steers the
+not-yet-initialized backend to CPU.
+"""
+
+import os
+import sys
+
+_FLAG = "--xla_force_host_platform_device_count=8"
+_existing = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _existing:
+    os.environ["XLA_FLAGS"] = (_existing + " " + _FLAG).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import tempfile  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _isolated_socket_dir(tmp_path, monkeypatch):
+    """Each test gets its own unix-socket namespace so parallel/repeated
+    runs don't collide on /tmp paths."""
+    monkeypatch.setenv("DLROVER_TPU_SOCKET_DIR", str(tmp_path / "socks"))
+    yield
+
+
+@pytest.fixture
+def tmp_ckpt_dir():
+    with tempfile.TemporaryDirectory(prefix="dlrover_tpu_ckpt_") as d:
+        yield d

@@ -1,0 +1,257 @@
+"""Tests for the common substrate (constants, node model, messages,
+storage, IPC primitives)."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.constants import NodeStatus, NodeType
+from dlrover_tpu.common.multi_process import (
+    SharedDict,
+    SharedLock,
+    SharedMemory,
+    SharedQueue,
+)
+from dlrover_tpu.common.node import Node, NodeGroupResource, NodeResource
+from dlrover_tpu.common.storage import (
+    KeepLatestStepStrategy,
+    KeepStepIntervalStrategy,
+    PosixDiskStorage,
+    PosixStorageWithDeletion,
+)
+
+
+class TestNodeModel:
+    def test_resource_str_parse(self):
+        res = NodeResource.resource_str_to_node_resource(
+            "cpu=4,memory=8192,tpu_chips=4,tpu_type=v5e,tpu_topology=2x2"
+        )
+        assert res.cpu == 4.0
+        assert res.memory == 8192
+        assert res.tpu_chips == 4
+        assert res.tpu_type == "v5e"
+        assert res.tpu_topology == "2x2"
+
+    def test_group_resource_update(self):
+        group = NodeGroupResource(2, NodeResource(cpu=1, memory=128))
+        group.update(count=4, cpu=8, memory=1024)
+        assert group.count == 4
+        assert group.node_resource.cpu == 8
+        assert group.node_resource.memory == 1024
+
+    def test_node_lifecycle(self):
+        node = Node(NodeType.WORKER, 3, max_relaunch_count=2)
+        assert node.rank_index == 3
+        node.update_status(NodeStatus.RUNNING)
+        assert node.start_time is not None
+        node.update_status(NodeStatus.FAILED)
+        assert node.finish_time is not None
+        node.inc_relaunch_count()
+        assert not node.exceeded_max_relaunch()
+        node.inc_relaunch_count()
+        assert node.exceeded_max_relaunch()
+        assert node.is_unrecoverable_failure()
+
+    def test_relaunch_node_copy(self):
+        node = Node(NodeType.WORKER, 1, status=NodeStatus.FAILED)
+        node.relaunch_count = 1
+        new = node.get_relaunch_node(9)
+        assert new.id == 9
+        assert new.status == NodeStatus.INITIAL
+        assert new.relaunch_count == 1
+        assert node.status == NodeStatus.FAILED  # original untouched
+
+    def test_heartbeat_timeout(self):
+        node = Node(NodeType.WORKER, 0, status=NodeStatus.RUNNING)
+        node.heartbeat_time = time.time() - 100
+        assert node.timeout(50)
+        assert not node.timeout(1000)
+
+
+class TestMessages:
+    def test_roundtrip(self):
+        req = msg.JoinRendezvousRequest(
+            node_id=2, node_rank=2, local_world_size=4, rdzv_name="elastic"
+        )
+        raw = msg.serialize_message(req)
+        out = msg.deserialize_message(raw)
+        assert isinstance(out, msg.JoinRendezvousRequest)
+        assert out.node_rank == 2
+        assert out.local_world_size == 4
+
+    def test_envelope(self):
+        inner = msg.GlobalStep(step=7, timestamp=1.0)
+        env = msg.Envelope(
+            node_id=1, node_type="worker", data=msg.serialize_message(inner)
+        )
+        out = msg.deserialize_message(msg.serialize_message(env))
+        payload = msg.deserialize_message(out.data)
+        assert payload.step == 7
+
+    def test_empty(self):
+        assert msg.deserialize_message(b"") is None
+        assert msg.serialize_message(None) == b""
+
+    def test_restricted_unpickle_rejects_foreign_class(self):
+        import pickle
+
+        # raw GLOBAL opcodes so find_class is actually exercised
+        with pytest.raises(pickle.UnpicklingError):
+            msg.deserialize_message(b"cos\nsystem\n.")
+        with pytest.raises(pickle.UnpicklingError):
+            msg.deserialize_message(b"cbuiltins\neval\n.")
+        # safe builtins still work
+        assert msg.deserialize_message(pickle.dumps({1, 2})) == {1, 2}
+
+    def test_task_empty(self):
+        assert msg.Task().is_empty
+        assert not msg.Task(task_id=1, task_type=msg.TaskType.TRAINING).is_empty
+        wait = msg.Task(task_id=-1, task_type=msg.TaskType.WAIT)
+        assert not wait.is_empty
+
+
+class TestStorage:
+    def test_posix_write_read(self, tmp_path):
+        storage = PosixDiskStorage()
+        p = str(tmp_path / "a" / "b.txt")
+        storage.write("hello", p)
+        assert storage.read(p) == "hello"
+        storage.write(b"\x00\x01", str(tmp_path / "bin"))
+        assert storage.read(str(tmp_path / "bin"), "rb") == b"\x00\x01"
+        assert storage.listdir(str(tmp_path)) == ["a", "bin"]
+        storage.safe_rmtree(str(tmp_path / "a"))
+        assert not storage.exists(p)
+
+    def test_json_helpers(self, tmp_path):
+        storage = PosixDiskStorage()
+        p = str(tmp_path / "meta.json")
+        storage.write_json({"step": 3}, p)
+        assert storage.read_json(p) == {"step": 3}
+        assert storage.read_json(str(tmp_path / "missing.json")) is None
+
+    def test_keep_latest_strategy(self, tmp_path):
+        deleted = []
+        strategy = KeepLatestStepStrategy(2, str(tmp_path))
+        for step in (10, 20, 30):
+            strategy.clean_up(step, deleted.append)
+        assert deleted == [os.path.join(str(tmp_path), "checkpoint-10")]
+
+    def test_keep_interval_strategy(self, tmp_path):
+        deleted = []
+        strategy = KeepStepIntervalStrategy(100, str(tmp_path))
+        strategy.clean_up(100, deleted.append)
+        strategy.clean_up(150, deleted.append)
+        assert deleted == [os.path.join(str(tmp_path), "checkpoint-150")]
+
+    def test_storage_with_deletion(self, tmp_path):
+        tracker = str(tmp_path / "latest_checkpointed_iteration.txt")
+        storage = PosixStorageWithDeletion(
+            tracker, KeepLatestStepStrategy(1, str(tmp_path))
+        )
+        for step in (1, 2, 3):
+            d = tmp_path / f"checkpoint-{step}"
+            d.mkdir()
+            (d / "x").write_text("x")
+            storage.write(str(step), tracker)
+        # the strategy sees steps 1 and 2 (each read back on the next
+        # commit); with max_to_keep=1, checkpoint-1 must be purged
+        assert not (tmp_path / "checkpoint-1").exists()
+        assert (tmp_path / "checkpoint-2").exists()
+        assert (tmp_path / "checkpoint-3").exists()
+
+
+class TestSharedPrimitives:
+    def test_shared_lock(self):
+        server = SharedLock("l1", create=True)
+        client = SharedLock("l1", create=False)
+        assert client.acquire()
+        assert server.locked()
+        assert not client.acquire(blocking=False)
+        assert client.release()
+        assert not server.locked()
+        client.close()
+        server.close()
+
+    def test_shared_queue(self):
+        server = SharedQueue("q1", create=True)
+        client = SharedQueue("q1", create=False)
+        client.put({"step": 1})
+        assert server.qsize() == 1
+        got = server.get(timeout=5)
+        assert got == {"step": 1}
+        assert client.empty()
+        client.close()
+        server.close()
+
+    def test_shared_queue_empty_raises_queue_empty(self):
+        import queue as pyqueue
+
+        server = SharedQueue("q_empty", create=True)
+        client = SharedQueue("q_empty", create=False)
+        # the remote exception type must survive the socket boundary
+        with pytest.raises(pyqueue.Empty):
+            client.get(block=False)
+        client.close()
+        server.close()
+
+    def test_shared_dict(self):
+        server = SharedDict("d1", create=True)
+        client = SharedDict("d1", create=False)
+        client.set("k", [1, 2, 3])
+        client.update({"j": "v"})
+        assert server.get("k") == [1, 2, 3]
+        assert client.get_all() == {"k": [1, 2, 3], "j": "v"}
+        client.clear()
+        assert client.get_all() == {}
+        client.close()
+        server.close()
+
+    def test_shared_dict_concurrent(self):
+        server = SharedDict("d2", create=True)
+        clients = [SharedDict("d2", create=False) for _ in range(4)]
+
+        def writer(i, c):
+            for j in range(20):
+                c.set(f"{i}-{j}", j)
+
+        threads = [
+            threading.Thread(target=writer, args=(i, c))
+            for i, c in enumerate(clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(server.get_all()) == 80
+        for c in clients:
+            c.close()
+        server.close()
+
+    def test_shared_memory_roundtrip(self):
+        name = f"test_shm_{os.getpid()}"
+        shm = SharedMemory(name, create=True, size=1024)
+        try:
+            arr = np.arange(16, dtype=np.float32)
+            shm.buf[: arr.nbytes] = arr.tobytes()
+            reader = SharedMemory(name)
+            out = np.frombuffer(bytes(reader.buf[: arr.nbytes]), dtype=np.float32)
+            np.testing.assert_array_equal(out, arr)
+            reader.close()
+        finally:
+            shm.close()
+            shm.unlink()
+
+    def test_shared_memory_recreate_larger(self):
+        name = f"test_shm_grow_{os.getpid()}"
+        shm = SharedMemory(name, create=True, size=128)
+        shm.close()
+        bigger = SharedMemory(name, create=True, size=4096)
+        try:
+            assert bigger.size >= 4096
+        finally:
+            bigger.close()
+            bigger.unlink()
