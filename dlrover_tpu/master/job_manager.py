@@ -1,0 +1,362 @@
+"""Node lifecycle supervision inside the master.
+
+Reference parity: ``dlrover/python/master/node/dist_job_manager.py`` and
+``local_job_manager.py`` — the job manager owns the node table, consumes
+node events (from the agent heartbeats locally, or a pod watcher on
+k8s), decides relaunches, and feeds the speed monitor / rendezvous
+managers through event callbacks.
+"""
+
+import threading
+import time
+from abc import ABCMeta, abstractmethod
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import (
+    NodeEventType,
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+    TrainingExceptionLevel,
+)
+from dlrover_tpu.common.global_context import Context
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.messages import ParallelConfig
+from dlrover_tpu.common.node import Node, NodeResource
+from dlrover_tpu.master.status_flow import get_node_state_flow
+
+_ctx = Context.singleton_instance()
+
+
+class NodeEvent:
+    def __init__(self, event_type: str, node: Node):
+        self.event_type = event_type
+        self.node = node
+
+
+class NodeEventCallback(metaclass=ABCMeta):
+    """Hooks invoked on node status transitions (reference:
+    ``master/node/event_callback.py:42``)."""
+
+    def on_node_started(self, node: Node, cluster_context):
+        ...
+
+    def on_node_succeeded(self, node: Node, cluster_context):
+        ...
+
+    def on_node_failed(self, node: Node, cluster_context):
+        ...
+
+    def on_node_deleted(self, node: Node, cluster_context):
+        ...
+
+
+class TaskRescheduleCallback(NodeEventCallback):
+    """Recover the data shards of a dead worker (reference ``:111``)."""
+
+    def __init__(self, task_manager):
+        self._task_manager = task_manager
+
+    def on_node_failed(self, node: Node, cluster_context):
+        self._task_manager.recover_tasks(node.id)
+
+    def on_node_deleted(self, node: Node, cluster_context):
+        self._task_manager.recover_tasks(node.id)
+
+
+class AllReduceNodeHandlingCallback(NodeEventCallback):
+    """Bookkeeping for SPMD training: update the speed monitor and drop
+    dead nodes from pending rendezvous (reference ``:218``)."""
+
+    def __init__(self, master):
+        self._master = master
+
+    def on_node_started(self, node: Node, cluster_context):
+        if node.type == NodeType.WORKER:
+            self._master.speed_monitor.add_running_worker(
+                node.type, node.id
+            )
+
+    def on_node_succeeded(self, node: Node, cluster_context):
+        self._master.speed_monitor.remove_running_worker(
+            node.type, node.id
+        )
+
+    def on_node_failed(self, node: Node, cluster_context):
+        self._master.speed_monitor.remove_running_worker(
+            node.type, node.id
+        )
+        for manager in self._master.rdzv_managers.values():
+            manager.remove_alive_node(node.rank_index)
+
+    def on_node_deleted(self, node: Node, cluster_context):
+        self.on_node_failed(node, cluster_context)
+
+
+class JobManager(metaclass=ABCMeta):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._nodes: Dict[int, Node] = {}
+        self._event_callbacks: List[NodeEventCallback] = []
+        self._stopped = False
+        self._paral_config = ParallelConfig()
+        self._restart_verdicts: Dict[int, bool] = {}
+
+    def add_node_event_callback(self, callback: NodeEventCallback):
+        self._event_callbacks.append(callback)
+
+    @abstractmethod
+    def start(self):
+        ...
+
+    def stop(self):
+        self._stopped = True
+
+    # -- node table --------------------------------------------------------
+    @property
+    def nodes(self) -> Dict[int, Node]:
+        return self._nodes
+
+    def get_node(self, node_id: int) -> Optional[Node]:
+        return self._nodes.get(node_id)
+
+    def get_running_nodes(self) -> List[Node]:
+        with self._lock:
+            return [
+                n
+                for n in self._nodes.values()
+                if n.status == NodeStatus.RUNNING
+            ]
+
+    def all_workers_exited(self) -> bool:
+        with self._lock:
+            if not self._nodes:
+                return False
+            return all(
+                n.status in NodeStatus.end_states()
+                for n in self._nodes.values()
+            )
+
+    def all_workers_failed(self) -> bool:
+        with self._lock:
+            if not self._nodes:
+                return False
+            return all(
+                n.status in (NodeStatus.FAILED, NodeStatus.BREAKDOWN)
+                for n in self._nodes.values()
+            )
+
+    # -- events ------------------------------------------------------------
+    def process_event(self, event: NodeEvent):
+        new_status = event.node.status
+        with self._lock:
+            node = self._nodes.get(event.node.id)
+            if node is None:
+                # first sighting: insert and treat the reported status as
+                # a transition from INITIAL so callbacks still fire
+                node = event.node
+                self._nodes[node.id] = node
+                node.update_status(new_status)
+                if event.event_type == NodeEventType.DELETED:
+                    node.is_released = True
+                fire = new_status != NodeStatus.INITIAL
+            else:
+                flow = get_node_state_flow(node.status, new_status)
+                if flow is None:
+                    return
+                node.update_status(new_status)
+                if event.event_type == NodeEventType.DELETED:
+                    node.is_released = True
+                fire = True
+        if fire:
+            self._fire_callbacks(node, new_status)
+
+    def _fire_callbacks(self, node: Node, status: str):
+        for callback in self._event_callbacks:
+            try:
+                if status == NodeStatus.RUNNING:
+                    callback.on_node_started(node, None)
+                elif status == NodeStatus.SUCCEEDED:
+                    callback.on_node_succeeded(node, None)
+                elif status in (NodeStatus.FAILED, NodeStatus.BREAKDOWN):
+                    callback.on_node_failed(node, None)
+                elif status == NodeStatus.DELETED:
+                    callback.on_node_deleted(node, None)
+            except Exception as e:  # noqa: BLE001
+                logger.error("node event callback error: %s", e)
+
+    # -- agent-facing state ------------------------------------------------
+    def update_node_resource_usage(self, node_type: str, node_id: int,
+                                   cpu: float, memory: int,
+                                   tpu_stats=None):
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None:
+                return
+            node.used_resource = NodeResource(cpu=cpu, memory=memory)
+            if tpu_stats:
+                node.used_resource.tpu_chips = len(tpu_stats)
+
+    def update_node_address(self, node_type: str, node_id: int, addr: str):
+        with self._lock:
+            node = self._nodes.setdefault(
+                node_id,
+                Node(node_type, node_id, status=NodeStatus.RUNNING),
+            )
+            node.host_addr = addr
+
+    def collect_node_heartbeat(self, node_type: str, node_id: int,
+                               timestamp: float):
+        started = False
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None:
+                node = Node(node_type, node_id,
+                            status=NodeStatus.RUNNING)
+                self._nodes[node_id] = node
+                started = True
+            node.heartbeat_time = timestamp
+            if node.status == NodeStatus.INITIAL:
+                node.update_status(NodeStatus.RUNNING)
+                started = True
+        if started:
+            self._fire_callbacks(node, NodeStatus.RUNNING)
+
+    def handle_training_failure(self, node_type: str, node_id: int,
+                                restart_count: int, error_data: str,
+                                level: str):
+        logger.warning(
+            "training failure on %s-%s (restart %s, level %s): %s",
+            node_type, node_id, restart_count, level, error_data,
+        )
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None:
+                return
+            if level == TrainingExceptionLevel.NODE_ERROR:
+                node.set_exit_reason(NodeExitReason.HARDWARE_ERROR)
+                self._restart_verdicts[node_id] = True
+
+    def should_restart_node(self, node_type: str, node_id: int) -> bool:
+        return self._restart_verdicts.pop(node_id, False)
+
+    def update_paral_config(self, config: ParallelConfig):
+        self._paral_config = config
+
+    def get_paral_config(self) -> ParallelConfig:
+        return self._paral_config
+
+
+class LocalJobManager(JobManager):
+    """Single-host job manager used by the local master that
+    ``dlrover-tpu-run`` spawns (reference:
+    ``master/node/local_job_manager.py``)."""
+
+    def __init__(self, node_num: int = 1):
+        super().__init__()
+        self._node_num = node_num
+
+    def start(self):
+        for node_id in range(self._node_num):
+            self._nodes[node_id] = Node(
+                NodeType.WORKER,
+                node_id,
+                status=NodeStatus.INITIAL,
+                max_relaunch_count=_ctx.max_node_relaunch_times,
+            )
+
+    def has_job_error(self) -> bool:
+        return False
+
+
+class DistributedJobManager(JobManager):
+    """Multi-host job manager: supervises heartbeats and relaunches
+    through a pluggable scaler (reference:
+    ``master/node/dist_job_manager.py:80``).  The k8s watcher/scaler
+    plug in here; in in-process tests a fake scaler is injected.
+    """
+
+    def __init__(self, node_num: int, scaler=None,
+                 heartbeat_timeout: Optional[float] = None,
+                 pending_timeout: Optional[float] = None):
+        super().__init__()
+        self._node_num = node_num
+        self._scaler = scaler
+        self._heartbeat_timeout = (
+            heartbeat_timeout or _ctx.node_heartbeat_timeout
+        )
+        self._pending_timeout = (
+            pending_timeout or _ctx.pending_timeout_secs
+        )
+        self._next_node_id = node_num
+
+    def start(self):
+        for node_id in range(self._node_num):
+            node = Node(
+                NodeType.WORKER,
+                node_id,
+                status=NodeStatus.INITIAL,
+                max_relaunch_count=_ctx.max_node_relaunch_times,
+            )
+            node.create_time = time.time()
+            self._nodes[node_id] = node
+        if self._scaler is not None:
+            self._scaler.scale_to(self._node_num)
+        threading.Thread(
+            target=self._monitor_heartbeats,
+            name="heartbeat-monitor",
+            daemon=True,
+        ).start()
+
+    def _monitor_heartbeats(self):
+        while not self._stopped:
+            self.check_dead_nodes()
+            time.sleep(15)
+
+    def check_dead_nodes(self) -> List[Node]:
+        """Mark heartbeat-timed-out and pending-timed-out nodes failed
+        and decide relaunch.  The pending check catches nodes that never
+        sent a single heartbeat (crashlooping before the agent starts)."""
+        dead = []
+        now = time.time()
+        with self._lock:
+            for node in list(self._nodes.values()):
+                hb_dead = node.timeout(self._heartbeat_timeout)
+                pend_dead = (
+                    node.status
+                    in (NodeStatus.INITIAL, NodeStatus.PENDING)
+                    and node.create_time is not None
+                    and now - node.create_time > self._pending_timeout
+                )
+                if hb_dead or pend_dead:
+                    node.update_status(NodeStatus.FAILED)
+                    node.set_exit_reason(NodeExitReason.KILLED)
+                    dead.append(node)
+        for node in dead:
+            logger.warning(
+                "node %s dead (heartbeat/pending timeout); failed",
+                node.id,
+            )
+            self._fire_callbacks(node, NodeStatus.FAILED)
+            self._maybe_relaunch(node)
+        return dead
+
+    def _maybe_relaunch(self, node: Node):
+        if node.is_unrecoverable_failure():
+            logger.error(
+                "node %s is unrecoverable (relaunch %s/%s, reason=%s)",
+                node.id, node.relaunch_count,
+                node.max_relaunch_count, node.exit_reason,
+            )
+            return
+        node.inc_relaunch_count()
+        with self._lock:
+            new_node = node.get_relaunch_node(self._next_node_id)
+            new_node.create_time = time.time()
+            self._next_node_id += 1
+            self._nodes[new_node.id] = new_node
+        logger.info(
+            "relaunching node %s as node %s", node.id, new_node.id
+        )
+        if self._scaler is not None:
+            self._scaler.relaunch(node, new_node)

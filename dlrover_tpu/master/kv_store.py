@@ -1,0 +1,49 @@
+"""Master KV-store service: the rendezvous/bootstrap store the agents
+use to exchange small blobs (e.g. the jax coordinator address).
+
+Reference parity: ``dlrover/python/master/elastic_training/
+kv_store_service.py:18``.
+"""
+
+import threading
+import time
+from typing import Dict, Optional
+
+
+class KVStoreService:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._store: Dict[str, bytes] = {}
+
+    def set(self, key: str, value: bytes):
+        with self._lock:
+            self._store[key] = value
+
+    def get(self, key: str) -> bytes:
+        with self._lock:
+            return self._store.get(key, b"")
+
+    def add(self, key: str, delta: int) -> int:
+        """Atomic counter (torch-Store-style add semantics)."""
+        with self._lock:
+            current = int(self._store.get(key, b"0") or b"0")
+            current += delta
+            self._store[key] = str(current).encode()
+            return current
+
+    def wait(self, key: str, timeout: float = 30.0) -> Optional[bytes]:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            value = self.get(key)
+            if value:
+                return value
+            time.sleep(0.05)
+        return None
+
+    def delete(self, key: str):
+        with self._lock:
+            self._store.pop(key, None)
+
+    def clear(self):
+        with self._lock:
+            self._store.clear()

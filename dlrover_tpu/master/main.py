@@ -1,0 +1,52 @@
+"""Master CLI: ``python -m dlrover_tpu.master.main --port ... --node-num N``.
+
+Reference parity: ``dlrover/python/master/main.py:43-70`` +
+``master/args.py``.
+"""
+
+import argparse
+import sys
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+def parse_master_args(argv=None):
+    parser = argparse.ArgumentParser(description="dlrover_tpu job master")
+    parser.add_argument("--port", type=int, default=0,
+                        help="gRPC port (0 = pick a free port)")
+    parser.add_argument("--node_num", "--node-num", dest="node_num",
+                        type=int, default=1)
+    parser.add_argument("--platform", default="local",
+                        choices=["local", "k8s", "ray"])
+    parser.add_argument("--job_name", default="local-job")
+    parser.add_argument("--pending_timeout", type=int, default=900)
+    return parser.parse_args(argv)
+
+
+def run(args) -> int:
+    from dlrover_tpu.common.env import get_free_port
+    from dlrover_tpu.master.master import (
+        DistributedJobMaster,
+        LocalJobMaster,
+    )
+
+    port = args.port or get_free_port()
+    if args.platform == "local":
+        master = LocalJobMaster(port, args.node_num)
+    else:
+        master = DistributedJobMaster(
+            port, args.node_num, pending_timeout=args.pending_timeout
+        )
+    master.prepare()
+    logger.info("job %s master listening on %s", args.job_name,
+                master.addr)
+    print(f"DLROVER_TPU_MASTER_ADDR={master.addr}", flush=True)
+    return master.run()
+
+
+def main(argv=None) -> int:
+    return run(parse_master_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

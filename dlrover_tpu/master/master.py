@@ -1,0 +1,174 @@
+"""Job masters: local (in-process, spawned by ``dlrover-tpu-run``) and
+distributed (its own process/pod supervising a multi-host job).
+
+Reference parity: ``dlrover/python/master/local_master.py`` and
+``dist_master.py:86,175,211``.
+"""
+
+import threading
+import time
+from typing import Optional
+
+from dlrover_tpu.common.constants import (
+    JobExitReason,
+    RendezvousName,
+)
+from dlrover_tpu.common.global_context import Context
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.job_manager import (
+    AllReduceNodeHandlingCallback,
+    DistributedJobManager,
+    LocalJobManager,
+    TaskRescheduleCallback,
+)
+from dlrover_tpu.master.kv_store import KVStoreService
+from dlrover_tpu.master.rendezvous import (
+    ElasticTrainingRendezvousManager,
+    NetworkCheckRendezvousManager,
+)
+from dlrover_tpu.master.servicer import (
+    MasterServicer,
+    create_master_service,
+)
+from dlrover_tpu.master.shard.task_manager import TaskManager
+from dlrover_tpu.master.speed_monitor import SpeedMonitor
+
+_ctx = Context.singleton_instance()
+
+
+class JobMaster:
+    """Common wiring of the master components + gRPC service."""
+
+    def __init__(self, port: int, node_num: int = 1,
+                 job_manager=None, diagnosis_manager=None):
+        self.speed_monitor = SpeedMonitor()
+        self.task_manager = TaskManager(speed_monitor=self.speed_monitor)
+        self.rdzv_managers = {
+            RendezvousName.ELASTIC_TRAINING:
+                ElasticTrainingRendezvousManager(),
+            RendezvousName.NETWORK_CHECK: NetworkCheckRendezvousManager(),
+        }
+        self.kv_store = KVStoreService()
+        self.job_manager = job_manager
+        self.diagnosis_manager = diagnosis_manager
+        self.speed_monitor.set_target_worker_num(node_num)
+        self._node_num = node_num
+        self._port = port
+        self._server = None
+        self._exit_reason: Optional[str] = None
+        self._stopped = threading.Event()
+
+        self.job_manager.add_node_event_callback(
+            TaskRescheduleCallback(self.task_manager)
+        )
+        self.job_manager.add_node_event_callback(
+            AllReduceNodeHandlingCallback(self)
+        )
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self._port}"
+
+    def prepare(self):
+        servicer = MasterServicer(
+            task_manager=self.task_manager,
+            job_manager=self.job_manager,
+            speed_monitor=self.speed_monitor,
+            rdzv_managers=self.rdzv_managers,
+            kv_store=self.kv_store,
+            diagnosis_manager=self.diagnosis_manager,
+        )
+        self._server = create_master_service(self._port, servicer)
+        self._server.start()
+        self.task_manager.start()
+        self.job_manager.start()
+        logger.info("master serving on port %s", self._port)
+
+    def stop(self, reason: str = ""):
+        self._exit_reason = reason or self._exit_reason
+        self._stopped.set()
+        self.task_manager.stop()
+        self.job_manager.stop()
+        if self._server:
+            self._server.stop(grace=0.5)
+
+    def request_stop(self, success: bool, reason: str, msg: str = ""):
+        logger.info("stop requested: success=%s reason=%s %s",
+                    success, reason, msg)
+        self.stop(reason)
+
+
+class LocalJobMaster(JobMaster):
+    """In-process master for single-host runs (reference:
+    ``local_master.py:118``)."""
+
+    def __init__(self, port: int, node_num: int = 1):
+        super().__init__(
+            port, node_num, job_manager=LocalJobManager(node_num)
+        )
+
+    def run(self):
+        """Block until training finishes (used when run as a thread)."""
+        while not self._stopped.is_set():
+            if self.task_manager.finished():
+                logger.info("all dataset tasks finished")
+                self.request_stop(True, JobExitReason.SUCCEEDED)
+                break
+            time.sleep(1)
+        return 0
+
+
+class DistributedJobMaster(JobMaster):
+    """Multi-host master with a 30s supervision loop deciding
+    early-stop / hang / all-exited (reference: ``dist_master.py:211``)."""
+
+    SUPERVISE_INTERVAL = 30
+
+    def __init__(self, port: int, node_num: int, scaler=None,
+                 diagnosis_manager=None, pending_timeout=None):
+        super().__init__(
+            port,
+            node_num,
+            job_manager=DistributedJobManager(
+                node_num, scaler=scaler, pending_timeout=pending_timeout
+            ),
+            diagnosis_manager=diagnosis_manager,
+        )
+
+    def run(self) -> int:
+        exit_code = 0
+        while not self._stopped.is_set():
+            if self.job_manager.all_workers_exited():
+                if self.job_manager.all_workers_failed():
+                    self.request_stop(
+                        False, JobExitReason.WORKER_ERROR
+                    )
+                    exit_code = 1
+                else:
+                    self.request_stop(True, JobExitReason.SUCCEEDED)
+                break
+            if self.speed_monitor.step_is_stagnant():
+                logger.warning("global step stagnant: possible hang")
+                self.request_stop(False, JobExitReason.HANG_ERROR)
+                exit_code = 1
+                break
+            if self.task_manager.finished():
+                self.request_stop(True, JobExitReason.SUCCEEDED)
+                break
+            self._stopped.wait(self.SUPERVISE_INTERVAL)
+        return exit_code
+
+
+def run_local_master(port: int, node_num: int) -> LocalJobMaster:
+    """Start a local master on ``port`` in background threads and return
+    it (what the run CLI calls on rank 0)."""
+    master = LocalJobMaster(port, node_num)
+    master.prepare()
+    threading.Thread(
+        target=master.run, name="local-master", daemon=True
+    ).start()
+    return master

@@ -1,0 +1,275 @@
+"""Master-side rendezvous managers.
+
+Reference parity: ``dlrover/python/master/elastic_training/rdzv_manager.py``
+(RendezvousManager ABC ``:58``, min/max/node-unit window ``:135``,
+``join_rendezvous:213``, ``num_nodes_waiting:272`` — the restart signal,
+ckpt-step barrier ``sync_ckpt_nodes:295``; ElasticTrainingRendezvousManager
+``:329``; NetworkCheckRendezvousManager ``:390`` with pairwise group
+shuffling for straggler/fault isolation).
+
+TPU notes: a "node" is a TPU-VM worker; ``local_world_size`` is its
+training-process count (usually 1 process driving N chips).  The
+completed world is what the agent feeds into
+``jax.distributed.initialize`` (process_id = rank in this world).
+"""
+
+import math
+import threading
+import time
+from abc import ABCMeta
+from typing import Dict, List, Tuple
+
+from dlrover_tpu.common.constants import NetworkFailureReason
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class RendezvousParameters:
+    def __init__(self, min_nodes: int = 1, max_nodes: int = 1,
+                 waiting_timeout: float = 30.0, node_unit: int = 1,
+                 join_timeout: float = 600.0):
+        self.min_nodes = min_nodes
+        self.max_nodes = max_nodes
+        self.waiting_timeout = waiting_timeout
+        self.node_unit = node_unit
+        self.join_timeout = join_timeout
+
+
+class RendezvousManager(metaclass=ABCMeta):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._name = ""
+        self._waiting_nodes: Dict[int, int] = {}  # rank -> local_world_size
+        self._rdzv_nodes: Dict[int, int] = {}
+        self._lastcall_time = 0.0
+        self._rdzv_params = RendezvousParameters()
+        self._rdzv_round = 0
+        self._node_unit = 1
+        self._start_rdzv_time = 0.0
+        self._latest_rdzv_nodes: List[int] = []
+        self._ckpt_steps: Dict[int, int] = {}
+
+    def update_rdzv_params(self, min_nodes: int, max_nodes: int,
+                           waiting_timeout: float, node_unit: int):
+        with self._lock:
+            self._rdzv_params.min_nodes = min_nodes
+            self._rdzv_params.max_nodes = max_nodes
+            self._rdzv_params.waiting_timeout = waiting_timeout
+            self._node_unit = max(node_unit, 1)
+            logger.info(
+                "%s rdzv params: min=%s max=%s timeout=%s unit=%s",
+                self._name, min_nodes, max_nodes, waiting_timeout, node_unit,
+            )
+
+    def get_rdzv_round(self) -> int:
+        return self._rdzv_round
+
+    def add_alive_node(self, node_rank: int):
+        pass
+
+    def remove_alive_node(self, node_rank: int):
+        """Drop a dead node from the pending rendezvous."""
+        with self._lock:
+            if node_rank in self._waiting_nodes:
+                del self._waiting_nodes[node_rank]
+                logger.info(
+                    "%s: removed dead node %s from waiting list",
+                    self._name, node_rank,
+                )
+
+    def join_rendezvous(self, node_rank: int, local_world_size: int) -> int:
+        with self._lock:
+            if not self._waiting_nodes:
+                self._start_rdzv_time = time.time()
+            self._waiting_nodes[node_rank] = local_world_size
+            self._rdzv_nodes = {}
+            self._lastcall_time = time.time()
+        return self._rdzv_round
+
+    def _check_rdzv_completed(self) -> bool:
+        """Caller holds the lock.  The window rule (reference ``:135``):
+        complete immediately at max_nodes; after waiting_timeout complete
+        with the largest multiple of node_unit >= min_nodes."""
+        waiting = len(self._waiting_nodes)
+        params = self._rdzv_params
+        if waiting == params.max_nodes:
+            completed = True
+        else:
+            over_min = (
+                waiting >= params.min_nodes
+                and waiting >= self._node_unit
+            )
+            timed_out = (
+                self._lastcall_time > 0
+                and time.time() - self._lastcall_time
+                >= params.waiting_timeout
+            )
+            completed = over_min and timed_out
+        if completed:
+            # round down to a node_unit multiple; excess nodes STAY in
+            # the waiting list so they keep signalling a pending
+            # re-rendezvous instead of being stranded
+            waiting = len(self._waiting_nodes)
+            usable = (waiting // self._node_unit) * self._node_unit
+            usable = min(usable, self._rdzv_params.max_nodes)
+            ranks = sorted(self._waiting_nodes.keys())[:usable]
+            self._rdzv_nodes = {
+                r: self._waiting_nodes[r] for r in ranks
+            }
+            self._latest_rdzv_nodes = list(self._rdzv_nodes.keys())
+            for r in ranks:
+                del self._waiting_nodes[r]
+            self._lastcall_time = 0.0
+            self._rdzv_round += 1
+            self._ckpt_steps = {}  # new world: reset the ckpt barrier
+            logger.info(
+                "%s rendezvous round %s completed with %s nodes",
+                self._name, self._rdzv_round, len(self._rdzv_nodes),
+            )
+        return completed
+
+    def get_comm_world(self, node_rank: int) -> Tuple[int, int, Dict[int, int]]:
+        """Return (round, group, world).  Empty world while pending."""
+        with self._lock:
+            if not self._rdzv_nodes:
+                self._check_rdzv_completed()
+            if self._rdzv_nodes:
+                return self._rdzv_round, 0, dict(self._rdzv_nodes)
+            return self._rdzv_round, 0, {}
+
+    def num_nodes_waiting(self) -> int:
+        """Nonzero once a new rendezvous is pending — the running agents
+        poll this to learn that a restart/re-mesh is required."""
+        with self._lock:
+            return len(self._waiting_nodes)
+
+    def sync_ckpt_nodes(self, node_id: int, step: int) -> bool:
+        """Barrier: all latest-rendezvous nodes report the same in-memory
+        checkpoint step (reference ``:295``)."""
+        with self._lock:
+            self._ckpt_steps[node_id] = step
+            steps = set(self._ckpt_steps.values())
+            if len(steps) > 1:
+                return False
+            return len(self._ckpt_steps) == len(self._latest_rdzv_nodes)
+
+
+class ElasticTrainingRendezvousManager(RendezvousManager):
+    def __init__(self):
+        super().__init__()
+        self._name = "elastic-training"
+
+
+class NetworkCheckRendezvousManager(RendezvousManager):
+    """Pairwise health-check rendezvous (reference ``:390``).
+
+    Nodes are split into groups of 2 that run a matmul+collective
+    payload; a second round re-pairs suspect nodes with known-good ones
+    so a bad chip/link is isolated to a single node.
+    """
+
+    GROUP_SIZE = 2
+
+    def __init__(self):
+        super().__init__()
+        self._name = "network-check"
+        self._node_status: Dict[int, bool] = {}
+        self._node_times: Dict[int, float] = {}
+        self._check_round = 0
+        self._node_groups: List[Dict[int, int]] = []
+        self._fault_nodes: List[int] = []
+        self._straggler_ratio = 3.0
+
+    def join_rendezvous(self, node_rank: int, local_world_size: int) -> int:
+        with self._lock:
+            if not self._waiting_nodes:
+                self._start_rdzv_time = time.time()
+                self._node_groups = []
+            self._waiting_nodes[node_rank] = local_world_size
+            self._rdzv_nodes = {}
+            self._lastcall_time = time.time()
+        return self._rdzv_round
+
+    def get_comm_world(self, node_rank: int) -> Tuple[int, int, Dict[int, int]]:
+        with self._lock:
+            if not self._rdzv_nodes:
+                if self._check_rdzv_completed():
+                    self._group_nodes()
+                    self._check_round += 1
+            if self._rdzv_nodes:
+                for group_idx, group in enumerate(self._node_groups):
+                    if node_rank in group:
+                        return self._rdzv_round, group_idx, dict(group)
+                return self._rdzv_round, 0, {}
+            return self._rdzv_round, 0, {}
+
+    def _group_nodes(self):
+        """Round r=1: adjacent pairs.  Round r>=2: reverse order so a
+        suspect node lands with a different peer (reference's shuffle)."""
+        ranks = list(self._rdzv_nodes.keys())
+        if self._check_round % 2 == 1:
+            ranks = ranks[::-1]
+        groups = []
+        for i in range(0, len(ranks), self.GROUP_SIZE):
+            chunk = ranks[i : i + self.GROUP_SIZE]
+            groups.append({r: self._rdzv_nodes[r] for r in chunk})
+        # a trailing singleton can't run a pair check; merge it
+        if len(groups) > 1 and len(groups[-1]) == 1:
+            groups[-2].update(groups.pop())
+        self._node_groups = groups
+        logger.info(
+            "network-check round %s groups: %s",
+            self._check_round,
+            [list(g.keys()) for g in groups],
+        )
+
+    def report_network_status(self, node_rank: int, succeeded: bool,
+                              elapsed: float):
+        with self._lock:
+            prev = self._node_status.get(node_rank)
+            # a success in any round clears earlier suspicion
+            self._node_status[node_rank] = succeeded or bool(prev)
+            if succeeded:
+                self._node_times[node_rank] = elapsed
+
+    def check_fault_node(self) -> Tuple[List[int], str]:
+        with self._lock:
+            if not self._rdzv_nodes:
+                return [], NetworkFailureReason.NO_INIT
+            expected = set(self._rdzv_nodes.keys())
+            reported = set(self._node_status.keys())
+            if not expected.issubset(reported):
+                return [], NetworkFailureReason.WAITING_NODE
+            faults = sorted(
+                r for r in expected if not self._node_status[r]
+            )
+            reason = (
+                NetworkFailureReason.NODE_FAILURE if faults else ""
+            )
+            return faults, reason
+
+    def check_straggler(self) -> Tuple[List[int], str]:
+        with self._lock:
+            times = {
+                r: t
+                for r, t in self._node_times.items()
+                if r in self._rdzv_nodes
+            }
+            if len(times) < 2:
+                return [], ""
+            values = sorted(times.values())
+            median = values[len(values) // 2]
+            if median <= 0:
+                return [], ""
+            stragglers = sorted(
+                r
+                for r, t in times.items()
+                if t > self._straggler_ratio * median
+                and not math.isclose(t, median)
+            )
+            return stragglers, ""
+
+    def reset(self):
+        with self._lock:
+            self._node_status = {}
+            self._node_times = {}
+            self._check_round = 0

@@ -1,0 +1,286 @@
+"""The master RPC servicer: dispatch tables for ``get`` and ``report``.
+
+Reference parity: ``dlrover/python/master/servicer.py:72,99,650``; the
+full dispatch surface is the parity checklist in SURVEY.md Appendix A.
+Every request type routes to the backing component (task manager,
+rendezvous managers, KV store, job manager, speed monitor, diagnosis).
+"""
+
+import time
+from typing import Optional
+
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.comm import build_master_server
+from dlrover_tpu.common.constants import (
+    RendezvousName,
+    TrainingLoopStatus,
+)
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class MasterServicer:
+    def __init__(
+        self,
+        task_manager=None,
+        job_manager=None,
+        speed_monitor=None,
+        rdzv_managers=None,
+        kv_store=None,
+        diagnosis_manager=None,
+        sync_service=None,
+    ):
+        self._task_manager = task_manager
+        self._job_manager = job_manager
+        self._speed_monitor = speed_monitor
+        self._rdzv_managers = rdzv_managers or {}
+        self._kv_store = kv_store
+        self._diagnosis_manager = diagnosis_manager
+        self._sync_service = sync_service
+        self._start_training_time = 0.0
+
+    # ------------------------------------------------------------------ get
+    def get(self, envelope: msg.Envelope) -> Optional[msg.Message]:
+        request = msg.deserialize_message(envelope.data)
+        node_id, node_type = envelope.node_id, envelope.node_type
+        if isinstance(request, msg.TaskRequest):
+            return self._get_task(node_id, request)
+        if isinstance(request, msg.ShardCheckpointRequest):
+            return self._task_manager.get_dataset_checkpoint(
+                request.dataset_name
+            )
+        if isinstance(request, msg.RunningNodesRequest):
+            return msg.RunningNodes(
+                nodes=self._job_manager.get_running_nodes()
+            )
+        if isinstance(request, msg.JoinRendezvousRequest):
+            return self._join_rendezvous(request)
+        if isinstance(request, msg.WaitingNodeNumRequest):
+            manager = self._rdzv_managers.get(
+                request.rdzv_name or RendezvousName.ELASTIC_TRAINING
+            )
+            return msg.WaitingNodeNum(
+                waiting_num=manager.num_nodes_waiting() if manager else 0
+            )
+        if isinstance(request, msg.NetworkReadyRequest):
+            return self._check_fault_node()
+        if isinstance(request, msg.StragglerExistRequest):
+            return self._check_straggler()
+        if isinstance(request, msg.CommWorldRequest):
+            return self._get_comm_world(request)
+        if isinstance(request, msg.KeyValuePair):
+            return msg.KeyValuePair(
+                key=request.key, value=self._kv_store.get(request.key)
+            )
+        if isinstance(request, msg.TrainingStatusRequest):
+            if self._task_manager and self._task_manager.training_started():
+                status = TrainingLoopStatus.START
+            else:
+                status = TrainingLoopStatus.PENDING
+            return msg.TrainingStatus(status=status)
+        if isinstance(request, msg.ParallelConfigRequest):
+            if self._job_manager:
+                return self._job_manager.get_paral_config()
+            return msg.ParallelConfig()
+        if isinstance(request, msg.CheckHardwareResetRequest):
+            restart = False
+            if self._job_manager:
+                restart = self._job_manager.should_restart_node(
+                    node_type, node_id
+                )
+            return msg.ParallelConfig(restart=restart)
+        if isinstance(request, msg.PsNodesRequest):
+            return msg.PsNodes()
+        if isinstance(request, msg.ClusterVersionRequest):
+            return msg.ClusterVersion()
+        if isinstance(request, msg.ElasticRunConfigRequest):
+            return msg.ElasticRunConfig()
+        logger.warning("unhandled get request: %r", request)
+        return None
+
+    def _get_task(self, node_id: int, request: msg.TaskRequest) -> msg.Task:
+        if not self._start_training_time:
+            self._start_training_time = time.time()
+            if self._speed_monitor:
+                self._speed_monitor.set_start_timestamp()
+        return self._task_manager.get_task(node_id, request.dataset_name)
+
+    def _join_rendezvous(self, request: msg.JoinRendezvousRequest):
+        manager = self._rdzv_managers.get(
+            request.rdzv_name or RendezvousName.ELASTIC_TRAINING
+        )
+        if manager is None:
+            return msg.RendezvousState(round=-1)
+        rdzv_round = manager.join_rendezvous(
+            request.node_rank, request.local_world_size
+        )
+        if request.rdzv_name == RendezvousName.NETWORK_CHECK:
+            # joining a network check clears the training waitlist
+            # bookkeeping (reference servicer.py:257-263)
+            training = self._rdzv_managers.get(
+                RendezvousName.ELASTIC_TRAINING
+            )
+            if training:
+                training.remove_alive_node(request.node_rank)
+        return msg.RendezvousState(round=rdzv_round)
+
+    def _get_comm_world(self, request: msg.CommWorldRequest):
+        manager = self._rdzv_managers.get(
+            request.rdzv_name or RendezvousName.ELASTIC_TRAINING
+        )
+        if manager is None:
+            return msg.CommWorld()
+        rdzv_round, group, world = manager.get_comm_world(request.node_id)
+        return msg.CommWorld(
+            rdzv_name=request.rdzv_name,
+            round=rdzv_round,
+            group=group,
+            world=world,
+        )
+
+    def _check_fault_node(self):
+        manager = self._rdzv_managers.get(RendezvousName.NETWORK_CHECK)
+        if manager is None:
+            return msg.NetworkCheckResult()
+        nodes, reason = manager.check_fault_node()
+        return msg.NetworkCheckResult(nodes=nodes, reason=reason)
+
+    def _check_straggler(self):
+        manager = self._rdzv_managers.get(RendezvousName.NETWORK_CHECK)
+        if manager is None:
+            return msg.NetworkCheckResult()
+        nodes, reason = manager.check_straggler()
+        return msg.NetworkCheckResult(nodes=nodes, reason=reason)
+
+    # --------------------------------------------------------------- report
+    def report(self, envelope: msg.Envelope) -> msg.BoolResponse:
+        request = msg.deserialize_message(envelope.data)
+        node_id, node_type = envelope.node_id, envelope.node_type
+        success = False
+        try:
+            success = self._dispatch_report(node_id, node_type, request)
+        except Exception as e:  # noqa: BLE001
+            logger.error("report handler error for %r: %s", request, e)
+            return msg.BoolResponse(success=False, reason=repr(e))
+        return msg.BoolResponse(success=bool(success))
+
+    def _dispatch_report(self, node_id, node_type, request) -> bool:
+        if isinstance(request, msg.DatasetShardParams):
+            self._task_manager.new_dataset(request)
+            return True
+        if isinstance(request, msg.ShardCheckpoint):
+            return self._task_manager.restore_dataset_from_checkpoint(
+                request
+            )
+        if isinstance(request, msg.TaskResult):
+            return self._task_manager.report_task_status(
+                request.dataset_name,
+                request.task_id,
+                success=not request.err_message,
+            )
+        if isinstance(request, msg.ResourceStats):
+            if self._job_manager:
+                self._job_manager.update_node_resource_usage(
+                    node_type,
+                    node_id,
+                    request.cpu_percent,
+                    request.memory_mb,
+                    request.tpu_stats,
+                )
+            return True
+        if isinstance(request, msg.GlobalStep):
+            if self._speed_monitor:
+                self._speed_monitor.collect_global_step(
+                    request.step, request.timestamp or time.time()
+                )
+            return True
+        if isinstance(request, msg.NodeAddress):
+            if self._job_manager:
+                self._job_manager.update_node_address(
+                    request.node_type, request.node_id, request.addr
+                )
+            return True
+        if isinstance(request, msg.NetworkStatus):
+            manager = self._rdzv_managers.get(
+                RendezvousName.NETWORK_CHECK
+            )
+            if manager:
+                manager.report_network_status(
+                    request.node_rank,
+                    request.succeeded,
+                    request.elapsed_time,
+                )
+            return True
+        if isinstance(request, msg.NodeEventMessage):
+            return True
+        if isinstance(request, msg.NodeFailure):
+            if self._job_manager:
+                self._job_manager.handle_training_failure(
+                    node_type,
+                    node_id,
+                    request.restart_count,
+                    request.error_data,
+                    request.level,
+                )
+            return True
+        if isinstance(request, msg.RendezvousParams):
+            for manager in self._rdzv_managers.values():
+                manager.update_rdzv_params(
+                    request.min_nodes,
+                    request.max_nodes,
+                    request.waiting_timeout,
+                    request.node_unit,
+                )
+            return True
+        if isinstance(request, msg.KeyValuePair):
+            self._kv_store.set(request.key, request.value)
+            return True
+        if isinstance(request, msg.ParallelConfig):
+            if self._job_manager:
+                self._job_manager.update_paral_config(request)
+            return True
+        if isinstance(request, msg.HeartBeat):
+            if self._job_manager:
+                self._job_manager.collect_node_heartbeat(
+                    node_type, node_id, request.timestamp or time.time()
+                )
+            return True
+        if isinstance(request, msg.NodeCheckpointState):
+            manager = self._rdzv_managers.get(
+                RendezvousName.ELASTIC_TRAINING
+            )
+            if manager:
+                return manager.sync_ckpt_nodes(node_id, request.step)
+            return False
+        if isinstance(request, msg.ModelInfo):
+            return True
+        if isinstance(request, msg.DiagnosisReportData):
+            if self._diagnosis_manager:
+                self._diagnosis_manager.collect_data(request)
+            return True
+        if isinstance(request, msg.Event):
+            logger.info(
+                "event from %s-%s: %s %s %s",
+                node_type, node_id,
+                request.event_type, request.action, request.msg,
+            )
+            return True
+        if isinstance(request, (msg.SyncJoin, msg.SyncFinish,
+                                msg.SyncBarrier)):
+            if self._sync_service:
+                return self._sync_service.handle(node_type, node_id,
+                                                 request)
+            return True
+        if isinstance(request, msg.PsReady):
+            return True
+        if isinstance(request, msg.SucceededRequest):
+            return True
+        logger.warning("unhandled report: %r", request)
+        return False
+
+
+def create_master_service(port: int, servicer: MasterServicer,
+                          max_workers: int = 64):
+    """Build the gRPC server wired to the servicer."""
+    return build_master_server(
+        port, servicer.report, servicer.get, max_workers=max_workers
+    )
