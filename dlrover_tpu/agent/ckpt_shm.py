@@ -1,0 +1,227 @@
+"""Shared-memory checkpoint shard handling, used on both sides of the
+agent/training-process boundary.
+
+Reference parity: ``dlrover/python/elastic_agent/torch/ckpt_saver.py:
+175-345`` (``SharedMemoryHandler``: tensors are memcpy'd into a pinned
+shm buffer, metadata lives in a ``SharedDict``).  TPU twist: leaves are
+JAX arrays; each training process snapshots its *addressable shards*
+(``jax.device_get`` of fully-replicated or per-host-sharded arrays) so a
+multi-host GSPMD checkpoint is the union of per-process shard files.
+
+Layout of one shard:
+- shm segment ``dlrover_tpu_shm_ckpt_{name}_{rank}``: concatenated raw
+  array bytes.
+- SharedDict ``ckpt_meta_{name}_{rank}``: {"step", "specs":
+  [(keypath, dtype, shape, offset, nbytes)], "total_bytes", "valid"}.
+
+File format of a persisted shard (``*.drckpt``): 8-byte little-endian
+header length + pickled meta + raw bytes (same offsets as shm), so the
+agent persists with a single pass over the shm buffer.
+"""
+
+import pickle
+import struct
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.multi_process import (
+    SharedDict,
+    SharedLock,
+    SharedMemory,
+)
+
+SHM_PREFIX = "dlrover_tpu_ckpt"
+_HDR = struct.Struct("<Q")
+
+
+def _flatten_with_paths(tree) -> List[Tuple[str, np.ndarray]]:
+    """Flatten a pytree to (keypath, host ndarray) pairs in a
+    deterministic order."""
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, leaf in flat:
+        key = jax.tree_util.keystr(path)
+        out.append((key, np.asarray(jax.device_get(leaf))))
+    return out
+
+
+def restore_to_target(target, arrays: Dict[str, np.ndarray]):
+    """Map {keypath: array} back onto the structure of ``target``."""
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(target)
+    leaves = []
+    for path, leaf in flat:
+        key = jax.tree_util.keystr(path)
+        if key not in arrays:
+            raise KeyError(f"checkpoint missing leaf {key}")
+        value = arrays[key]
+        if hasattr(leaf, "dtype") and value.dtype != leaf.dtype:
+            value = value.astype(leaf.dtype)
+        leaves.append(value)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+class SharedMemoryHandler:
+    """One checkpoint shard in shared memory (one per training process).
+
+    The training-process side writes (``save_state``); the agent-side
+    saver reads (``read_raw``/``load_state``).  Both sides synchronize
+    through the companion ``SharedLock`` owned by the agent.
+    """
+
+    def __init__(self, rank: int, name: str = "default",
+                 host: bool = False):
+        # host=True on the agent side (creates the meta dict service)
+        self._rank = rank
+        self._name = name
+        self._shm_name = f"{SHM_PREFIX}_{name}_{rank}"
+        self._shm: Optional[SharedMemory] = None
+        self.meta = SharedDict(f"ckpt_meta_{name}_{rank}", create=host)
+
+    # -- writer (training process) ----------------------------------------
+    def save_state(self, step: int, tree) -> int:
+        """Snapshot a pytree into shm; returns total bytes written."""
+        pairs = _flatten_with_paths(tree)
+        specs = []
+        offset = 0
+        for key, arr in pairs:
+            nbytes = arr.nbytes
+            specs.append(
+                (key, str(arr.dtype), tuple(arr.shape), offset, nbytes)
+            )
+            offset += nbytes
+        total = offset
+        self._ensure_shm(total)
+        buf = self._shm.buf
+        for (key, arr), (_, _, _, off, nbytes) in zip(pairs, specs):
+            # single memcpy into shm: an ndarray view of the shm buffer
+            # avoids tobytes() materializing a second host copy of every
+            # leaf inside the snapshot window
+            dst = np.ndarray(arr.shape, dtype=arr.dtype, buffer=buf,
+                             offset=off)
+            np.copyto(dst, arr)
+        self.meta.update(
+            {
+                "step": step,
+                "specs": specs,
+                "total_bytes": total,
+                "valid": True,
+            }
+        )
+        return total
+
+    def mark_invalid(self):
+        self.meta.set("valid", False)
+
+    def _ensure_shm(self, size: int):
+        if self._shm is None or self._shm.size < size:
+            if self._shm is not None:
+                self._shm.close()
+            self._shm = SharedMemory(
+                self._shm_name, create=True, size=max(size, 1)
+            )
+
+    # -- reader (agent or restarted training process) ----------------------
+    def attach(self, min_size: int = 0) -> bool:
+        """Attach to the segment; re-attach when the writer grew and
+        recreated it (a stale mapping would silently truncate reads)."""
+        if self._shm is not None and self._shm.size < min_size:
+            self._shm.close()
+            self._shm = None
+        if self._shm is not None:
+            return True
+        try:
+            self._shm = SharedMemory(self._shm_name)
+        except FileNotFoundError:
+            return False
+        if min_size and self._shm.size < min_size:
+            # segment exists but is the old, smaller generation
+            self._shm.close()
+            self._shm = None
+            return False
+        return True
+
+    def get_step(self) -> int:
+        meta = self.meta.get_all()
+        if not meta.get("valid"):
+            return -1
+        return meta.get("step", -1)
+
+    def load_state(self) -> Tuple[int, Dict[str, np.ndarray]]:
+        """Rebuild {keypath: ndarray} from shm (zero-copy views are
+        copied out so the shm can be overwritten)."""
+        meta = self.meta.get_all()
+        if not meta.get("valid"):
+            return -1, {}
+        if not self.attach(min_size=meta.get("total_bytes", 0)):
+            return -1, {}
+        arrays = {}
+        buf = self._shm.buf
+        for key, dtype, shape, off, nbytes in meta["specs"]:
+            arrays[key] = (
+                np.frombuffer(bytes(buf[off : off + nbytes]), dtype=dtype)
+                .reshape(shape)
+                .copy()
+            )
+        return meta.get("step", -1), arrays
+
+    def dump_to_file(self, path: str, storage) -> bool:
+        """Persist header+raw shm bytes to ``path`` (agent side)."""
+        meta = self.meta.get_all()
+        if not meta.get("valid") or not self.attach(
+            min_size=meta.get("total_bytes", 0)
+        ):
+            logger.warning("no valid shm checkpoint for rank %s",
+                           self._rank)
+            return False
+        header = pickle.dumps(
+            {"step": meta["step"], "specs": meta["specs"]}
+        )
+        total = meta["total_bytes"]
+        payload = (
+            _HDR.pack(len(header))
+            + header
+            + bytes(self._shm.buf[:total])
+        )
+        storage.write(payload, path)
+        return True
+
+    def close(self, unlink: bool = False):
+        if self._shm is not None:
+            self._shm.close()
+            if unlink:
+                self._shm.unlink()
+            self._shm = None
+        self.meta.close()
+
+
+def read_shard_file(path: str, storage=None) -> Tuple[int, Dict[str, np.ndarray]]:
+    """Load a persisted ``*.drckpt`` shard."""
+    if storage is not None:
+        raw = storage.read(path, "rb")
+    else:
+        with open(path, "rb") as f:
+            raw = f.read()
+    if not raw:
+        return -1, {}
+    (hdr_len,) = _HDR.unpack(raw[: _HDR.size])
+    meta = pickle.loads(raw[_HDR.size : _HDR.size + hdr_len])
+    base = _HDR.size + hdr_len
+    arrays = {}
+    for key, dtype, shape, off, nbytes in meta["specs"]:
+        arrays[key] = (
+            np.frombuffer(raw[base + off : base + off + nbytes],
+                          dtype=dtype)
+            .reshape(shape)
+            .copy()
+        )
+    return meta.get("step", -1), arrays
+
+
+def shard_lock(rank: int, name: str = "default", create: bool = False) -> SharedLock:
+    return SharedLock(f"ckpt_{name}_{rank}", create=create)

@@ -138,9 +138,23 @@ class RendezvousManager(metaclass=ABCMeta):
 
     def num_nodes_waiting(self) -> int:
         """Nonzero once a new rendezvous is pending — the running agents
-        poll this to learn that a restart/re-mesh is required."""
+        poll this to learn that a restart/re-mesh is required.
+
+        Gated (reference ``:272-285``): leftover sub-node_unit nodes
+        alone must NOT signal a restart (they cannot change the world),
+        or every completed round with a remainder would trigger an
+        infinite restart storm.  A re-joining member of the latest world
+        always signals (its training process died)."""
         with self._lock:
-            return len(self._waiting_nodes)
+            if not self._waiting_nodes:
+                return 0
+            rejoined = any(
+                r in self._latest_rdzv_nodes
+                for r in self._waiting_nodes
+            )
+            if rejoined or len(self._waiting_nodes) >= self._node_unit:
+                return len(self._waiting_nodes)
+            return 0
 
     def sync_ckpt_nodes(self, node_id: int, step: int) -> bool:
         """Barrier: all latest-rendezvous nodes report the same in-memory
@@ -184,6 +198,13 @@ class NetworkCheckRendezvousManager(RendezvousManager):
             if not self._waiting_nodes:
                 self._start_rdzv_time = time.time()
                 self._node_groups = []
+                if self._check_round >= 2:
+                    # a fresh sweep after a completed 2-round check:
+                    # stale verdicts from the previous sweep must not
+                    # leak (a then-healthy node may be broken now)
+                    self._node_status = {}
+                    self._node_times = {}
+                    self._check_round = 0
             self._waiting_nodes[node_rank] = local_world_size
             self._rdzv_nodes = {}
             self._lastcall_time = time.time()

@@ -137,11 +137,15 @@ class BatchDatasetManager(DatasetManager):
     def checkpoint(self) -> str:
         import json
 
-        # doubt shards: both todo and doing go back to todo on restore
+        # doubt shards: both todo and doing go back to todo on restore;
+        # the full shard is kept (name for streaming partitions,
+        # record_indices for shuffled text datasets)
+        def _shard(s):
+            return [s.name, s.start, s.end, s.record_indices]
+
         todo_shards = [
-            [t.task.shard.start, t.task.shard.end]
-            for t in self.doing.values()
-        ] + [[t.shard.start, t.shard.end] for t in self.todo]
+            _shard(t.task.shard) for t in self.doing.values()
+        ] + [_shard(t.shard) for t in self.todo]
         return json.dumps(
             {
                 "todo": todo_shards,
@@ -160,12 +164,13 @@ class BatchDatasetManager(DatasetManager):
         self._task_id = state.get("task_id", 0)
         self.todo.clear()
         self.doing.clear()
-        for lo, hi in state["todo"]:
+        for name, lo, hi, indices in state["todo"]:
             self.todo.append(
                 Task(
                     task_id=self._task_id,
                     task_type=self._task_type,
-                    shard=DataShard(self._splitter.dataset_name, lo, hi),
+                    shard=DataShard(name, lo, hi,
+                                    record_indices=indices),
                 )
             )
             self._task_id += 1

@@ -280,6 +280,11 @@ def new_dataset_splitter(
         )
     if storage_type == "stream":
         return StreamingDatasetSplitter(
-            dataset_name, shard_size, dataset_size=dataset_size
+            dataset_name,
+            shard_size,
+            # without explicit partitions, consume one default partition
+            # from offset 0 so shards actually get produced
+            partition_offset=PartitionOffsets({dataset_name: 0}),
+            dataset_size=dataset_size,
         )
     raise ValueError(f"unknown dataset storage type {storage_type}")

@@ -118,14 +118,15 @@ class SpeedMonitor:
 
     def step_is_stagnant(self, hang_secs: Optional[float] = None) -> bool:
         """Hang signal: no global-step progress for hang_secs while
-        workers are running (feeds the master's hang diagnosis)."""
+        workers are running (feeds the master's hang diagnosis).
+
+        Jobs that never report GlobalStep are NOT flagged — killing a
+        healthy job that simply doesn't use step reporting is worse
+        than missing a hang (reference gates on
+        ``all_running_node_hanged`` + task hang for the same reason)."""
         hang_secs = hang_secs or _ctx.hang_detection_secs
         with self._lock:
-            if not self._global_step_records:
-                started = self._start_training_time or self._init_time
-                return (
-                    bool(self._workers)
-                    and time.time() - started > hang_secs
-                )
+            if self._sample_count == 0:
+                return False
             last = self._global_step_records[-1]
             return time.time() - last.timestamp > hang_secs

@@ -1,0 +1,4 @@
+from dlrover_tpu.trainer.checkpoint.checkpointer import (  # noqa: F401
+    Checkpointer,
+    StorageType,
+)

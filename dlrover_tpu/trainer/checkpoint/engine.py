@@ -1,0 +1,193 @@
+"""Training-process side of flash checkpoint.
+
+Reference parity: ``dlrover/trainer/torch/flash_checkpoint/engine.py:136``
+(CheckpointEngine: shm handler in the train proc, agent notification,
+``save_to_memory:391`` / ``save_to_storage:409`` / ``load:428``) and
+``full_ckpt_engine.py``.
+
+TPU design: a snapshot is ``jax.device_get`` of the process's
+addressable view of the train-state pytree, memcpy'd into host shared
+memory guarded by the agent's SharedLock.  Persistence is asynchronous
+in the agent process, so the training step is blocked only for the
+device->host copy (seconds for 7B-class states), and the snapshot
+survives a crashed or preempted training process.
+"""
+
+import os
+import time
+from typing import Optional
+
+from dlrover_tpu.common.constants import CheckpointConstant
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.multi_process import SharedQueue
+from dlrover_tpu.common.storage import get_checkpoint_storage
+from dlrover_tpu.agent.ckpt_saver import (
+    AsyncCheckpointSaver,
+    CheckpointEvent,
+    EVENT_QUEUE,
+    FACTORY_QUEUE,
+    SaverConfig,
+    find_latest_checkpoint,
+)
+from dlrover_tpu.agent.ckpt_shm import (
+    SharedMemoryHandler,
+    read_shard_file,
+    restore_to_target,
+    shard_lock,
+)
+
+
+def _agent_factory_queue_exists() -> bool:
+    from dlrover_tpu.common.multi_process import _socket_path
+
+    return os.path.exists(_socket_path("queue_" + FACTORY_QUEUE))
+
+
+class CheckpointEngine:
+    """Save/restore a pytree through shm + the async agent saver."""
+
+    def __init__(
+        self,
+        checkpoint_dir: str,
+        process_rank: int = 0,
+        process_count: int = 1,
+        node_rank: int = 0,
+        local_shard_num: int = 1,
+        name: str = "default",
+        storage=None,
+    ):
+        self.checkpoint_dir = checkpoint_dir
+        self._rank = process_rank
+        self._world = process_count
+        self._node_rank = node_rank
+        self._name = name
+        self._storage = storage or get_checkpoint_storage()
+        self._local_saver: Optional[AsyncCheckpointSaver] = None
+        self._cached_step = -1
+
+        # the saver serves shm/lock endpoints for global ranks
+        # [node_rank*local_shard_num, ...); this process's rank must be
+        # one of them or its lock/meta sockets will never exist
+        local_rank = process_rank - node_rank * local_shard_num
+        if not 0 <= local_rank < local_shard_num:
+            raise ValueError(
+                f"process_rank {process_rank} outside node {node_rank}'s "
+                f"local shard range (local_shard_num={local_shard_num}); "
+                "expected contiguous rank assignment "
+                "rank = node_rank*local_shard_num + local_rank"
+            )
+
+        config = SaverConfig(
+            checkpoint_dir=checkpoint_dir,
+            local_shard_num=local_shard_num,
+            global_shard_num=process_count,
+            node_rank=node_rank,
+            name=name,
+        )
+        if _agent_factory_queue_exists():
+            # running under an agent: ask its factory to build the saver
+            factory = SharedQueue(FACTORY_QUEUE, create=False)
+            factory.put(config)
+            factory.close()
+        elif local_rank == 0:
+            # standalone (no dlrover-tpu-run): local rank 0 hosts the
+            # saver in-process; async persist still works, crash
+            # resilience does not (reference: engine.py:114
+            # start_saver_process).  Other local ranks connect to its
+            # shm/lock endpoints as clients.
+            self._local_saver = AsyncCheckpointSaver(config,
+                                                     storage=self._storage)
+            self._local_saver.start()
+            AsyncCheckpointSaver._instance = self._local_saver
+        self._shm_handler = SharedMemoryHandler(
+            process_rank, name=name, host=False
+        )
+        self._lock = shard_lock(process_rank, name=name, create=False)
+        self._event_queue = SharedQueue(
+            f"{EVENT_QUEUE}_{name}", create=False
+        )
+
+    # -- save --------------------------------------------------------------
+    def save_to_memory(self, step: int, state) -> bool:
+        """Block only for device->host copy into shm."""
+        start = time.time()
+        if not self._lock.acquire(timeout=60):
+            logger.warning(
+                "rank %s: saver still busy; skip memory save of step %s",
+                self._rank, step,
+            )
+            return False
+        try:
+            nbytes = self._shm_handler.save_state(step, state)
+        finally:
+            self._lock.release()
+        self._cached_step = step
+        logger.info(
+            "rank %s: step %s snapshot (%.1f MB) to shm in %.3fs",
+            self._rank, step, nbytes / 1e6, time.time() - start,
+        )
+        return True
+
+    def save_to_storage(self, step: int, state,
+                        checkpoint_dir: Optional[str] = None) -> bool:
+        if not self.save_to_memory(step, state):
+            return False
+        self._event_queue.put(
+            CheckpointEvent(
+                event_type="save",
+                step=step,
+                checkpoint_dir=checkpoint_dir or self.checkpoint_dir,
+            )
+        )
+        return True
+
+    # -- load --------------------------------------------------------------
+    def load(self, target=None, checkpoint_dir: Optional[str] = None):
+        """Restore the newest state: shm first (seconds), storage next.
+
+        Returns (step, state) where state is ``target``-shaped if a
+        target pytree was given, else {keypath: ndarray}; (-1, None)
+        when nothing exists.
+        """
+        step, arrays = self._shm_handler.load_state()
+        if step < 0:
+            step, arrays = self._load_from_storage(checkpoint_dir)
+        if step < 0:
+            return -1, None
+        if target is not None:
+            return step, restore_to_target(target, arrays)
+        return step, arrays
+
+    def _load_from_storage(self, checkpoint_dir: Optional[str] = None):
+        root = checkpoint_dir or self.checkpoint_dir
+        latest = find_latest_checkpoint(root, self._storage)
+        if latest is None:
+            return -1, {}
+        path = os.path.join(latest, f"shard_{self._rank}.drckpt")
+        if not self._storage.exists(path):
+            logger.warning("no shard file %s in %s", self._rank, latest)
+            return -1, {}
+        return read_shard_file(path, self._storage)
+
+    def latest_persisted_step(self) -> int:
+        tracker = os.path.join(
+            self.checkpoint_dir, CheckpointConstant.TRACKER_FILE
+        )
+        content = self._storage.read(tracker)
+        return int(content) if content else -1
+
+    def wait_for_persist(self, step: int, timeout: float = 120) -> bool:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.latest_persisted_step() >= step:
+                return True
+            time.sleep(0.1)
+        return False
+
+    def close(self):
+        self._shm_handler.close()
+        self._lock.close()
+        self._event_queue.close()
+        if self._local_saver is not None:
+            self._local_saver.close(unlink=True)
+            AsyncCheckpointSaver._instance = None

@@ -1,0 +1,309 @@
+"""Flash-checkpoint tests: shm snapshot, async persist + two-phase
+commit, restore from shm and from disk, crash survival across a real
+process boundary (mirrors reference checkpoint_egine_test.py /
+test_ckpt_saver.py)."""
+
+import multiprocessing as mp
+import os
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.agent.ckpt_saver import (
+    AsyncCheckpointSaver,
+    SaverConfig,
+    find_latest_checkpoint,
+)
+from dlrover_tpu.agent.ckpt_shm import (
+    SharedMemoryHandler,
+    read_shard_file,
+    restore_to_target,
+)
+from dlrover_tpu.common.constants import CheckpointConstant
+from dlrover_tpu.trainer.checkpoint import Checkpointer, StorageType
+
+
+def make_state(step=0, scale=1.0):
+    return {
+        "params": {
+            "w": jnp.ones((4, 8), jnp.float32) * scale,
+            "b": jnp.zeros((8,), jnp.bfloat16),
+        },
+        "opt": {"mu": np.full((4, 8), 0.5, np.float32)},
+        "step": np.int64(step),
+    }
+
+
+def assert_state_equal(a, b):
+    np.testing.assert_array_equal(
+        np.asarray(a["params"]["w"]), np.asarray(b["params"]["w"])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(a["params"]["b"], dtype=np.float32),
+        np.asarray(b["params"]["b"], dtype=np.float32),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(a["opt"]["mu"]), np.asarray(b["opt"]["mu"])
+    )
+    assert int(a["step"]) == int(b["step"])
+
+
+class TestSharedMemoryHandler:
+    def test_save_load_roundtrip(self):
+        handler = SharedMemoryHandler(0, name="t1", host=True)
+        state = make_state(step=3)
+        handler.save_state(3, state)
+        step, arrays = handler.load_state()
+        assert step == 3
+        restored = restore_to_target(state, arrays)
+        assert_state_equal(state, restored)
+        # bfloat16 survives the roundtrip
+        assert restored["params"]["b"].dtype == jnp.bfloat16
+        handler.close(unlink=True)
+
+    def test_overwrite_with_larger_state(self):
+        handler = SharedMemoryHandler(0, name="t2", host=True)
+        handler.save_state(1, {"a": np.zeros(4)})
+        handler.save_state(2, {"a": np.zeros(4), "b": np.ones(1000)})
+        step, arrays = handler.load_state()
+        assert step == 2
+        assert arrays["['b']"].shape == (1000,)
+        handler.close(unlink=True)
+
+    def test_invalid_returns_minus_one(self):
+        handler = SharedMemoryHandler(0, name="t3", host=True)
+        assert handler.get_step() == -1
+        handler.save_state(5, {"x": np.ones(2)})
+        handler.mark_invalid()
+        assert handler.get_step() == -1
+        handler.close(unlink=True)
+
+
+class TestCheckpointerStandalone:
+    """No agent: the engine hosts its own async saver in-process."""
+
+    def test_memory_save_and_load(self, tmp_ckpt_dir):
+        ckpt = Checkpointer(tmp_ckpt_dir, process_rank=0,
+                            process_count=1, node_rank=0, name="m1")
+        state = make_state(step=10)
+        assert ckpt.save_checkpoint(10, state, StorageType.MEMORY)
+        step, restored = ckpt.load_checkpoint(target=state)
+        assert step == 10
+        assert_state_equal(state, restored)
+        ckpt.close()
+
+    def test_disk_save_commit_and_load(self, tmp_ckpt_dir):
+        ckpt = Checkpointer(tmp_ckpt_dir, process_rank=0,
+                            process_count=1, node_rank=0, name="d1")
+        state = make_state(step=20, scale=2.0)
+        assert ckpt.save_checkpoint(20, state, StorageType.DISK)
+        assert ckpt.wait_latest_checkpoint(20, timeout=30)
+        final = os.path.join(tmp_ckpt_dir, "checkpoint-20")
+        assert os.path.isdir(final)
+        assert os.path.exists(os.path.join(final, "shard_0.drckpt"))
+        # stage dir cleaned up
+        stage_root = os.path.join(
+            tmp_ckpt_dir, CheckpointConstant.STAGE_DIR
+        )
+        assert not os.path.exists(
+            os.path.join(stage_root, "checkpoint-20")
+        )
+        # read back from disk
+        step, arrays = read_shard_file(
+            os.path.join(final, "shard_0.drckpt")
+        )
+        assert step == 20
+        restored = restore_to_target(state, arrays)
+        assert_state_equal(state, restored)
+        ckpt.close()
+
+    def test_load_prefers_newer_shm(self, tmp_ckpt_dir):
+        ckpt = Checkpointer(tmp_ckpt_dir, process_rank=0,
+                            process_count=1, node_rank=0, name="d2")
+        old = make_state(step=1, scale=1.0)
+        new = make_state(step=2, scale=9.0)
+        ckpt.save_checkpoint(1, old, StorageType.DISK)
+        ckpt.wait_latest_checkpoint(1, timeout=30)
+        ckpt.save_checkpoint(2, new, StorageType.MEMORY)
+        step, restored = ckpt.load_checkpoint(target=new)
+        assert step == 2
+        assert float(np.asarray(restored["params"]["w"])[0, 0]) == 9.0
+        ckpt.close()
+
+    def test_multiple_steps_tracker(self, tmp_ckpt_dir):
+        ckpt = Checkpointer(tmp_ckpt_dir, process_rank=0,
+                            process_count=1, node_rank=0, name="d3")
+        for step in (5, 6, 7):
+            ckpt.save_checkpoint(step, make_state(step), StorageType.DISK)
+            assert ckpt.wait_latest_checkpoint(step, timeout=30)
+        assert ckpt.latest_persisted_step() == 7
+        latest = find_latest_checkpoint(tmp_ckpt_dir)
+        assert latest.endswith("checkpoint-7")
+        ckpt.close()
+
+
+def _crashing_trainer(ckpt_dir, sock_dir):
+    """Simulated training process: snapshot to shm then die abruptly."""
+    os.environ["DLROVER_TPU_SOCKET_DIR"] = sock_dir
+    from dlrover_tpu.agent.ckpt_shm import SharedMemoryHandler as H
+
+    handler = H(0, name="crash", host=False)
+    state = {
+        "w": np.arange(64, dtype=np.float32).reshape(8, 8),
+        "step": np.int64(77),
+    }
+    handler.save_state(77, state)
+    os._exit(1)  # crash without cleanup
+
+
+class TestCrashSurvival:
+    def test_agent_flushes_after_trainer_crash(self, tmp_ckpt_dir):
+        """The agent-side saver persists the shm snapshot of a training
+        process that died — the core flash-checkpoint property."""
+        sock_dir = os.environ["DLROVER_TPU_SOCKET_DIR"]
+        config = SaverConfig(
+            checkpoint_dir=tmp_ckpt_dir,
+            local_shard_num=1,
+            global_shard_num=1,
+            node_rank=0,
+            name="crash",
+        )
+        saver = AsyncCheckpointSaver(config)
+        saver.start()
+        try:
+            proc = mp.get_context("spawn").Process(
+                target=_crashing_trainer,
+                args=(tmp_ckpt_dir, sock_dir),
+            )
+            proc.start()
+            proc.join(timeout=60)
+            assert proc.exitcode == 1  # it crashed as intended
+            # agent notices and emergency-flushes
+            assert saver.save_shm_to_storage(reason="worker crash")
+            final = os.path.join(tmp_ckpt_dir, "checkpoint-77")
+            assert os.path.isdir(final)
+            step, arrays = read_shard_file(
+                os.path.join(final, "shard_0.drckpt")
+            )
+            assert step == 77
+            np.testing.assert_array_equal(
+                arrays["['w']"],
+                np.arange(64, dtype=np.float32).reshape(8, 8),
+            )
+        finally:
+            saver.close(unlink=True)
+            AsyncCheckpointSaver._instance = None
+
+    def test_reader_reattaches_after_shm_growth(self, tmp_ckpt_dir):
+        """A reader holding a mapping of the old (small) segment must
+        re-attach after the writer grows it, not read truncated bytes."""
+        writer = SharedMemoryHandler(0, name="grow", host=True)
+        reader = SharedMemoryHandler(0, name="grow", host=False)
+        writer.save_state(1, {"a": np.zeros(4, np.float32)})
+        step, arrays = reader.load_state()
+        assert step == 1
+        writer.save_state(2, {"a": np.zeros(4, np.float32),
+                              "b": np.ones(100000, np.float32)})
+        step, arrays = reader.load_state()
+        assert step == 2
+        assert arrays["['b']"].shape == (100000,)
+        writer.close(unlink=True)
+        reader.close()
+
+    def test_recommit_same_step_replaces(self, tmp_ckpt_dir):
+        """Re-saving an existing step must replace the old contents,
+        not silently discard the fresh shards."""
+        config = SaverConfig(checkpoint_dir=tmp_ckpt_dir, name="rc")
+        saver = AsyncCheckpointSaver(config)
+        try:
+            handler = SharedMemoryHandler(0, name="rc", host=False)
+            handler.save_state(4, {"x": np.zeros(3, np.float32)})
+            assert saver.save_step_checkpoint(4)
+            handler.save_state(4, {"x": np.full(3, 9.0, np.float32)})
+            assert saver.save_step_checkpoint(4)
+            _, arrays = read_shard_file(
+                os.path.join(tmp_ckpt_dir, "checkpoint-4",
+                             "shard_0.drckpt")
+            )
+            np.testing.assert_array_equal(
+                arrays["['x']"], np.full(3, 9.0, np.float32)
+            )
+            handler.close()
+        finally:
+            saver.close(unlink=True)
+            AsyncCheckpointSaver._instance = None
+
+    def test_mixed_step_shards_abort_save(self, tmp_ckpt_dir):
+        """Shards at different steps must fail the save rather than
+        committing a mixed-step checkpoint."""
+        config = SaverConfig(checkpoint_dir=tmp_ckpt_dir,
+                             local_shard_num=2, global_shard_num=2,
+                             name="mix")
+        saver = AsyncCheckpointSaver(config)
+        try:
+            h0 = SharedMemoryHandler(0, name="mix", host=False)
+            h1 = SharedMemoryHandler(1, name="mix", host=False)
+            h0.save_state(10, {"x": np.zeros(2)})
+            h1.save_state(11, {"x": np.zeros(2)})
+            assert not saver.save_step_checkpoint(10)
+            assert not os.path.exists(
+                os.path.join(tmp_ckpt_dir, "checkpoint-10")
+            )
+            h0.close()
+            h1.close()
+        finally:
+            saver.close(unlink=True)
+            AsyncCheckpointSaver._instance = None
+
+    def test_flush_skips_already_persisted(self, tmp_ckpt_dir):
+        config = SaverConfig(checkpoint_dir=tmp_ckpt_dir, name="skipf")
+        saver = AsyncCheckpointSaver(config)
+        try:
+            handler = SharedMemoryHandler(0, name="skipf", host=False)
+            handler.save_state(5, {"x": np.ones(3)})
+            assert saver.save_step_checkpoint(5)
+            # second flush is a no-op
+            assert saver.save_shm_to_storage(reason="again")
+            handler.close()
+        finally:
+            saver.close(unlink=True)
+            AsyncCheckpointSaver._instance = None
+
+
+class TestMultiShardCommit:
+    def test_two_node_commit_waits_for_done_files(self, tmp_ckpt_dir):
+        """Node 1 persists its shard first; node 0 commits only after
+        both done files exist."""
+        cfg0 = SaverConfig(checkpoint_dir=tmp_ckpt_dir,
+                           local_shard_num=1, global_shard_num=2,
+                           node_rank=0, name="n0")
+        cfg1 = SaverConfig(checkpoint_dir=tmp_ckpt_dir,
+                           local_shard_num=1, global_shard_num=2,
+                           node_rank=1, name="n1")
+        saver0 = AsyncCheckpointSaver(cfg0)
+        saver1 = AsyncCheckpointSaver(cfg1)
+        try:
+            h0 = SharedMemoryHandler(0, name="n0", host=False)
+            h1 = SharedMemoryHandler(1, name="n1", host=False)
+            h0.save_state(9, {"w": np.zeros(4)})
+            h1.save_state(9, {"w": np.ones(4)})
+            # node 1 first: no commit yet
+            assert saver1.save_step_checkpoint(9)
+            assert not os.path.exists(
+                os.path.join(tmp_ckpt_dir, "checkpoint-9")
+            )
+            # node 0 persists + commits
+            assert saver0.save_step_checkpoint(9)
+            final = os.path.join(tmp_ckpt_dir, "checkpoint-9")
+            assert os.path.isdir(final)
+            assert sorted(os.listdir(final)) == [
+                "shard_0.drckpt", "shard_1.drckpt"
+            ]
+            h0.close()
+            h1.close()
+        finally:
+            saver0.close(unlink=True)
+            saver1.close(unlink=True)
+            AsyncCheckpointSaver._instance = None

@@ -110,6 +110,35 @@ class TestDatasetManager:
         t = mgr.get_task(2)
         assert t.shard.start == t0.shard.start
 
+    def test_checkpoint_preserves_record_indices(self):
+        """Shuffled text-dataset shards must survive a master restore
+        with their exact record permutation."""
+        from dlrover_tpu.master.shard.dataset_splitter import (
+            TextDatasetSplitter,
+        )
+
+        splitter = TextDatasetSplitter("t", 8, 4, shuffle=True)
+        mgr = BatchDatasetManager("training", 2, splitter)
+        t = mgr.get_task(0)
+        original_indices = list(t.shard.record_indices)
+        ckpt = mgr.checkpoint()
+        splitter2 = TextDatasetSplitter("t", 8, 4, shuffle=True)
+        mgr2 = BatchDatasetManager("training", 2, splitter2)
+        mgr2.restore_checkpoint(ckpt)
+        restored = mgr2.get_task(0)
+        assert restored.shard.record_indices == original_indices
+
+    def test_stream_splitter_via_factory_produces_shards(self):
+        from dlrover_tpu.master.shard.dataset_splitter import (
+            new_dataset_splitter,
+        )
+
+        splitter = new_dataset_splitter(
+            False, 10, 20, 1, "s", storage_type="stream"
+        )
+        splitter.create_shards()
+        assert len(splitter.get_shards()) == 2
+
     def test_checkpoint_restore_covers_doing(self):
         mgr = self._manager(30, 10)
         mgr.get_task(0)  # doing
@@ -233,9 +262,10 @@ class TestRendezvous:
         assert not mgr.sync_ckpt_nodes(0, 200)
         assert mgr.sync_ckpt_nodes(1, 200)
 
-    def test_node_unit_excess_stays_waiting(self):
-        """Nodes cut by node_unit rounding stay pending so the restart
-        signal keeps firing."""
+    def test_node_unit_excess_no_restart_storm(self):
+        """Nodes cut by node_unit rounding stay pending but do NOT
+        signal a restart (they cannot change the world), avoiding an
+        infinite restart loop."""
         mgr = ElasticTrainingRendezvousManager()
         mgr.update_rdzv_params(2, 8, 0.2, 2)
         for rank in range(3):
@@ -243,7 +273,43 @@ class TestRendezvous:
         time.sleep(0.3)
         _, _, world = mgr.get_comm_world(0)
         assert len(world) == 2
+        assert mgr.num_nodes_waiting() == 0  # rank 2 alone: no signal
+        # a second leftover makes a full unit: now signal
+        mgr.join_rendezvous(3, 1)
+        assert mgr.num_nodes_waiting() == 2
+
+    def test_world_member_rejoin_signals_restart(self):
+        """A member of the current world re-joining (its process died)
+        must signal even below node_unit."""
+        mgr = ElasticTrainingRendezvousManager()
+        mgr.update_rdzv_params(2, 2, 0.2, 2)
+        mgr.join_rendezvous(0, 1)
+        mgr.join_rendezvous(1, 1)
+        mgr.get_comm_world(0)
+        mgr.join_rendezvous(1, 1)  # member restarts
         assert mgr.num_nodes_waiting() == 1
+
+    def test_network_check_new_sweep_clears_stale_verdicts(self):
+        """After a completed 2-round sweep, a fresh sweep must not see
+        the previous sweep's sticky successes."""
+        mgr = NetworkCheckRendezvousManager()
+        mgr.update_rdzv_params(2, 2, 1, 1)
+        # sweep 1: two rounds, both nodes healthy
+        for _ in range(2):
+            mgr.join_rendezvous(0, 1)
+            mgr.join_rendezvous(1, 1)
+            mgr.get_comm_world(0)
+            for rank in range(2):
+                mgr.report_network_status(rank, True, 1.0)
+        assert mgr.check_fault_node()[0] == []
+        # sweep 2: node 1 is now broken
+        mgr.join_rendezvous(0, 1)
+        mgr.join_rendezvous(1, 1)
+        mgr.get_comm_world(0)
+        mgr.report_network_status(0, True, 1.0)
+        mgr.report_network_status(1, False, 0.0)
+        faults, _ = mgr.check_fault_node()
+        assert faults == [1]
 
 
 class TestSpeedMonitor:
