@@ -166,6 +166,15 @@ class AsyncCheckpointSaver:
             global_rank = self._global_rank(local_rank)
             lock = self._locks[local_rank]
             acquired = lock.acquire(timeout=60)
+            if not acquired:
+                # a trainer mid-snapshot holds the lock; persisting
+                # without it could write a torn buffer
+                logger.error(
+                    "shard %s: lock not acquired; aborting this save",
+                    global_rank,
+                )
+                ok = False
+                continue
             try:
                 shm_step = handler.get_step()
                 if shm_step != step:
@@ -181,8 +190,7 @@ class AsyncCheckpointSaver:
                 )
                 ok = handler.dump_to_file(path, self._storage) and ok
             finally:
-                if acquired:
-                    lock.release()
+                lock.release()
         if not ok:
             logger.error("step %s: some shards failed to persist", step)
             return False

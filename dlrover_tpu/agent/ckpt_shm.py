@@ -183,12 +183,16 @@ class SharedMemoryHandler:
             {"step": meta["step"], "specs": meta["specs"]}
         )
         total = meta["total_bytes"]
-        payload = (
-            _HDR.pack(len(header))
-            + header
-            + bytes(self._shm.buf[:total])
+        # stream header + a zero-copy view of the shm buffer so the
+        # agent never materializes a second shard-sized bytes object
+        storage.write_chunks(
+            [
+                _HDR.pack(len(header)),
+                header,
+                memoryview(self._shm.buf)[:total],
+            ],
+            path,
         )
-        storage.write(payload, path)
         return True
 
     def close(self, unlink: bool = False):

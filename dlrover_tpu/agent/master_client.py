@@ -1,0 +1,327 @@
+"""Singleton agent→master client: every RPC the agent makes.
+
+Reference parity: ``dlrover/python/elastic_agent/master_client.py:50``
+(``MasterClient``) — one method per control-plane interaction:
+rendezvous, data shards, metrics, failures, heartbeats, KV store.
+Transport is the 2-RPC pickled-envelope channel
+(``dlrover_tpu.common.comm.MasterChannel``).
+"""
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.comm import MasterChannel
+from dlrover_tpu.common.constants import NodeEnv, NodeType, RendezvousName
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class MasterClient:
+    """gRPC client to the job master; one instance per process."""
+
+    _instance: Optional["MasterClient"] = None
+    _lock = threading.Lock()
+
+    def __init__(
+        self,
+        master_addr: str,
+        node_id: int = 0,
+        node_type: str = NodeType.WORKER,
+        timeout: float = 15.0,
+    ):
+        self._addr = master_addr
+        self._node_id = node_id
+        self._node_type = node_type
+        self._channel = MasterChannel(
+            master_addr, node_id=node_id, node_type=node_type, timeout=timeout
+        )
+
+    # ------------------------------------------------------------ lifecycle
+    @classmethod
+    def singleton_instance(
+        cls, master_addr: str = "", node_id: Optional[int] = None
+    ) -> "MasterClient":
+        with cls._lock:
+            if cls._instance is None:
+                addr = master_addr or os.getenv(NodeEnv.MASTER_ADDR, "")
+                if not addr:
+                    raise RuntimeError(
+                        "no master address: pass master_addr or set "
+                        f"${NodeEnv.MASTER_ADDR}"
+                    )
+                if node_id is None:
+                    node_id = int(os.getenv(NodeEnv.NODE_RANK, "0"))
+                cls._instance = cls(addr, node_id=node_id)
+            return cls._instance
+
+    @classmethod
+    def reset(cls):
+        with cls._lock:
+            if cls._instance is not None:
+                cls._instance.close()
+            cls._instance = None
+
+    @property
+    def addr(self) -> str:
+        return self._addr
+
+    @property
+    def node_id(self) -> int:
+        return self._node_id
+
+    def close(self):
+        self._channel.close()
+
+    # ----------------------------------------------------------- rendezvous
+    def report_rdzv_params(
+        self,
+        min_nodes: int,
+        max_nodes: int,
+        waiting_timeout: int,
+        node_unit: int = 1,
+    ) -> bool:
+        return self._channel.report(
+            msg.RendezvousParams(
+                min_nodes=min_nodes,
+                max_nodes=max_nodes,
+                waiting_timeout=waiting_timeout,
+                node_unit=node_unit,
+            )
+        )
+
+    def join_rendezvous(
+        self,
+        node_rank: int,
+        local_world_size: int,
+        rdzv_name: str = RendezvousName.ELASTIC_TRAINING,
+    ) -> int:
+        state = self._channel.get(
+            msg.JoinRendezvousRequest(
+                node_rank=node_rank,
+                local_world_size=local_world_size,
+                rdzv_name=rdzv_name,
+            )
+        )
+        return state.round if state else -1
+
+    def get_comm_world(
+        self, rdzv_name: str, node_rank: int
+    ) -> Tuple[int, int, Dict[int, int]]:
+        """Returns (round, group, {node_rank: local_world_size})."""
+        world = self._channel.get(
+            msg.CommWorldRequest(node_id=node_rank, rdzv_name=rdzv_name)
+        )
+        if world is None:
+            return -1, 0, {}
+        return world.round, world.group, world.world or {}
+
+    def num_nodes_waiting(
+        self, rdzv_name: str = RendezvousName.ELASTIC_TRAINING
+    ) -> int:
+        res = self._channel.get(msg.WaitingNodeNumRequest(rdzv_name=rdzv_name))
+        return res.waiting_num if res else 0
+
+    def check_fault_node(self) -> Tuple[List[int], str]:
+        res = self._channel.get(msg.NetworkReadyRequest())
+        if res is None:
+            return [], ""
+        return res.nodes or [], res.reason or ""
+
+    def check_straggler(self) -> Tuple[List[int], str]:
+        res = self._channel.get(msg.StragglerExistRequest())
+        if res is None:
+            return [], ""
+        return res.nodes or [], res.reason or ""
+
+    def report_network_status(
+        self, node_rank: int, succeeded: bool, elapsed_time: float
+    ) -> bool:
+        return self._channel.report(
+            msg.NetworkStatus(
+                node_rank=node_rank,
+                succeeded=succeeded,
+                elapsed_time=elapsed_time,
+            )
+        )
+
+    def sync_checkpoint(self, step: int) -> bool:
+        return self._channel.report(
+            msg.NodeCheckpointState(step=step)
+        )
+
+    # ------------------------------------------------------------ KV store
+    def kv_store_set(self, key: str, value: bytes) -> bool:
+        return self._channel.report(msg.KeyValuePair(key=key, value=value))
+
+    def kv_store_get(self, key: str) -> bytes:
+        res = self._channel.get(msg.KeyValuePair(key=key))
+        return res.value if res and res.value is not None else b""
+
+    def kv_store_wait(
+        self, key: str, timeout: float = 300.0, interval: float = 0.2
+    ) -> bytes:
+        """Poll the master KV store until ``key`` appears."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            value = self.kv_store_get(key)
+            if value:
+                return value
+            time.sleep(interval)
+        raise TimeoutError(f"key {key!r} not set within {timeout}s")
+
+    # ---------------------------------------------------------- data shards
+    def report_dataset_shard_params(
+        self,
+        dataset_name: str,
+        dataset_size: int,
+        batch_size: int = 0,
+        num_epochs: int = 1,
+        shuffle: bool = False,
+        num_minibatches_per_shard: int = 2,
+        storage_type: str = "table",
+        task_type: str = msg.TaskType.TRAINING,
+    ) -> bool:
+        return self._channel.report(
+            msg.DatasetShardParams(
+                dataset_name=dataset_name,
+                dataset_size=dataset_size,
+                batch_size=batch_size,
+                num_epochs=num_epochs,
+                shuffle=shuffle,
+                num_minibatches_per_shard=num_minibatches_per_shard,
+                storage_type=storage_type,
+                task_type=task_type,
+            )
+        )
+
+    def get_task(self, dataset_name: str) -> msg.Task:
+        task = self._channel.get(msg.TaskRequest(dataset_name=dataset_name))
+        return task if task is not None else msg.Task(task_id=-1)
+
+    def report_task_result(
+        self, dataset_name: str, task_id: int, err_message: str = ""
+    ) -> bool:
+        return self._channel.report(
+            msg.TaskResult(
+                dataset_name=dataset_name,
+                task_id=task_id,
+                err_message=err_message,
+            )
+        )
+
+    def get_shard_checkpoint(self, dataset_name: str):
+        return self._channel.get(
+            msg.ShardCheckpointRequest(dataset_name=dataset_name)
+        )
+
+    def report_shard_checkpoint(
+        self, dataset_name: str, content: str
+    ) -> bool:
+        return self._channel.report(
+            msg.ShardCheckpoint(dataset_name=dataset_name, content=content)
+        )
+
+    # -------------------------------------------------------------- metrics
+    def report_global_step(
+        self, step: int, timestamp: Optional[float] = None
+    ) -> bool:
+        return self._channel.report(
+            msg.GlobalStep(step=step, timestamp=timestamp or time.time())
+        )
+
+    def report_resource_stats(
+        self,
+        cpu_percent: float,
+        memory_mb: float,
+        tpu_stats: Optional[list] = None,
+    ) -> bool:
+        return self._channel.report(
+            msg.ResourceStats(
+                cpu_percent=cpu_percent,
+                memory_mb=memory_mb,
+                tpu_stats=tpu_stats or [],
+            )
+        )
+
+    def report_model_info(
+        self,
+        num_params: int,
+        flops_per_step: float = 0.0,
+        hidden_size: int = 0,
+        num_layers: int = 0,
+        seq_len: int = 0,
+        extra=None,
+    ) -> bool:
+        return self._channel.report(
+            msg.ModelInfo(
+                num_params=num_params,
+                flops_per_step=flops_per_step,
+                hidden_size=hidden_size,
+                num_layers=num_layers,
+                seq_len=seq_len,
+                extra=extra or {},
+            )
+        )
+
+    def report_node_address(
+        self, node_type: str, node_id: int, addr: str
+    ) -> bool:
+        return self._channel.report(
+            msg.NodeAddress(node_type=node_type, node_id=node_id, addr=addr)
+        )
+
+    def report_heartbeat(self, timestamp: Optional[float] = None) -> bool:
+        return self._channel.report(
+            msg.HeartBeat(timestamp=timestamp or time.time())
+        )
+
+    def report_failure(
+        self, error_data: str, restart_count: int = 0, level: str = "warning"
+    ) -> bool:
+        return self._channel.report(
+            msg.NodeFailure(
+                error_data=error_data,
+                restart_count=restart_count,
+                level=level,
+            )
+        )
+
+    def report_succeeded(self) -> bool:
+        return self._channel.report(msg.SucceededRequest())
+
+    # -------------------------------------------------------------- control
+    def get_running_nodes(self) -> list:
+        res = self._channel.get(msg.RunningNodesRequest())
+        return res.nodes if res else []
+
+    def get_training_status(self) -> str:
+        res = self._channel.get(msg.TrainingStatusRequest())
+        return res.status if res else ""
+
+    def get_paral_config(self) -> msg.ParallelConfig:
+        res = self._channel.get(msg.ParallelConfigRequest())
+        return res if res is not None else msg.ParallelConfig()
+
+    def report_paral_config(self, config: msg.ParallelConfig) -> bool:
+        return self._channel.report(config)
+
+    def need_to_restart_training(self) -> bool:
+        res = self._channel.get(msg.CheckHardwareResetRequest())
+        return bool(res and getattr(res, "restart", False))
+
+    def get_elastic_run_config(self) -> Dict[str, str]:
+        res = self._channel.get(msg.ElasticRunConfigRequest())
+        return res.configs if res and res.configs else {}
+
+    def report_diagnosis_data(
+        self, data_cls: str, data_content: str, node_rank: int = -1
+    ) -> bool:
+        return self._channel.report(
+            msg.DiagnosisReportData(
+                data_cls=data_cls,
+                data_content=data_content,
+                node_rank=node_rank,
+            )
+        )

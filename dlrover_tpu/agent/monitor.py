@@ -1,0 +1,168 @@
+"""Agent-side monitors: node resources and training progress.
+
+Reference parity: ``dlrover/python/elastic_agent/monitor/resource.py:86``
+(``ResourceMonitor``: psutil CPU/mem + per-accelerator stats reported to
+the master) and ``monitor/training.py:77`` (``TorchTrainingMonitor``:
+global step read from a file the training process writes).  On TPU the
+per-chip stats come from the training process itself (it owns the
+libtpu runtime); the agent aggregates host-level stats.
+"""
+
+import json
+import os
+import threading
+import time
+from typing import List, Optional
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.log import default_logger as logger
+
+try:
+    import psutil
+except ImportError:  # pragma: no cover
+    psutil = None
+
+
+def get_process_cpu_percent() -> float:
+    if psutil is None:
+        return 0.0
+    return psutil.cpu_percent(interval=None)
+
+
+def get_used_memory_mb() -> int:
+    if psutil is None:
+        return 0
+    return int(psutil.virtual_memory().used / 1024 / 1024)
+
+
+class ResourceMonitor:
+    """Periodically reports host CPU/memory (+ optional chip stats file)
+    to the master; feeds the autoscaler / resource optimizer."""
+
+    def __init__(
+        self,
+        client: Optional[MasterClient] = None,
+        interval: float = 15.0,
+        chip_stats_file: str = "",
+    ):
+        self._client = client or MasterClient.singleton_instance()
+        self._interval = interval
+        self._chip_stats_file = chip_stats_file or os.getenv(
+            "DLROVER_TPU_CHIP_STATS_FILE", ""
+        )
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+
+    def _read_chip_stats(self) -> List[dict]:
+        """Chip stats dropped by the training process (device memory in
+        use, duty cycle) — the TPU runtime is only visible there."""
+        if not self._chip_stats_file or not os.path.exists(
+            self._chip_stats_file
+        ):
+            return []
+        try:
+            with open(self._chip_stats_file) as f:
+                data = json.load(f)
+            return data if isinstance(data, list) else [data]
+        except (OSError, ValueError):
+            return []
+
+    def _loop(self):
+        while not self._stopped.wait(self._interval):
+            try:
+                self._client.report_resource_stats(
+                    cpu_percent=get_process_cpu_percent(),
+                    memory_mb=get_used_memory_mb(),
+                    tpu_stats=self._read_chip_stats(),
+                )
+            except ConnectionError as e:
+                logger.warning("resource report failed: %s", e)
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="resource-monitor", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stopped.set()
+
+
+class HeartbeatReporter:
+    """Agent heartbeat so the master can detect dead nodes
+    (reference ``dist_job_manager.py:340`` heartbeat monitor)."""
+
+    def __init__(
+        self, client: Optional[MasterClient] = None, interval: float = 15.0
+    ):
+        self._client = client or MasterClient.singleton_instance()
+        self._interval = interval
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+
+    def _loop(self):
+        while not self._stopped.wait(self._interval):
+            try:
+                self._client.report_heartbeat(time.time())
+            except ConnectionError as e:
+                logger.warning("heartbeat failed: %s", e)
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="heartbeat", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stopped.set()
+
+
+class TrainingMonitor:
+    """Reports the training global step to the master's SpeedMonitor by
+    watching the step file the trainer writes (reference
+    ``TorchTrainingMonitor`` ``monitor/training.py:77``)."""
+
+    def __init__(
+        self,
+        step_file: str,
+        client: Optional[MasterClient] = None,
+        interval: float = 15.0,
+    ):
+        self._step_file = step_file
+        self._client = client or MasterClient.singleton_instance()
+        self._interval = interval
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+        self._last_step = -1
+
+    def _loop(self):
+        while not self._stopped.wait(self._interval):
+            try:
+                if not os.path.exists(self._step_file):
+                    continue
+                with open(self._step_file) as f:
+                    data = json.load(f)
+                step = int(data.get("step", -1))
+                ts = float(data.get("timestamp", time.time()))
+                if step > self._last_step:
+                    self._last_step = step
+                    self._client.report_global_step(step, ts)
+            except (OSError, ValueError):
+                continue
+            except ConnectionError as e:
+                logger.warning("step report failed: %s", e)
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="training-monitor", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stopped.set()

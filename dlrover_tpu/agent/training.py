@@ -1,0 +1,466 @@
+"""The per-node elastic agent: rendezvous, worker lifecycle, failover.
+
+Reference parity: ``dlrover/python/elastic_agent/torch/training.py`` —
+``ElasticLaunchConfig:118``, ``MasterRendezvousHandler:181``,
+``ElasticTrainingAgent:364`` (``_invoke_run:582`` monitor loop,
+``_initialize_workers:547``, restart-on-membership-change ``:716``),
+``launch_agent:776`` and the node-check agent ``:906``.
+
+TPU-native redesign: instead of torchelastic's C10d store handing out
+MASTER_ADDR/MASTER_PORT, the rank-0 agent publishes a
+``jax.distributed`` coordinator address through the master KV store and
+each training process calls ``jax.distributed.initialize`` with the
+world assembled by the master's rendezvous (SURVEY.md §2.9).  Because
+JAX cannot change process count in-place, every re-mesh fully restarts
+the training processes — the same behavior the reference exhibits on
+membership change (``training.py:646-648``); a persistent XLA
+compilation cache keeps the restart cheap.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.constants import (
+    NodeEnv,
+    RendezvousConstant,
+    RendezvousName,
+    TrainingExceptionLevel,
+)
+from dlrover_tpu.common.log import default_logger as logger
+
+
+def find_free_port(host: str = "") -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+@dataclass
+class ElasticLaunchConfig:
+    """Launch flags (reference ``ElasticLaunchConfig`` ``training.py:118``)."""
+
+    min_nodes: int = 1
+    max_nodes: int = 1
+    nproc_per_node: int = 1
+    rdzv_timeout: int = RendezvousConstant.MAX_WAIT_SECS
+    node_unit: int = 1
+    network_check: bool = False
+    comm_perf_test: bool = False
+    max_restarts: int = 3
+    monitor_interval: float = 5.0
+    node_rank: int = field(
+        default_factory=lambda: int(os.getenv(NodeEnv.NODE_RANK, "0"))
+    )
+    # extra env vars injected into every training process
+    envs: Dict[str, str] = field(default_factory=dict)
+    # persistent XLA compilation cache keeps post-restart warmup cheap
+    compile_cache_dir: str = ""
+
+    def auto_configure_params(self):
+        """Fill nproc from local device count when unset (reference
+        ``auto_configure_params`` ``training.py:155``)."""
+        if self.nproc_per_node <= 0:
+            self.nproc_per_node = 1
+        if self.max_nodes < self.min_nodes:
+            self.max_nodes = self.min_nodes
+
+
+class WorkerState:
+    INIT = "INIT"
+    HEALTHY = "HEALTHY"
+    FAILED = "FAILED"
+    SUCCEEDED = "SUCCEEDED"
+
+
+@dataclass
+class RunResult:
+    state: str = WorkerState.INIT
+    failed_ranks: List[int] = field(default_factory=list)
+    return_codes: Dict[int, int] = field(default_factory=dict)
+
+
+class MasterRendezvousHandler:
+    """Master-backed rendezvous (reference ``training.py:181``).
+
+    ``next_rendezvous`` joins the master round, polls until the master
+    declares the world complete, and returns
+    ``(round, rank, world_size, world)`` where ``world`` maps
+    node_rank -> local_world_size for every participating node.
+    """
+
+    def __init__(
+        self,
+        client: MasterClient,
+        node_rank: int,
+        local_world_size: int,
+        rdzv_name: str = RendezvousName.ELASTIC_TRAINING,
+        timeout: float = RendezvousConstant.MAX_WAIT_SECS,
+        poll_interval: float = 0.3,
+    ):
+        self._client = client
+        self._node_rank = node_rank
+        self._local_world_size = local_world_size
+        self._rdzv_name = rdzv_name
+        self._timeout = timeout
+        self._poll = poll_interval
+
+    def next_rendezvous(self):
+        rdzv_round = self._client.join_rendezvous(
+            self._node_rank, self._local_world_size, self._rdzv_name
+        )
+        logger.info(
+            "node %d joined %s rendezvous round %d",
+            self._node_rank,
+            self._rdzv_name,
+            rdzv_round,
+        )
+        deadline = time.time() + self._timeout
+        while time.time() < deadline:
+            rnd, group, world = self._client.get_comm_world(
+                self._rdzv_name, self._node_rank
+            )
+            if world:
+                if self._node_rank not in world:
+                    raise NodeExcludedError(
+                        f"node {self._node_rank} excluded from round {rnd}"
+                    )
+                return rnd, group, world
+            time.sleep(self._poll)
+        raise TimeoutError(
+            f"rendezvous {self._rdzv_name!r} timed out after {self._timeout}s"
+        )
+
+
+class NodeExcludedError(RuntimeError):
+    """The master left this node out of the comm world (fault/straggler)."""
+
+
+class ElasticTrainingAgent:
+    """Spawns and supervises the node's training processes.
+
+    The monitor loop (reference ``_invoke_run`` ``training.py:582``):
+
+    - any proc FAILED  -> report to master, flush shm ckpt, restart
+    - all procs done   -> SUCCEEDED, exit
+    - master says new nodes waiting -> flush shm ckpt, restart (re-mesh)
+    """
+
+    def __init__(
+        self,
+        config: ElasticLaunchConfig,
+        entrypoint: Sequence[str],
+        client: Optional[MasterClient] = None,
+        start_ckpt_saver: bool = True,
+    ):
+        self._config = config
+        self._entrypoint = list(entrypoint)
+        self._client = client or MasterClient.singleton_instance()
+        self._node_rank = config.node_rank
+        self._procs: List[subprocess.Popen] = []
+        self._restart_count = 0
+        self._remaining_restarts = config.max_restarts
+        self._start_ckpt_saver = start_ckpt_saver
+        self._coordinator_port = find_free_port()
+        self._stopped = False
+
+    # ------------------------------------------------------------- workers
+    def _rendezvous(self):
+        handler = MasterRendezvousHandler(
+            self._client,
+            self._node_rank,
+            self._config.nproc_per_node,
+            timeout=self._config.rdzv_timeout,
+        )
+        rnd, _group, world = handler.next_rendezvous()
+        return rnd, world
+
+    def _assign_worker_ranks(self, world: Dict[int, int]):
+        """Global process ranks from the sorted node world (reference
+        ``_assign_worker_ranks`` ``training.py:486``)."""
+        sorted_nodes = sorted(world)
+        world_size = sum(world.values())
+        rank_offset = 0
+        for nr in sorted_nodes:
+            if nr == self._node_rank:
+                break
+            rank_offset += world[nr]
+        num_processes = world_size
+        process_ids = list(
+            range(rank_offset, rank_offset + world[self._node_rank])
+        )
+        node_index = sorted_nodes.index(self._node_rank)
+        return world_size, num_processes, process_ids, node_index
+
+    def _publish_coordinator(self, rdzv_round: int, is_first_node: bool):
+        """Rank-0 node publishes the jax.distributed coordinator address
+        via the master KV store; everyone else waits for it.
+
+        This replaces the reference's ``MasterKVStore`` MASTER_ADDR /
+        MASTER_PORT exchange (``master_kv_store.py``, ``training.py:252``).
+        """
+        key = f"jax_coordinator/{rdzv_round}"
+        if is_first_node:
+            host = os.getenv(
+                "DLROVER_TPU_HOST_IP", socket.gethostbyname(socket.gethostname())
+            )
+            addr = f"{host}:{self._coordinator_port}"
+            self._client.kv_store_set(key, addr.encode())
+            return addr
+        return self._client.kv_store_wait(
+            key, timeout=self._config.rdzv_timeout
+        ).decode()
+
+    def _worker_env(
+        self,
+        rdzv_round: int,
+        coordinator: str,
+        world_size: int,
+        process_rank: int,
+        local_rank: int,
+    ) -> Dict[str, str]:
+        env = dict(os.environ)
+        env.update(self._config.envs)
+        env.update(
+            {
+                NodeEnv.MASTER_ADDR: self._client.addr,
+                NodeEnv.NODE_RANK: str(self._node_rank),
+                NodeEnv.PROCESS_RANK: str(process_rank),
+                NodeEnv.PROCESS_COUNT: str(world_size),
+                NodeEnv.LOCAL_RANK: str(local_rank),
+                NodeEnv.LOCAL_PROCESS_COUNT: str(
+                    self._config.nproc_per_node
+                ),
+                NodeEnv.COORDINATOR_ADDR: coordinator,
+                "DLROVER_TPU_RDZV_ROUND": str(rdzv_round),
+                "DLROVER_TPU_RESTART_COUNT": str(self._restart_count),
+            }
+        )
+        if self._config.compile_cache_dir:
+            env.setdefault(
+                "JAX_COMPILATION_CACHE_DIR", self._config.compile_cache_dir
+            )
+        return env
+
+    def _initialize_workers(self) -> bool:
+        """One rendezvous round + process spawn. Returns False when the
+        master excluded this node."""
+        if self._config.network_check:
+            self._run_network_check()
+        try:
+            rdzv_round, world = self._rendezvous()
+        except NodeExcludedError as e:
+            logger.error("%s", e)
+            return False
+        (
+            world_size,
+            _num,
+            process_ids,
+            node_index,
+        ) = self._assign_worker_ranks(world)
+        coordinator = self._publish_coordinator(rdzv_round, node_index == 0)
+        logger.info(
+            "round %d: world_size=%d coordinator=%s local ranks=%s",
+            rdzv_round,
+            world_size,
+            coordinator,
+            process_ids,
+        )
+        self._procs = []
+        for local_rank, process_rank in enumerate(process_ids):
+            env = self._worker_env(
+                rdzv_round, coordinator, world_size, process_rank, local_rank
+            )
+            proc = subprocess.Popen(  # noqa: S603
+                self._entrypoint, env=env
+            )
+            self._procs.append(proc)
+        return True
+
+    # ------------------------------------------------------------- monitor
+    def _monitor_workers(self) -> RunResult:
+        result = RunResult(state=WorkerState.HEALTHY)
+        codes: Dict[int, int] = {}
+        running = 0
+        for local_rank, proc in enumerate(self._procs):
+            rc = proc.poll()
+            if rc is None:
+                running += 1
+            else:
+                codes[local_rank] = rc
+                if rc != 0:
+                    result.failed_ranks.append(local_rank)
+        result.return_codes = codes
+        if result.failed_ranks:
+            result.state = WorkerState.FAILED
+        elif running == 0:
+            result.state = WorkerState.SUCCEEDED
+        return result
+
+    def _membership_changed(self) -> bool:
+        try:
+            waiting = self._client.num_nodes_waiting()
+        except ConnectionError:
+            return False
+        node_unit = max(self._config.node_unit, 1)
+        return waiting > 0 and waiting % node_unit == 0
+
+    def _stop_workers(self, timeout: float = 15.0):
+        for proc in self._procs:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        deadline = time.time() + timeout
+        for proc in self._procs:
+            remaining = max(deadline - time.time(), 0.1)
+            try:
+                proc.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+        self._procs = []
+
+    def _save_ckpt_to_storage(self, reason: str):
+        """Flush the latest shm checkpoint snapshot before killing
+        workers (reference ``_save_ckpt_to_storage`` ``training.py:670``)."""
+        saver = AsyncCheckpointSaver.get_ckpt_saver()
+        if saver is not None:
+            try:
+                saver.save_shm_to_storage(reason=reason)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("breakpoint ckpt flush failed: %s", e)
+
+    def _restart_workers(self, reason: str) -> bool:
+        if self._remaining_restarts <= 0:
+            logger.error("restart budget exhausted (%s)", reason)
+            return False
+        self._remaining_restarts -= 1
+        self._restart_count += 1
+        logger.info(
+            "restarting workers (%s); %d restarts left",
+            reason,
+            self._remaining_restarts,
+        )
+        self._save_ckpt_to_storage(reason)
+        self._stop_workers()
+        return self._initialize_workers()
+
+    def _report_failure(self, result: RunResult):
+        try:
+            self._client.report_failure(
+                error_data=str(result.return_codes),
+                restart_count=self._restart_count,
+                level=TrainingExceptionLevel.PROCESS_ERROR,
+            )
+        except ConnectionError as e:
+            logger.warning("failed reporting failure to master: %s", e)
+
+    def _run_network_check(self):
+        """Pre-flight node health check round (reference
+        ``run_network_check`` ``training.py:1154``)."""
+        with tempfile.NamedTemporaryFile(
+            prefix="node_check_", suffix=".txt", delete=False
+        ) as f:
+            result_file = f.name
+        env = dict(os.environ)
+        env["DLROVER_TPU_NODE_CHECK_RESULT_FILE"] = result_file
+        handler = MasterRendezvousHandler(
+            self._client,
+            self._node_rank,
+            self._config.nproc_per_node,
+            rdzv_name=RendezvousName.NETWORK_CHECK,
+            timeout=self._config.rdzv_timeout,
+        )
+        try:
+            handler.next_rendezvous()
+        except (TimeoutError, NodeExcludedError) as e:
+            logger.warning("network-check rendezvous failed: %s", e)
+            return
+        proc = subprocess.Popen(  # noqa: S603
+            [sys.executable, "-m", "dlrover_tpu.agent.node_check"], env=env
+        )
+        rc = proc.wait(timeout=300)
+        elapsed = -1.0
+        if rc == 0:
+            try:
+                with open(result_file) as f:
+                    elapsed = float(f.read().strip())
+            except (OSError, ValueError):
+                pass
+        os.unlink(result_file)
+        self._client.report_network_status(
+            self._node_rank, succeeded=(rc == 0), elapsed_time=elapsed
+        )
+        if rc != 0:
+            raise RuntimeError(
+                f"node {self._node_rank} failed the health check"
+            )
+
+    # ----------------------------------------------------------------- run
+    def run(self) -> int:
+        """Agent main loop. Returns a process exit code."""
+        factory_queue = None
+        if self._start_ckpt_saver:
+            factory_queue = AsyncCheckpointSaver.start_async_saving_ckpt()
+        try:
+            return self._invoke_run()
+        finally:
+            self._stopped = True
+            self._stop_workers()
+            if factory_queue is not None:
+                factory_queue.close()
+                AsyncCheckpointSaver.reset()
+
+    def _invoke_run(self) -> int:
+        if not self._initialize_workers():
+            return 1
+        while True:
+            time.sleep(self._config.monitor_interval)
+            result = self._monitor_workers()
+            if result.state == WorkerState.SUCCEEDED:
+                logger.info("all workers finished successfully")
+                try:
+                    self._client.report_succeeded()
+                except ConnectionError:
+                    pass
+                return 0
+            if result.state == WorkerState.FAILED:
+                logger.error(
+                    "worker failure: local ranks %s codes %s",
+                    result.failed_ranks,
+                    result.return_codes,
+                )
+                self._report_failure(result)
+                if not self._restart_workers("worker failure"):
+                    return 1
+                continue
+            # HEALTHY: elastic re-mesh when new nodes wait at the master
+            if self._membership_changed():
+                if not self._restart_workers("membership change"):
+                    return 1
+
+
+def launch_agent(
+    config: ElasticLaunchConfig,
+    entrypoint: Sequence[str],
+    master_addr: str = "",
+) -> int:
+    """Build the client + agent and run (reference ``launch_agent``
+    ``training.py:776``)."""
+    config.auto_configure_params()
+    client = MasterClient.singleton_instance(master_addr)
+    client.report_rdzv_params(
+        config.min_nodes,
+        config.max_nodes,
+        config.rdzv_timeout,
+        config.node_unit,
+    )
+    agent = ElasticTrainingAgent(config, entrypoint, client=client)
+    return agent.run()

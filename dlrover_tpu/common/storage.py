@@ -66,6 +66,11 @@ class CheckpointStorage(metaclass=ABCMeta):
     def write(self, content, path: str):
         ...
 
+    def write_chunks(self, chunks, path: str):
+        """Write a sequence of byte-like chunks as one file. Default
+        joins in memory; byte-addressable backends should stream."""
+        self.write(b"".join(bytes(c) for c in chunks), path)
+
     @abstractmethod
     def read(self, path: str, mode: str = "r"):
         ...
@@ -113,6 +118,14 @@ class PosixDiskStorage(CheckpointStorage):
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         with open(path, mode) as f:
             f.write(content)
+            f.flush()
+            os.fsync(f.fileno())
+
+    def write_chunks(self, chunks, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "wb") as f:
+            for chunk in chunks:
+                f.write(chunk)
             f.flush()
             os.fsync(f.fileno())
 

@@ -31,7 +31,6 @@ class SpeedMonitor:
         self._target_worker_num = 0
         self._init_time = time.time()
         self._start_training_time = 0.0
-        self._sample_count = 0
 
     def set_target_worker_num(self, worker_num: int):
         self._target_worker_num = worker_num
@@ -64,7 +63,6 @@ class SpeedMonitor:
             if not self._start_training_time:
                 self._start_training_time = time.time()
             self._global_step = global_step
-            self._sample_count += 1
             self._global_step_records.append(
                 GlobalStepRecord(
                     global_step, timestamp, len(self._workers)
@@ -126,7 +124,7 @@ class SpeedMonitor:
         ``all_running_node_hanged`` + task hang for the same reason)."""
         hang_secs = hang_secs or _ctx.hang_detection_secs
         with self._lock:
-            if self._sample_count == 0:
+            if not self._global_step_records:
                 return False
             last = self._global_step_records[-1]
             return time.time() - last.timestamp > hang_secs

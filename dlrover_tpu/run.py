@@ -1,0 +1,12 @@
+"""``python -m dlrover_tpu.run`` — console entry for the elastic launcher.
+
+Reference parity: the ``dlrover-run`` console script
+(``dlrover/setup.py:57-59`` → ``dlrover/trainer/torch/main.py``).
+"""
+
+import sys
+
+from dlrover_tpu.trainer.elastic_run import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,9 +38,29 @@ from dlrover_tpu.agent.ckpt_shm import (
 
 
 def _agent_factory_queue_exists() -> bool:
+    """True only if an agent is actually listening — a stale socket
+    file from a SIGKILLed agent must not make the standalone path
+    block on a dead queue."""
+    import socket as _socket
+
     from dlrover_tpu.common.multi_process import _socket_path
 
-    return os.path.exists(_socket_path("queue_" + FACTORY_QUEUE))
+    path = _socket_path("queue_" + FACTORY_QUEUE)
+    if not os.path.exists(path):
+        return False
+    probe = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+    try:
+        probe.settimeout(2.0)
+        probe.connect(path)
+        return True
+    except OSError:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return False
+    finally:
+        probe.close()
 
 
 class CheckpointEngine:
@@ -63,7 +83,6 @@ class CheckpointEngine:
         self._name = name
         self._storage = storage or get_checkpoint_storage()
         self._local_saver: Optional[AsyncCheckpointSaver] = None
-        self._cached_step = -1
 
         # the saver serves shm/lock endpoints for global ranks
         # [node_rank*local_shard_num, ...); this process's rank must be
@@ -121,7 +140,6 @@ class CheckpointEngine:
             nbytes = self._shm_handler.save_state(step, state)
         finally:
             self._lock.release()
-        self._cached_step = step
         logger.info(
             "rank %s: step %s snapshot (%.1f MB) to shm in %.3fs",
             self._rank, step, nbytes / 1e6, time.time() - start,

@@ -1,0 +1,11 @@
+from dlrover_tpu.trainer.elastic.context import (  # noqa: F401
+    ElasticContext,
+    init_distributed,
+    local_rank,
+    process_count,
+    process_rank,
+)
+from dlrover_tpu.trainer.elastic.sampler import (  # noqa: F401
+    ElasticDistributedSampler,
+)
+from dlrover_tpu.trainer.elastic.trainer import ElasticTrainer  # noqa: F401

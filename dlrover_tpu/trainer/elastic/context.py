@@ -1,0 +1,98 @@
+"""Training-process bootstrap: ``jax.distributed`` from the agent's env.
+
+Reference parity: the torch side reads MASTER_ADDR/MASTER_PORT that the
+agent's ``MasterKVStore`` handed out (``elastic_agent/torch/training.py``);
+here the agent exports ``DLROVER_TPU_COORDINATOR_ADDR`` /
+``PROCESS_RANK`` / ``PROCESS_COUNT`` (see
+``dlrover_tpu.agent.training._worker_env``) and the trainer calls
+``jax.distributed.initialize`` with them — device discovery replaces
+NCCL init (SURVEY.md §2.9).
+"""
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import default_logger as logger
+
+
+def process_rank() -> int:
+    return int(os.getenv(NodeEnv.PROCESS_RANK, "0"))
+
+
+def process_count() -> int:
+    return int(os.getenv(NodeEnv.PROCESS_COUNT, "1"))
+
+
+def local_rank() -> int:
+    return int(os.getenv(NodeEnv.LOCAL_RANK, "0"))
+
+
+def node_rank() -> int:
+    return int(os.getenv(NodeEnv.NODE_RANK, "0"))
+
+
+def restart_count() -> int:
+    return int(os.getenv("DLROVER_TPU_RESTART_COUNT", "0"))
+
+
+@dataclass
+class ElasticContext:
+    rank: int
+    world_size: int
+    local_rank: int
+    node_rank: int
+    restart_count: int
+    coordinator_addr: str
+    master_addr: str
+
+
+_context: Optional[ElasticContext] = None
+
+
+def init_distributed(initialize_jax: bool = True) -> ElasticContext:
+    """Initialize multi-process JAX from the agent-provided env.
+
+    Safe to call when launched standalone (single process, no
+    coordinator): it becomes a no-op world of size 1.
+    """
+    global _context
+    if _context is not None:
+        return _context
+    rank = process_rank()
+    world = process_count()
+    coord = os.getenv(NodeEnv.COORDINATOR_ADDR, "")
+    if initialize_jax and world > 1 and coord:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=world,
+            process_id=rank,
+        )
+        logger.info(
+            "jax.distributed initialized: rank %d/%d via %s",
+            rank,
+            world,
+            coord,
+        )
+    _context = ElasticContext(
+        rank=rank,
+        world_size=world,
+        local_rank=local_rank(),
+        node_rank=node_rank(),
+        restart_count=restart_count(),
+        coordinator_addr=coord,
+        master_addr=os.getenv(NodeEnv.MASTER_ADDR, ""),
+    )
+    return _context
+
+
+def get_context() -> Optional[ElasticContext]:
+    return _context
+
+
+def reset_context():
+    global _context
+    _context = None

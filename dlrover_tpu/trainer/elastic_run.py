@@ -1,0 +1,220 @@
+"""``dlrover-tpu-run`` — the elastic launcher CLI (torchrun analog).
+
+Reference parity: ``dlrover/trainer/torch/elastic_run.py`` —
+``parse_args:125``, auto-launch of a local master on the rank-0 node
+``:245``, reachability check + standalone fallback ``:335``, ``run:351``
+and ``main:399``.
+
+Usage::
+
+    python -m dlrover_tpu.run --nnodes=1:4 --nproc_per_node=1 \
+        [--network-check] [--max-restarts=3] train.py --flag ...
+
+The launcher starts (on node rank 0, when no master address is set) a
+local job master subprocess, then runs the per-node
+``ElasticTrainingAgent`` which spawns/monitors ``nproc_per_node``
+training processes wired up for ``jax.distributed.initialize``.
+"""
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+import time
+from typing import List, Optional, Tuple
+
+from dlrover_tpu.agent.training import (
+    ElasticLaunchConfig,
+    launch_agent,
+)
+from dlrover_tpu.common.comm import addr_connectable
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.env import get_free_port
+from dlrover_tpu.common.log import default_logger as logger
+
+
+def parse_nnodes(value: str) -> Tuple[int, int]:
+    if ":" in value:
+        lo, hi = value.split(":", 1)
+        return int(lo), int(hi)
+    n = int(value)
+    return n, n
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="dlrover-tpu-run", description="elastic TPU training launcher"
+    )
+    parser.add_argument(
+        "--nnodes", default="1", help="N or MIN:MAX node range"
+    )
+    parser.add_argument("--nproc_per_node", type=int, default=1)
+    parser.add_argument(
+        "--master_addr",
+        default="",
+        help="job master host:port; empty = auto (env, then local spawn)",
+    )
+    parser.add_argument("--node_rank", type=int, default=-1)
+    parser.add_argument("--max_restarts", type=int, default=3)
+    parser.add_argument("--node_unit", type=int, default=1)
+    parser.add_argument("--rdzv_timeout", type=int, default=600)
+    parser.add_argument("--monitor_interval", type=float, default=3.0)
+    parser.add_argument(
+        "--network-check",
+        "--network_check",
+        dest="network_check",
+        action="store_true",
+        help="run a chip/ICI health check round before training",
+    )
+    parser.add_argument(
+        "--standalone",
+        action="store_true",
+        help="single-node without any master (plain spawn)",
+    )
+    parser.add_argument(
+        "--compile_cache_dir",
+        default=os.getenv("JAX_COMPILATION_CACHE_DIR", ""),
+        help="persistent XLA compile cache (keeps restarts cheap)",
+    )
+    parser.add_argument("training_script", help="script or -m module")
+    parser.add_argument(
+        "training_script_args", nargs=argparse.REMAINDER
+    )
+    return parser.parse_args(argv)
+
+
+def _launch_local_master(node_num: int) -> Tuple[subprocess.Popen, str]:
+    """Spawn ``python -m dlrover_tpu.master.main`` and parse its address
+    line (reference ``_launch_dlrover_local_master`` ``elastic_run.py:245``)."""
+    port = get_free_port()
+    proc = subprocess.Popen(  # noqa: S603
+        [
+            sys.executable,
+            "-m",
+            "dlrover_tpu.master.main",
+            "--platform",
+            "local",
+            "--port",
+            str(port),
+            "--node_num",
+            str(node_num),
+        ],
+        stdout=subprocess.PIPE,
+        stderr=None,
+        text=True,
+    )
+    addr = f"127.0.0.1:{port}"
+    deadline = time.time() + 30
+    pattern = re.compile(r"DLROVER_TPU_MASTER_ADDR=(\S+)")
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError("local master exited during startup")
+        line = proc.stdout.readline()
+        m = pattern.search(line or "")
+        if m:
+            addr = m.group(1)
+            break
+    # stop consuming stdout; master logs go to stderr
+    return proc, addr
+
+
+def _wait_master(addr: str, timeout: float = 60.0) -> bool:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if addr_connectable(addr):
+            return True
+        time.sleep(0.5)
+    return False
+
+
+def _build_entrypoint(args) -> List[str]:
+    script_args = list(args.training_script_args)
+    if args.training_script == "-m":
+        if not script_args:
+            raise SystemExit("-m requires a module name")
+        return [sys.executable, "-m", *script_args]
+    return [sys.executable, args.training_script, *script_args]
+
+
+def run(args) -> int:
+    min_nodes, max_nodes = parse_nnodes(args.nnodes)
+    node_rank = args.node_rank
+    if node_rank < 0:
+        node_rank = int(os.getenv(NodeEnv.NODE_RANK, "0"))
+
+    entrypoint = _build_entrypoint(args)
+
+    if args.standalone:
+        # no master / agent: spawn procs directly with local coordinator
+        return _run_standalone(args, entrypoint)
+
+    master_addr = args.master_addr or os.getenv(NodeEnv.MASTER_ADDR, "")
+    master_proc: Optional[subprocess.Popen] = None
+    if not master_addr:
+        if node_rank != 0:
+            raise SystemExit(
+                "no master address: set --master_addr or "
+                f"${NodeEnv.MASTER_ADDR} on non-zero node ranks"
+            )
+        master_proc, master_addr = _launch_local_master(max_nodes)
+        logger.info("launched local master at %s", master_addr)
+    if not _wait_master(master_addr):
+        raise SystemExit(f"master at {master_addr} is unreachable")
+
+    os.environ[NodeEnv.MASTER_ADDR] = master_addr
+    os.environ[NodeEnv.NODE_RANK] = str(node_rank)
+
+    config = ElasticLaunchConfig(
+        min_nodes=min_nodes,
+        max_nodes=max_nodes,
+        nproc_per_node=args.nproc_per_node,
+        rdzv_timeout=args.rdzv_timeout,
+        node_unit=args.node_unit,
+        network_check=args.network_check,
+        max_restarts=args.max_restarts,
+        monitor_interval=args.monitor_interval,
+        node_rank=node_rank,
+        compile_cache_dir=args.compile_cache_dir,
+    )
+    try:
+        return launch_agent(config, entrypoint, master_addr)
+    finally:
+        if master_proc is not None and master_proc.poll() is None:
+            master_proc.terminate()
+            try:
+                master_proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                master_proc.kill()
+
+
+def _run_standalone(args, entrypoint: List[str]) -> int:
+    """Plain local spawn without elasticity (reference falls back to
+    vanilla torchrun — ``elastic_run.py:335``)."""
+    nproc = args.nproc_per_node
+    coord = f"127.0.0.1:{get_free_port()}"
+    procs = []
+    for rank in range(nproc):
+        env = dict(os.environ)
+        env.update(
+            {
+                NodeEnv.PROCESS_RANK: str(rank),
+                NodeEnv.PROCESS_COUNT: str(nproc),
+                NodeEnv.LOCAL_RANK: str(rank),
+                NodeEnv.LOCAL_PROCESS_COUNT: str(nproc),
+                NodeEnv.COORDINATOR_ADDR: coord,
+            }
+        )
+        procs.append(subprocess.Popen(entrypoint, env=env))  # noqa: S603
+    rc = 0
+    for proc in procs:
+        rc = proc.wait() or rc
+    return rc
+
+
+def main(argv=None) -> int:
+    return run(parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,185 @@
+"""Elastic-agent tests against a real LocalJobMaster over gRPC.
+
+Mirrors the reference's strategy (SURVEY.md §4): a real agent with a
+real master on a free port; worker processes are tiny generated
+scripts, faults are injected by exit codes.
+"""
+
+import os
+import sys
+import textwrap
+import time
+
+import pytest
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.training import (
+    ElasticLaunchConfig,
+    ElasticTrainingAgent,
+    MasterRendezvousHandler,
+)
+from dlrover_tpu.common.comm import MasterChannel
+from dlrover_tpu.common.constants import NodeEnv, NodeType
+from dlrover_tpu.common.env import get_free_port
+from dlrover_tpu.master.master import LocalJobMaster
+from dlrover_tpu.trainer.sharding import IndexShardingClient, ShardingClient
+
+
+@pytest.fixture
+def master():
+    port = get_free_port()
+    m = LocalJobMaster(port, node_num=1)
+    m.prepare()
+    yield m
+    m.stop()
+
+
+@pytest.fixture
+def client(master):
+    MasterClient.reset()
+    c = MasterClient.singleton_instance(master.addr, node_id=0)
+    yield c
+    MasterClient.reset()
+
+
+def _write_script(tmp_path, body: str) -> str:
+    path = tmp_path / "worker.py"
+    path.write_text(textwrap.dedent(body))
+    return str(path)
+
+
+class TestMasterClient:
+    def test_kv_store_roundtrip(self, client):
+        assert client.kv_store_set("k1", b"v1")
+        assert client.kv_store_get("k1") == b"v1"
+        assert client.kv_store_wait("k1") == b"v1"
+
+    def test_rendezvous_single_node(self, client):
+        client.report_rdzv_params(1, 1, 60, 1)
+        rnd = client.join_rendezvous(0, local_world_size=2)
+        assert rnd >= 0
+        handler = MasterRendezvousHandler(client, 0, 2, timeout=10)
+        rnd, group, world = handler.next_rendezvous()
+        assert world == {0: 2}
+
+    def test_metrics_reports(self, client):
+        assert client.report_global_step(10)
+        assert client.report_resource_stats(12.0, 1024, [])
+        assert client.report_heartbeat()
+        assert client.report_model_info(num_params=100)
+
+
+class TestShardingClient:
+    def test_shard_flow(self, client):
+        sc = ShardingClient(
+            "ds", batch_size=4, dataset_size=16, client=client
+        )
+        shards = []
+        for shard in sc.iter_shards():
+            shards.append(shard)
+            sc.report_batch_done()
+        assert sum(s.end - s.start for s in shards) == 16
+
+    def test_index_client(self, client):
+        sc = IndexShardingClient(
+            "ds_idx",
+            batch_size=4,
+            dataset_size=8,
+            client=client,
+        )
+        seen = []
+        while True:
+            idx = sc.fetch_sample_index()
+            if idx is None:
+                break
+            seen.append(idx)
+            sc.report_sample_consumed()
+        assert sorted(seen) == list(range(8))
+
+
+class TestElasticAgent:
+    def _agent(self, client, script, **kw):
+        config = ElasticLaunchConfig(
+            min_nodes=1,
+            max_nodes=1,
+            nproc_per_node=kw.pop("nproc", 2),
+            monitor_interval=0.2,
+            max_restarts=kw.pop("max_restarts", 1),
+            node_rank=0,
+            rdzv_timeout=30,
+        )
+        client.report_rdzv_params(1, 1, 30, 1)
+        return ElasticTrainingAgent(
+            config,
+            [sys.executable, script],
+            client=client,
+            start_ckpt_saver=False,
+        )
+
+    def test_successful_run(self, client, tmp_path):
+        script = _write_script(
+            tmp_path,
+            """
+            import os, sys
+            rank = int(os.environ["DLROVER_TPU_PROCESS_RANK"])
+            world = int(os.environ["DLROVER_TPU_PROCESS_COUNT"])
+            assert world == 2
+            assert os.environ["DLROVER_TPU_COORDINATOR_ADDR"]
+            sys.exit(0)
+            """,
+        )
+        agent = self._agent(client, script)
+        assert agent.run() == 0
+
+    def test_failed_worker_restarts_then_gives_up(self, client, tmp_path):
+        marker = tmp_path / "attempts"
+        script = _write_script(
+            tmp_path,
+            f"""
+            import os, sys
+            with open({str(marker)!r}, "a") as f:
+                f.write("x")
+            sys.exit(3)
+            """,
+        )
+        agent = self._agent(client, script, nproc=1, max_restarts=1)
+        assert agent.run() == 1
+        # initial attempt + 1 restart
+        assert marker.read_text() == "xx"
+
+    def test_restart_recovers(self, client, tmp_path):
+        # fails on the first incarnation, succeeds on the restart
+        script = _write_script(
+            tmp_path,
+            """
+            import os, sys
+            sys.exit(0 if int(os.environ["DLROVER_TPU_RESTART_COUNT"]) > 0
+                     else 5)
+            """,
+        )
+        agent = self._agent(client, script, nproc=1, max_restarts=2)
+        assert agent.run() == 0
+
+
+class TestElasticRunCLI:
+    def test_parse_nnodes(self):
+        from dlrover_tpu.trainer.elastic_run import parse_nnodes
+
+        assert parse_nnodes("4") == (4, 4)
+        assert parse_nnodes("1:8") == (1, 8)
+
+    def test_standalone_launch(self, tmp_path):
+        from dlrover_tpu.trainer import elastic_run
+
+        script = _write_script(
+            tmp_path,
+            """
+            import os, sys
+            sys.exit(0 if os.environ["DLROVER_TPU_PROCESS_COUNT"] == "2"
+                     else 1)
+            """,
+        )
+        args = elastic_run.parse_args(
+            ["--standalone", "--nproc_per_node=2", script]
+        )
+        assert elastic_run.run(args) == 0
