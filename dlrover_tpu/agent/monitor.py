@@ -1,4 +1,4 @@
-"""Agent-side monitors: node resources and training progress.
+"""Agent-side monitors: node resources, heartbeat, training progress.
 
 Reference parity: ``dlrover/python/elastic_agent/monitor/resource.py:86``
 (``ResourceMonitor``: psutil CPU/mem + per-accelerator stats reported to
@@ -35,9 +35,47 @@ def get_used_memory_mb() -> int:
     return int(psutil.virtual_memory().used / 1024 / 1024)
 
 
-class ResourceMonitor:
+class PeriodicReporter:
+    """Daemon-thread loop calling ``_tick`` every ``interval`` seconds;
+    master connectivity errors are logged, never fatal."""
+
+    name = "periodic-reporter"
+
+    def __init__(
+        self, client: Optional[MasterClient] = None, interval: float = 15.0
+    ):
+        self._client = client or MasterClient.singleton_instance()
+        self._interval = interval
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+
+    def _tick(self):
+        raise NotImplementedError
+
+    def _loop(self):
+        while not self._stopped.wait(self._interval):
+            try:
+                self._tick()
+            except ConnectionError as e:
+                logger.warning("%s report failed: %s", self.name, e)
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name=self.name, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stopped.set()
+
+
+class ResourceMonitor(PeriodicReporter):
     """Periodically reports host CPU/memory (+ optional chip stats file)
     to the master; feeds the autoscaler / resource optimizer."""
+
+    name = "resource-monitor"
 
     def __init__(
         self,
@@ -45,13 +83,10 @@ class ResourceMonitor:
         interval: float = 15.0,
         chip_stats_file: str = "",
     ):
-        self._client = client or MasterClient.singleton_instance()
-        self._interval = interval
+        super().__init__(client, interval)
         self._chip_stats_file = chip_stats_file or os.getenv(
             "DLROVER_TPU_CHIP_STATS_FILE", ""
         )
-        self._thread: Optional[threading.Thread] = None
-        self._stopped = threading.Event()
 
     def _read_chip_stats(self) -> List[dict]:
         """Chip stats dropped by the training process (device memory in
@@ -67,64 +102,30 @@ class ResourceMonitor:
         except (OSError, ValueError):
             return []
 
-    def _loop(self):
-        while not self._stopped.wait(self._interval):
-            try:
-                self._client.report_resource_stats(
-                    cpu_percent=get_process_cpu_percent(),
-                    memory_mb=get_used_memory_mb(),
-                    tpu_stats=self._read_chip_stats(),
-                )
-            except ConnectionError as e:
-                logger.warning("resource report failed: %s", e)
-
-    def start(self):
-        if self._thread is not None:
-            return
-        self._thread = threading.Thread(
-            target=self._loop, name="resource-monitor", daemon=True
+    def _tick(self):
+        self._client.report_resource_stats(
+            cpu_percent=get_process_cpu_percent(),
+            memory_mb=get_used_memory_mb(),
+            tpu_stats=self._read_chip_stats(),
         )
-        self._thread.start()
-
-    def stop(self):
-        self._stopped.set()
 
 
-class HeartbeatReporter:
+class HeartbeatReporter(PeriodicReporter):
     """Agent heartbeat so the master can detect dead nodes
     (reference ``dist_job_manager.py:340`` heartbeat monitor)."""
 
-    def __init__(
-        self, client: Optional[MasterClient] = None, interval: float = 15.0
-    ):
-        self._client = client or MasterClient.singleton_instance()
-        self._interval = interval
-        self._thread: Optional[threading.Thread] = None
-        self._stopped = threading.Event()
+    name = "heartbeat"
 
-    def _loop(self):
-        while not self._stopped.wait(self._interval):
-            try:
-                self._client.report_heartbeat(time.time())
-            except ConnectionError as e:
-                logger.warning("heartbeat failed: %s", e)
-
-    def start(self):
-        if self._thread is not None:
-            return
-        self._thread = threading.Thread(
-            target=self._loop, name="heartbeat", daemon=True
-        )
-        self._thread.start()
-
-    def stop(self):
-        self._stopped.set()
+    def _tick(self):
+        self._client.report_heartbeat(time.time())
 
 
-class TrainingMonitor:
+class TrainingMonitor(PeriodicReporter):
     """Reports the training global step to the master's SpeedMonitor by
     watching the step file the trainer writes (reference
     ``TorchTrainingMonitor`` ``monitor/training.py:77``)."""
+
+    name = "training-monitor"
 
     def __init__(
         self,
@@ -132,37 +133,22 @@ class TrainingMonitor:
         client: Optional[MasterClient] = None,
         interval: float = 15.0,
     ):
+        super().__init__(client, interval)
         self._step_file = step_file
-        self._client = client or MasterClient.singleton_instance()
-        self._interval = interval
-        self._thread: Optional[threading.Thread] = None
-        self._stopped = threading.Event()
         self._last_step = -1
 
-    def _loop(self):
-        while not self._stopped.wait(self._interval):
-            try:
-                if not os.path.exists(self._step_file):
-                    continue
-                with open(self._step_file) as f:
-                    data = json.load(f)
-                step = int(data.get("step", -1))
-                ts = float(data.get("timestamp", time.time()))
-                if step > self._last_step:
-                    self._last_step = step
-                    self._client.report_global_step(step, ts)
-            except (OSError, ValueError):
-                continue
-            except ConnectionError as e:
-                logger.warning("step report failed: %s", e)
-
-    def start(self):
-        if self._thread is not None:
+    def _tick(self):
+        if not os.path.exists(self._step_file):
             return
-        self._thread = threading.Thread(
-            target=self._loop, name="training-monitor", daemon=True
-        )
-        self._thread.start()
-
-    def stop(self):
-        self._stopped.set()
+        try:
+            with open(self._step_file) as f:
+                data = json.load(f)
+            step = int(data.get("step", -1))
+            ts = float(data.get("timestamp", time.time()))
+        except (OSError, ValueError):
+            return
+        if step > self._last_step:
+            # report first: a ConnectionError must not advance
+            # _last_step or the step would never be re-reported
+            self._client.report_global_step(step, ts)
+            self._last_step = step

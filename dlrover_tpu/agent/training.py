@@ -35,13 +35,8 @@ from dlrover_tpu.common.constants import (
     RendezvousName,
     TrainingExceptionLevel,
 )
+from dlrover_tpu.common.env import get_free_port
 from dlrover_tpu.common.log import default_logger as logger
-
-
-def find_free_port(host: str = "") -> int:
-    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
-        s.bind((host, 0))
-        return s.getsockname()[1]
 
 
 @dataclass
@@ -169,7 +164,7 @@ class ElasticTrainingAgent:
         self._restart_count = 0
         self._remaining_restarts = config.max_restarts
         self._start_ckpt_saver = start_ckpt_saver
-        self._coordinator_port = find_free_port()
+        self._coordinator_port = get_free_port()
         self._stopped = False
 
     # ------------------------------------------------------------- workers
@@ -260,13 +255,29 @@ class ElasticTrainingAgent:
         except NodeExcludedError as e:
             logger.error("%s", e)
             return False
+        except (TimeoutError, ConnectionError) as e:
+            logger.error("rendezvous failed: %s", e)
+            self._try_report_failure(
+                f"rendezvous: {e}", TrainingExceptionLevel.RDZV_ERROR
+            )
+            return False
         (
             world_size,
             _num,
             process_ids,
             node_index,
         ) = self._assign_worker_ranks(world)
-        coordinator = self._publish_coordinator(rdzv_round, node_index == 0)
+        try:
+            coordinator = self._publish_coordinator(
+                rdzv_round, node_index == 0
+            )
+        except (TimeoutError, ConnectionError) as e:
+            logger.error("coordinator exchange failed: %s", e)
+            self._try_report_failure(
+                f"coordinator exchange: {e}",
+                TrainingExceptionLevel.RDZV_ERROR,
+            )
+            return False
         logger.info(
             "round %d: world_size=%d coordinator=%s local ranks=%s",
             rdzv_round,
@@ -337,11 +348,18 @@ class ElasticTrainingAgent:
             except Exception as e:  # noqa: BLE001
                 logger.warning("breakpoint ckpt flush failed: %s", e)
 
-    def _restart_workers(self, reason: str) -> bool:
-        if self._remaining_restarts <= 0:
-            logger.error("restart budget exhausted (%s)", reason)
-            return False
-        self._remaining_restarts -= 1
+    def _restart_workers(
+        self, reason: str, consume_budget: bool = True
+    ) -> bool:
+        """Restart the local worker set.  Failure restarts consume the
+        budget; elastic re-mesh restarts (membership change) do not —
+        a healthy job that scales N times must not die on the N+1th
+        node join (torchelastic decrements only on failures)."""
+        if consume_budget:
+            if self._remaining_restarts <= 0:
+                logger.error("restart budget exhausted (%s)", reason)
+                return False
+            self._remaining_restarts -= 1
         self._restart_count += 1
         logger.info(
             "restarting workers (%s); %d restarts left",
@@ -353,11 +371,16 @@ class ElasticTrainingAgent:
         return self._initialize_workers()
 
     def _report_failure(self, result: RunResult):
+        self._try_report_failure(
+            str(result.return_codes), TrainingExceptionLevel.PROCESS_ERROR
+        )
+
+    def _try_report_failure(self, error_data: str, level: str):
         try:
             self._client.report_failure(
-                error_data=str(result.return_codes),
+                error_data=error_data,
                 restart_count=self._restart_count,
-                level=TrainingExceptionLevel.PROCESS_ERROR,
+                level=level,
             )
         except ConnectionError as e:
             logger.warning("failed reporting failure to master: %s", e)
@@ -386,7 +409,14 @@ class ElasticTrainingAgent:
         proc = subprocess.Popen(  # noqa: S603
             [sys.executable, "-m", "dlrover_tpu.agent.node_check"], env=env
         )
-        rc = proc.wait(timeout=300)
+        try:
+            rc = proc.wait(timeout=300)
+        except subprocess.TimeoutExpired:
+            # a wedged chip must not hang the agent: kill the payload
+            # and report the node unhealthy
+            proc.kill()
+            proc.wait()
+            rc = -1
         elapsed = -1.0
         if rc == 0:
             try:
@@ -443,7 +473,9 @@ class ElasticTrainingAgent:
                 continue
             # HEALTHY: elastic re-mesh when new nodes wait at the master
             if self._membership_changed():
-                if not self._restart_workers("membership change"):
+                if not self._restart_workers(
+                    "membership change", consume_budget=False
+                ):
                     return 1
 
 

@@ -1,0 +1,8 @@
+from dlrover_tpu.models.llama import (  # noqa: F401
+    LlamaConfig,
+    init_params,
+    forward,
+    loss_fn,
+    param_logical_axes,
+    count_params,
+)

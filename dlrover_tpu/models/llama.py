@@ -1,0 +1,307 @@
+"""Llama-family transformer, TPU-first functional JAX.
+
+Role parity: the reference accelerates user-supplied HF/Megatron models
+(``atorch`` injects FA/TP/MoE into them — SURVEY.md §2.6); a TPU
+framework must ship the model family itself.  This is the flagship:
+RMSNorm + RoPE + GQA + SwiGLU, bfloat16 activations, layers stacked on
+a leading dim and executed with ``lax.scan`` (one compiled block for
+all layers — fast compile, XLA-friendly), every parameter carrying a
+logical-axes annotation consumed by
+``dlrover_tpu.parallel.sharding.LogicalAxisRules``.
+
+Design notes (TPU):
+- params are a plain dict pytree; "layers" is a stacked leading axis —
+  sharding it on the "pipe" mesh axis gives pipeline stages for free.
+- attention is exposed through a pluggable kernel so
+  ``dlrover_tpu.ops`` can swap in Pallas flash / ring attention.
+- all matmuls run in bfloat16 with fp32 accumulation
+  (``preferred_element_type``) — the MXU contract.
+"""
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dlrover_tpu.parallel import sharding as sh
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    mlp_dim: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    # remat policy for the scanned block: "none" | "full" | "dots"
+    remat: str = "full"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @staticmethod
+    def tiny(**overrides) -> "LlamaConfig":
+        """Test-sized config (virtual-device CI)."""
+        base = dict(
+            vocab_size=256,
+            dim=64,
+            n_layers=2,
+            n_heads=4,
+            n_kv_heads=2,
+            mlp_dim=128,
+            max_seq_len=128,
+        )
+        base.update(overrides)
+        return LlamaConfig(**base)
+
+    @staticmethod
+    def llama2_7b(**overrides) -> "LlamaConfig":
+        base = dict(
+            vocab_size=32000,
+            dim=4096,
+            n_layers=32,
+            n_heads=32,
+            n_kv_heads=32,
+            mlp_dim=11008,
+            max_seq_len=4096,
+        )
+        base.update(overrides)
+        return LlamaConfig(**base)
+
+
+# ---------------------------------------------------------------- params
+
+
+def init_params(key, cfg: LlamaConfig) -> Dict:
+    """Stacked-layer param pytree; fp32 master weights."""
+    k_embed, k_layers, k_out = jax.random.split(key, 3)
+    d, hd = cfg.dim, cfg.head_dim
+    nh, nkv, mlp, L = cfg.n_heads, cfg.n_kv_heads, cfg.mlp_dim, cfg.n_layers
+
+    def norm_init(*shape):
+        return jnp.ones(shape, dtype=jnp.float32)
+
+    def dense_init(key, *shape, in_axis: int = 0):
+        fan_in = shape[in_axis]
+        return (
+            jax.random.normal(key, shape, dtype=jnp.float32)
+            * (fan_in**-0.5)
+        )
+
+    keys = jax.random.split(k_layers, 7)
+    layer = {
+        "attn_norm": norm_init(L, d),
+        "wq": dense_init(keys[0], L, d, nh * hd, in_axis=1),
+        "wk": dense_init(keys[1], L, d, nkv * hd, in_axis=1),
+        "wv": dense_init(keys[2], L, d, nkv * hd, in_axis=1),
+        "wo": dense_init(keys[3], L, nh * hd, d, in_axis=1),
+        "mlp_norm": norm_init(L, d),
+        "w_gate": dense_init(keys[4], L, d, mlp, in_axis=1),
+        "w_up": dense_init(keys[5], L, d, mlp, in_axis=1),
+        "w_down": dense_init(keys[6], L, mlp, d, in_axis=1),
+    }
+    return {
+        "embed": dense_init(k_embed, cfg.vocab_size, d, in_axis=1),
+        "layers": layer,
+        "final_norm": norm_init(d),
+        "lm_head": dense_init(k_out, d, cfg.vocab_size, in_axis=0),
+    }
+
+
+def param_logical_axes(cfg: LlamaConfig) -> Dict:
+    """Same structure as ``init_params``, leaves = logical-axes tuples
+    (None = replicated dim)."""
+    return {
+        "embed": (sh.VOCAB, sh.EMBED),
+        "layers": {
+            "attn_norm": (sh.LAYERS, None),
+            "wq": (sh.LAYERS, sh.EMBED, sh.HEADS),
+            "wk": (sh.LAYERS, sh.EMBED, sh.KV_HEADS),
+            "wv": (sh.LAYERS, sh.EMBED, sh.KV_HEADS),
+            "wo": (sh.LAYERS, sh.HEADS, sh.EMBED),
+            "mlp_norm": (sh.LAYERS, None),
+            "w_gate": (sh.LAYERS, sh.EMBED, sh.MLP),
+            "w_up": (sh.LAYERS, sh.EMBED, sh.MLP),
+            "w_down": (sh.LAYERS, sh.MLP, sh.EMBED),
+        },
+        "final_norm": (None,),
+        "lm_head": (sh.EMBED, sh.VOCAB),
+    }
+
+
+def count_params(params) -> int:
+    return sum(
+        x.size for x in jax.tree_util.tree_leaves(params)
+    )
+
+
+# --------------------------------------------------------------- modules
+
+
+def rms_norm(x, weight, eps: float):
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(x * x, axis=-1, keepdims=True)
+    return (x * lax.rsqrt(var + eps)).astype(dtype) * weight.astype(dtype)
+
+
+def rope_frequencies(cfg: LlamaConfig, positions):
+    """[S] -> cos/sin [S, head_dim/2] (fp32)."""
+    half = cfg.head_dim // 2
+    freqs = cfg.rope_theta ** (
+        -jnp.arange(0, half, dtype=jnp.float32) / half
+    )
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x, cos, sin):
+    """x: [B, S, H, D]; rotate pairs (split-half convention)."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    cos = cos[None, :, None, :]
+    sin = sin[None, :, None, :]
+    out1 = x1 * cos - x2 * sin
+    out2 = x2 * cos + x1 * sin
+    return jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)
+
+
+def dot_product_attention(q, k, v, causal: bool = True):
+    """Reference attention kernel [B,S,H,D]x[B,S,KV,D]; the ops package
+    swaps this for Pallas flash attention on real TPU."""
+    b, s, nh, d = q.shape
+    nkv = k.shape[2]
+    group = nh // nkv
+    q = q.reshape(b, s, nkv, group, d)
+    logits = jnp.einsum(
+        "bqkgd,bskd->bkgqs", q, k, preferred_element_type=jnp.float32
+    ) * (d**-0.5)
+    if causal:
+        mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+        logits = jnp.where(mask[None, None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum(
+        "bkgqs,bskd->bqkgd", probs.astype(v.dtype), v
+    )
+    return out.reshape(b, s, nh, d)
+
+
+AttentionFn = Callable[..., jnp.ndarray]
+
+
+def _layer_forward(
+    cfg: LlamaConfig,
+    attention_fn: AttentionFn,
+    lp: Dict,
+    x: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+) -> jnp.ndarray:
+    b, s, d = x.shape
+    nh, nkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    dt = cfg.dtype
+
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"].astype(dt)).reshape(b, s, nh, hd)
+    k = (h @ lp["wk"].astype(dt)).reshape(b, s, nkv, hd)
+    v = (h @ lp["wv"].astype(dt)).reshape(b, s, nkv, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    q = sh.apply_sharding_constraint(
+        q, (sh.BATCH, sh.SEQ, sh.HEADS, None), _current_rules()
+    )
+    attn = attention_fn(q, k, v, causal=True)
+    x = x + attn.reshape(b, s, nh * hd) @ lp["wo"].astype(dt)
+
+    h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
+    up = h @ lp["w_up"].astype(dt)
+    x = x + (gate * up) @ lp["w_down"].astype(dt)
+    return x
+
+
+# activation-sharding rules used inside forward; set by the trainer
+_rules_holder = {"rules": None}
+
+
+def set_activation_rules(rules):
+    _rules_holder["rules"] = rules
+
+
+def _current_rules():
+    rules = _rules_holder["rules"]
+    if rules is None:
+        rules = sh.default_rules(fsdp=False)
+    return rules
+
+
+def forward(
+    params: Dict,
+    tokens: jnp.ndarray,
+    cfg: LlamaConfig,
+    attention_fn: Optional[AttentionFn] = None,
+) -> jnp.ndarray:
+    """tokens [B, S] int32 -> logits [B, S, vocab] (fp32)."""
+    attention_fn = attention_fn or dot_product_attention
+    dt = cfg.dtype
+    b, s = tokens.shape
+    x = params["embed"].astype(dt)[tokens]
+    x = sh.apply_sharding_constraint(
+        x, (sh.BATCH, sh.SEQ, sh.EMBED), _current_rules()
+    )
+    positions = jnp.arange(s)
+    cos, sin = rope_frequencies(cfg, positions)
+
+    block = partial(_layer_forward, cfg, attention_fn)
+    if cfg.remat == "full":
+        block = jax.checkpoint(block)
+    elif cfg.remat == "dots":
+        block = jax.checkpoint(
+            block,
+            policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+        )
+
+    def scan_body(x, lp):
+        return block(lp, x, cos, sin), None
+
+    x, _ = lax.scan(scan_body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum(
+        "bsd,dv->bsv",
+        x,
+        params["lm_head"].astype(dt),
+        preferred_element_type=jnp.float32,
+    )
+    return logits
+
+
+def loss_fn(
+    params: Dict,
+    batch: Dict,
+    cfg: LlamaConfig,
+    attention_fn: Optional[AttentionFn] = None,
+) -> jnp.ndarray:
+    """Next-token cross entropy; batch = {"tokens": [B, S+1]} or
+    {"inputs", "targets"} (+ optional "mask")."""
+    if "inputs" in batch:
+        inputs, targets = batch["inputs"], batch["targets"]
+    else:
+        inputs, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
+    logits = forward(params, inputs, cfg, attention_fn)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(
+        logp, targets[..., None], axis=-1
+    ).squeeze(-1)
+    mask = batch.get("mask")
+    if mask is not None:
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+    return jnp.mean(nll)

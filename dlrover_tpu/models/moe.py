@@ -1,0 +1,145 @@
+"""Mixture-of-Experts layer with expert-parallel dispatch.
+
+Reference parity: ``atorch/atorch/modules/moe/moe_layer.py:161``
+(``MOELayer`` with ``_AllToAll:87`` expert dispatch), top-k gating
+(``topk_gating.py``) and grouped-GEMM experts (``grouped_gemm_moe.py``).
+
+TPU-native design: experts live stacked on a leading dim annotated with
+the "expert" logical axis; token routing is dense one-hot matmuls
+(MXU-friendly, static shapes — no sorting/scatter, which XLA can't tile)
+with a capacity factor, the canonical Switch/GShard formulation.  Under
+expert parallelism the stacked dim is sharded over the "expert" mesh
+axis and GSPMD turns the routing einsums into the all-to-all exchange;
+``dlrover_tpu.parallel.collectives.expert_all_to_all`` is the explicit
+shard_map form for custom schedules.
+"""
+
+from dataclasses import dataclass
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.parallel import sharding as sh
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    dim: int
+    mlp_dim: int
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    router_aux_weight: float = 0.01
+    dtype: object = jnp.bfloat16
+
+
+def init_moe_params(key, cfg: MoEConfig) -> Dict:
+    kr, kg, ku, kd = jax.random.split(key, 4)
+    e, d, m = cfg.num_experts, cfg.dim, cfg.mlp_dim
+    scale_in = d**-0.5
+    scale_mid = m**-0.5
+    return {
+        "router": jax.random.normal(kr, (d, e), dtype=jnp.float32)
+        * scale_in,
+        "w_gate": jax.random.normal(kg, (e, d, m), dtype=jnp.float32)
+        * scale_in,
+        "w_up": jax.random.normal(ku, (e, d, m), dtype=jnp.float32)
+        * scale_in,
+        "w_down": jax.random.normal(kd, (e, m, d), dtype=jnp.float32)
+        * scale_mid,
+    }
+
+
+def moe_param_logical_axes() -> Dict:
+    return {
+        "router": (sh.EMBED, None),
+        "w_gate": (sh.EXPERT, sh.EMBED, sh.MLP),
+        "w_up": (sh.EXPERT, sh.EMBED, sh.MLP),
+        "w_down": (sh.EXPERT, sh.MLP, sh.EMBED),
+    }
+
+
+def _top_k_gating(
+    logits: jnp.ndarray, top_k: int
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Returns (gates [T,E] with zeros off the top-k, aux_loss,
+    router_probs [T,E])."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    e = logits.shape[-1]
+    top_vals, top_idx = jax.lax.top_k(probs, top_k)
+    gates = jnp.zeros_like(probs)
+    one_hot = jax.nn.one_hot(top_idx, e, dtype=probs.dtype)  # [T,k,E]
+    gates = jnp.einsum("tk,tke->te", top_vals, one_hot)
+    # renormalize the kept gates
+    gates = gates / jnp.maximum(
+        jnp.sum(gates, axis=-1, keepdims=True), 1e-9
+    )
+    # Switch-style load-balancing loss: mean prob * mean assignment
+    density = jnp.mean(one_hot[:, 0], axis=0)  # top-1 assignment share
+    density_proxy = jnp.mean(probs, axis=0)
+    aux = jnp.sum(density * density_proxy) * (e**2) / e
+    return gates, aux, probs
+
+
+def moe_forward(
+    params: Dict, x: jnp.ndarray, cfg: MoEConfig
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """x [B, S, D] -> (y [B, S, D], aux_loss scalar).
+
+    Dense-dispatch formulation: tokens -> per-expert capacity buffers
+    via one-hot combine/dispatch tensors (static shapes; GSPMD shards
+    the expert dim)."""
+    b, s, d = x.shape
+    t = b * s
+    e, k = cfg.num_experts, cfg.top_k
+    capacity = max(1, int(cfg.capacity_factor * t * k / e))
+    dt = cfg.dtype
+
+    flat = x.reshape(t, d)
+    logits = flat.astype(jnp.float32) @ params["router"]
+    gates, aux, _ = _top_k_gating(logits, k)  # [T,E]
+
+    # position of each token in its expert's buffer (by arrival order)
+    expert_mask = (gates > 0).astype(jnp.int32)  # [T,E]
+    position = jnp.cumsum(expert_mask, axis=0) * expert_mask - 1
+    in_capacity = (position < capacity) & (expert_mask > 0)
+    dispatch = (
+        jax.nn.one_hot(
+            jnp.where(in_capacity, position, capacity), capacity + 1,
+            dtype=dt,
+        )[..., :capacity]
+        * in_capacity[..., None].astype(dt)
+    )  # [T,E,C]
+    combine = dispatch * gates[..., None].astype(dt)  # [T,E,C]
+
+    # dispatch tokens: [E, C, D]
+    expert_in = jnp.einsum(
+        "tec,td->ecd", dispatch, flat.astype(dt)
+    )
+    expert_in = sh.apply_sharding_constraint(
+        expert_in, (sh.EXPERT, None, sh.EMBED), _moe_rules()
+    )
+    gate = jax.nn.silu(
+        jnp.einsum("ecd,edm->ecm", expert_in, params["w_gate"].astype(dt))
+    )
+    up = jnp.einsum("ecd,edm->ecm", expert_in, params["w_up"].astype(dt))
+    expert_out = jnp.einsum(
+        "ecm,emd->ecd", gate * up, params["w_down"].astype(dt)
+    )
+    y = jnp.einsum("tec,ecd->td", combine, expert_out)
+    return y.reshape(b, s, d), aux * cfg.router_aux_weight
+
+
+_rules_holder = {"rules": None}
+
+
+def set_moe_rules(rules):
+    _rules_holder["rules"] = rules
+
+
+def _moe_rules():
+    rules = _rules_holder["rules"]
+    if rules is None:
+        rules = sh.default_rules(fsdp=False, expert_parallel=True)
+    return rules

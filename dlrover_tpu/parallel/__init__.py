@@ -1,0 +1,14 @@
+from dlrover_tpu.parallel.mesh import (  # noqa: F401
+    AxisName,
+    MeshContext,
+    create_parallel_mesh,
+    destroy_parallel_mesh,
+    get_mesh,
+    get_mesh_context,
+)
+from dlrover_tpu.parallel.sharding import (  # noqa: F401
+    LogicalAxisRules,
+    default_rules,
+    logical_sharding,
+    shard_pytree,
+)

@@ -1,0 +1,210 @@
+"""Sequence/expert-parallel collectives for use inside ``shard_map``.
+
+Reference parity:
+
+- Ulysses all-to-all: ``atorch/atorch/distributed/distributed.py:474``
+  (``_SeqAllToAll`` autograd: scatter_idx/gather_idx exchange) and
+  ``seq_all_to_all:500``.  Here it is a single ``lax.all_to_all`` whose
+  transpose rule gives the backward pass for free — no custom autograd.
+- Ring primitives: the micro-Q all-gather ring of
+  ``modules/distributed_transformer/commu_utils.py`` becomes
+  ``lax.ppermute`` rotation (the idiomatic ICI ring).
+- Distributed softmax: ``distributed_attention.py:21``
+  (``DistributedSoftmax``: global max+sum via allreduce over the
+  sharded sequence) becomes two ``psum``/``pmax`` calls.
+- Expert dispatch: ``modules/moe/moe_layer.py:87`` (``_AllToAll``)
+  becomes ``lax.all_to_all`` over the "expert" axis.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def device_varying(x, axis_name):
+    """Mark a freshly-created array as device-varying over ``axis_name``
+    (shard_map vma typing for scan carries)."""
+    try:
+        return lax.pcast(x, axis_name, to="varying")
+    except (AttributeError, TypeError):  # older jax
+        return lax.pvary(x, axis_name)
+
+
+def seq_all_to_all(
+    x: jnp.ndarray,
+    axis_name: str,
+    scatter_axis: int,
+    gather_axis: int,
+    tiled: bool = True,
+) -> jnp.ndarray:
+    """Ulysses exchange: scatter ``scatter_axis`` over the mesh axis,
+    gather ``gather_axis`` from it.
+
+    Attention usage (inside shard_map, seq sharded per device):
+    ``q,k,v: [B, S/p, H, D] -> [B, S, H/p, D]`` via
+    ``seq_all_to_all(x, "seq", scatter_axis=2, gather_axis=1)`` —
+    full sequence per head-group; inverse after attention.
+    """
+    return lax.all_to_all(
+        x,
+        axis_name,
+        split_axis=scatter_axis,
+        concat_axis=gather_axis,
+        tiled=tiled,
+    )
+
+
+def ring_permute(x: jnp.ndarray, axis_name: str, shift: int = 1):
+    """Rotate a block to the next device on the ring (ppermute); the
+    building block of ring attention's KV rotation."""
+    n = lax.psum(1, axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def distributed_softmax(
+    logits: jnp.ndarray, axis_name: str, axis: int = -1
+) -> jnp.ndarray:
+    """Softmax over an axis that is sharded across ``axis_name``:
+    global max (pmax) then global sum (psum) — numerically identical to
+    a softmax over the gathered axis (reference ``DistributedSoftmax``).
+    """
+    local_max = jnp.max(logits, axis=axis, keepdims=True)
+    global_max = lax.pmax(local_max, axis_name)
+    unnorm = jnp.exp(logits - global_max)
+    denom = lax.psum(
+        jnp.sum(unnorm, axis=axis, keepdims=True), axis_name
+    )
+    return unnorm / denom
+
+
+def expert_all_to_all(
+    x: jnp.ndarray, axis_name: str, split_axis: int = 0, concat_axis: int = 0
+):
+    """MoE dispatch/combine exchange over the expert mesh axis."""
+    return lax.all_to_all(
+        x,
+        axis_name,
+        split_axis=split_axis,
+        concat_axis=concat_axis,
+        tiled=True,
+    )
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    inner_attention: Optional[callable] = None,
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Ulysses sequence parallelism (inside shard_map): exchange the
+    sharded seq dim for the head dim around any attention kernel.
+
+    q,k,v ``[B, S/p, H, D]`` -> attention sees ``[B, S, H/p, D]``
+    (full sequence, head subset) -> output back to ``[B, S/p, H, D]``.
+    Reference: ``SequenceParallelOptimization`` + ``_SeqAllToAll``
+    (``distributed/distributed.py:474``).
+    """
+    if inner_attention is None:
+        from dlrover_tpu.models.llama import dot_product_attention
+
+        inner_attention = dot_product_attention
+    q, k, v = (
+        seq_all_to_all(x, axis_name, scatter_axis=2, gather_axis=1)
+        for x in (q, k, v)
+    )
+    out = inner_attention(q, k, v, causal=causal)
+    return seq_all_to_all(out, axis_name, scatter_axis=1, gather_axis=2)
+
+
+def grad_sync(grads, axis_names):
+    """Mean-reduce gradients over the given data-flavored axes — what
+    DDP's bucketed allreduce becomes (a single pmean per leaf; XLA
+    fuses and schedules them)."""
+    if not axis_names:
+        return grads
+    return jax.tree_util.tree_map(
+        lambda g: lax.pmean(g, axis_names), grads
+    )
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    causal: bool = False,
+    seq_chunk_index: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Blockwise ring attention over a sequence-sharded mesh axis.
+
+    Reference parity: ``DistributedSelfAttention``
+    (``distributed_attention.py:79``) — the reference all-gathers Q in
+    micro-chunks and reduce-scatters the context; the TPU-idiomatic
+    dual keeps Q resident and rotates the KV shard around the ring with
+    ``ppermute`` (one hop per step, overlapping compute), carrying
+    running max/sum statistics so the softmax is exact (flash-attention
+    style log-sum-exp accumulation).
+
+    Shapes (inside shard_map): q,k,v ``[B, S/p, H, D]``; returns the
+    context for the local Q chunk ``[B, S/p, H, D]``.
+
+    ``causal`` masking uses the ring step to decide whole-block
+    visibility: block j attends block i only when i <= j (diagonal
+    blocks use the intra-block triangular mask).
+    """
+    n = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    q = q * scale
+
+    b, s, h, d = q.shape
+
+    def qk(qc, kc):
+        return jnp.einsum("bqhd,bkhd->bhqk", qc, kc)
+
+    neg_inf = jnp.finfo(jnp.float32).max * -1.0
+
+    def block(carry, step):
+        kc, vc, acc, m, denom = carry
+        # after `step` rotations (shift=+1) the chunk we hold
+        # originated `step` positions behind us on the ring
+        src_idx = (my_idx - step) % n
+        logits = qk(q, kc).astype(jnp.float32)  # [b,h,q,k]
+        if causal:
+            q_pos = my_idx * s + jnp.arange(s)
+            k_pos = src_idx * s + jnp.arange(s)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            logits = jnp.where(mask[None, None], logits, neg_inf)
+        new_m = jnp.maximum(m, jnp.max(logits, axis=-1, keepdims=True))
+        correction = jnp.exp(m - new_m)
+        p = jnp.exp(logits - new_m)
+        acc = acc * correction.swapaxes(1, 2) + jnp.einsum(
+            "bhqk,bkhd->bqhd", p, vc.astype(jnp.float32)
+        )
+        denom = denom * correction + jnp.sum(p, axis=-1, keepdims=True)
+        # rotate KV to the next ring position
+        kc = ring_permute(kc, axis_name)
+        vc = ring_permute(vc, axis_name)
+        return (kc, vc, acc, new_m, denom), None
+
+    acc0 = device_varying(
+        jnp.zeros((b, s, h, d), dtype=jnp.float32), axis_name
+    )
+    m0 = device_varying(
+        jnp.full((b, h, s, 1), neg_inf, dtype=jnp.float32), axis_name
+    )
+    den0 = device_varying(
+        jnp.zeros((b, h, s, 1), dtype=jnp.float32), axis_name
+    )
+    (kc, vc, acc, m, denom), _ = lax.scan(
+        block, (k, v, acc0, m0, den0), jnp.arange(n)
+    )
+    out = acc / denom.swapaxes(1, 2)
+    return out.astype(q.dtype)

@@ -1,0 +1,185 @@
+"""Named device-mesh construction — the process-group factory, TPU-way.
+
+Reference parity: ``atorch/atorch/distributed/distributed.py:323``
+(``create_parallel_group``: N-dim named process groups from
+``[(name, size), ...]`` + rank order, with strided rank slicing
+``_get_pg_ranks:266``) and the ``_DistributedContext`` registry
+(``:19``).
+
+TPU-native redesign: there are no process groups to create — a single
+``jax.sharding.Mesh`` with named axes expresses every parallel
+dimension at once, and XLA emits the collectives (SURVEY.md §2.8 row
+"Mixed / 3D").  ``create_parallel_mesh([("data", -1), ("tensor", 4)])``
+is the whole API: ``-1`` infers the remaining factor from the device
+count, axis order controls ICI locality (the *last* axis is
+innermost = most-local, so put tensor/seq there and data/pipe
+outermost over DCN).
+"""
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class AxisName:
+    """Canonical mesh-axis names (reference group names
+    ``distributed.py`` "data"/"tensor"/"pipe"/"sequence"/"expert")."""
+
+    DATA = "data"
+    FSDP = "fsdp"  # parameter-sharding (ZeRO-3) sub-axis of data
+    TENSOR = "tensor"
+    SEQUENCE = "seq"
+    EXPERT = "expert"
+    PIPELINE = "pipe"
+
+    ALL = (DATA, FSDP, TENSOR, SEQUENCE, EXPERT, PIPELINE)
+
+
+@dataclass
+class MeshContext:
+    """What ``_DistributedContext`` kept for process groups, kept for
+    the mesh instead."""
+
+    mesh: "object"  # jax.sharding.Mesh
+    dims: List[Tuple[str, int]] = field(default_factory=list)
+
+    def axis_size(self, name: str) -> int:
+        return dict(self.dims).get(name, 1)
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(n for n, _ in self.dims)
+
+    @property
+    def num_devices(self) -> int:
+        return int(np.prod([s for _, s in self.dims])) if self.dims else 1
+
+
+_context: Optional[MeshContext] = None
+_lock = threading.Lock()
+
+
+def _resolve_dims(
+    parallel_config: Sequence[Tuple[str, int]], num_devices: int
+) -> List[Tuple[str, int]]:
+    dims: List[Tuple[str, int]] = []
+    infer_index = -1
+    known = 1
+    for i, (name, size) in enumerate(parallel_config):
+        if size == -1:
+            if infer_index >= 0:
+                raise ValueError("at most one axis size may be -1")
+            infer_index = i
+            dims.append((name, -1))
+        else:
+            if size <= 0:
+                raise ValueError(f"axis {name!r} size must be >0 or -1")
+            known *= size
+            dims.append((name, size))
+    if infer_index >= 0:
+        if num_devices % known != 0:
+            raise ValueError(
+                f"{num_devices} devices not divisible by fixed axes {known}"
+            )
+        name = dims[infer_index][0]
+        dims[infer_index] = (name, num_devices // known)
+        known *= dims[infer_index][1]
+    if known != num_devices:
+        raise ValueError(
+            f"mesh {dims} covers {known} devices, have {num_devices}"
+        )
+    return dims
+
+
+def create_parallel_mesh(
+    parallel_config: Optional[Sequence[Tuple[str, int]]] = None,
+    devices=None,
+    set_global: bool = True,
+) -> MeshContext:
+    """Build a named ``jax.sharding.Mesh``.
+
+    ``parallel_config`` is ``[(axis_name, size), ...]``; one size may be
+    ``-1`` (inferred).  Default: pure data parallelism over all devices.
+    Axis order = ``parallel_config`` order; the last axis maps to the
+    innermost (most ICI-local) device dimension, matching the
+    reference's rank-order semantics for strided groups.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if parallel_config is None:
+        parallel_config = [(AxisName.DATA, -1)]
+    dims = _resolve_dims(parallel_config, len(devices))
+    names = tuple(n for n, _ in dims)
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate axis names in {names}")
+    shape = tuple(s for _, s in dims)
+    device_array = np.asarray(devices).reshape(shape)
+    mesh = Mesh(device_array, names)
+    ctx = MeshContext(mesh=mesh, dims=list(dims))
+    logger.info(
+        "parallel mesh: %s over %d devices",
+        dict(dims),
+        len(devices),
+    )
+    if set_global:
+        global _context
+        with _lock:
+            _context = ctx
+    return ctx
+
+
+def get_mesh_context() -> Optional[MeshContext]:
+    return _context
+
+
+def get_mesh():
+    if _context is None:
+        raise RuntimeError(
+            "no parallel mesh: call create_parallel_mesh() first"
+        )
+    return _context.mesh
+
+
+def axis_size(name: str) -> int:
+    return _context.axis_size(name) if _context else 1
+
+
+def destroy_parallel_mesh():
+    global _context
+    with _lock:
+        _context = None
+
+
+def data_parallel_size() -> int:
+    """Total batch-sharding factor: data * fsdp axes (ZeRO shards
+    params over the same replicas that shard the batch)."""
+    return axis_size(AxisName.DATA) * axis_size(AxisName.FSDP)
+
+
+def build_device_mesh_dims(
+    num_devices: int,
+    data: int = -1,
+    fsdp: int = 1,
+    tensor: int = 1,
+    seq: int = 1,
+    expert: int = 1,
+    pipe: int = 1,
+) -> List[Tuple[str, int]]:
+    """Convenience: the canonical axis ordering (outermost→innermost =
+    pipe, data, fsdp, expert, seq, tensor) with one inferred dim."""
+    dims = [
+        (AxisName.PIPELINE, pipe),
+        (AxisName.DATA, data),
+        (AxisName.FSDP, fsdp),
+        (AxisName.EXPERT, expert),
+        (AxisName.SEQUENCE, seq),
+        (AxisName.TENSOR, tensor),
+    ]
+    return _resolve_dims(dims, num_devices)

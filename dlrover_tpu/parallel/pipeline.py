@@ -1,0 +1,121 @@
+"""Pipeline parallelism without RPC: collective-permute microbatching.
+
+Reference parity: ``atorch/atorch/auto/opt_lib/
+pipeline_parallel_optimization.py:56`` (PiPPy graph-split pipeline over
+an RPC mesh, ``distributed/distributed.py:504``).  PiPPy's RPC design
+has no JAX analog (SURVEY.md §7 hard parts); the TPU-native form is
+GPipe-style SPMD: every pipeline stage is one slice of a "pipe" mesh
+axis, microbatch activations hop stage-to-stage with ``lax.ppermute``
+inside a ``lax.scan`` over clock ticks, and autodiff through the
+scan+ppermute yields the 1F1B-equivalent backward schedule for free.
+
+The model contributes a single ``stage_fn(stage_params, x)``; stage
+params live stacked on a leading "layers/stage" dim sharded over the
+"pipe" axis, so the same jitted program runs on every stage (SPMD, no
+per-stage programs to compile).
+"""
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_spmd(
+    stage_fn: Callable,
+    stage_params,
+    microbatches: jnp.ndarray,
+    axis_name: str = "pipe",
+):
+    """Run ``microbatches`` through the pipeline; call inside
+    ``shard_map`` over the "pipe" axis.
+
+    Args:
+      stage_fn: ``(stage_params, x) -> y`` — this stage's chunk of
+        layers; activations keep one shape across stages.
+      stage_params: the local stage's params (already sharded).
+      microbatches: ``[M, mb, ...]`` — the full microbatch stream
+        (present on all stages; only stage 0 reads it).
+
+    Returns ``[M, mb, ...]`` outputs (valid on every stage after the
+    final psum-broadcast).
+    """
+    n_stages = lax.psum(1, axis_name)
+    stage_idx = lax.axis_index(axis_name)
+    num_mb = microbatches.shape[0]
+    total_ticks = num_mb + n_stages - 1
+
+    # send to next stage only (no wraparound; missing sources give 0)
+    fwd_perm_fn = lambda n: [(i, i + 1) for i in range(n - 1)]  # noqa: E731
+
+    act_shape = microbatches.shape[1:]
+    out_buf = jnp.zeros(
+        (num_mb,) + act_shape, dtype=microbatches.dtype
+    )
+
+    def tick(carry, t):
+        incoming, out_buf = carry
+        # stage 0 ingests microbatch t while the stream lasts
+        mb_idx = jnp.clip(t, 0, num_mb - 1)
+        ingest = microbatches[mb_idx]
+        x = jnp.where(stage_idx == 0, ingest, incoming)
+        y = stage_fn(stage_params, x)
+        # the microbatch this stage just finished is (t - stage_idx);
+        # drop ticks where this stage was idle (bubble)
+        done_idx = t - stage_idx
+        valid = jnp.logical_and(done_idx >= 0, done_idx < num_mb)
+        is_last = stage_idx == n_stages - 1
+        out_buf = lax.cond(
+            jnp.logical_and(valid, is_last),
+            lambda b: b.at[jnp.clip(done_idx, 0, num_mb - 1)].set(y),
+            lambda b: b,
+            out_buf,
+        )
+        nxt = lax.ppermute(
+            y, axis_name, fwd_perm_fn(n_stages)
+        )
+        return (nxt, out_buf), None
+
+    from dlrover_tpu.parallel.collectives import device_varying
+
+    incoming0 = device_varying(
+        jnp.zeros(act_shape, dtype=microbatches.dtype), axis_name
+    )
+    out_buf = device_varying(out_buf, axis_name)
+    (_, out_buf), _ = lax.scan(
+        tick, (incoming0, out_buf), jnp.arange(total_ticks)
+    )
+    # only the last stage holds real outputs; broadcast over the axis
+    return lax.psum(out_buf, axis_name)
+
+
+def split_microbatches(batch, num_microbatches: int):
+    """[B, ...] -> [M, B/M, ...] pytree-wise."""
+
+    def _split(x):
+        b = x.shape[0]
+        if b % num_microbatches != 0:
+            raise ValueError(
+                f"batch {b} not divisible into {num_microbatches} microbatches"
+            )
+        return x.reshape((num_microbatches, b // num_microbatches) + x.shape[1:])
+
+    return jax.tree_util.tree_map(_split, batch)
+
+
+def merge_microbatches(stream):
+    """[M, mb, ...] -> [M*mb, ...] pytree-wise."""
+
+    def _merge(x):
+        return x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:])
+
+    return jax.tree_util.tree_map(_merge, stream)
+
+
+def stack_stage_params(per_stage_params):
+    """List of per-stage param pytrees -> stacked pytree with a leading
+    stage dim (shard it on "pipe")."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *per_stage_params
+    )

@@ -1,0 +1,159 @@
+"""Logical-axis sharding rules — the strategy engine's output format.
+
+Reference parity: the role of atorch's opt_lib transforms
+(``zero_optimization.py:115,240`` ZeRO/FSDP,
+``tensor_parallel_optimization.py:23`` TP module replacement,
+``mixed_parallel_optimization.py:57``): deciding *how each tensor is
+laid out across the cluster*.  In the reference that is a module
+rewrite + process-group plumbing; on TPU it is a table mapping
+**logical array axes** ("embed", "heads", "mlp", ...) to **mesh axes**,
+compiled by GSPMD into collectives.  Strategies differ only in the
+table:
+
+- DDP        -> params replicated, batch over ("data","fsdp")
+- ZeRO-3/FSDP-> params sharded on "fsdp" along their largest dim
+- TP         -> Megatron-style: qkv/mlp-in column, proj/mlp-out row
+- SP/EP      -> sequence/expert dims on "seq"/"expert"
+
+so "auto_accelerate" becomes: pick a rule table, shard_pytree, jit.
+"""
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from dlrover_tpu.parallel.mesh import AxisName
+
+# logical axis vocabulary used by model definitions
+BATCH = "batch"
+SEQ = "seq_len"
+EMBED = "embed"
+MLP = "mlp"
+HEADS = "heads"
+KV_HEADS = "kv_heads"
+HEAD_DIM = "head_dim"
+VOCAB = "vocab"
+EXPERT = "expert"
+LAYERS = "layers"
+
+
+class LogicalAxisRules:
+    """Ordered mapping logical-axis -> mesh axis (or tuple of axes).
+
+    First match wins; unlisted logical axes are replicated (None).
+    """
+
+    def __init__(self, rules: Sequence[Tuple[str, Optional[object]]]):
+        self._rules: List[Tuple[str, Optional[object]]] = list(rules)
+
+    def mesh_axes(self, logical: Optional[str]):
+        if logical is None:
+            return None
+        for name, axes in self._rules:
+            if name == logical:
+                return axes
+        return None
+
+    def spec(self, logical_axes: Sequence[Optional[str]]):
+        """PartitionSpec from a tuple of logical axis names."""
+        from jax.sharding import PartitionSpec
+
+        used = set()
+        entries = []
+        for ax in logical_axes:
+            target = self.mesh_axes(ax)
+            # a mesh axis may appear at most once in a spec
+            if target is None:
+                entries.append(None)
+                continue
+            flat = target if isinstance(target, tuple) else (target,)
+            if any(a in used for a in flat):
+                entries.append(None)
+                continue
+            used.update(flat)
+            entries.append(target)
+        return PartitionSpec(*entries)
+
+    def extend(self, extra: Sequence[Tuple[str, Optional[object]]]):
+        return LogicalAxisRules(list(extra) + self._rules)
+
+
+def default_rules(
+    fsdp: bool = True,
+    tensor_parallel: bool = False,
+    sequence_parallel: bool = False,
+    expert_parallel: bool = False,
+) -> LogicalAxisRules:
+    """The canonical rule tables (strategy selection in one place)."""
+    rules: List[Tuple[str, Optional[object]]] = [
+        # batch is always sharded over every data-flavored axis
+        (BATCH, (AxisName.DATA, AxisName.FSDP)),
+    ]
+    if sequence_parallel:
+        rules.append((SEQ, AxisName.SEQUENCE))
+    if tensor_parallel:
+        rules += [
+            (HEADS, AxisName.TENSOR),
+            (KV_HEADS, AxisName.TENSOR),
+            (MLP, AxisName.TENSOR),
+            (VOCAB, AxisName.TENSOR),
+        ]
+    if expert_parallel:
+        rules.append((EXPERT, AxisName.EXPERT))
+    if fsdp:
+        # ZeRO-3: shard the big parameter dim over the fsdp axis
+        rules.append((EMBED, AxisName.FSDP))
+    return LogicalAxisRules(rules)
+
+
+def filter_spec_for_mesh(spec, mesh):
+    """Drop spec entries referencing axes the mesh doesn't have (a rule
+    table is strategy-global; the mesh picks which axes exist)."""
+    from jax.sharding import PartitionSpec
+
+    mesh_axes = set(mesh.axis_names)
+    entries = []
+    for e in spec:
+        flat = e if isinstance(e, tuple) else (e,)
+        if e is None or all(a in mesh_axes for a in flat):
+            entries.append(e)
+        else:
+            present = tuple(a for a in flat if a in mesh_axes)
+            entries.append(
+                present if len(present) > 1
+                else (present[0] if present else None)
+            )
+    return PartitionSpec(*entries)
+
+
+def logical_sharding(mesh, rules: LogicalAxisRules, logical_axes):
+    from jax.sharding import NamedSharding
+
+    return NamedSharding(
+        mesh, filter_spec_for_mesh(rules.spec(logical_axes), mesh)
+    )
+
+
+def shard_pytree(pytree, axes_pytree, mesh, rules: LogicalAxisRules):
+    """Produce a NamedSharding pytree from a logical-axes pytree with
+    the same structure (the model exports the latter)."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda axes: logical_sharding(mesh, rules, axes),
+        axes_pytree,
+        is_leaf=lambda x: isinstance(x, (tuple, type(None))),
+    )
+
+
+def apply_sharding_constraint(x, logical_axes, rules: LogicalAxisRules):
+    """In-graph activation-sharding constraint; a no-op when no global
+    mesh is set (eager debugging / single device)."""
+    import jax
+
+    from dlrover_tpu.parallel.mesh import get_mesh_context
+
+    ctx = get_mesh_context()
+    if ctx is None:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, logical_sharding(ctx.mesh, rules, logical_axes)
+    )

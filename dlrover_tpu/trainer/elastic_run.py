@@ -77,11 +77,26 @@ def parse_args(argv=None):
         default=os.getenv("JAX_COMPILATION_CACHE_DIR", ""),
         help="persistent XLA compile cache (keeps restarts cheap)",
     )
-    parser.add_argument("training_script", help="script or -m module")
+    parser.add_argument(
+        "-m",
+        "--module",
+        dest="module",
+        default="",
+        help="run the entrypoint as 'python -m MODULE' instead of a script",
+    )
+    parser.add_argument(
+        "training_script",
+        nargs="?",
+        default="",
+        help="training script path (omit when using -m)",
+    )
     parser.add_argument(
         "training_script_args", nargs=argparse.REMAINDER
     )
-    return parser.parse_args(argv)
+    args = parser.parse_args(argv)
+    if not args.module and not args.training_script:
+        parser.error("a training script or -m MODULE is required")
+    return args
 
 
 def _launch_local_master(node_num: int) -> Tuple[subprocess.Popen, str]:
@@ -130,10 +145,11 @@ def _wait_master(addr: str, timeout: float = 60.0) -> bool:
 
 def _build_entrypoint(args) -> List[str]:
     script_args = list(args.training_script_args)
-    if args.training_script == "-m":
-        if not script_args:
-            raise SystemExit("-m requires a module name")
-        return [sys.executable, "-m", *script_args]
+    if args.module:
+        if args.training_script:
+            # with -m, the positional slot is the first module arg
+            script_args.insert(0, args.training_script)
+        return [sys.executable, "-m", args.module, *script_args]
     return [sys.executable, args.training_script, *script_args]
 
 
