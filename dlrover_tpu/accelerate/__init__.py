@@ -1,0 +1,5 @@
+from dlrover_tpu.accelerate.api import (  # noqa: F401
+    AccelerateResult,
+    auto_accelerate,
+)
+from dlrover_tpu.accelerate.strategy import Strategy, load_strategy  # noqa: F401

@@ -1,0 +1,95 @@
+"""Model analysis for strategy selection.
+
+Reference parity: ``atorch/atorch/auto/analyser/analyser.py:327``
+(model props: #params, submodule census) and ``device_context.py:213``
+(GPU memory/flops census).  JAX version works on abstract shapes
+(``jax.eval_shape``) so analysis costs nothing and runs without
+devices.
+"""
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Tuple
+
+import jax
+import numpy as np
+
+
+@dataclass
+class ModelProfile:
+    num_params: int
+    param_bytes: int  # fp32 master copy
+    largest_leaf: int
+    leaf_count: int
+    # optimizer adds 2 fp32 moments/param for adam-family
+    optimizer_bytes: int = 0
+    # rough activation bytes per sample at bf16 (caller-supplied)
+    activation_bytes_per_sample: int = 0
+    extra: Dict = field(default_factory=dict)
+
+    def train_state_bytes(self) -> int:
+        return self.param_bytes + self.optimizer_bytes
+
+
+def analyse_model(
+    init_params_fn: Callable,
+    optimizer=None,
+    rng_shape=(2,),
+) -> ModelProfile:
+    """Abstract-shape census of params + optimizer state."""
+    shapes = jax.eval_shape(
+        init_params_fn,
+        jax.ShapeDtypeStruct(rng_shape, np.uint32),
+    )
+    leaves = jax.tree_util.tree_leaves(shapes)
+    num_params = sum(int(np.prod(leaf.shape)) for leaf in leaves)
+    param_bytes = sum(
+        int(np.prod(leaf.shape)) * leaf.dtype.itemsize for leaf in leaves
+    )
+    largest = max(
+        (int(np.prod(leaf.shape)) for leaf in leaves), default=0
+    )
+    optimizer_bytes = 0
+    if optimizer is not None:
+        opt_shapes = jax.eval_shape(optimizer.init, shapes)
+        optimizer_bytes = sum(
+            int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+            for leaf in jax.tree_util.tree_leaves(opt_shapes)
+        )
+    return ModelProfile(
+        num_params=num_params,
+        param_bytes=param_bytes,
+        largest_leaf=largest,
+        leaf_count=len(leaves),
+        optimizer_bytes=optimizer_bytes,
+    )
+
+
+def device_memory_bytes(default_gb: float = 16.0) -> int:
+    """Per-device HBM (v5e default 16 GB); CPU CI uses the default so
+    strategy selection is deterministic."""
+    try:
+        dev = jax.devices()[0]
+        stats = dev.memory_stats()
+        if stats and "bytes_limit" in stats:
+            return int(stats["bytes_limit"])
+    except Exception:  # noqa: BLE001
+        pass
+    return int(default_gb * (1 << 30))
+
+
+def fits_in_memory(
+    profile: ModelProfile,
+    n_devices: int,
+    fsdp: int,
+    tensor: int,
+    batch_per_device: int = 1,
+    headroom: float = 0.85,
+) -> Tuple[bool, float]:
+    """Memory-fit model: params+opt shard over fsdp*tensor; activations
+    scale with the local batch.  Returns (fits, utilization)."""
+    hbm = device_memory_bytes() * headroom
+    shard = max(fsdp * tensor, 1)
+    state = profile.train_state_bytes() / shard
+    acts = profile.activation_bytes_per_sample * batch_per_device
+    used = state + acts
+    return used <= hbm, used / hbm

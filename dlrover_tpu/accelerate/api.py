@@ -1,0 +1,128 @@
+"""``auto_accelerate`` — one call from model to sharded train step.
+
+Reference parity: ``atorch/atorch/auto/accelerate.py:406``
+(``auto_accelerate(model, optim_func, dataset, loss_func, ...)`` →
+namedtuple of transformed artifacts).  The TPU pipeline: analyse
+(abstract shapes) → generate candidate meshes → optionally dry-run →
+build the winning sharded train step.  Semi-auto: pass
+``load_strategy=Strategy(...)`` to skip the search, exactly like the
+reference's ``load_strategy`` path.
+"""
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import jax
+
+from dlrover_tpu.accelerate.analyser import analyse_model
+from dlrover_tpu.accelerate.dry_runner import pick_best
+from dlrover_tpu.accelerate.strategy import Strategy, generate_candidates
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.parallel.mesh import create_parallel_mesh
+from dlrover_tpu.parallel.sharding import default_rules
+from dlrover_tpu.parallel.train_step import TrainStepFns, build_train_step
+
+
+@dataclass
+class AccelerateResult:
+    fns: TrainStepFns
+    strategy: Strategy
+    mesh_ctx: object
+    rules: object
+    profile: object
+    timings: dict
+
+
+def _build_for_strategy(
+    strategy: Strategy,
+    loss_fn,
+    optimizer,
+    init_params_fn,
+    param_axes,
+    devices,
+):
+    mesh_ctx = create_parallel_mesh(
+        strategy.mesh_dims(), devices=devices
+    )
+    rules = default_rules(**strategy.rule_flags())
+    fns = build_train_step(
+        loss_fn=loss_fn,
+        optimizer=optimizer,
+        init_params_fn=init_params_fn,
+        param_axes=param_axes,
+        mesh_ctx=mesh_ctx,
+        rules=rules,
+        num_micro_steps=strategy.num_micro_steps,
+    )
+    return fns, mesh_ctx, rules
+
+
+def auto_accelerate(
+    loss_fn: Callable,
+    optimizer,
+    init_params_fn: Callable,
+    param_axes,
+    sample_batch_fn: Optional[Callable] = None,
+    devices=None,
+    load_strategy: Optional[Strategy] = None,
+    dry_run: bool = False,
+    long_context: bool = False,
+    moe: bool = False,
+) -> AccelerateResult:
+    """Args mirror ``build_train_step`` plus search knobs.
+
+    ``sample_batch_fn(batch_sharding) -> batch`` enables the timed dry
+    run; without it (or with dry_run=False) the top-ranked memory-fit
+    candidate wins directly.
+    """
+    if devices is None:
+        devices = jax.devices()
+    profile = analyse_model(init_params_fn, optimizer)
+    timings = {}
+
+    if load_strategy is not None:
+        strategy = load_strategy
+    else:
+        candidates = generate_candidates(
+            profile,
+            len(devices),
+            long_context=long_context,
+            moe=moe,
+        )
+        if not candidates:
+            raise RuntimeError(
+                f"no strategy fits: {profile.num_params} params on "
+                f"{len(devices)} devices"
+            )
+        if dry_run and sample_batch_fn is not None:
+            def build(s):
+                fns, _, _ = _build_for_strategy(
+                    s, loss_fn, optimizer, init_params_fn,
+                    param_axes, devices,
+                )
+                state = fns.init_state(jax.random.PRNGKey(0))
+                batch = sample_batch_fn(fns.batch_sharding)
+                return fns.train_step, state, batch
+
+            strategy, timings = pick_best(build, candidates)
+            if strategy is None:
+                strategy = candidates[0]
+        else:
+            strategy = candidates[0]
+
+    logger.info(
+        "auto_accelerate: %s params -> strategy %s",
+        profile.num_params,
+        strategy.describe(),
+    )
+    fns, mesh_ctx, rules = _build_for_strategy(
+        strategy, loss_fn, optimizer, init_params_fn, param_axes, devices
+    )
+    return AccelerateResult(
+        fns=fns,
+        strategy=strategy,
+        mesh_ctx=mesh_ctx,
+        rules=rules,
+        profile=profile,
+        timings=timings,
+    )

@@ -1,0 +1,58 @@
+"""Timed dry runs of candidate strategies.
+
+Reference parity: ``atorch/atorch/auto/dry_runner/dry_runner.py``
+(timed fwd/bwd batches per candidate) driven by the engine's task loop
+(``auto/engine/executor.py:36``).  The JAX version compiles the
+candidate's sharded train step and times a few real steps — the
+compile itself also validates that the sharding is partitionable.
+"""
+
+import time
+from typing import Callable, List, Optional, Tuple
+
+import jax
+
+from dlrover_tpu.accelerate.strategy import Strategy
+from dlrover_tpu.common.log import default_logger as logger
+
+
+def time_strategy(
+    build_fn: Callable[[Strategy], Tuple[Callable, object, object]],
+    strategy: Strategy,
+    warmup: int = 1,
+    steps: int = 3,
+) -> Optional[float]:
+    """``build_fn(strategy) -> (step_fn, state, batch)``; returns mean
+    step seconds or None when the candidate fails to build/compile."""
+    try:
+        step_fn, state, batch = build_fn(strategy)
+        for _ in range(warmup):
+            state, metrics = step_fn(state, batch)
+        jax.block_until_ready(metrics)
+        start = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step_fn(state, batch)
+        jax.block_until_ready(metrics)
+        return (time.perf_counter() - start) / steps
+    except Exception as e:  # noqa: BLE001
+        logger.warning(
+            "strategy %s failed dry run: %s", strategy.describe(), e
+        )
+        return None
+
+
+def pick_best(
+    build_fn: Callable,
+    candidates: List[Strategy],
+    max_candidates: int = 4,
+) -> Tuple[Optional[Strategy], dict]:
+    """Dry-run the top candidates; fastest wins (the reference's
+    DRYRUN task phase)."""
+    timings = {}
+    best, best_t = None, float("inf")
+    for s in candidates[:max_candidates]:
+        t = time_strategy(build_fn, s)
+        timings[s.describe()] = t
+        if t is not None and t < best_t:
+            best, best_t = s, t
+    return best, timings

@@ -1,0 +1,145 @@
+"""Strategy representation + candidate generation.
+
+Reference parity: ``atorch/atorch/auto/strategy.py:4`` (``Strategy`` =
+ordered opt-method list), ``auto/engine/optimization_method.py``
+(candidate generation) and the semi-auto ``load_strategy`` path of
+``auto_accelerate`` (``auto/accelerate.py:406``).
+
+A TPU strategy is fully described by (mesh dims, rule flags, remat,
+micro-steps) — there is no module surgery; candidates are mesh
+factorizations that pass the memory-fit model, ranked by a simple
+cost model and optionally re-ranked by a timed dry run.
+"""
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.accelerate.analyser import ModelProfile, fits_in_memory
+from dlrover_tpu.parallel.mesh import AxisName
+
+
+@dataclass(frozen=True)
+class Strategy:
+    """One parallelization plan."""
+
+    data: int = 1
+    fsdp: int = 1
+    tensor: int = 1
+    seq: int = 1
+    expert: int = 1
+    pipe: int = 1
+    remat: str = "full"
+    num_micro_steps: int = 1
+    extras: Tuple = ()
+
+    @property
+    def n_devices(self) -> int:
+        return (
+            self.data
+            * self.fsdp
+            * self.tensor
+            * self.seq
+            * self.expert
+            * self.pipe
+        )
+
+    def mesh_dims(self) -> List[Tuple[str, int]]:
+        return [
+            (AxisName.PIPELINE, self.pipe),
+            (AxisName.DATA, self.data),
+            (AxisName.FSDP, self.fsdp),
+            (AxisName.EXPERT, self.expert),
+            (AxisName.SEQUENCE, self.seq),
+            (AxisName.TENSOR, self.tensor),
+        ]
+
+    def rule_flags(self) -> Dict[str, bool]:
+        return {
+            "fsdp": self.fsdp > 1,
+            "tensor_parallel": self.tensor > 1,
+            "sequence_parallel": self.seq > 1,
+            "expert_parallel": self.expert > 1,
+        }
+
+    def describe(self) -> str:
+        parts = [
+            f"{k}={v}"
+            for k, v in [
+                ("dp", self.data),
+                ("fsdp", self.fsdp),
+                ("tp", self.tensor),
+                ("sp", self.seq),
+                ("ep", self.expert),
+                ("pp", self.pipe),
+            ]
+            if v > 1
+        ]
+        return "x".join(parts) if parts else "single-device"
+
+
+def load_strategy(config: Dict) -> Strategy:
+    """Semi-auto: user supplies the plan (reference ``load_strategy``)."""
+    return Strategy(**config)
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def generate_candidates(
+    profile: ModelProfile,
+    n_devices: int,
+    max_tensor: int = 8,
+    long_context: bool = False,
+    moe: bool = False,
+    batch_per_replica: int = 1,
+) -> List[Strategy]:
+    """Mesh factorizations that fit memory, cheapest-communication
+    first (DP > FSDP > TP in preference — TP pays per-layer
+    collectives, FSDP pays per-step gathers, DP only grad reduce)."""
+    candidates = []
+    for tensor, fsdp_d in itertools.product(
+        _divisors(n_devices), _divisors(n_devices)
+    ):
+        if tensor > max_tensor:
+            continue
+        if n_devices % (tensor * fsdp_d) != 0:
+            continue
+        rest = n_devices // (tensor * fsdp_d)
+        seq = 1
+        expert = 1
+        if long_context and rest % 2 == 0 and rest > 1:
+            seq = 2
+            rest //= 2
+        if moe and rest % 2 == 0 and rest > 1:
+            expert = 2
+            rest //= 2
+        s = Strategy(
+            data=rest,
+            fsdp=fsdp_d,
+            tensor=tensor,
+            seq=seq,
+            expert=expert,
+        )
+        fits, util = fits_in_memory(
+            profile,
+            n_devices,
+            fsdp=fsdp_d,
+            tensor=tensor,
+            batch_per_device=batch_per_replica,
+        )
+        if fits:
+            candidates.append((s, util))
+    # rank: prefer less model-parallelism, then lower memory pressure
+    candidates.sort(
+        key=lambda su: (su[0].tensor, su[0].fsdp, su[1])
+    )
+    seen = set()
+    unique = []
+    for s, _ in candidates:
+        key = (s.data, s.fsdp, s.tensor, s.seq, s.expert, s.pipe)
+        if key not in seen:
+            seen.add(key)
+            unique.append(s)
+    return unique

@@ -1,0 +1,74 @@
+"""JobAutoScaler: periodic optimizer-driven scaling.
+
+Reference parity: ``dlrover/python/master/node/job_auto_scaler.py`` —
+``AllreduceTrainingAutoScaler:271`` (periodically query the resource
+optimizer, execute plans through the scaler) and the factory ``:40``.
+The PS variant is out of TPU scope (SURVEY.md §2.8 last row).
+"""
+
+import threading
+from typing import Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.resource_optimizer import (
+    JobStage,
+    LocalAllreduceOptimizer,
+)
+from dlrover_tpu.master.scaler import Scaler
+
+
+class AllreduceAutoScaler:
+    def __init__(
+        self,
+        optimizer: LocalAllreduceOptimizer,
+        scaler: Scaler,
+        speed_monitor=None,
+        job_manager=None,
+        interval: float = 60.0,
+    ):
+        self._optimizer = optimizer
+        self._scaler = scaler
+        self._speed_monitor = speed_monitor
+        self._job_manager = job_manager
+        self._interval = interval
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._started_job = False
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="auto-scaler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stopped.set()
+
+    def execute_initial_plan(self):
+        plan = self._optimizer.generate_plan(JobStage.CREATE)
+        if plan and not plan.is_empty():
+            self._scaler.scale(plan)
+            self._started_job = True
+
+    def _collect_speed(self):
+        if self._speed_monitor is None:
+            return
+        speed = self._speed_monitor.running_speed
+        worker_num = 0
+        if self._job_manager is not None:
+            worker_num = len(self._job_manager.get_running_nodes())
+        if speed > 0 and worker_num > 0:
+            self._optimizer.record_speed(worker_num, speed)
+
+    def _loop(self):
+        while not self._stopped.wait(self._interval):
+            try:
+                self._collect_speed()
+                plan = self._optimizer.generate_plan(JobStage.RUNNING)
+                if plan and not plan.is_empty():
+                    logger.info("auto-scaler executing plan: %s", plan)
+                    self._scaler.scale(plan)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("auto-scale cycle failed: %s", e)

@@ -1,0 +1,216 @@
+"""Failure diagnosis: data store + pluggable inference chain.
+
+Reference parity: ``dlrover/python/master/diagnosis/`` —
+``DiagnosisManager`` (``diagnosis.py:31``: collect ``DiagnosisData``,
+periodic ``_diagnose_failures``), ``Diagnostician`` and the
+``InferenceChain`` rule engine (``inferencechain/inference_chain.py:28``
+with pluggable ``InferenceOperator``s).
+
+TPU operators: step-stagnation (hang), OOM pattern in training logs,
+chip unhealthy (libtpu error strings), preemption notice.
+"""
+
+import re
+import threading
+import time
+from abc import ABCMeta, abstractmethod
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+class DiagnosisDataType:
+    TRAINING_LOG = "training_log"
+    CHIP_METRICS = "chip_metrics"
+    AGENT_REPORT = "agent_report"
+
+
+@dataclass
+class DiagnosisData:
+    data_type: str
+    content: str
+    node_rank: int = -1
+    timestamp: float = field(default_factory=time.time)
+
+
+@dataclass
+class Inference:
+    """A (problem, cause, action) conclusion."""
+
+    problem: str
+    cause: str = ""
+    action: str = ""  # restart_process | relaunch_node | abort | none
+    node_rank: int = -1
+
+
+class InferenceOperator(metaclass=ABCMeta):
+    @abstractmethod
+    def infer(self, store: "DiagnosisDataStore") -> List[Inference]:
+        ...
+
+
+class DiagnosisDataStore:
+    def __init__(self, window_secs: float = 1800.0):
+        self._data: Dict[str, List[DiagnosisData]] = {}
+        self._window = window_secs
+        self._lock = threading.Lock()
+
+    def add(self, data: DiagnosisData):
+        with self._lock:
+            bucket = self._data.setdefault(data.data_type, [])
+            bucket.append(data)
+            horizon = time.time() - self._window
+            while bucket and bucket[0].timestamp < horizon:
+                bucket.pop(0)
+
+    def get(self, data_type: str) -> List[DiagnosisData]:
+        with self._lock:
+            return list(self._data.get(data_type, []))
+
+
+class OomOperator(InferenceOperator):
+    _PATTERN = re.compile(
+        r"out of memory|oom-kill|RESOURCE_EXHAUSTED", re.IGNORECASE
+    )
+
+    def infer(self, store: DiagnosisDataStore) -> List[Inference]:
+        results = []
+        for d in store.get(DiagnosisDataType.TRAINING_LOG):
+            if self._PATTERN.search(d.content):
+                results.append(
+                    Inference(
+                        problem="oom",
+                        cause="host or HBM memory exhausted",
+                        action="relaunch_node",
+                        node_rank=d.node_rank,
+                    )
+                )
+        return results
+
+
+class ChipErrorOperator(InferenceOperator):
+    """libtpu / XLA hardware error signatures → node replacement."""
+
+    _PATTERN = re.compile(
+        r"(tpu.*(unhealthy|halted)|DEADLINE_EXCEEDED.*collective|"
+        r"slice health|device or resource busy|uncorrectable)",
+        re.IGNORECASE,
+    )
+
+    def infer(self, store: DiagnosisDataStore) -> List[Inference]:
+        results = []
+        for d in store.get(DiagnosisDataType.TRAINING_LOG):
+            if self._PATTERN.search(d.content):
+                results.append(
+                    Inference(
+                        problem="chip_error",
+                        cause="TPU hardware/runtime fault",
+                        action="relaunch_node",
+                        node_rank=d.node_rank,
+                    )
+                )
+        return results
+
+
+class PreemptionOperator(InferenceOperator):
+    _PATTERN = re.compile(
+        r"(maintenance event|preempt|TERMINATING)", re.IGNORECASE
+    )
+
+    def infer(self, store: DiagnosisDataStore) -> List[Inference]:
+        results = []
+        for d in store.get(DiagnosisDataType.AGENT_REPORT):
+            if self._PATTERN.search(d.content):
+                results.append(
+                    Inference(
+                        problem="preemption",
+                        cause="TPU-VM maintenance/spot reclaim",
+                        action="relaunch_node",
+                        node_rank=d.node_rank,
+                    )
+                )
+        return results
+
+
+class HangOperator(InferenceOperator):
+    """Step stagnation from the SpeedMonitor."""
+
+    def __init__(self, speed_monitor, hang_secs: Optional[float] = None):
+        self._speed_monitor = speed_monitor
+        self._hang_secs = hang_secs
+
+    def infer(self, store: DiagnosisDataStore) -> List[Inference]:
+        if self._speed_monitor and self._speed_monitor.step_is_stagnant(
+            self._hang_secs
+        ):
+            return [
+                Inference(
+                    problem="hang",
+                    cause="global step stagnant beyond threshold",
+                    action="restart_process",
+                )
+            ]
+        return []
+
+
+class InferenceChain:
+    def __init__(self, operators: List[InferenceOperator]):
+        self._operators = operators
+
+    def infer(self, store: DiagnosisDataStore) -> List[Inference]:
+        conclusions = []
+        for op in self._operators:
+            conclusions.extend(op.infer(store))
+        return conclusions
+
+
+class DiagnosisManager:
+    def __init__(
+        self,
+        speed_monitor=None,
+        operators: Optional[List[InferenceOperator]] = None,
+        interval: float = 60.0,
+    ):
+        self.store = DiagnosisDataStore()
+        if operators is None:
+            operators = [
+                OomOperator(),
+                ChipErrorOperator(),
+                PreemptionOperator(),
+            ]
+            if speed_monitor is not None:
+                operators.append(HangOperator(speed_monitor))
+        self.chain = InferenceChain(operators)
+        self._interval = interval
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._conclusions: List[Inference] = []
+        self._lock = threading.Lock()
+
+    def collect_data(self, data: DiagnosisData):
+        self.store.add(data)
+
+    def diagnose(self) -> List[Inference]:
+        conclusions = self.chain.infer(self.store)
+        with self._lock:
+            self._conclusions = conclusions
+        return conclusions
+
+    def latest_conclusions(self) -> List[Inference]:
+        with self._lock:
+            return list(self._conclusions)
+
+    def start(self):
+        if self._thread is not None:
+            return
+
+        def _loop():
+            while not self._stopped.wait(self._interval):
+                self.diagnose()
+
+        self._thread = threading.Thread(
+            target=_loop, name="diagnosis", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stopped.set()

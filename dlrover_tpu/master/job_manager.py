@@ -240,6 +240,33 @@ class JobManager(metaclass=ABCMeta):
     def should_restart_node(self, node_type: str, node_id: int) -> bool:
         return self._restart_verdicts.pop(node_id, False)
 
+    def apply_diagnosis_conclusions(self, conclusions):
+        """Act on inference-chain conclusions (master/diagnosis.py):
+        restart_process / relaunch_node set the per-node restart
+        verdict that agents poll via CheckHardwareResetRequest."""
+        with self._lock:
+            for c in conclusions:
+                if c.action not in ("restart_process", "relaunch_node"):
+                    continue
+                targets = (
+                    [c.node_rank]
+                    if c.node_rank >= 0
+                    else list(self._nodes)
+                )
+                for node_id in targets:
+                    node = self._nodes.get(node_id)
+                    if node is None:
+                        continue
+                    if c.action == "relaunch_node":
+                        node.set_exit_reason(
+                            NodeExitReason.HARDWARE_ERROR
+                        )
+                    self._restart_verdicts[node_id] = True
+                logger.info(
+                    "diagnosis %s (%s) -> %s nodes %s",
+                    c.problem, c.cause, c.action, targets,
+                )
+
     def update_paral_config(self, config: ParallelConfig):
         self._paral_config = config
 

@@ -50,6 +50,12 @@ class JobMaster:
         }
         self.kv_store = KVStoreService()
         self.job_manager = job_manager
+        if diagnosis_manager is None:
+            from dlrover_tpu.master.diagnosis import DiagnosisManager
+
+            diagnosis_manager = DiagnosisManager(
+                speed_monitor=self.speed_monitor
+            )
         self.diagnosis_manager = diagnosis_manager
         self.speed_monitor.set_target_worker_num(node_num)
         self._node_num = node_num
@@ -86,13 +92,26 @@ class JobMaster:
         self._server.start()
         self.task_manager.start()
         self.job_manager.start()
+        if self.diagnosis_manager:
+            self.diagnosis_manager.start()
         logger.info("master serving on port %s", self._port)
+
+    def process_diagnosis(self):
+        """Feed inference-chain conclusions to the job manager (run
+        from the supervision loops)."""
+        if not self.diagnosis_manager:
+            return
+        conclusions = self.diagnosis_manager.latest_conclusions()
+        if conclusions:
+            self.job_manager.apply_diagnosis_conclusions(conclusions)
 
     def stop(self, reason: str = ""):
         self._exit_reason = reason or self._exit_reason
         self._stopped.set()
         self.task_manager.stop()
         self.job_manager.stop()
+        if self.diagnosis_manager:
+            self.diagnosis_manager.stop()
         if self._server:
             self._server.stop(grace=0.5)
 
@@ -118,6 +137,7 @@ class LocalJobMaster(JobMaster):
                 logger.info("all dataset tasks finished")
                 self.request_stop(True, JobExitReason.SUCCEEDED)
                 break
+            self.process_diagnosis()
             time.sleep(1)
         return 0
 
@@ -159,6 +179,7 @@ class DistributedJobMaster(JobMaster):
             if self.task_manager.finished():
                 self.request_stop(True, JobExitReason.SUCCEEDED)
                 break
+            self.process_diagnosis()
             self._stopped.wait(self.SUPERVISE_INTERVAL)
         return exit_code
 

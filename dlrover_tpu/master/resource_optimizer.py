@@ -1,0 +1,129 @@
+"""Resource optimization: throughput-driven scale plans.
+
+Reference parity: ``dlrover/python/master/resource/optimizer.py``
+(``ResourceOptimizer`` ABC), ``local_optimizer.py:66``
+(``PSLocalOptimizer``: stage-based plans, worker-speed-ratio scaling
+``:250``, OOM recovery ``:98``) and the Go Brain's
+``optimize_job_worker_resource.go`` linear-throughput extrapolation.
+
+TPU form: the unit of scaling is a whole TPU-VM worker (chips come in
+fixed slices), so plans adjust *worker count* within [min, max] using
+the marginal-throughput estimate from SpeedMonitor samples, plus the
+OOM ladder (grow host memory for the relaunched worker).
+"""
+
+from abc import ABCMeta, abstractmethod
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.common.constants import NodeType
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.messages import ScalePlan
+
+
+@dataclass
+class SpeedSample:
+    worker_num: int
+    records_per_sec: float
+
+
+class ResourceOptimizer(metaclass=ABCMeta):
+    @abstractmethod
+    def generate_plan(self, stage: str) -> Optional[ScalePlan]:
+        ...
+
+
+class JobStage:
+    CREATE = "create"
+    RUNNING = "running"
+
+
+class LocalAllreduceOptimizer(ResourceOptimizer):
+    """Worker-count optimizer from observed throughput scaling.
+
+    Strategy (mirrors the reference's worker-speed-ratio logic): keep a
+    throughput sample per world size; scale up while the marginal
+    speedup of the last grow step exceeded ``min_marginal_gain`` of
+    linear; scale back to the best-known size otherwise.
+    """
+
+    def __init__(
+        self,
+        min_workers: int = 1,
+        max_workers: int = 1,
+        min_marginal_gain: float = 0.6,
+        oom_memory_factor: float = 1.5,
+    ):
+        self._min = min_workers
+        self._max = max_workers
+        self._gain = min_marginal_gain
+        self._oom_factor = oom_memory_factor
+        self._samples: Dict[int, float] = {}
+
+    def record_speed(self, worker_num: int, records_per_sec: float):
+        if worker_num <= 0 or records_per_sec <= 0:
+            return
+        # keep the best observed throughput per world size
+        prev = self._samples.get(worker_num, 0.0)
+        self._samples[worker_num] = max(prev, records_per_sec)
+
+    def _best_known(self) -> Tuple[int, float]:
+        best_n, best_v = self._min, 0.0
+        for n, v in self._samples.items():
+            if v > best_v:
+                best_n, best_v = n, v
+        return best_n, best_v
+
+    def generate_plan(self, stage: str) -> Optional[ScalePlan]:
+        if stage == JobStage.CREATE:
+            plan = ScalePlan()
+            plan.node_group_resources[NodeType.WORKER] = {
+                "count": self._max
+            }
+            return plan
+        if not self._samples:
+            return None
+        sizes = sorted(self._samples)
+        current = sizes[-1]
+        if len(sizes) >= 2:
+            n0, n1 = sizes[-2], sizes[-1]
+            v0, v1 = self._samples[n0], self._samples[n1]
+            linear = v0 * n1 / n0
+            marginal = (v1 - v0) / max(linear - v0, 1e-9)
+            if marginal < self._gain:
+                # diminishing returns: settle at the best-known size,
+                # never grow further
+                best_n, _ = self._best_known()
+                if best_n < current:
+                    plan = ScalePlan()
+                    plan.node_group_resources[NodeType.WORKER] = {
+                        "count": max(best_n, self._min)
+                    }
+                    logger.info(
+                        "scale back to %d workers (marginal %.2f)",
+                        best_n,
+                        marginal,
+                    )
+                    return plan
+                return None
+        if current < self._max:
+            plan = ScalePlan()
+            plan.node_group_resources[NodeType.WORKER] = {
+                "count": min(current + 1, self._max)
+            }
+            return plan
+        return None
+
+    def oom_recovery_plan(self, node_name: str,
+                          current_memory_mb: int) -> ScalePlan:
+        """Relaunch an OOM-killed worker with grown host memory
+        (reference ``local_optimizer.py:98``)."""
+        plan = ScalePlan()
+        plan.remove_nodes.append(node_name)
+        plan.launch_nodes.append(
+            {
+                "type": NodeType.WORKER,
+                "memory": int(current_memory_mb * self._oom_factor),
+            }
+        )
+        return plan

@@ -255,7 +255,15 @@ class MasterServicer:
             return True
         if isinstance(request, msg.DiagnosisReportData):
             if self._diagnosis_manager:
-                self._diagnosis_manager.collect_data(request)
+                from dlrover_tpu.master.diagnosis import DiagnosisData
+
+                self._diagnosis_manager.collect_data(
+                    DiagnosisData(
+                        data_type=request.data_cls,
+                        content=request.data_content,
+                        node_rank=request.node_rank,
+                    )
+                )
             return True
         if isinstance(request, msg.Event):
             logger.info(

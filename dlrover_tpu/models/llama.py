@@ -190,8 +190,11 @@ def dot_product_attention(q, k, v, causal: bool = True):
         logits = jnp.where(mask[None, None, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum(
-        "bkgqs,bskd->bqkgd", probs.astype(v.dtype), v
-    )
+        "bkgqs,bskd->bqkgd",
+        probs.astype(v.dtype),
+        v,
+        preferred_element_type=jnp.float32,
+    ).astype(v.dtype)
     return out.reshape(b, s, nh, d)
 
 
@@ -210,22 +213,28 @@ def _layer_forward(
     nh, nkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     dt = cfg.dtype
 
+    def proj(a, w):
+        # fp32 MXU accumulation, bf16 storage (the contract above)
+        return jnp.matmul(
+            a, w.astype(dt), preferred_element_type=jnp.float32
+        ).astype(dt)
+
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-    q = (h @ lp["wq"].astype(dt)).reshape(b, s, nh, hd)
-    k = (h @ lp["wk"].astype(dt)).reshape(b, s, nkv, hd)
-    v = (h @ lp["wv"].astype(dt)).reshape(b, s, nkv, hd)
+    q = proj(h, lp["wq"]).reshape(b, s, nh, hd)
+    k = proj(h, lp["wk"]).reshape(b, s, nkv, hd)
+    v = proj(h, lp["wv"]).reshape(b, s, nkv, hd)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     q = sh.apply_sharding_constraint(
         q, (sh.BATCH, sh.SEQ, sh.HEADS, None), _current_rules()
     )
     attn = attention_fn(q, k, v, causal=True)
-    x = x + attn.reshape(b, s, nh * hd) @ lp["wo"].astype(dt)
+    x = x + proj(attn.reshape(b, s, nh * hd), lp["wo"])
 
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-    gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
-    up = h @ lp["w_up"].astype(dt)
-    x = x + (gate * up) @ lp["w_down"].astype(dt)
+    gate = jax.nn.silu(proj(h, lp["w_gate"]))
+    up = proj(h, lp["w_up"])
+    x = x + proj(gate * up, lp["w_down"])
     return x
 
 

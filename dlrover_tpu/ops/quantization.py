@@ -1,0 +1,107 @@
+"""Pallas blockwise int8 quantize/dequantize — low-bit optimizer states.
+
+Reference parity: atorch's CUDA quantization kernels
+(``atorch/atorch/ops/csrc/quantization/quantize.cu:150``,
+``dequantize.cu:67``, ``quantization_optimizer.cu:686``) which store
+Adam moments in 1-byte formats.  The TPU form is a Pallas kernel pair:
+per-block absmax scaling to int8 (symmetric, matching the reference's
+signed dynamic quantization), tiled (block, 128)-aligned for the VPU.
+
+Used by ``dlrover_tpu.optimizers.low_bit`` to keep optimizer state in
+1 byte/param (4x HBM saving vs fp32 moments).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# quantization block: one scale per BLOCK elements
+BLOCK = 1024
+_LANES = 128
+_SUBLANES = BLOCK // _LANES
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _quant_kernel(x_ref, q_ref, scale_ref):
+    x = x_ref[:].astype(jnp.float32)  # [S, 128]
+    absmax = jnp.max(jnp.abs(x))
+    scale = jnp.maximum(absmax / 127.0, 1e-12)
+    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    q_ref[:] = q
+    scale_ref[0, 0] = scale
+
+
+def _dequant_kernel(q_ref, scale_ref, x_ref):
+    x_ref[:] = q_ref[:].astype(jnp.float32) * scale_ref[0, 0]
+
+
+@jax.jit
+def _quantize_2d(x):
+    n_blocks = x.shape[0] // _SUBLANES
+    grid = (n_blocks,)
+    q, scales = pl.pallas_call(
+        _quant_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct(x.shape, jnp.int8),
+            jax.ShapeDtypeStruct((n_blocks, 1), jnp.float32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_SUBLANES, _LANES), lambda i: (i, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((_SUBLANES, _LANES), lambda i: (i, 0)),
+            pl.BlockSpec(
+                (1, 1), lambda i: (i, 0), memory_space=pltpu.SMEM
+            ),
+        ),
+        interpret=_use_interpret(),
+    )(x)
+    return q, scales
+
+
+@jax.jit
+def _dequantize_2d(q, scales):
+    n_blocks = q.shape[0] // _SUBLANES
+    return pl.pallas_call(
+        _dequant_kernel,
+        out_shape=jax.ShapeDtypeStruct(q.shape, jnp.float32),
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((_SUBLANES, _LANES), lambda i: (i, 0)),
+            pl.BlockSpec(
+                (1, 1), lambda i: (i, 0), memory_space=pltpu.SMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec((_SUBLANES, _LANES), lambda i: (i, 0)),
+        interpret=_use_interpret(),
+    )(q, scales)
+
+
+def _pad_to_blocks(flat):
+    n = flat.shape[0]
+    padded = ((n + BLOCK - 1) // BLOCK) * BLOCK
+    if padded != n:
+        flat = jnp.pad(flat, (0, padded - n))
+    return flat, n
+
+
+def quantize_blockwise(x: jnp.ndarray):
+    """Any-shape fp array -> (int8 payload [P/128,128], scales, meta)."""
+    flat = x.reshape(-1).astype(jnp.float32)
+    flat, n = _pad_to_blocks(flat)
+    x2 = flat.reshape(-1, _LANES)
+    q, scales = _quantize_2d(x2)
+    return q, scales, (x.shape, n)
+
+
+def dequantize_blockwise(q, scales, meta, dtype=jnp.float32):
+    shape, n = meta
+    out = _dequantize_2d(q, scales).reshape(-1)[:n]
+    return out.reshape(shape).astype(dtype)

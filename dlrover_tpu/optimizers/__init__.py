@@ -1,0 +1,5 @@
+from dlrover_tpu.optimizers.agd import agd  # noqa: F401
+from dlrover_tpu.optimizers.low_bit import quantized_moments  # noqa: F401
+from dlrover_tpu.optimizers.wsam import (  # noqa: F401
+    wsam_gradients,
+)

@@ -1,0 +1,102 @@
+"""AGD optimizer (NeurIPS'23) as an optax transformation.
+
+Reference parity: ``atorch/atorch/optimizers/agd.py:18`` — AGD
+preconditions with the *stepwise gradient difference*: the second
+moment tracks ``diff = m_t/bc1_t - m_{t-1}/bc1_{t-1}`` (difference of
+bias-corrected first moments) instead of the raw gradient square,
+auto-switching between SGD-like and Adam-like behavior.  Functional
+re-derivation for JAX; same hyperparameters and update rule as the
+reference's dense path (win=False).
+"""
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class AGDState(NamedTuple):
+    step: jnp.ndarray
+    exp_avg: optax.Updates
+    exp_avg_sq: optax.Updates
+    max_exp_avg_sq: Optional[optax.Updates]
+
+
+def agd(
+    learning_rate: float = 1e-3,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    delta: float = 1e-5,
+    weight_decay: float = 0.0,
+    amsgrad: bool = False,
+    clip: Optional[float] = None,
+) -> optax.GradientTransformation:
+    def init_fn(params):
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros_like(p, dtype=jnp.float32), params
+        )
+        return AGDState(
+            step=jnp.zeros((), jnp.int32),
+            exp_avg=zeros,
+            exp_avg_sq=jax.tree_util.tree_map(jnp.copy, zeros),
+            max_exp_avg_sq=(
+                jax.tree_util.tree_map(jnp.copy, zeros)
+                if amsgrad
+                else None
+            ),
+        )
+
+    def update_fn(grads, state, params=None):
+        step = state.step + 1
+        stepf = step.astype(jnp.float32)
+        bc1_old = 1.0 - b1 ** (stepf - 1.0)
+        bc1 = 1.0 - b1**stepf
+        bc2 = 1.0 - b2**stepf
+
+        exp_avg = jax.tree_util.tree_map(
+            lambda m, g: b1 * m + (1 - b1) * g, state.exp_avg, grads
+        )
+        # stepwise gradient difference (first step: just m/bc1)
+        def diff(m_new, m_old):
+            d = m_new / bc1 - m_old / jnp.maximum(bc1_old, 1e-12)
+            return jnp.where(step == 1, m_new / bc1, d)
+
+        diffs = jax.tree_util.tree_map(diff, exp_avg, state.exp_avg)
+        exp_avg_sq = jax.tree_util.tree_map(
+            lambda v, d: b2 * v + (1 - b2) * d * d,
+            state.exp_avg_sq,
+            diffs,
+        )
+        if amsgrad:
+            max_sq = jax.tree_util.tree_map(
+                jnp.maximum, state.max_exp_avg_sq, exp_avg_sq
+            )
+            precond_sq = max_sq
+        else:
+            max_sq = None
+            precond_sq = exp_avg_sq
+
+        delta_adjust = delta * jnp.sqrt(bc2)
+        lr_adjust = learning_rate * jnp.sqrt(bc2) / bc1
+
+        def direction(m, v):
+            denom = jnp.maximum(jnp.sqrt(v), delta_adjust)
+            u = m / denom
+            if clip is not None:
+                u = jnp.clip(u, -clip, clip)
+            return -lr_adjust * u
+
+        updates = jax.tree_util.tree_map(
+            direction, exp_avg, precond_sq
+        )
+        if weight_decay and params is not None:
+            # decoupled decay (reference weight_decouple=True default)
+            updates = jax.tree_util.tree_map(
+                lambda u, p: u - learning_rate * weight_decay * p,
+                updates,
+                params,
+            )
+        return updates, AGDState(step, exp_avg, exp_avg_sq, max_sq)
+
+    return optax.GradientTransformation(init_fn, update_fn)

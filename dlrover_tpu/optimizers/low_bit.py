@@ -1,0 +1,112 @@
+"""1-byte optimizer states: int8-quantized Adam moments.
+
+Reference parity: atorch's low-bit optimizer
+(``atorch/atorch/optimizers/low_bit/`` backed by the CUDA kernels in
+``ops/csrc/quantization/quantization_optimizer.cu``) — Adam moments
+stored quantized, dequantized transiently for the update.  Here the
+quant/dequant are the Pallas kernels in
+``dlrover_tpu.ops.quantization`` and the optimizer is an optax
+transformation, so it composes with the sharded train step (states
+inherit the params' sharding; the quantized payloads shard the same
+way).
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from dlrover_tpu.ops.quantization import (
+    dequantize_blockwise,
+    quantize_blockwise,
+)
+
+
+@jax.tree_util.register_pytree_node_class
+class _QTensor:
+    """Quantized payload; shape/n are static aux data so reshapes stay
+    concrete under jit."""
+
+    def __init__(self, q, scales, shape, n):
+        self.q = q
+        self.scales = scales
+        self.shape = tuple(shape)
+        self.n = n
+
+    def tree_flatten(self):
+        return (self.q, self.scales), (self.shape, self.n)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], *aux)
+
+
+def _quant(x) -> _QTensor:
+    q, scales, (shape, n) = quantize_blockwise(x)
+    return _QTensor(q=q, scales=scales, shape=shape, n=n)
+
+
+def _dequant(t: _QTensor) -> jnp.ndarray:
+    return dequantize_blockwise(t.q, t.scales, (t.shape, t.n))
+
+
+class QuantizedMomentsState(NamedTuple):
+    step: jnp.ndarray
+    mu: optax.Updates  # _QTensor pytree
+    nu: optax.Updates
+
+
+def quantized_moments(
+    learning_rate: float = 1e-3,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    """AdamW with int8 moments (1 byte/param/moment vs 4)."""
+
+    def init_fn(params):
+        def zq(p):
+            return _quant(jnp.zeros(p.shape, jnp.float32))
+
+        return QuantizedMomentsState(
+            step=jnp.zeros((), jnp.int32),
+            mu=jax.tree_util.tree_map(zq, params),
+            nu=jax.tree_util.tree_map(zq, params),
+        )
+
+    def update_fn(grads, state, params=None):
+        step = state.step + 1
+        stepf = step.astype(jnp.float32)
+        bc1 = 1.0 - b1**stepf
+        bc2 = 1.0 - b2**stepf
+
+        def moment_update(g, mu_q, nu_q):
+            g = g.astype(jnp.float32)
+            mu = b1 * _dequant(mu_q) + (1 - b1) * g
+            nu = b2 * _dequant(nu_q) + (1 - b2) * g * g
+            update = -(learning_rate) * (
+                (mu / bc1) / (jnp.sqrt(nu / bc2) + eps)
+            )
+            return update, _quant(mu), _quant(nu)
+
+        out = jax.tree_util.tree_map(
+            moment_update, grads, state.mu, state.nu
+        )
+        # tree_map over 3 trees returns tuples at leaves; unzip
+        treedef = jax.tree_util.tree_structure(grads)
+        flat = treedef.flatten_up_to(out)
+        updates = treedef.unflatten([u for u, _, _ in flat])
+        mu = treedef.unflatten([m for _, m, _ in flat])
+        nu = treedef.unflatten([n for _, _, n in flat])
+
+        if weight_decay and params is not None:
+            updates = jax.tree_util.tree_map(
+                lambda u, p: u - learning_rate * weight_decay * p,
+                updates,
+                params,
+            )
+        return updates, QuantizedMomentsState(step, mu, nu)
+
+    return optax.GradientTransformation(init_fn, update_fn)

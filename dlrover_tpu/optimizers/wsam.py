@@ -1,0 +1,75 @@
+"""Weighted Sharpness-Aware Minimization (KDD'23), functional form.
+
+Reference parity: ``atorch/atorch/optimizers/wsam.py:11``
+(``WeightedSAM``) — two-pass SAM where the final gradient mixes the
+base gradient and the sharpness gradient with weight
+``alpha = gamma / (1 - gamma)``; the torch version is a closure-driven
+optimizer wrapper, the JAX version is a gradient transform:
+``wsam_gradients`` runs both passes and returns the combined gradient
+for any optax optimizer (data-parallel mean included by the caller's
+pjit — no explicit allreduce needed).
+"""
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def _normalized_perturbation(grads, params, rho, adaptive, eps):
+    if adaptive:
+        scaled = jax.tree_util.tree_map(
+            lambda p, g: jnp.abs(p) * g, params, grads
+        )
+    else:
+        scaled = grads
+    norm = optax.global_norm(scaled)
+    scale = rho / (norm + eps)
+    if adaptive:
+        return jax.tree_util.tree_map(
+            lambda p, g: (p**2) * g * scale, params, grads
+        )
+    return jax.tree_util.tree_map(lambda g: g * scale, grads)
+
+
+def wsam_gradients(
+    loss_and_grad_fn: Callable,
+    params,
+    batch,
+    rho: float = 0.05,
+    gamma: float = 0.9,
+    adaptive: bool = False,
+    decouple: bool = True,
+    eps: float = 1e-12,
+) -> Tuple[jnp.ndarray, optax.Updates, optax.Updates]:
+    """Returns (loss, combined_grads, sharpness_grads).
+
+    - coupled (decouple=False): combined = (1-alpha)*g_w + alpha*g_adv
+    - decoupled (default): combined = g_w; sharpness = g_adv - g_w
+      must be applied by the caller as an extra
+      ``-lr * alpha * sharpness`` step (reference ``wsam.py:97-103``).
+    """
+    alpha = gamma / (1.0 - gamma)
+    loss, g_w = loss_and_grad_fn(params, batch)
+    e_w = _normalized_perturbation(g_w, params, rho, adaptive, eps)
+    params_adv = jax.tree_util.tree_map(jnp.add, params, e_w)
+    _, g_adv = loss_and_grad_fn(params_adv, batch)
+    if decouple:
+        sharpness = jax.tree_util.tree_map(
+            jnp.subtract, g_adv, g_w
+        )
+        return loss, g_w, sharpness
+    combined = jax.tree_util.tree_map(
+        lambda gw, ga: (1.0 - alpha) * gw + alpha * ga, g_w, g_adv
+    )
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, g_w)
+    return loss, combined, zeros
+
+
+def wsam_apply_sharpness(params, sharpness, learning_rate, gamma):
+    """The decoupled sharpness correction step."""
+    alpha = gamma / (1.0 - gamma)
+    return jax.tree_util.tree_map(
+        lambda p, s: p - learning_rate * alpha * s, params, sharpness
+    )

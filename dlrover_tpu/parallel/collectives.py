@@ -138,7 +138,6 @@ def ring_attention(
     v: jnp.ndarray,
     axis_name: str,
     causal: bool = False,
-    seq_chunk_index: Optional[jnp.ndarray] = None,
     scale: Optional[float] = None,
 ) -> jnp.ndarray:
     """Blockwise ring attention over a sequence-sharded mesh axis.

@@ -65,19 +65,28 @@ def build_train_step(
     replicated = logical_sharding(mesh, rules, ())
 
     def _opt_state_shardings(params_shape):
-        """Optimizer state inherits each param's sharding; scalars
-        (counts) replicate."""
+        """Optimizer state inherits params' shardings structurally:
+        optax moment trees mirror the params pytree (match by tree
+        structure, NOT by leaf shape — distinct params often share a
+        shape, e.g. llama wq/wo, but have transposed layouts); scalar
+        leaves (counts) replicate."""
         opt_shape = jax.eval_shape(optimizer.init, params_shape)
-        param_leaves = jax.tree_util.tree_leaves(params_shape)
-        sharding_leaves = jax.tree_util.tree_leaves(param_shardings)
-        shape_to_sharding = {}
-        for leaf, shard in zip(param_leaves, sharding_leaves):
-            shape_to_sharding.setdefault(leaf.shape, shard)
+        params_def = jax.tree_util.tree_structure(params_shape)
 
-        def pick(leaf):
-            return shape_to_sharding.get(leaf.shape, replicated)
+        def is_params_like(sub):
+            try:
+                return (
+                    jax.tree_util.tree_structure(sub) == params_def
+                )
+            except Exception:  # noqa: BLE001
+                return False
 
-        return jax.tree_util.tree_map(pick, opt_shape)
+        def pick(sub):
+            return param_shardings if is_params_like(sub) else replicated
+
+        return jax.tree_util.tree_map(
+            pick, opt_shape, is_leaf=is_params_like
+        )
 
     def _init_state(rng):
         params = init_params_fn(rng)

@@ -78,22 +78,37 @@ def parse_args(argv=None):
         help="persistent XLA compile cache (keeps restarts cheap)",
     )
     parser.add_argument(
-        "-m",
-        "--module",
-        dest="module",
-        default="",
-        help="run the entrypoint as 'python -m MODULE' instead of a script",
-    )
-    parser.add_argument(
         "training_script",
         nargs="?",
         default="",
-        help="training script path (omit when using -m)",
+        help="training script path (or use -m MODULE)",
     )
     parser.add_argument(
         "training_script_args", nargs=argparse.REMAINDER
     )
+
+    # `-m MODULE [module args...]` is extracted before argparse runs:
+    # REMAINDER cannot absorb option-like tokens after an optional
+    # positional, so flags passed to the module would be rejected.
+    if argv is None:
+        argv = sys.argv[1:]
+    argv = list(argv)
+    module = ""
+    module_args: List[str] = []
+    for flag in ("-m", "--module"):
+        if flag in argv:
+            i = argv.index(flag)
+            if i + 1 >= len(argv):
+                parser.error(f"{flag} requires a module name")
+            module = argv[i + 1]
+            module_args = argv[i + 2 :]
+            argv = argv[:i]
+            break
+
     args = parser.parse_args(argv)
+    args.module = module
+    if module:
+        args.training_script_args = module_args
     if not args.module and not args.training_script:
         parser.error("a training script or -m MODULE is required")
     return args
@@ -146,9 +161,6 @@ def _wait_master(addr: str, timeout: float = 60.0) -> bool:
 def _build_entrypoint(args) -> List[str]:
     script_args = list(args.training_script_args)
     if args.module:
-        if args.training_script:
-            # with -m, the positional slot is the first module arg
-            script_args.insert(0, args.training_script)
         return [sys.executable, "-m", args.module, *script_args]
     return [sys.executable, args.training_script, *script_args]
 

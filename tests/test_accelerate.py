@@ -1,0 +1,130 @@
+"""auto_accelerate engine tests: analyser census, candidate generation
+memory-fit behavior, semi-auto path, full-auto on the virtual mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.accelerate import (
+    Strategy,
+    auto_accelerate,
+    load_strategy,
+)
+from dlrover_tpu.accelerate.analyser import (
+    ModelProfile,
+    analyse_model,
+    fits_in_memory,
+)
+from dlrover_tpu.accelerate.strategy import generate_candidates
+from dlrover_tpu.models.llama import (
+    LlamaConfig,
+    init_params,
+    loss_fn,
+    param_logical_axes,
+)
+from dlrover_tpu.parallel.mesh import destroy_parallel_mesh
+
+
+@pytest.fixture(autouse=True)
+def _clean_mesh():
+    yield
+    destroy_parallel_mesh()
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg():
+    return LlamaConfig.tiny(remat="none")
+
+
+class TestAnalyser:
+    def test_census_matches_real_init(self, tiny_cfg):
+        profile = analyse_model(
+            lambda rng: init_params(rng, tiny_cfg), optax.adamw(1e-3)
+        )
+        params = init_params(jax.random.PRNGKey(0), tiny_cfg)
+        real = sum(x.size for x in jax.tree_util.tree_leaves(params))
+        assert profile.num_params == real
+        assert profile.optimizer_bytes > profile.param_bytes  # 2 moments
+
+    def test_memory_fit(self):
+        # 100B fp32 params + opt never fits one 16GB device unsharded
+        big = ModelProfile(
+            num_params=100_000_000_000,
+            param_bytes=400_000_000_000,
+            largest_leaf=1,
+            leaf_count=1,
+            optimizer_bytes=800_000_000_000,
+        )
+        fits, _ = fits_in_memory(big, 8, fsdp=1, tensor=1)
+        assert not fits
+        fits_sharded, _ = fits_in_memory(big, 256, fsdp=128, tensor=8)
+        assert fits_sharded
+
+
+class TestCandidates:
+    def test_small_model_prefers_pure_dp(self, tiny_cfg):
+        profile = analyse_model(
+            lambda rng: init_params(rng, tiny_cfg), optax.adamw(1e-3)
+        )
+        cands = generate_candidates(profile, 8)
+        assert cands[0].data == 8  # tiny model -> plain DP wins
+        assert cands[0].tensor == 1
+
+    def test_big_model_requires_sharding(self):
+        big = ModelProfile(
+            num_params=7_000_000_000,
+            param_bytes=28_000_000_000,
+            largest_leaf=1,
+            leaf_count=1,
+            optimizer_bytes=56_000_000_000,
+        )
+        cands = generate_candidates(big, 8)
+        assert cands, "7B must have some fitting layout on 8 devices"
+        for s in cands:
+            assert s.fsdp * s.tensor >= 8  # must shard the state
+
+    def test_long_context_adds_seq_axis(self, tiny_cfg):
+        profile = analyse_model(
+            lambda rng: init_params(rng, tiny_cfg), optax.adamw(1e-3)
+        )
+        cands = generate_candidates(profile, 8, long_context=True)
+        assert any(s.seq > 1 for s in cands)
+
+
+class TestAutoAccelerate:
+    def test_semi_auto(self, tiny_cfg):
+        result = auto_accelerate(
+            loss_fn=lambda p, b: loss_fn(p, b, tiny_cfg),
+            optimizer=optax.adamw(1e-3),
+            init_params_fn=lambda rng: init_params(rng, tiny_cfg),
+            param_axes=param_logical_axes(tiny_cfg),
+            load_strategy=load_strategy(
+                {"data": 2, "fsdp": 4, "remat": "none"}
+            ),
+        )
+        assert result.strategy.fsdp == 4
+        state = result.fns.init_state(jax.random.PRNGKey(0))
+        tokens = jnp.ones((8, 17), dtype=jnp.int32)
+        batch = jax.device_put(
+            {"tokens": tokens}, result.fns.batch_sharding
+        )
+        state, metrics = result.fns.train_step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))
+
+    def test_full_auto_picks_and_runs(self, tiny_cfg):
+        result = auto_accelerate(
+            loss_fn=lambda p, b: loss_fn(p, b, tiny_cfg),
+            optimizer=optax.adamw(1e-3),
+            init_params_fn=lambda rng: init_params(rng, tiny_cfg),
+            param_axes=param_logical_axes(tiny_cfg),
+        )
+        assert result.strategy.n_devices == 8
+        state = result.fns.init_state(jax.random.PRNGKey(0))
+        tokens = jnp.ones((8, 17), dtype=jnp.int32)
+        batch = jax.device_put(
+            {"tokens": tokens}, result.fns.batch_sharding
+        )
+        _, metrics = result.fns.train_step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))

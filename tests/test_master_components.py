@@ -1,0 +1,197 @@
+"""Master-side components: scalers, watchers, auto-scaler, resource
+optimizer, diagnosis inference chain, stats collection."""
+
+import time
+
+import pytest
+
+from dlrover_tpu.common.constants import (
+    NodeEventType,
+    NodeStatus,
+    NodeType,
+)
+from dlrover_tpu.common.messages import ScalePlan
+from dlrover_tpu.common.node import Node
+from dlrover_tpu.master.auto_scaler import AllreduceAutoScaler
+from dlrover_tpu.master.diagnosis import (
+    DiagnosisData,
+    DiagnosisDataType,
+    DiagnosisManager,
+)
+from dlrover_tpu.master.job_manager import NodeEvent
+from dlrover_tpu.master.resource_optimizer import (
+    JobStage,
+    LocalAllreduceOptimizer,
+)
+from dlrover_tpu.master.scaler import InMemoryScaler
+from dlrover_tpu.master.stats import (
+    JobMetricCollector,
+    LocalStatsReporter,
+    RuntimeMetric,
+)
+from dlrover_tpu.master.watcher import FakeWatcher, pod_phase_to_status
+
+
+class TestInMemoryScaler:
+    def test_group_scale_up(self):
+        scaler = InMemoryScaler()
+        plan = ScalePlan()
+        plan.node_group_resources[NodeType.WORKER] = {"count": 3}
+        scaler.scale(plan)
+        workers = [
+            n for n in scaler.alive.values()
+            if n.type == NodeType.WORKER
+        ]
+        assert len(workers) == 3
+
+    def test_remove_and_launch(self):
+        scaler = InMemoryScaler()
+        plan = ScalePlan()
+        plan.node_group_resources[NodeType.WORKER] = {"count": 2}
+        scaler.scale(plan)
+        victim = next(iter(scaler.alive))
+        plan2 = ScalePlan()
+        plan2.remove_nodes.append(victim)
+        plan2.launch_nodes.append(
+            {"type": NodeType.WORKER, "memory": 4096}
+        )
+        scaler.scale(plan2)
+        assert victim not in scaler.alive
+        assert len(scaler.alive) == 2
+
+
+class TestResourceOptimizer:
+    def test_create_stage_plan(self):
+        opt = LocalAllreduceOptimizer(min_workers=1, max_workers=4)
+        plan = opt.generate_plan(JobStage.CREATE)
+        assert plan.node_group_resources[NodeType.WORKER]["count"] == 4
+
+    def test_scale_up_while_linear(self):
+        opt = LocalAllreduceOptimizer(min_workers=1, max_workers=8)
+        opt.record_speed(2, 200.0)
+        opt.record_speed(3, 295.0)  # near-linear gain
+        plan = opt.generate_plan(JobStage.RUNNING)
+        assert plan.node_group_resources[NodeType.WORKER]["count"] == 4
+
+    def test_scale_back_on_diminishing_returns(self):
+        opt = LocalAllreduceOptimizer(min_workers=1, max_workers=8)
+        opt.record_speed(2, 200.0)
+        opt.record_speed(4, 210.0)  # barely better than 2 workers
+        plan = opt.generate_plan(JobStage.RUNNING)
+        # marginal gain << linear: settle at best-known (4 has best
+        # absolute speed but marginal is poor -> keeps best_n=4? no:
+        # best throughput is 210 @ 4; plan only shrinks when best_n <
+        # current. Here best_n == current -> grow is suppressed.
+        if plan is not None:
+            count = plan.node_group_resources[NodeType.WORKER]["count"]
+            assert count <= 4
+
+    def test_oom_recovery_grows_memory(self):
+        opt = LocalAllreduceOptimizer(oom_memory_factor=2.0)
+        plan = opt.oom_recovery_plan("worker-1", 8192)
+        assert plan.remove_nodes == ["worker-1"]
+        assert plan.launch_nodes[0]["memory"] == 16384
+
+
+class TestAutoScaler:
+    def test_initial_plan_executes(self):
+        opt = LocalAllreduceOptimizer(min_workers=1, max_workers=2)
+        scaler = InMemoryScaler()
+        auto = AllreduceAutoScaler(opt, scaler, interval=3600)
+        auto.execute_initial_plan()
+        assert len(scaler.alive) == 2
+
+
+class TestWatcher:
+    def test_phase_mapping(self):
+        assert pod_phase_to_status("Running") == NodeStatus.RUNNING
+        assert pod_phase_to_status("Failed") == NodeStatus.FAILED
+        assert pod_phase_to_status("???") == NodeStatus.UNKNOWN
+
+    def test_fake_watcher_event_flow(self):
+        received = []
+        w = FakeWatcher()
+        w.watch(received.append)
+        node = Node(node_id=0, status=NodeStatus.RUNNING)
+        w.push(NodeEvent(NodeEventType.MODIFIED, node))
+        assert received and received[0].node.id == 0
+
+
+class TestDiagnosis:
+    def test_oom_inference(self):
+        mgr = DiagnosisManager()
+        mgr.collect_data(
+            DiagnosisData(
+                DiagnosisDataType.TRAINING_LOG,
+                "CUDA error: RESOURCE_EXHAUSTED: out of memory",
+                node_rank=3,
+            )
+        )
+        conclusions = mgr.diagnose()
+        assert any(
+            c.problem == "oom" and c.node_rank == 3
+            for c in conclusions
+        )
+
+    def test_chip_error_inference(self):
+        mgr = DiagnosisManager()
+        mgr.collect_data(
+            DiagnosisData(
+                DiagnosisDataType.TRAINING_LOG,
+                "TPU slice health check failed: device halted",
+                node_rank=1,
+            )
+        )
+        assert any(
+            c.problem == "chip_error" for c in mgr.diagnose()
+        )
+
+    def test_preemption_inference(self):
+        mgr = DiagnosisManager()
+        mgr.collect_data(
+            DiagnosisData(
+                DiagnosisDataType.AGENT_REPORT,
+                "received maintenance event notice",
+                node_rank=0,
+            )
+        )
+        assert any(
+            c.problem == "preemption" and c.action == "relaunch_node"
+            for c in mgr.diagnose()
+        )
+
+    def test_clean_logs_no_conclusions(self):
+        mgr = DiagnosisManager()
+        mgr.collect_data(
+            DiagnosisData(
+                DiagnosisDataType.TRAINING_LOG, "step 100 loss 2.5"
+            )
+        )
+        assert mgr.diagnose() == []
+
+
+class TestStats:
+    def test_runtime_collection(self, tmp_path):
+        dump = tmp_path / "stats.jsonl"
+        reporter = LocalStatsReporter(dump_path=str(dump))
+        reporter.report_runtime(
+            RuntimeMetric(
+                timestamp=time.time(),
+                global_step=10,
+                speed=5.0,
+                running_nodes=2,
+            )
+        )
+        reporter.report_job_exit(True, "finished")
+        assert len(reporter.runtime) == 1
+        assert reporter.exit_info["success"]
+        assert dump.exists() and len(dump.read_text().splitlines()) == 2
+
+    def test_collector_model_info(self):
+        reporter = LocalStatsReporter()
+        collector = JobMetricCollector(reporter)
+        collector.collect_model_info(
+            num_params=123, hidden_size=64, num_layers=2
+        )
+        assert reporter.model.num_params == 123
+        assert reporter.model.hidden_size == 64

@@ -1,0 +1,126 @@
+"""Optimizer library tests: AGD, WSAM, int8-quantized moments, and the
+Pallas quantization kernels (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.ops.quantization import (
+    dequantize_blockwise,
+    quantize_blockwise,
+)
+from dlrover_tpu.optimizers import agd, quantized_moments, wsam_gradients
+from dlrover_tpu.optimizers.wsam import wsam_apply_sharpness
+
+
+def _quadratic_problem():
+    """min ||Wx - y||^2 over W."""
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (32, 8))
+    w_true = jax.random.normal(jax.random.fold_in(key, 1), (8, 4))
+    y = x @ w_true
+
+    def loss_fn(params, batch=None):
+        return jnp.mean((x @ params["w"] - y) ** 2)
+
+    params = {"w": jnp.zeros((8, 4))}
+    return loss_fn, params
+
+
+def _run_optimizer(opt, steps=60, lr_for_sharpness=None):
+    loss_fn, params = _quadratic_problem()
+    state = opt.init(params)
+    losses = []
+    for _ in range(steps):
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, state = opt.update(grads, state, params)
+        params = optax.apply_updates(params, updates)
+        losses.append(float(loss))
+    return losses
+
+
+class TestAGD:
+    def test_converges(self):
+        losses = _run_optimizer(agd(learning_rate=5e-2))
+        assert losses[-1] < 0.05 * losses[0]
+
+    def test_weight_decay_shrinks(self):
+        opt = agd(learning_rate=1e-2, weight_decay=0.5)
+        params = {"w": jnp.ones((4, 4))}
+        state = opt.init(params)
+        grads = {"w": jnp.zeros((4, 4))}
+        updates, _ = opt.update(grads, state, params)
+        assert float(jnp.sum(updates["w"])) < 0
+
+    def test_amsgrad_path(self):
+        losses = _run_optimizer(agd(learning_rate=5e-2, amsgrad=True))
+        assert losses[-1] < 0.1 * losses[0]
+
+
+class TestWSAM:
+    def test_decoupled_converges(self):
+        loss_fn, params = _quadratic_problem()
+        opt = optax.sgd(5e-2)
+        state = opt.init(params)
+        lg = jax.value_and_grad(loss_fn)
+
+        def lg_fn(p, b):
+            return lg(p)
+
+        losses = []
+        for _ in range(80):
+            loss, g, sharp = wsam_gradients(
+                lg_fn, params, None, rho=0.05, gamma=0.5
+            )
+            updates, state = opt.update(g, state, params)
+            params = optax.apply_updates(params, updates)
+            params = wsam_apply_sharpness(params, sharp, 5e-2, 0.5)
+            losses.append(float(loss))
+        assert losses[-1] < 0.05 * losses[0]
+
+    def test_coupled_mixes_gradients(self):
+        loss_fn, params = _quadratic_problem()
+        lg = jax.value_and_grad(loss_fn)
+        loss, g, zeros = wsam_gradients(
+            lambda p, b: lg(p), params, None, decouple=False
+        )
+        assert float(optax.global_norm(g)) > 0
+        assert float(optax.global_norm(zeros)) == 0
+
+
+class TestQuantization:
+    @pytest.mark.parametrize("shape", [(1024,), (300,), (17, 257)])
+    def test_roundtrip_error_small(self, shape):
+        x = jax.random.normal(jax.random.PRNGKey(0), shape) * 3.0
+        q, scales, meta = quantize_blockwise(x)
+        back = dequantize_blockwise(q, scales, meta)
+        assert back.shape == x.shape
+        err = np.max(np.abs(np.asarray(back - x)))
+        scale = float(jnp.max(jnp.abs(x)))
+        assert err <= scale / 127.0 + 1e-6
+        assert q.dtype == jnp.int8
+
+    def test_zero_input(self):
+        x = jnp.zeros((256,))
+        q, scales, meta = quantize_blockwise(x)
+        back = dequantize_blockwise(q, scales, meta)
+        np.testing.assert_array_equal(np.asarray(back), 0)
+
+
+class TestQuantizedMoments:
+    def test_converges_close_to_adamw(self):
+        q_losses = _run_optimizer(quantized_moments(5e-2), steps=60)
+        a_losses = _run_optimizer(optax.adam(5e-2), steps=60)
+        assert q_losses[-1] < 0.1 * q_losses[0]
+        # same ballpark as full-precision adam
+        assert q_losses[-1] < max(10 * a_losses[-1], 0.05)
+
+    def test_state_is_int8(self):
+        opt = quantized_moments(1e-3)
+        params = {"w": jnp.ones((256, 4))}
+        state = opt.init(params)
+        assert state.mu["w"].q.dtype == jnp.int8
+        payload = state.mu["w"].q.size  # bytes
+        assert payload == 256 * 4  # 1 byte per param
