@@ -169,8 +169,11 @@ class DiagnosisManager:
         speed_monitor=None,
         operators: Optional[List[InferenceOperator]] = None,
         interval: float = 60.0,
+        conclusion_cooldown: float = 600.0,
     ):
         self.store = DiagnosisDataStore()
+        self._cooldown = conclusion_cooldown
+        self._emitted: Dict = {}
         if operators is None:
             operators = [
                 OomOperator(),
@@ -190,14 +193,29 @@ class DiagnosisManager:
         self.store.add(data)
 
     def diagnose(self) -> List[Inference]:
+        """Run the chain, de-duplicating conclusions: the same
+        (problem, node, action) fires at most once per cooldown — a
+        single stored log line must not re-trigger restarts every
+        cycle while it ages out of the data window."""
         conclusions = self.chain.infer(self.store)
+        now = time.time()
+        fresh = []
         with self._lock:
-            self._conclusions = conclusions
-        return conclusions
+            for c in conclusions:
+                key = (c.problem, c.node_rank, c.action)
+                last = self._emitted.get(key, 0.0)
+                if now - last < self._cooldown:
+                    continue
+                self._emitted[key] = now
+                fresh.append(c)
+            self._conclusions.extend(fresh)
+        return fresh
 
-    def latest_conclusions(self) -> List[Inference]:
+    def take_conclusions(self) -> List[Inference]:
+        """Consume pending conclusions (applied exactly once)."""
         with self._lock:
-            return list(self._conclusions)
+            out, self._conclusions = self._conclusions, []
+            return out
 
     def start(self):
         if self._thread is not None:

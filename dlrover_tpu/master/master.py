@@ -101,7 +101,7 @@ class JobMaster:
         from the supervision loops)."""
         if not self.diagnosis_manager:
             return
-        conclusions = self.diagnosis_manager.latest_conclusions()
+        conclusions = self.diagnosis_manager.take_conclusions()
         if conclusions:
             self.job_manager.apply_diagnosis_conclusions(conclusions)
 

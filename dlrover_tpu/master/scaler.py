@@ -57,6 +57,14 @@ class InMemoryScaler(Scaler):
                 )
                 self.alive[node.name] = node
                 self._next_id += 1
+            # scale-down: drop the newest nodes beyond the target
+            excess = len(existing) - count
+            if excess > 0:
+                for node in sorted(
+                    existing, key=lambda n: n.id, reverse=True
+                )[:excess]:
+                    self.alive.pop(node.name, None)
+                    node.update_status(NodeStatus.DELETED)
         for name in plan.remove_nodes:
             node = self.alive.pop(name, None)
             if node:
@@ -113,6 +121,8 @@ class TpuPodScaler(Scaler):
         """TPU worker pod: the template carries the TPU nodeSelector
         (``cloud.google.com/gke-tpu-topology`` etc.); per-node env
         carries the rank contract."""
+        import copy
+
         from dlrover_tpu.common.constants import NodeEnv
 
         manifest = {
@@ -127,7 +137,9 @@ class TpuPodScaler(Scaler):
                     "node-id": str(node_id),
                 },
             },
-            "spec": dict(self._pod_template),
+            # deep copy: env appended below must not mutate the shared
+            # template across pods
+            "spec": copy.deepcopy(self._pod_template),
         }
         containers = manifest["spec"].setdefault(
             "containers",
@@ -142,13 +154,52 @@ class TpuPodScaler(Scaler):
         )
         return manifest
 
+    def _existing_ids(self, node_type: str):
+        """Live pod ids + names from labels (id reuse after a
+        mid-range death would 409 on AlreadyExists)."""
+        pods = self._client.list_pods(
+            f"job={self._job_name},node-type={node_type}"
+        )
+        ids = {}
+        for pod in pods.items:
+            labels = pod.metadata.labels or {}
+            try:
+                ids[int(labels.get("node-id", "-1"))] = pod.metadata.name
+            except ValueError:
+                continue
+        ids.pop(-1, None)
+        return ids
+
     def scale(self, plan: ScalePlan):
         for node_type, group in plan.node_group_resources.items():
             count = group.get("count", 0)
-            alive = self._client.count_pods(self._job_name, node_type)
-            for i in range(alive, count):
-                self._create_pod(node_type, i, group)
+            existing = self._existing_ids(node_type)
+            missing = count - len(existing)
+            if missing > 0:
+                next_id = max(existing, default=-1) + 1
+                for i in range(missing):
+                    self._create_pod(node_type, next_id + i, group)
+            elif missing < 0:
+                # scale-down: remove the highest-id pods
+                for node_id in sorted(existing, reverse=True)[:-missing]:
+                    self._remove_pod(existing[node_id])
         for name in plan.remove_nodes:
+            self._remove_pod(name)
+        # launch_nodes: replacement pods with fresh ids and per-node
+        # resource overrides (OOM memory growth etc.)
+        for node_spec in plan.launch_nodes:
+            node_type = node_spec.get("type", NodeType.WORKER)
+            existing = self._existing_ids(node_type)
+            self._create_pod(
+                node_type, max(existing, default=-1) + 1, node_spec
+            )
+        # migrate = launch replacement, then remove the old pod
+        for name, node_spec in plan.migrate_nodes.items():
+            node_type = node_spec.get("type", NodeType.WORKER)
+            existing = self._existing_ids(node_type)
+            self._create_pod(
+                node_type, max(existing, default=-1) + 1, node_spec
+            )
             self._remove_pod(name)
 
     def _create_pod(self, node_type: str, node_id: int, resource: Dict,
@@ -184,9 +235,13 @@ class TpuPodScaler(Scaler):
                 time.sleep(self._retry_interval)
                 with self._lock:
                     queue, self._retry_queue = self._retry_queue, []
-                if not queue:
-                    self._retry_thread = None
-                    return
+                    if not queue:
+                        # exit decision under the lock: a concurrent
+                        # enqueue either lands before this (we drain
+                        # it next loop) or sees _retry_thread=None and
+                        # spawns a fresh thread
+                        self._retry_thread = None
+                        return
                 for node_type, node_id, resource, attempt in queue:
                     self._create_pod(
                         node_type, node_id, resource, attempt

@@ -86,11 +86,13 @@ class PodWatcher(NodeWatcher):
         return nodes
 
     def watch(self, handler: Callable[[NodeEvent], None]):
+        backoff = 1.0
         while not self._stopped.is_set():
             try:
                 for raw in self._client.watch_pods(self._selector):
                     if self._stopped.is_set():
                         return
+                    backoff = 1.0  # stream is healthy
                     node = self._pod_to_node(raw["object"])
                     if node is None:
                         continue
@@ -101,7 +103,14 @@ class PodWatcher(NodeWatcher):
                     }.get(raw["type"], NodeEventType.MODIFIED)
                     handler(NodeEvent(etype, node))
             except Exception as e:  # noqa: BLE001
-                logger.warning("pod watch interrupted: %s; re-listing", e)
+                logger.warning(
+                    "pod watch interrupted: %s; retry in %.0fs",
+                    e,
+                    backoff,
+                )
+                if self._stopped.wait(backoff):
+                    return
+                backoff = min(backoff * 2, 60.0)
 
     def stop(self):
         self._stopped.set()

@@ -1,0 +1,5 @@
+from dlrover_tpu.observability.metrics import (  # noqa: F401
+    MetricsExporter,
+    MetricsRegistry,
+)
+from dlrover_tpu.observability.profiler import AProfiler  # noqa: F401

@@ -1,0 +1,132 @@
+"""Training metrics registry + native Prometheus exporter control.
+
+Reference parity: xpu_timer's bvar/Prometheus export
+(``atorch/dev/xpu_timer``, port 28888+rank).  Training processes write
+counters/gauges through ``MetricsRegistry`` (atomic file rewrite);
+the C++ daemon (``native/metrics_exporter/exporter.cc``) serves them
+as Prometheus text on 28888+rank.
+"""
+
+import os
+import subprocess
+import tempfile
+import threading
+import time
+from typing import Dict, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+BASE_PORT = 28888  # xpu_timer's port convention
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_SRC = os.path.join(
+    _REPO_ROOT, "native", "metrics_exporter", "exporter.cc"
+)
+_BIN_DIR = os.path.join(_REPO_ROOT, "native", "metrics_exporter", "build")
+_BIN = os.path.join(_BIN_DIR, "metrics_exporter")
+
+
+class MetricsRegistry:
+    """Process-local metric store flushed to the exporter file."""
+
+    def __init__(self, path: str = "", flush_interval: float = 5.0):
+        self._path = path or os.path.join(
+            tempfile.gettempdir(),
+            f"dlrover_tpu_metrics_{os.getpid()}.prom",
+        )
+        self._metrics: Dict[str, float] = {}
+        self._lock = threading.Lock()
+        self._flush_interval = flush_interval
+        self._last_flush = 0.0
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def _key(self, name: str, labels: Optional[Dict] = None) -> str:
+        if not labels:
+            return name
+        inner = ",".join(
+            f'{k}="{v}"' for k, v in sorted(labels.items())
+        )
+        return f"{name}{{{inner}}}"
+
+    def set_gauge(self, name: str, value: float, labels=None):
+        with self._lock:
+            self._metrics[self._key(name, labels)] = float(value)
+        self._maybe_flush()
+
+    def inc_counter(self, name: str, value: float = 1.0, labels=None):
+        key = self._key(name, labels)
+        with self._lock:
+            self._metrics[key] = self._metrics.get(key, 0.0) + value
+        self._maybe_flush()
+
+    def observe_duration(self, name: str, seconds: float, labels=None):
+        """Simple duration tracking: _sum/_count pair."""
+        self.inc_counter(name + "_seconds_sum", seconds, labels)
+        self.inc_counter(name + "_count", 1.0, labels)
+
+    def _maybe_flush(self):
+        now = time.time()
+        if now - self._last_flush >= self._flush_interval:
+            self.flush()
+
+    def flush(self):
+        with self._lock:
+            lines = [
+                f"{k} {v:.9g}" for k, v in sorted(self._metrics.items())
+            ]
+            self._last_flush = time.time()
+        tmp = self._path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                f.write("\n".join(lines) + "\n")
+            os.replace(tmp, self._path)
+        except OSError as e:
+            logger.warning("metrics flush failed: %s", e)
+
+
+class MetricsExporter:
+    """Builds (once) and supervises the native exporter daemon."""
+
+    def __init__(self, registry: MetricsRegistry, rank: int = 0,
+                 port: Optional[int] = None):
+        self._registry = registry
+        self._port = port if port is not None else BASE_PORT + rank
+        self._proc: Optional[subprocess.Popen] = None
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    @staticmethod
+    def build() -> str:
+        os.makedirs(_BIN_DIR, exist_ok=True)
+        if not os.path.exists(_BIN) or os.path.getmtime(
+            _BIN
+        ) < os.path.getmtime(_SRC):
+            cmd = ["g++", "-O2", "-std=c++17", "-o", _BIN, _SRC]
+            logger.info("building metrics exporter: %s", " ".join(cmd))
+            subprocess.run(cmd, check=True, capture_output=True)
+        return _BIN
+
+    def start(self):
+        binary = self.build()
+        self._registry.flush()
+        self._proc = subprocess.Popen(  # noqa: S603
+            [binary, self._registry.path, str(self._port)],
+            stderr=subprocess.DEVNULL,
+        )
+        logger.info("metrics exporter on :%d", self._port)
+
+    def stop(self):
+        if self._proc is not None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+            self._proc = None

@@ -1,0 +1,2 @@
+from dlrover_tpu.sparse.kv_table import KvTable  # noqa: F401
+from dlrover_tpu.sparse.embedding import SparseEmbedding  # noqa: F401

@@ -1,0 +1,68 @@
+"""Sparse embedding layer: host KvTable gather -> TPU dense compute.
+
+Reference parity: tfplus ``embedding_ops.py`` +
+``kv_variable_ops.py``'s saver patching.  The TPU split: the unbounded
+id space lives in host memory (C++ table), each step gathers the
+batch's rows into a dense [B, dim] array that goes to the device; the
+backward path scatters row gradients back into the table (sparse
+update, no dense embedding matrix ever exists).  This is the classic
+host-offload recommendation engine pattern.
+"""
+
+from typing import Optional
+
+import numpy as np
+
+from dlrover_tpu.sparse.kv_table import KvTable
+
+
+class SparseEmbedding:
+    def __init__(
+        self,
+        dim: int,
+        init_stddev: float = 0.01,
+        seed: int = 0,
+        learning_rate: float = 0.01,
+    ):
+        self.table = KvTable(dim, init_stddev=init_stddev, seed=seed)
+        self.dim = dim
+        self.learning_rate = learning_rate
+        self._last_keys: Optional[np.ndarray] = None
+
+    def lookup(self, ids: np.ndarray, training: bool = True) -> np.ndarray:
+        """[...] int64 ids -> [..., dim] float32 (feed to jax)."""
+        ids = np.asarray(ids, dtype=np.int64)
+        if training:
+            self._last_keys = ids.reshape(-1)
+        return self.table.gather(
+            ids, insert_missing=training, count_frequency=training
+        )
+
+    def apply_gradients(self, grads: np.ndarray,
+                        ids: Optional[np.ndarray] = None):
+        """grads [..., dim] aligned with the last lookup (or given ids)."""
+        keys = (
+            np.asarray(ids, dtype=np.int64).reshape(-1)
+            if ids is not None
+            else self._last_keys
+        )
+        if keys is None:
+            raise RuntimeError("no lookup recorded before update")
+        grads = np.asarray(grads, dtype=np.float32).reshape(
+            keys.size, self.dim
+        )
+        # duplicate ids within the batch must accumulate before SGD
+        uniq, inverse = np.unique(keys, return_inverse=True)
+        summed = np.zeros((uniq.size, self.dim), dtype=np.float32)
+        np.add.at(summed, inverse, grads)
+        self.table.apply_gradients(uniq, summed, self.learning_rate)
+
+    # ---------------------------------------------------------- ckpt
+    def state_dict(self) -> dict:
+        keys, values = self.table.export()
+        return {"keys": keys, "values": values, "dim": self.dim}
+
+    def load_state_dict(self, state: dict):
+        if int(state["dim"]) != self.dim:
+            raise ValueError("embedding dim mismatch")
+        self.table.import_(state["keys"], state["values"])

@@ -1,0 +1,228 @@
+"""ctypes binding for the native sparse embedding table.
+
+Reference parity: the python glue of tfplus
+(``tfplus/tfplus/python/ops/kv_variable_ops.py`` + ``embedding_ops.py``)
+over the C++ table in ``native/kv_store/kv_table.cc``.  The shared
+library is built on first use with g++ (no pybind11/bazel needed —
+ctypes over a C API, as the environment prescribes).
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_SRC = os.path.join(_REPO_ROOT, "native", "kv_store", "kv_table.cc")
+_LIB_DIR = os.path.join(_REPO_ROOT, "native", "kv_store", "build")
+_LIB = os.path.join(_LIB_DIR, "libkvtable.so")
+
+_lib_handle = None
+_build_lock = threading.Lock()
+
+
+def _build_library() -> str:
+    os.makedirs(_LIB_DIR, exist_ok=True)
+    cmd = [
+        "g++",
+        "-O2",
+        "-shared",
+        "-fPIC",
+        "-std=c++17",
+        "-o",
+        _LIB,
+        _SRC,
+        "-lpthread",
+    ]
+    logger.info("building kv_table: %s", " ".join(cmd))
+    subprocess.run(cmd, check=True, capture_output=True)
+    return _LIB
+
+
+def _load_library():
+    global _lib_handle
+    with _build_lock:
+        if _lib_handle is not None:
+            return _lib_handle
+        if not os.path.exists(_LIB) or os.path.getmtime(
+            _LIB
+        ) < os.path.getmtime(_SRC):
+            _build_library()
+        lib = ctypes.CDLL(_LIB)
+        lib.kv_create.restype = ctypes.c_void_p
+        lib.kv_create.argtypes = [
+            ctypes.c_int,
+            ctypes.c_float,
+            ctypes.c_uint64,
+        ]
+        lib.kv_free.argtypes = [ctypes.c_void_p]
+        lib.kv_dim.restype = ctypes.c_int
+        lib.kv_dim.argtypes = [ctypes.c_void_p]
+        lib.kv_size.restype = ctypes.c_uint64
+        lib.kv_size.argtypes = [ctypes.c_void_p]
+        lib.kv_gather.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int,
+            ctypes.c_int,
+        ]
+        lib.kv_scatter.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int,
+        ]
+        lib.kv_frequency.restype = ctypes.c_uint64
+        lib.kv_frequency.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.kv_export.restype = ctypes.c_int64
+        lib.kv_export.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64,
+        ]
+        lib.kv_import.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float),
+        ]
+        lib.kv_evict_below.restype = ctypes.c_int64
+        lib.kv_evict_below.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        _lib_handle = lib
+        return lib
+
+
+def _i64_ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _f32_ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+class KvTable:
+    """Host-side dynamic embedding table (C++ backed)."""
+
+    SCATTER_ASSIGN = 0
+    SCATTER_ADD = 1
+    SCATTER_SUB = 2
+
+    def __init__(self, dim: int, init_stddev: float = 0.0,
+                 seed: int = 0):
+        self._lib = _load_library()
+        self._handle = self._lib.kv_create(
+            dim, ctypes.c_float(init_stddev), ctypes.c_uint64(seed)
+        )
+        if not self._handle:
+            raise ValueError(f"bad embedding dim {dim}")
+        self.dim = dim
+
+    def close(self):
+        if self._handle:
+            self._lib.kv_free(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+    def __len__(self) -> int:
+        return int(self._lib.kv_size(self._handle))
+
+    def gather(
+        self,
+        keys: np.ndarray,
+        insert_missing: bool = True,
+        count_frequency: bool = True,
+    ) -> np.ndarray:
+        keys = np.ascontiguousarray(keys, dtype=np.int64)
+        out = np.empty((keys.size, self.dim), dtype=np.float32)
+        self._lib.kv_gather(
+            self._handle,
+            _i64_ptr(keys),
+            keys.size,
+            _f32_ptr(out),
+            1 if insert_missing else 0,
+            1 if count_frequency else 0,
+        )
+        return out.reshape(keys.shape + (self.dim,))
+
+    def scatter(self, keys: np.ndarray, updates: np.ndarray,
+                op: int = SCATTER_ASSIGN):
+        keys = np.ascontiguousarray(keys, dtype=np.int64).reshape(-1)
+        updates = np.ascontiguousarray(
+            updates, dtype=np.float32
+        ).reshape(keys.size, self.dim)
+        self._lib.kv_scatter(
+            self._handle, _i64_ptr(keys), keys.size, _f32_ptr(updates), op
+        )
+
+    def apply_gradients(self, keys: np.ndarray, grads: np.ndarray,
+                        learning_rate: float):
+        """Sparse SGD on touched rows (the tfplus sparse-optimizer
+        family lives in ``sparse/optimizers.py``)."""
+        self.scatter(
+            keys,
+            np.asarray(grads, dtype=np.float32) * learning_rate,
+            op=self.SCATTER_SUB,
+        )
+
+    def frequency(self, key: int) -> int:
+        return int(self._lib.kv_frequency(self._handle, key))
+
+    def export(
+        self, min_frequency: int = 0
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        count = int(
+            self._lib.kv_export(
+                self._handle,
+                ctypes.c_uint64(min_frequency),
+                None,
+                None,
+                0,
+            )
+        )
+        keys = np.empty(count, dtype=np.int64)
+        values = np.empty((count, self.dim), dtype=np.float32)
+        if count:
+            written = int(
+                self._lib.kv_export(
+                    self._handle,
+                    ctypes.c_uint64(min_frequency),
+                    _i64_ptr(keys),
+                    _f32_ptr(values),
+                    count,
+                )
+            )
+            if written < 0:
+                raise RuntimeError("kv_export capacity race")
+            keys, values = keys[:written], values[:written]
+        return keys, values
+
+    def import_(self, keys: np.ndarray, values: np.ndarray):
+        keys = np.ascontiguousarray(keys, dtype=np.int64)
+        values = np.ascontiguousarray(values, dtype=np.float32)
+        self._lib.kv_import(
+            self._handle, _i64_ptr(keys), keys.size, _f32_ptr(values)
+        )
+
+    def evict_below(self, min_frequency: int) -> int:
+        return int(
+            self._lib.kv_evict_below(
+                self._handle, ctypes.c_uint64(min_frequency)
+            )
+        )

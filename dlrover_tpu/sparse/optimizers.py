@@ -1,0 +1,89 @@
+"""Sparse optimizers over the KvTable (Adam / Adagrad family).
+
+Reference parity: tfplus's sparse training kernels
+(``kv_variable/kernels/training_ops.cc`` — Adagrad, Adam, GroupAdam
+etc. applied per touched row).  Moments live in sibling KvTables so
+state grows with the touched-id set, exactly like the reference's
+slot variables.
+"""
+
+from typing import Dict
+
+import numpy as np
+
+from dlrover_tpu.sparse.kv_table import KvTable
+
+
+class SparseAdam:
+    def __init__(
+        self,
+        table: KvTable,
+        learning_rate: float = 1e-3,
+        b1: float = 0.9,
+        b2: float = 0.999,
+        eps: float = 1e-8,
+    ):
+        self.table = table
+        self.lr = learning_rate
+        self.b1, self.b2, self.eps = b1, b2, eps
+        self._m = KvTable(table.dim)
+        self._v = KvTable(table.dim)
+        self._step = 0
+
+    def update(self, keys: np.ndarray, grads: np.ndarray):
+        keys = np.asarray(keys, dtype=np.int64).reshape(-1)
+        grads = np.asarray(grads, dtype=np.float32).reshape(
+            keys.size, self.table.dim
+        )
+        uniq, inverse = np.unique(keys, return_inverse=True)
+        g = np.zeros((uniq.size, self.table.dim), dtype=np.float32)
+        np.add.at(g, inverse, grads)
+
+        self._step += 1
+        m = self._m.gather(uniq, count_frequency=False)
+        v = self._v.gather(uniq, count_frequency=False)
+        m = self.b1 * m + (1 - self.b1) * g
+        v = self.b2 * v + (1 - self.b2) * g * g
+        self._m.scatter(uniq, m)
+        self._v.scatter(uniq, v)
+        bc1 = 1 - self.b1**self._step
+        bc2 = 1 - self.b2**self._step
+        update = self.lr * (m / bc1) / (np.sqrt(v / bc2) + self.eps)
+        self.table.scatter(uniq, update, op=KvTable.SCATTER_SUB)
+
+    def state_dict(self) -> Dict:
+        mk, mv = self._m.export()
+        vk, vv = self._v.export()
+        return {
+            "step": self._step,
+            "m_keys": mk, "m_values": mv,
+            "v_keys": vk, "v_values": vv,
+        }
+
+    def load_state_dict(self, state: Dict):
+        self._step = int(state["step"])
+        self._m.import_(state["m_keys"], state["m_values"])
+        self._v.import_(state["v_keys"], state["v_values"])
+
+
+class SparseAdagrad:
+    def __init__(self, table: KvTable, learning_rate: float = 0.1,
+                 eps: float = 1e-10):
+        self.table = table
+        self.lr = learning_rate
+        self.eps = eps
+        self._accum = KvTable(table.dim)
+
+    def update(self, keys: np.ndarray, grads: np.ndarray):
+        keys = np.asarray(keys, dtype=np.int64).reshape(-1)
+        grads = np.asarray(grads, dtype=np.float32).reshape(
+            keys.size, self.table.dim
+        )
+        uniq, inverse = np.unique(keys, return_inverse=True)
+        g = np.zeros((uniq.size, self.table.dim), dtype=np.float32)
+        np.add.at(g, inverse, grads)
+        acc = self._accum.gather(uniq, count_frequency=False)
+        acc = acc + g * g
+        self._accum.scatter(uniq, acc)
+        update = self.lr * g / (np.sqrt(acc) + self.eps)
+        self.table.scatter(uniq, update, op=KvTable.SCATTER_SUB)

@@ -77,41 +77,24 @@ def parse_args(argv=None):
         default=os.getenv("JAX_COMPILATION_CACHE_DIR", ""),
         help="persistent XLA compile cache (keeps restarts cheap)",
     )
+    # torchrun-style: with -m/--module the positional IS the module
+    # name; the required positional keeps REMAINDER working for
+    # option-like script/module args, and a "-m" token after the
+    # script stays in REMAINDER (belongs to the script).
     parser.add_argument(
-        "training_script",
-        nargs="?",
-        default="",
-        help="training script path (or use -m MODULE)",
+        "-m",
+        "--module",
+        dest="module",
+        action="store_true",
+        help="treat the entrypoint as 'python -m MODULE'",
+    )
+    parser.add_argument(
+        "training_script", help="training script path (or module with -m)"
     )
     parser.add_argument(
         "training_script_args", nargs=argparse.REMAINDER
     )
-
-    # `-m MODULE [module args...]` is extracted before argparse runs:
-    # REMAINDER cannot absorb option-like tokens after an optional
-    # positional, so flags passed to the module would be rejected.
-    if argv is None:
-        argv = sys.argv[1:]
-    argv = list(argv)
-    module = ""
-    module_args: List[str] = []
-    for flag in ("-m", "--module"):
-        if flag in argv:
-            i = argv.index(flag)
-            if i + 1 >= len(argv):
-                parser.error(f"{flag} requires a module name")
-            module = argv[i + 1]
-            module_args = argv[i + 2 :]
-            argv = argv[:i]
-            break
-
-    args = parser.parse_args(argv)
-    args.module = module
-    if module:
-        args.training_script_args = module_args
-    if not args.module and not args.training_script:
-        parser.error("a training script or -m MODULE is required")
-    return args
+    return parser.parse_args(argv)
 
 
 def _launch_local_master(node_num: int) -> Tuple[subprocess.Popen, str]:
@@ -161,7 +144,9 @@ def _wait_master(addr: str, timeout: float = 60.0) -> bool:
 def _build_entrypoint(args) -> List[str]:
     script_args = list(args.training_script_args)
     if args.module:
-        return [sys.executable, "-m", args.module, *script_args]
+        return [
+            sys.executable, "-m", args.training_script, *script_args
+        ]
     return [sys.executable, args.training_script, *script_args]
 
 

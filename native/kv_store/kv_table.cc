@@ -1,0 +1,203 @@
+// Dynamic-capacity sparse embedding table (host-side), C API.
+//
+// Reference parity: tfplus KvVariable
+// (tfplus/tfplus/kv_variable/kernels/kv_variable.h:89 — a concurrent
+// hashtable variable with gather-or-insert / scatter update ops,
+// frequency tracking, filtered export) re-designed for the TPU stack:
+// the table lives in HOST memory (TPU HBM holds only the dense batch
+// gathered per step), sharded into lock-striped submaps for concurrent
+// access from the data-loader and update threads.  Exposed as a plain
+// C API consumed through ctypes (no pybind11 in the image).
+//
+// Build: g++ -O2 -shared -fPIC -o libkvtable.so kv_table.cc -lpthread
+
+#include <cstdint>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <random>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int kNumShards = 16;  // lock striping
+
+struct Row {
+  std::unique_ptr<float[]> data;
+  uint64_t frequency = 0;
+};
+
+struct Shard {
+  std::mutex mu;
+  std::unordered_map<int64_t, Row> map;
+};
+
+struct KvTable {
+  int dim;
+  float init_stddev;
+  uint64_t seed;
+  Shard shards[kNumShards];
+
+  explicit KvTable(int d, float stddev, uint64_t s)
+      : dim(d), init_stddev(stddev), seed(s) {}
+
+  Shard& shard_for(int64_t key) {
+    // mix bits so sequential ids spread across shards
+    uint64_t h = static_cast<uint64_t>(key) * 0x9E3779B97F4A7C15ull;
+    return shards[h >> 60];
+  }
+
+  void init_row(int64_t key, float* out) {
+    if (init_stddev == 0.0f) {
+      std::memset(out, 0, sizeof(float) * dim);
+      return;
+    }
+    // deterministic per-key init: same key -> same vector on any host
+    std::mt19937_64 gen(seed ^ static_cast<uint64_t>(key));
+    std::normal_distribution<float> dist(0.0f, init_stddev);
+    for (int i = 0; i < dim; ++i) out[i] = dist(gen);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* kv_create(int dim, float init_stddev, uint64_t seed) {
+  if (dim <= 0) return nullptr;
+  return new KvTable(dim, init_stddev, seed);
+}
+
+void kv_free(void* handle) { delete static_cast<KvTable*>(handle); }
+
+int kv_dim(void* handle) { return static_cast<KvTable*>(handle)->dim; }
+
+uint64_t kv_size(void* handle) {
+  auto* t = static_cast<KvTable*>(handle);
+  uint64_t n = 0;
+  for (auto& s : t->shards) {
+    std::lock_guard<std::mutex> lk(s.mu);
+    n += s.map.size();
+  }
+  return n;
+}
+
+// Gather rows for `n` keys into out[n * dim].  insert_missing: 1 =
+// gather-or-insert (training), 0 = gather-or-zeros (inference,
+// reference KvVariableGatherOrZerosV2).  Counts frequency when
+// count_freq != 0.
+void kv_gather(void* handle, const int64_t* keys, int64_t n, float* out,
+               int insert_missing, int count_freq) {
+  auto* t = static_cast<KvTable*>(handle);
+  const int dim = t->dim;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t key = keys[i];
+    Shard& s = t->shard_for(key);
+    std::lock_guard<std::mutex> lk(s.mu);
+    auto it = s.map.find(key);
+    if (it == s.map.end()) {
+      if (!insert_missing) {
+        std::memset(out + i * dim, 0, sizeof(float) * dim);
+        continue;
+      }
+      Row row;
+      row.data.reset(new float[dim]);
+      t->init_row(key, row.data.get());
+      it = s.map.emplace(key, std::move(row)).first;
+    }
+    if (count_freq) it->second.frequency++;
+    std::memcpy(out + i * dim, it->second.data.get(),
+                sizeof(float) * dim);
+  }
+}
+
+// updates[n * dim]; op: 0 = assign, 1 = add (grad accumulate),
+// 2 = sub (apply positive lr*grad).  Missing keys are inserted first
+// (zeros) so scatter after a failover replays cleanly.
+void kv_scatter(void* handle, const int64_t* keys, int64_t n,
+                const float* updates, int op) {
+  auto* t = static_cast<KvTable*>(handle);
+  const int dim = t->dim;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t key = keys[i];
+    Shard& s = t->shard_for(key);
+    std::lock_guard<std::mutex> lk(s.mu);
+    auto it = s.map.find(key);
+    if (it == s.map.end()) {
+      Row row;
+      row.data.reset(new float[dim]());
+      it = s.map.emplace(key, std::move(row)).first;
+    }
+    float* dst = it->second.data.get();
+    const float* src = updates + i * dim;
+    switch (op) {
+      case 0: std::memcpy(dst, src, sizeof(float) * dim); break;
+      case 1:
+        for (int j = 0; j < dim; ++j) dst[j] += src[j];
+        break;
+      case 2:
+        for (int j = 0; j < dim; ++j) dst[j] -= src[j];
+        break;
+    }
+  }
+}
+
+uint64_t kv_frequency(void* handle, int64_t key) {
+  auto* t = static_cast<KvTable*>(handle);
+  Shard& s = t->shard_for(key);
+  std::lock_guard<std::mutex> lk(s.mu);
+  auto it = s.map.find(key);
+  return it == s.map.end() ? 0 : it->second.frequency;
+}
+
+// Export keys whose frequency >= min_frequency (reference
+// frequency-filtered delta export).  Two-call protocol: pass
+// keys=nullptr to get the count, then allocate and call again.
+int64_t kv_export(void* handle, uint64_t min_frequency, int64_t* keys,
+                  float* values, int64_t capacity) {
+  auto* t = static_cast<KvTable*>(handle);
+  const int dim = t->dim;
+  int64_t count = 0;
+  for (auto& s : t->shards) {
+    std::lock_guard<std::mutex> lk(s.mu);
+    for (auto& kvp : s.map) {
+      if (kvp.second.frequency < min_frequency) continue;
+      if (keys != nullptr) {
+        if (count >= capacity) return -1;  // caller buffer too small
+        keys[count] = kvp.first;
+        std::memcpy(values + count * dim, kvp.second.data.get(),
+                    sizeof(float) * dim);
+      }
+      ++count;
+    }
+  }
+  return count;
+}
+
+// Bulk import (checkpoint restore): assign n rows.
+void kv_import(void* handle, const int64_t* keys, int64_t n,
+               const float* values) {
+  kv_scatter(handle, keys, n, values, /*op=*/0);
+}
+
+// Remove keys below a frequency threshold (under-frequency eviction,
+// reference under-/frequency-filtering).  Returns evicted count.
+int64_t kv_evict_below(void* handle, uint64_t min_frequency) {
+  auto* t = static_cast<KvTable*>(handle);
+  int64_t evicted = 0;
+  for (auto& s : t->shards) {
+    std::lock_guard<std::mutex> lk(s.mu);
+    for (auto it = s.map.begin(); it != s.map.end();) {
+      if (it->second.frequency < min_frequency) {
+        it = s.map.erase(it);
+        ++evicted;
+      } else {
+        ++it;
+      }
+    }
+  }
+  return evicted;
+}
+
+}  // extern "C"

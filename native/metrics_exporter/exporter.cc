@@ -1,0 +1,115 @@
+// Prometheus metrics exporter daemon for training processes.
+//
+// Reference parity: atorch's xpu_timer C++ profiler exports kernel/
+// collective timings via brpc/bvar + Prometheus on port 28888+rank
+// (atorch/dev/xpu_timer/README.md:1-40).  An LD_PRELOAD hook is
+// impractical against libtpu (SURVEY.md §7 table), so the TPU design
+// inverts the flow: training processes append metrics to a shared
+// JSONL-ish text file (one "name value" per line, last-wins) and this
+// tiny standalone HTTP server renders the Prometheus text format on
+// /metrics.  No deps beyond POSIX sockets.
+//
+// Build: g++ -O2 -std=c++17 -o metrics_exporter exporter.cc
+// Run:   ./metrics_exporter <metrics_file> <port>
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <sstream>
+#include <string>
+
+namespace {
+
+// Parse "name{labels} value" or "name value" lines; last write wins.
+std::map<std::string, std::string> read_metrics(const std::string& path) {
+  std::map<std::string, std::string> out;
+  std::ifstream f(path);
+  std::string line;
+  while (std::getline(f, line)) {
+    if (line.empty() || line[0] == '#') continue;
+    auto pos = line.find_last_of(' ');
+    if (pos == std::string::npos || pos == 0) continue;
+    out[line.substr(0, pos)] = line.substr(pos + 1);
+  }
+  return out;
+}
+
+std::string render(const std::string& path) {
+  std::ostringstream body;
+  body << "# dlrover_tpu metrics exporter\n";
+  for (auto& kv : read_metrics(path)) {
+    body << kv.first << " " << kv.second << "\n";
+  }
+  return body.str();
+}
+
+void serve_client(int fd, const std::string& path) {
+  char buf[4096];
+  ssize_t n = read(fd, buf, sizeof(buf) - 1);
+  if (n <= 0) return;
+  buf[n] = 0;
+  std::string body;
+  std::string status = "200 OK";
+  if (std::strstr(buf, "GET /metrics") != nullptr) {
+    body = render(path);
+  } else if (std::strstr(buf, "GET /healthz") != nullptr) {
+    body = "ok\n";
+  } else {
+    status = "404 Not Found";
+    body = "not found\n";
+  }
+  std::ostringstream resp;
+  resp << "HTTP/1.1 " << status << "\r\n"
+       << "Content-Type: text/plain; version=0.0.4\r\n"
+       << "Content-Length: " << body.size() << "\r\n"
+       << "Connection: close\r\n\r\n"
+       << body;
+  std::string s = resp.str();
+  ssize_t off = 0;
+  while (off < static_cast<ssize_t>(s.size())) {
+    ssize_t w = write(fd, s.data() + off, s.size() - off);
+    if (w <= 0) break;
+    off += w;
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::fprintf(stderr, "usage: %s <metrics_file> <port>\n", argv[0]);
+    return 2;
+  }
+  std::string path = argv[1];
+  int port = std::atoi(argv[2]);
+
+  int srv = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(srv, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    std::perror("bind");
+    return 1;
+  }
+  if (listen(srv, 16) != 0) {
+    std::perror("listen");
+    return 1;
+  }
+  std::fprintf(stderr, "metrics exporter serving :%d from %s\n", port,
+               path.c_str());
+  for (;;) {
+    int fd = accept(srv, nullptr, nullptr);
+    if (fd < 0) continue;
+    serve_client(fd, path);
+    close(fd);
+  }
+}

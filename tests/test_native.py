@@ -1,0 +1,178 @@
+"""Native C++ components: KvTable (sparse embedding), sparse
+optimizers, metrics exporter daemon."""
+
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.common.env import get_free_port
+from dlrover_tpu.observability.metrics import (
+    MetricsExporter,
+    MetricsRegistry,
+)
+from dlrover_tpu.sparse import KvTable, SparseEmbedding
+from dlrover_tpu.sparse.optimizers import SparseAdagrad, SparseAdam
+
+
+class TestKvTable:
+    def test_gather_or_insert_deterministic(self):
+        t = KvTable(8, init_stddev=0.1, seed=42)
+        keys = np.array([5, 7, 5], dtype=np.int64)
+        rows = t.gather(keys)
+        assert rows.shape == (3, 8)
+        np.testing.assert_array_equal(rows[0], rows[2])  # same key
+        assert len(t) == 2
+        # re-gather returns identical values (persistent rows)
+        again = t.gather(np.array([5], dtype=np.int64))
+        np.testing.assert_array_equal(again[0], rows[0])
+        # determinism across tables with the same seed
+        t2 = KvTable(8, init_stddev=0.1, seed=42)
+        np.testing.assert_array_equal(
+            t2.gather(np.array([5]))[0], rows[0]
+        )
+
+    def test_gather_or_zeros(self):
+        t = KvTable(4)
+        out = t.gather(
+            np.array([99], dtype=np.int64), insert_missing=False
+        )
+        np.testing.assert_array_equal(out, 0)
+        assert len(t) == 0
+
+    def test_scatter_ops(self):
+        t = KvTable(2)
+        k = np.array([1], dtype=np.int64)
+        t.scatter(k, np.array([[1.0, 2.0]]))
+        t.scatter(k, np.array([[0.5, 0.5]]), op=KvTable.SCATTER_ADD)
+        out = t.gather(k, count_frequency=False)
+        np.testing.assert_allclose(out[0], [1.5, 2.5])
+        t.scatter(k, np.array([[1.0, 1.0]]), op=KvTable.SCATTER_SUB)
+        np.testing.assert_allclose(
+            t.gather(k, count_frequency=False)[0], [0.5, 1.5]
+        )
+
+    def test_frequency_and_eviction(self):
+        t = KvTable(2)
+        hot = np.array([1], dtype=np.int64)
+        cold = np.array([2], dtype=np.int64)
+        for _ in range(5):
+            t.gather(hot)
+        t.gather(cold)
+        assert t.frequency(1) == 5
+        assert t.frequency(2) == 1
+        assert t.evict_below(3) == 1
+        assert len(t) == 1
+
+    def test_export_import_roundtrip(self):
+        t = KvTable(3, init_stddev=0.1, seed=1)
+        t.gather(np.arange(10, dtype=np.int64))
+        keys, values = t.export()
+        assert keys.size == 10
+        t2 = KvTable(3)
+        t2.import_(keys, values)
+        np.testing.assert_array_equal(
+            t2.gather(keys, count_frequency=False), values
+        )
+
+    def test_filtered_export(self):
+        t = KvTable(2)
+        for _ in range(3):
+            t.gather(np.array([7], dtype=np.int64))
+        t.gather(np.array([8], dtype=np.int64))
+        keys, _ = t.export(min_frequency=2)
+        assert list(keys) == [7]
+
+
+class TestSparseEmbedding:
+    def test_training_reduces_loss(self):
+        emb = SparseEmbedding(dim=4, init_stddev=0.1, learning_rate=0.5)
+        ids = np.array([1, 2, 3], dtype=np.int64)
+        target = np.ones((3, 4), dtype=np.float32)
+        losses = []
+        for _ in range(30):
+            out = emb.lookup(ids)
+            grad = 2 * (out - target) / out.size
+            losses.append(float(np.mean((out - target) ** 2)))
+            emb.apply_gradients(grad)
+        assert losses[-1] < 0.01 * losses[0]
+
+    def test_duplicate_ids_accumulate(self):
+        emb = SparseEmbedding(
+            dim=2, init_stddev=0.0, learning_rate=1.0
+        )
+        ids = np.array([5, 5], dtype=np.int64)
+        emb.lookup(ids)
+        emb.apply_gradients(np.array([[1.0, 0.0], [1.0, 0.0]]))
+        out = emb.lookup(np.array([5]), training=False)
+        np.testing.assert_allclose(out[0], [-2.0, 0.0])
+
+    def test_checkpoint_roundtrip(self):
+        emb = SparseEmbedding(dim=2, init_stddev=0.1)
+        emb.lookup(np.arange(4, dtype=np.int64))
+        state = emb.state_dict()
+        emb2 = SparseEmbedding(dim=2)
+        emb2.load_state_dict(state)
+        np.testing.assert_array_equal(
+            emb2.lookup(state["keys"], training=False), state["values"]
+        )
+
+
+class TestSparseOptimizers:
+    def _fit(self, make_opt):
+        table = KvTable(4, init_stddev=0.1, seed=3)
+        opt = make_opt(table)
+        ids = np.arange(8, dtype=np.int64)
+        target = np.full((8, 4), 2.0, dtype=np.float32)
+        losses = []
+        for _ in range(50):
+            rows = table.gather(ids)
+            grad = 2 * (rows - target) / rows.size
+            losses.append(float(np.mean((rows - target) ** 2)))
+            opt.update(ids, grad)
+        return losses
+
+    def test_sparse_adam(self):
+        losses = self._fit(lambda t: SparseAdam(t, learning_rate=0.3))
+        assert losses[-1] < 0.05 * losses[0]
+
+    def test_sparse_adagrad(self):
+        losses = self._fit(
+            lambda t: SparseAdagrad(t, learning_rate=2.0)
+        )
+        assert losses[-1] < 0.1 * losses[0]
+
+
+class TestMetricsExporter:
+    def test_registry_and_daemon(self, tmp_path):
+        registry = MetricsRegistry(
+            path=str(tmp_path / "m.prom"), flush_interval=0.0
+        )
+        registry.set_gauge("train_step", 42)
+        registry.inc_counter(
+            "tokens_total", 1000, labels={"rank": 0}
+        )
+        registry.observe_duration("step_time", 0.5)
+        registry.flush()
+
+        port = get_free_port()
+        exporter = MetricsExporter(registry, port=port)
+        exporter.start()
+        try:
+            deadline = time.time() + 10
+            body = ""
+            while time.time() < deadline:
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics", timeout=2
+                    ) as r:
+                        body = r.read().decode()
+                    break
+                except OSError:
+                    time.sleep(0.2)
+            assert "train_step 42" in body, body
+            assert 'tokens_total{rank="0"} 1000' in body, body
+            assert "step_time_seconds_sum 0.5" in body, body
+        finally:
+            exporter.stop()
