@@ -1,0 +1,8 @@
+from dlrover_tpu.data.elastic_dataloader import (  # noqa: F401
+    ElasticDataLoader,
+)
+from dlrover_tpu.data.prefetch import device_prefetch  # noqa: F401
+from dlrover_tpu.data.shm_dataloader import (  # noqa: F401
+    ShmDataLoader,
+    ShmBatchWriter,
+)

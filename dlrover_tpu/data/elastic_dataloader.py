@@ -1,0 +1,159 @@
+"""Elastic dataloader: batch size re-tuned at runtime by the master.
+
+Reference parity: ``dlrover/trainer/torch/elastic/dataloader.py:26``
+(``ElasticDataLoader.load_config`` re-reads the JSON config file the
+``ParalConfigTuner`` writes — ``elastic_agent/config/
+paral_config_tuner.py:30``) so the master's auto-tuned dataloader
+parameters take effect without restarting training.
+"""
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Iterator, Optional
+
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.trainer.elastic.sampler import (
+    ElasticDistributedSampler,
+)
+
+DEFAULT_CONFIG_FILE = "/tmp/dlrover_tpu_paral_config.json"
+
+
+class ParalConfigTuner:
+    """Agent-side: polls master ``ParallelConfig`` and writes the
+    config file the dataloader watches (reference ``:30,70``)."""
+
+    def __init__(self, client=None, config_file: str = "",
+                 interval: float = 30.0):
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        self._client = client or MasterClient.singleton_instance()
+        self.config_file = config_file or os.getenv(
+            "DLROVER_TPU_PARAL_CONFIG_FILE", DEFAULT_CONFIG_FILE
+        )
+        self._interval = interval
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _tick(self):
+        config = self._client.get_paral_config()
+        dataloader = getattr(config, "dataloader", None)
+        payload = {
+            "version": getattr(config, "version", 0),
+            "dataloader": {
+                "batch_size": getattr(dataloader, "batch_size", 0),
+                "num_workers": getattr(dataloader, "num_workers", 0),
+            }
+            if dataloader
+            else {},
+        }
+        tmp = self.config_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, self.config_file)
+
+    def start(self):
+        if self._thread is not None:
+            return
+
+        def _loop():
+            while not self._stopped.wait(self._interval):
+                try:
+                    self._tick()
+                except (ConnectionError, OSError) as e:
+                    logger.warning("paral tuner tick failed: %s", e)
+
+        self._thread = threading.Thread(
+            target=_loop, name="paral-tuner", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stopped.set()
+
+
+class ElasticDataLoader:
+    """Batched index loader whose batch size follows the tuned config.
+
+    ``read_batch(indices) -> batch`` turns sampled indices into arrays
+    (user-supplied — file reads, tokenization, ...).  Each ``__iter__``
+    re-checks the config file; mid-epoch batch-size changes take
+    effect on the next epoch (matching the reference's
+    ``load_config``-on-init + set_batch_size semantics).
+    """
+
+    def __init__(
+        self,
+        dataset_size: int,
+        batch_size: int,
+        read_batch: Callable[[np.ndarray], object],
+        sampler: Optional[ElasticDistributedSampler] = None,
+        num_replicas: int = 1,
+        rank: int = 0,
+        shuffle: bool = True,
+        config_file: str = "",
+        drop_last: bool = True,
+    ):
+        self._read_batch = read_batch
+        self.batch_size = batch_size
+        self._config_file = config_file or os.getenv(
+            "DLROVER_TPU_PARAL_CONFIG_FILE", DEFAULT_CONFIG_FILE
+        )
+        self.sampler = sampler or ElasticDistributedSampler(
+            dataset_size,
+            num_replicas=num_replicas,
+            rank=rank,
+            shuffle=shuffle,
+        )
+        self._drop_last = drop_last
+        self.load_config()
+
+    def load_config(self):
+        if not os.path.exists(self._config_file):
+            return
+        try:
+            with open(self._config_file) as f:
+                config = json.load(f)
+            new_bs = int(
+                config.get("dataloader", {}).get("batch_size", 0)
+            )
+            if new_bs > 0 and new_bs != self.batch_size:
+                logger.info(
+                    "dataloader batch size tuned %d -> %d",
+                    self.batch_size,
+                    new_bs,
+                )
+                self.batch_size = new_bs
+        except (OSError, ValueError) as e:
+            logger.warning("paral config read failed: %s", e)
+
+    def __iter__(self) -> Iterator:
+        self.load_config()
+        batch = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield self._read_batch(np.asarray(batch))
+                batch = []
+        if batch and not self._drop_last:
+            yield self._read_batch(np.asarray(batch))
+
+    def __len__(self) -> int:
+        n = len(self.sampler)
+        if self._drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def state_dict(self) -> dict:
+        return {"sampler": self.sampler.state_dict(),
+                "batch_size": self.batch_size}
+
+    def load_state_dict(self, state: dict):
+        self.sampler.load_state_dict(state.get("sampler", {}))
+        bs = int(state.get("batch_size", 0))
+        if bs > 0:
+            self.batch_size = bs

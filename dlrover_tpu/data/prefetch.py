@@ -1,0 +1,44 @@
+"""Device prefetch: overlap host batch prep with device compute.
+
+Reference parity: ``atorch/atorch/data/preloader.py`` (GPU data
+preloader with a side CUDA stream).  On TPU the idiom is simpler:
+``jax.device_put`` is async — keep N batches in flight so the host
+pipeline never stalls the device (double/triple buffering).
+"""
+
+import collections
+from typing import Iterable, Iterator, Optional
+
+import jax
+
+
+def device_prefetch(
+    iterator: Iterable,
+    size: int = 2,
+    sharding: Optional[object] = None,
+) -> Iterator:
+    """Yield device-resident batches with ``size`` transfers in flight.
+
+    ``sharding`` (a NamedSharding / prefix pytree) places each batch
+    directly in its training layout — no host-side reshard later.
+    """
+    queue = collections.deque()
+
+    def _put(batch):
+        if sharding is not None:
+            return jax.device_put(batch, sharding)
+        return jax.device_put(batch)
+
+    it = iter(iterator)
+    try:
+        for _ in range(size):
+            queue.append(_put(next(it)))
+    except StopIteration:
+        pass
+    while queue:
+        out = queue.popleft()
+        try:
+            queue.append(_put(next(it)))
+        except StopIteration:
+            pass
+        yield out

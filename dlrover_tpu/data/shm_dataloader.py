@@ -1,0 +1,201 @@
+"""Cross-process shared-memory batch channel.
+
+Reference parity: ``atorch/atorch/data/shm_dataloader.py:138`` +
+``shm_context.py`` — a producer process (data worker) materializes
+batches into a shared-memory ring; consumer (training proc) reads
+without pickling tensors through a pipe.  On TPU hosts this feeds the
+single training process from CPU-side preprocessing workers without
+the GIL or copy chains.
+
+Design: a fixed-slot ring over one ``SharedMemory`` segment; slot
+states live in a ``SharedDict``; batch schema (shapes/dtypes) is
+declared up front so slot size is static (XLA-friendly static shapes
+end to end).
+"""
+
+import pickle
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.multi_process import SharedDict, SharedMemory
+
+_META_PREFIX = "slot_state_"  # 0 free, 1 full
+
+
+class BatchSpec:
+    """Static schema: {name: (shape, dtype)} per batch element."""
+
+    def __init__(self, fields: Dict[str, Tuple[tuple, str]]):
+        self.fields = {
+            name: (tuple(shape), np.dtype(dtype))
+            for name, (shape, dtype) in fields.items()
+        }
+        self.slot_bytes = sum(
+            int(np.prod(shape)) * dtype.itemsize
+            for shape, dtype in self.fields.values()
+        )
+
+    def serialize(self) -> bytes:
+        return pickle.dumps(
+            {
+                name: (shape, dtype.str)
+                for name, (shape, dtype) in self.fields.items()
+            }
+        )
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "BatchSpec":
+        return cls(pickle.loads(raw))
+
+
+def _attach_ring(name: str, timeout: float = 60.0,
+                 poll: float = 0.2) -> "_ShmRing":
+    """Writer-side attach: block until the consumer's ring exists."""
+    deadline = time.time() + timeout
+    while True:
+        try:
+            meta = SharedDict(f"shm_ring_meta_{name}", create=False)
+            raw = meta.get("spec")
+            num_slots = meta.get("num_slots")
+            meta.close()
+            if raw and num_slots:
+                spec = BatchSpec.deserialize(raw)
+                return _ShmRing(
+                    name, spec, int(num_slots), create=False
+                )
+        except (FileNotFoundError, TimeoutError, ConnectionError):
+            pass
+        if time.time() > deadline:
+            raise TimeoutError(f"shm ring {name!r} never appeared")
+        time.sleep(poll)
+
+
+class _ShmRing:
+    def __init__(self, name: str, spec: BatchSpec, num_slots: int,
+                 create: bool):
+        self.spec = spec
+        self.num_slots = num_slots
+        total = spec.slot_bytes * num_slots
+        self.shm = SharedMemory(
+            name=f"shm_ring_{name}", create=create, size=total
+        )
+        self.meta = SharedDict(f"shm_ring_meta_{name}", create=create)
+        if create:
+            init = {f"{_META_PREFIX}{i}": 0 for i in range(num_slots)}
+            init["spec"] = spec.serialize()
+            init["num_slots"] = num_slots
+            init["closed"] = False
+            self.meta.update(init)
+
+    def _offsets(self):
+        off = 0
+        for name, (shape, dtype) in self.spec.fields.items():
+            nbytes = int(np.prod(shape)) * dtype.itemsize
+            yield name, shape, dtype, off, nbytes
+            off += nbytes
+
+    def write_slot(self, slot: int, batch: Dict[str, np.ndarray]):
+        base = slot * self.spec.slot_bytes
+        for name, shape, dtype, off, nbytes in self._offsets():
+            arr = np.ascontiguousarray(batch[name], dtype=dtype)
+            if arr.shape != shape:
+                raise ValueError(
+                    f"batch field {name}: {arr.shape} != spec {shape}"
+                )
+            self.shm.buf[base + off : base + off + nbytes] = (
+                arr.tobytes()
+            )
+
+    def read_slot(self, slot: int) -> Dict[str, np.ndarray]:
+        base = slot * self.spec.slot_bytes
+        out = {}
+        for name, shape, dtype, off, nbytes in self._offsets():
+            raw = bytes(self.shm.buf[base + off : base + off + nbytes])
+            out[name] = np.frombuffer(raw, dtype=dtype).reshape(shape)
+        return out
+
+    def close(self, unlink: bool = False):
+        self.shm.close()
+        if unlink:
+            try:
+                self.shm.unlink()
+            except FileNotFoundError:
+                pass
+        self.meta.close()
+
+
+class ShmBatchWriter:
+    """Producer side (data-worker process).  The CONSUMER owns the
+    ring and its meta service (the training process outlives data
+    workers); the writer attaches — pass ``create=True`` only for
+    producer-owned standalone rings."""
+
+    def __init__(self, name: str, spec: Optional[BatchSpec] = None,
+                 num_slots: int = 4, create: bool = False):
+        if create:
+            if spec is None:
+                raise ValueError("create=True requires a spec")
+            self._ring = _ShmRing(name, spec, num_slots, create=True)
+        else:
+            self._ring = _attach_ring(name)
+        self._next = 0
+
+    def put(self, batch: Dict[str, np.ndarray],
+            timeout: float = 300.0) -> bool:
+        slot = self._next
+        key = f"{_META_PREFIX}{slot}"
+        deadline = time.time() + timeout
+        while self._ring.meta.get(key) == 1:
+            if time.time() > deadline:
+                return False
+            time.sleep(0.002)
+        self._ring.write_slot(slot, batch)
+        self._ring.meta.set(key, 1)
+        self._next = (slot + 1) % self._ring.num_slots
+        return True
+
+    def close(self):
+        self._ring.meta.set("closed", True)
+        self._ring.close()
+
+
+class ShmDataLoader:
+    """Consumer side (training process) — iterate numpy batches."""
+
+    def __init__(self, name: str, spec: BatchSpec,
+                 num_slots: int = 4, timeout: float = 300.0):
+        # the consumer CREATES the ring: it owns the meta service and
+        # outlives producer processes
+        self._ring = _ShmRing(name, spec, num_slots, create=True)
+        self._next = 0
+        self._timeout = timeout
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        while True:
+            batch = self.next_batch()
+            if batch is None:
+                return
+            yield batch
+
+    def next_batch(self) -> Optional[Dict[str, np.ndarray]]:
+        slot = self._next
+        key = f"{_META_PREFIX}{slot}"
+        deadline = time.time() + self._timeout
+        while self._ring.meta.get(key) != 1:
+            if self._ring.meta.get("closed"):
+                return None
+            if time.time() > deadline:
+                logger.warning("shm dataloader timed out on slot %d",
+                               slot)
+                return None
+            time.sleep(0.002)
+        batch = self._ring.read_slot(slot)
+        self._ring.meta.set(key, 0)
+        self._next = (slot + 1) % self._ring.num_slots
+        return batch
+
+    def close(self):
+        self._ring.close()

@@ -1,0 +1,9 @@
+from dlrover_tpu.local_sgd.diloco import (  # noqa: F401
+    DiLoCoState,
+    diloco_init,
+    diloco_outer_step,
+)
+from dlrover_tpu.local_sgd.reducers import (  # noqa: F401
+    gta_reduce,
+    linear_reduce,
+)

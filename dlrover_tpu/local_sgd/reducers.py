@@ -1,0 +1,53 @@
+"""Pseudo-gradient reducers for local SGD.
+
+Reference parity: ``atorch/atorch/local_sgd/reduce_methods/`` —
+``linear.py`` (plain mean) and ``generalized_task_arithmetic.py``
+(``GTAReducer``: sign-consensus + magnitude-weighted merge, which
+suppresses conflicting replica updates instead of averaging them
+away).
+"""
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+
+def linear_reduce(deltas: List):
+    """Plain mean over per-replica delta pytrees."""
+    n = len(deltas)
+    return jax.tree_util.tree_map(
+        lambda *xs: sum(xs) / n, *deltas
+    )
+
+
+def gta_reduce(
+    deltas: List,
+    consensus_threshold: float = 0.0,
+):
+    """Generalized task arithmetic: keep, per element, only replicas
+    agreeing with the dominant sign (by summed magnitude), then
+    magnitude-weighted average them."""
+
+    def merge(*xs):
+        stack = jnp.stack(xs).astype(jnp.float32)  # [R, ...]
+        mag = jnp.abs(stack)
+        pos = jnp.sum(jnp.where(stack > 0, mag, 0.0), axis=0)
+        neg = jnp.sum(jnp.where(stack < 0, mag, 0.0), axis=0)
+        dominant = jnp.where(pos >= neg, 1.0, -1.0)
+        agree = jnp.sign(stack) == dominant
+        # consensus mask: drop elements where agreement share is low
+        share = jnp.mean(agree.astype(jnp.float32), axis=0)
+        keep = share >= consensus_threshold
+        w = jnp.where(agree, mag, 0.0)
+        denom = jnp.sum(w, axis=0)
+        merged = jnp.where(
+            denom > 0,
+            jnp.sum(w * stack, axis=0) / jnp.maximum(denom, 1e-12),
+            jnp.mean(stack, axis=0),
+        )
+        return jnp.where(keep, merged, jnp.mean(stack, axis=0)).astype(
+            xs[0].dtype
+        )
+
+    return jax.tree_util.tree_map(merge, *deltas)

@@ -1,0 +1,7 @@
+from dlrover_tpu.mup.infshape import InfDim, InfShape  # noqa: F401
+from dlrover_tpu.mup.scaling import (  # noqa: F401
+    mup_init_scale,
+    mup_lr_scale,
+    mup_output_scale,
+    make_mup_optimizer,
+)

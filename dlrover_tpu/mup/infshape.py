@@ -1,0 +1,67 @@
+"""μP infinite-shape bookkeeping.
+
+Reference parity: ``atorch/atorch/mup/infshape.py:9,49`` (``InfDim`` /
+``InfShape``): each tensor dim is tagged finite or infinite (scales
+with width), and the ratio ``dim / base_dim`` drives init/lr scaling
+so hyperparameters transfer from a small proxy model to the target
+width (maximal update parametrization).
+"""
+
+from typing import List, Optional, Sequence
+
+
+class InfDim:
+    """One dimension: ``base_dim`` from the proxy model, ``dim`` from
+    the target.  ``None`` base means a finite (non-width) dim."""
+
+    def __init__(self, base_dim: Optional[int], dim: int):
+        self.base_dim = base_dim
+        self.dim = dim
+
+    def isinf(self) -> bool:
+        return self.base_dim is not None and self.base_dim != self.dim
+
+    def width_mult(self) -> float:
+        if self.base_dim is None or self.base_dim == 0:
+            return 1.0
+        return self.dim / self.base_dim
+
+    def __repr__(self):
+        return f"InfDim(base={self.base_dim}, dim={self.dim})"
+
+
+class InfShape:
+    def __init__(self, dims: Sequence[InfDim]):
+        self.dims: List[InfDim] = list(dims)
+
+    @classmethod
+    def from_base_shape(cls, base_shape, shape) -> "InfShape":
+        """Pair a proxy-model shape with the target shape; dims that
+        differ are infinite."""
+        if len(base_shape) != len(shape):
+            raise ValueError(
+                f"rank mismatch {base_shape} vs {shape}"
+            )
+        return cls(
+            [InfDim(b, d) for b, d in zip(base_shape, shape)]
+        )
+
+    def ninf(self) -> int:
+        return sum(1 for d in self.dims if d.isinf())
+
+    def width_mult(self) -> float:
+        """The fan-in width multiplier (last inf dim's ratio — μP
+        convention: matrices scale by fan-in)."""
+        for d in reversed(self.dims):
+            if d.isinf():
+                return d.width_mult()
+        return 1.0
+
+    def fanin_fanout_mult(self):
+        """(fan_in_mult, fan_out_mult) for a 2D weight."""
+        if len(self.dims) < 2:
+            return self.width_mult(), 1.0
+        return self.dims[0].width_mult(), self.dims[-1].width_mult()
+
+    def __repr__(self):
+        return f"InfShape({self.dims})"

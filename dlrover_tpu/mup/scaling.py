@@ -1,0 +1,90 @@
+"""μP scaling rules as functional transforms.
+
+Reference parity: ``atorch/atorch/mup/module.py:29,146,222``
+(``MupLinear`` / ``QKVLayer`` / ``OutputLayer``) — the torch version
+subclasses modules; the JAX version scales the *param pytree* and the
+*optimizer* instead (same math, no module surgery):
+
+- hidden (matrix-like, 2 inf dims): init std x 1/sqrt(m), Adam lr x 1/m
+- input/bias (1 inf dim, fan-out inf): unchanged init, lr unchanged
+- output layer: forward scaled by 1/m (``mup_output_scale``)
+
+where m = width multiplier vs the base (proxy) model.
+"""
+
+from typing import Callable, Dict
+
+import jax
+import optax
+
+from dlrover_tpu.mup.infshape import InfShape
+
+
+def make_infshapes(base_shapes, shapes) -> Dict:
+    """Pytrees of shape-tuples -> pytree of InfShape."""
+    return jax.tree_util.tree_map(
+        lambda b, s: InfShape.from_base_shape(b, s),
+        base_shapes,
+        shapes,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def mup_init_scale(infshape: InfShape) -> float:
+    """Multiply a standard (e.g. 1/sqrt(fan_in)) init by this."""
+    if infshape.ninf() >= 2:
+        # matrix-like: extra 1/sqrt(m) on top of base fan-in init
+        return infshape.width_mult() ** -0.5
+    return 1.0
+
+
+def mup_lr_scale(infshape: InfShape) -> float:
+    """Per-tensor Adam learning-rate multiplier."""
+    if infshape.ninf() >= 2:
+        return 1.0 / infshape.width_mult()
+    return 1.0
+
+
+def mup_output_scale(infshape: InfShape) -> float:
+    """Forward multiplier for the readout/vocab layer."""
+    if infshape.ninf() >= 1:
+        return 1.0 / infshape.width_mult()
+    return 1.0
+
+
+def scale_initial_params(params, infshapes):
+    """Apply μP init scaling to an already-initialized param pytree."""
+    return jax.tree_util.tree_map(
+        lambda p, s: p * mup_init_scale(s),
+        params,
+        infshapes,
+        is_leaf=lambda x: isinstance(x, InfShape),
+    )
+
+
+def make_mup_optimizer(
+    learning_rate: float,
+    infshapes,
+    optimizer_factory: Callable[[float], optax.GradientTransformation]
+    = None,
+) -> optax.GradientTransformation:
+    """Per-tensor lr scaling via an optax multi-transform-free mask:
+    scale each update by its tensor's μP multiplier."""
+    if optimizer_factory is None:
+        optimizer_factory = lambda lr: optax.adam(lr)  # noqa: E731
+    base = optimizer_factory(learning_rate)
+
+    def init_fn(params):
+        return base.init(params)
+
+    def update_fn(grads, state, params=None):
+        updates, state = base.update(grads, state, params)
+        updates = jax.tree_util.tree_map(
+            lambda u, s: u * mup_lr_scale(s),
+            updates,
+            infshapes,
+            is_leaf=lambda x: isinstance(x, InfShape),
+        )
+        return updates, state
+
+    return optax.GradientTransformation(init_fn, update_fn)

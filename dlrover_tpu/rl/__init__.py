@@ -1,0 +1,7 @@
+from dlrover_tpu.rl.config import RLConfig, RoleConfig  # noqa: F401
+from dlrover_tpu.rl.engine import ModelEngine  # noqa: F401
+from dlrover_tpu.rl.ppo import (  # noqa: F401
+    compute_gae,
+    ppo_loss,
+    ReplayBuffer,
+)

@@ -1,0 +1,105 @@
+"""Per-role model engine for RLHF.
+
+Reference parity: ``atorch/atorch/rl/model_engine/model_engine.py`` —
+builds each role (actor / critic / ref_model / reward_model) with its
+own acceleration strategy; the actor additionally gets a generation
+path (the reference plugs vLLM — here a jitted greedy/temperature
+sampler on the actor params, which shares the training mesh).
+"""
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.accelerate import auto_accelerate, load_strategy
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.rl.config import RLConfig
+
+
+class ModelEngine:
+    def __init__(self, config: RLConfig):
+        self.config = config
+        self.roles: Dict[str, object] = {}
+        self.states: Dict[str, object] = {}
+
+    def build_role(
+        self,
+        name: str,
+        loss_fn: Callable,
+        optimizer,
+        init_params_fn: Callable,
+        param_axes,
+        devices=None,
+    ):
+        """Accelerate one role with its configured strategy."""
+        role_cfg = self.config.role(name)
+        strategy = None
+        if role_cfg and role_cfg.strategy:
+            strategy = load_strategy(role_cfg.strategy)
+        result = auto_accelerate(
+            loss_fn=loss_fn,
+            optimizer=optimizer,
+            init_params_fn=init_params_fn,
+            param_axes=param_axes,
+            devices=devices,
+            load_strategy=strategy,
+        )
+        self.roles[name] = result
+        logger.info(
+            "role %s -> strategy %s", name, result.strategy.describe()
+        )
+        return result
+
+    def init_role_state(self, name: str, rng):
+        state = self.roles[name].fns.init_state(rng)
+        self.states[name] = state
+        return state
+
+    # --------------------------------------------------------- generation
+    @staticmethod
+    def make_sampler(
+        forward_fn: Callable,  # (params, tokens) -> logits
+        max_new_tokens: int,
+        temperature: float = 1.0,
+        eos_id: Optional[int] = None,
+    ):
+        """Jitted autoregressive sampler on the actor (no KV cache —
+        fine for short RLHF responses; a cached decoder can swap in
+        without changing callers)."""
+
+        def sample(params, prompt, rng):
+            b, plen = prompt.shape
+
+            def step(carry, _):
+                tokens, cur_len, rng = carry
+                logits = forward_fn(params, tokens)
+                # gather the last real position's logits per row
+                idx = jnp.clip(cur_len - 1, 0, tokens.shape[1] - 1)
+                last = jnp.take_along_axis(
+                    logits,
+                    idx[:, None, None].repeat(logits.shape[-1], -1),
+                    axis=1,
+                )[:, 0]
+                rng, sub = jax.random.split(rng)
+                if temperature <= 0:
+                    nxt = jnp.argmax(last, axis=-1)
+                else:
+                    nxt = jax.random.categorical(
+                        sub, last / temperature, axis=-1
+                    )
+                tokens = jax.vmap(
+                    lambda t, i, v: t.at[i].set(v)
+                )(tokens, cur_len, nxt)
+                return (tokens, cur_len + 1, rng), nxt
+
+            total = plen + max_new_tokens
+            padded = jnp.zeros((b, total), dtype=prompt.dtype)
+            padded = padded.at[:, :plen].set(prompt)
+            cur = jnp.full((b,), plen, dtype=jnp.int32)
+            (tokens, _, _), _ = jax.lax.scan(
+                step, (padded, cur, rng), None, length=max_new_tokens
+            )
+            return tokens
+
+        return jax.jit(sample)

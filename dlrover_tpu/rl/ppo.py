@@ -1,0 +1,128 @@
+"""PPO math + replay buffer, functional JAX.
+
+Reference parity: ``atorch/atorch/rl/ppo_utils``/replay buffer — GAE
+advantages, clipped surrogate policy loss with value clipping and a KL
+penalty against the frozen reference policy (the RLHF objective).
+"""
+
+from typing import Dict, List, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def compute_gae(
+    rewards: jnp.ndarray,  # [T]
+    values: jnp.ndarray,  # [T + 1] (bootstrap at the end)
+    gamma: float = 1.0,
+    lam: float = 0.95,
+):
+    """Generalized advantage estimation via reverse scan."""
+
+    def step(carry, t):
+        gae = carry
+        delta = (
+            rewards[t] + gamma * values[t + 1] - values[t]
+        )
+        gae = delta + gamma * lam * gae
+        return gae, gae
+
+    T = rewards.shape[0]
+    _, adv_rev = jax.lax.scan(
+        step, jnp.zeros(()), jnp.arange(T - 1, -1, -1)
+    )
+    advantages = adv_rev[::-1]
+    returns = advantages + values[:-1]
+    return advantages, returns
+
+
+class PPOOutputs(NamedTuple):
+    loss: jnp.ndarray
+    policy_loss: jnp.ndarray
+    value_loss: jnp.ndarray
+    kl: jnp.ndarray
+    clip_frac: jnp.ndarray
+
+
+def ppo_loss(
+    logprobs: jnp.ndarray,  # new policy logprobs [B, T]
+    old_logprobs: jnp.ndarray,  # rollout-time logprobs
+    ref_logprobs: jnp.ndarray,  # frozen reference policy
+    values: jnp.ndarray,  # new value estimates [B, T]
+    old_values: jnp.ndarray,
+    advantages: jnp.ndarray,  # [B, T]
+    returns: jnp.ndarray,
+    mask: jnp.ndarray,  # [B, T] response-token mask
+    clip_ratio: float = 0.2,
+    value_clip: float = 0.2,
+    vf_coef: float = 0.5,
+    kl_coef: float = 0.1,
+) -> PPOOutputs:
+    msum = jnp.maximum(jnp.sum(mask), 1.0)
+
+    # normalized advantages over response tokens
+    amean = jnp.sum(advantages * mask) / msum
+    astd = jnp.sqrt(
+        jnp.sum(((advantages - amean) ** 2) * mask) / msum + 1e-8
+    )
+    adv = (advantages - amean) / astd
+
+    ratio = jnp.exp(logprobs - old_logprobs)
+    unclipped = ratio * adv
+    clipped = jnp.clip(ratio, 1 - clip_ratio, 1 + clip_ratio) * adv
+    policy_loss = -jnp.sum(
+        jnp.minimum(unclipped, clipped) * mask
+    ) / msum
+    clip_frac = jnp.sum(
+        (jnp.abs(ratio - 1.0) > clip_ratio) * mask
+    ) / msum
+
+    v_clipped = old_values + jnp.clip(
+        values - old_values, -value_clip, value_clip
+    )
+    value_loss = 0.5 * jnp.sum(
+        jnp.maximum(
+            (values - returns) ** 2, (v_clipped - returns) ** 2
+        )
+        * mask
+    ) / msum
+
+    kl = jnp.sum((logprobs - ref_logprobs) * mask) / msum
+
+    loss = policy_loss + vf_coef * value_loss + kl_coef * kl
+    return PPOOutputs(loss, policy_loss, value_loss, kl, clip_frac)
+
+
+class ReplayBuffer:
+    """Rollout storage with random minibatch sampling."""
+
+    def __init__(self, capacity: int = 4096, seed: int = 0):
+        self._capacity = capacity
+        self._items: List[Dict] = []
+        self._rng = np.random.default_rng(seed)
+
+    def add(self, sample: Dict):
+        self._items.append(sample)
+        if len(self._items) > self._capacity:
+            self._items.pop(0)
+
+    def __len__(self):
+        return len(self._items)
+
+    def clear(self):
+        self._items.clear()
+
+    def sample_batches(self, batch_size: int, epochs: int = 1):
+        """Yield stacked-dict minibatches, ``epochs`` passes."""
+        n = len(self._items)
+        for _ in range(epochs):
+            order = self._rng.permutation(n)
+            for start in range(0, n - batch_size + 1, batch_size):
+                idx = order[start : start + batch_size]
+                batch = {}
+                for key in self._items[0]:
+                    batch[key] = np.stack(
+                        [self._items[i][key] for i in idx]
+                    )
+                yield batch

@@ -1,0 +1,211 @@
+"""The high-level training loop: accelerate + flash ckpt + elasticity.
+
+Reference parity: ``AtorchTrainer``
+(``atorch/atorch/trainer/atorch_trainer.py:136`` — HF-Trainer-shaped
+loop over auto_accelerate artifacts) and ``FlashCkptTrainer``
+(``dlrover/trainer/torch/flash_checkpoint/hf_trainer.py``) which
+replaces the save path with the async shm engine.
+
+One object wires the whole stack: sharded train step (auto_accelerate
+or explicit strategy), flash-checkpoint engine (memory every
+``save_memory_interval`` steps, storage every
+``save_storage_interval`` — the reference's two-tier cadence), elastic
+progress reporting, hang detection, loss-spike capture, and metrics.
+"""
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Optional
+
+import jax
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.trainer.elastic.context import (
+    init_distributed,
+)
+from dlrover_tpu.trainer.elastic.trainer import ElasticTrainer
+from dlrover_tpu.trainer.fault_tolerance import (
+    HangDetector,
+    LossSpikeCapture,
+    default_hang_action,
+)
+
+
+@dataclass
+class TrainingArgs:
+    max_steps: int
+    checkpoint_dir: str = ""
+    save_memory_interval: int = 10  # steps between shm snapshots
+    save_storage_interval: int = 100  # steps between persisted ckpts
+    log_interval: int = 10
+    global_batch_size: int = 0
+    micro_batch_size: int = 0
+    hang_timeout: float = 1800.0
+    capture_loss_spikes: bool = False
+    spike_dir: str = ""
+    metrics_port: int = 0  # 0 = no exporter daemon
+    extra: dict = field(default_factory=dict)
+
+
+class Trainer:
+    def __init__(
+        self,
+        accelerate_result,
+        args: TrainingArgs,
+        data_iter_fn: Callable[[], Iterable],
+        rng_seed: int = 0,
+    ):
+        """``accelerate_result``: an ``AccelerateResult`` (from
+        ``auto_accelerate``); ``data_iter_fn()`` returns a fresh batch
+        iterator yielding host pytrees matching the batch sharding."""
+        self._ctx = init_distributed()
+        self._result = accelerate_result
+        self._fns = accelerate_result.fns
+        self._args = args
+        self._data_iter_fn = data_iter_fn
+        self._rng_seed = rng_seed
+
+        self.state = None
+        self.progress = ElasticTrainer(
+            global_batch_size=args.global_batch_size
+            or args.micro_batch_size * self._ctx.world_size,
+            micro_batch_size=args.micro_batch_size or 1,
+            world_size=self._ctx.world_size,
+            rank=self._ctx.rank,
+        )
+        self._engine = None
+        if args.checkpoint_dir:
+            from dlrover_tpu.trainer.checkpoint.engine import (
+                CheckpointEngine,
+            )
+
+            self._engine = CheckpointEngine(
+                checkpoint_dir=args.checkpoint_dir,
+                process_rank=self._ctx.rank,
+                process_count=self._ctx.world_size,
+                node_rank=self._ctx.node_rank,
+                local_shard_num=int(
+                    os.getenv("DLROVER_TPU_LOCAL_PROCESS_COUNT", "1")
+                ),
+            )
+        self._hang = HangDetector(
+            timeout=args.hang_timeout, on_hang=default_hang_action
+        )
+        self._spikes = (
+            LossSpikeCapture(
+                args.spike_dir
+                or os.path.join(args.checkpoint_dir or "/tmp", "spikes")
+            )
+            if args.capture_loss_spikes
+            else None
+        )
+        self._registry = None
+        self._exporter = None
+        if args.metrics_port:
+            from dlrover_tpu.observability.metrics import (
+                MetricsExporter,
+                MetricsRegistry,
+            )
+
+            self._registry = MetricsRegistry()
+            self._exporter = MetricsExporter(
+                self._registry,
+                rank=self._ctx.rank,
+                port=args.metrics_port + self._ctx.rank,
+            )
+
+    # ------------------------------------------------------------ resume
+    def _init_or_restore_state(self):
+        self.state = self._fns.init_state(
+            jax.random.PRNGKey(self._rng_seed)
+        )
+        start_step = 0
+        if self._engine is not None:
+            host = jax.device_get(self.state)
+            step, restored = self._engine.load(target=host)
+            if step >= 0 and restored is not None:
+                self.state = jax.device_put(
+                    restored, self._fns.state_shardings
+                )
+                start_step = step
+                logger.info("resumed training from step %d", step)
+        self.progress.global_step = start_step
+        return start_step
+
+    # ------------------------------------------------------------- save
+    def _maybe_checkpoint(self, step: int):
+        if self._engine is None:
+            return
+        to_storage = step % self._args.save_storage_interval == 0
+        to_memory = step % self._args.save_memory_interval == 0
+        if not (to_storage or to_memory):
+            return
+        host = jax.device_get(self.state)
+        if to_storage:
+            self._engine.save_to_storage(step, host)
+        else:
+            self._engine.save_to_memory(step, host)
+
+    # ------------------------------------------------------------- train
+    def train(self):
+        start_step = self._init_or_restore_state()
+        if self._exporter is not None:
+            self._exporter.start()
+        self._hang.start()
+        batch_sharding = self._fns.batch_sharding
+        step = start_step
+        step_times = []
+        try:
+            while step < self._args.max_steps:
+                for batch in self._data_iter_fn():
+                    if step >= self._args.max_steps:
+                        break
+                    t0 = time.perf_counter()
+                    device_batch = jax.device_put(
+                        batch, batch_sharding
+                    )
+                    self.state, metrics = self._fns.train_step(
+                        self.state, device_batch
+                    )
+                    loss = float(metrics["loss"])
+                    dt = time.perf_counter() - t0
+                    step += 1
+                    step_times.append(dt)
+                    self.progress.step_done()
+                    self._hang.report_step(step)
+                    if self._spikes is not None:
+                        self._spikes.observe(step, loss, batch)
+                    if self._registry is not None:
+                        self._registry.set_gauge("train_step", step)
+                        self._registry.set_gauge("train_loss", loss)
+                        self._registry.observe_duration(
+                            "step_time", dt
+                        )
+                    if step % self._args.log_interval == 0:
+                        logger.info(
+                            "step %d loss %.4f (%.3fs/step)",
+                            step,
+                            loss,
+                            dt,
+                        )
+                    self._maybe_checkpoint(step)
+                else:
+                    continue
+                break
+        finally:
+            self._hang.stop()
+            if self._exporter is not None:
+                self._exporter.stop()
+            if self._engine is not None:
+                # final snapshot + persist
+                host = jax.device_get(self.state)
+                self._engine.save_to_storage(step, host)
+                self._engine.wait_for_persist(step, timeout=600)
+                self._engine.close()
+        return {
+            "final_step": step,
+            "mean_step_time": (
+                sum(step_times) / len(step_times) if step_times else 0.0
+            ),
+        }

@@ -1,0 +1,122 @@
+"""Llama pretraining with the full stack: auto_accelerate + Trainer +
+flash checkpoint + elasticity.
+
+Run elastic on one host (8 virtual devices for CI; real chips on TPU):
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python -m dlrover_tpu.run --nnodes=1 --nproc_per_node=1 \
+        examples/llama_pretrain.py --steps 50
+
+The strategy engine picks the mesh (DP for small configs, FSDP/TP as
+the model grows); pass --fsdp/--tensor to pin one.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--seq", type=int, default=128)
+    p.add_argument("--dim", type=int, default=256)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--fsdp", type=int, default=0)
+    p.add_argument("--tensor", type=int, default=0)
+    p.add_argument(
+        "--ckpt_dir", default="/tmp/dlrover_tpu_llama_ckpt"
+    )
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+
+    from dlrover_tpu.trainer.elastic import init_distributed
+
+    init_distributed()
+
+    import jax
+    import optax
+
+    from dlrover_tpu.accelerate import auto_accelerate, load_strategy
+    from dlrover_tpu.models.llama import (
+        LlamaConfig,
+        init_params,
+        loss_fn,
+        param_logical_axes,
+    )
+    from dlrover_tpu.optimizers import agd
+    from dlrover_tpu.trainer.trainer import Trainer, TrainingArgs
+
+    cfg = LlamaConfig(
+        vocab_size=4096,
+        dim=args.dim,
+        n_layers=args.layers,
+        n_heads=args.heads,
+        n_kv_heads=max(args.heads // 2, 1),
+        mlp_dim=args.dim * 3,
+        max_seq_len=args.seq,
+    )
+    strategy = None
+    if args.fsdp or args.tensor:
+        n = len(jax.devices())
+        fsdp = args.fsdp or 1
+        tensor = args.tensor or 1
+        strategy = load_strategy(
+            {
+                "data": n // (fsdp * tensor),
+                "fsdp": fsdp,
+                "tensor": tensor,
+            }
+        )
+    result = auto_accelerate(
+        loss_fn=lambda p, b: loss_fn(p, b, cfg),
+        optimizer=agd(3e-4),
+        init_params_fn=lambda rng: init_params(rng, cfg),
+        param_axes=param_logical_axes(cfg),
+        load_strategy=strategy,
+    )
+    print(
+        f"strategy: {result.strategy.describe()} | "
+        f"params: {result.profile.num_params:,}",
+        flush=True,
+    )
+
+    rng = np.random.default_rng(0)
+
+    def data_iter():
+        while True:
+            yield {
+                "tokens": rng.integers(
+                    0, cfg.vocab_size,
+                    size=(args.batch, args.seq + 1),
+                    dtype=np.int32,
+                )
+            }
+
+    trainer = Trainer(
+        result,
+        TrainingArgs(
+            max_steps=args.steps,
+            checkpoint_dir=args.ckpt_dir,
+            save_memory_interval=10,
+            save_storage_interval=25,
+            log_interval=10,
+            micro_batch_size=args.batch,
+        ),
+        data_iter,
+    )
+    summary = trainer.train()
+    print(f"done: {summary}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,172 @@
+"""μP scaling, DiLoCo local SGD, PPO math, RL engine sampler."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.local_sgd import (
+    diloco_init,
+    diloco_outer_step,
+    gta_reduce,
+    linear_reduce,
+)
+from dlrover_tpu.mup import (
+    InfShape,
+    make_mup_optimizer,
+    mup_init_scale,
+    mup_lr_scale,
+    mup_output_scale,
+)
+from dlrover_tpu.mup.infshape import InfDim
+from dlrover_tpu.rl import (
+    ModelEngine,
+    RLConfig,
+    ReplayBuffer,
+    compute_gae,
+    ppo_loss,
+)
+
+
+class TestMup:
+    def test_infshape_classification(self):
+        # base 64 -> target 256: width mult 4
+        mat = InfShape.from_base_shape((64, 64), (256, 256))
+        assert mat.ninf() == 2 and mat.width_mult() == 4.0
+        vec = InfShape.from_base_shape((64,), (256,))
+        assert vec.ninf() == 1
+        fin = InfShape.from_base_shape((64, 10), (256, 10))
+        assert fin.ninf() == 1
+
+    def test_scaling_rules(self):
+        mat = InfShape.from_base_shape((64, 64), (256, 256))
+        assert mup_init_scale(mat) == pytest.approx(0.5)  # 1/sqrt(4)
+        assert mup_lr_scale(mat) == pytest.approx(0.25)  # 1/4
+        vec = InfShape.from_base_shape((10, 64), (10, 256))
+        assert mup_lr_scale(vec) == 1.0
+        assert mup_output_scale(vec) == pytest.approx(0.25)
+
+    def test_mup_optimizer_scales_updates(self):
+        params = {"w": jnp.ones((4, 4)), "b": jnp.ones((4,))}
+        infshapes = {
+            "w": InfShape([InfDim(2, 4), InfDim(2, 4)]),
+            "b": InfShape([InfDim(2, 4)]),
+        }
+        opt = make_mup_optimizer(
+            1.0, infshapes, lambda lr: optax.sgd(lr)
+        )
+        state = opt.init(params)
+        grads = {"w": jnp.ones((4, 4)), "b": jnp.ones((4,))}
+        updates, _ = opt.update(grads, state, params)
+        # matrix update scaled by 1/2, vector unscaled
+        np.testing.assert_allclose(np.asarray(updates["w"]), -0.5)
+        np.testing.assert_allclose(np.asarray(updates["b"]), -1.0)
+
+
+class TestLocalSgd:
+    def test_diloco_moves_toward_replica_consensus(self):
+        params = {"w": jnp.zeros((4,))}
+        state = diloco_init(params)
+        # simulate 2 replicas drifting to +1 and +3 after inner steps
+        replica_deltas = [
+            {"w": jnp.full((4,), 1.0)},
+            {"w": jnp.full((4,), 3.0)},
+        ]
+        # replica params = anchor + delta; reduce their pseudo-grads
+        def reducer(my_pseudo):
+            all_pg = [
+                jax.tree_util.tree_map(lambda d: -d, rd)
+                for rd in replica_deltas
+            ]
+            return linear_reduce(all_pg)
+
+        my_params = {"w": params["w"] + replica_deltas[0]["w"]}
+        new_params, new_state = diloco_outer_step(
+            my_params, state, reducer=reducer,
+            outer_optimizer=optax.sgd(1.0),
+        )
+        # pseudo-grad mean = -2; sgd(1.0) -> params += 2
+        np.testing.assert_allclose(np.asarray(new_params["w"]), 2.0)
+        assert int(new_state.sync_count) == 1
+
+    def test_gta_suppresses_conflicts(self):
+        a = {"w": jnp.array([1.0, 1.0, -1.0])}
+        b = {"w": jnp.array([3.0, -1.0, -3.0])}
+        merged = gta_reduce([a, b])["w"]
+        # elem 0: agree positive -> magnitude-weighted avg
+        assert float(merged[0]) == pytest.approx((1 * 1 + 3 * 3) / 4)
+        # elem 1: conflict, dominant sign +, only a contributes
+        assert float(merged[1]) == pytest.approx(1.0)
+        # elem 2: agree negative
+        assert float(merged[2]) == pytest.approx(-(1 + 9) / 4)
+
+
+class TestPPO:
+    def test_gae_matches_manual(self):
+        rewards = jnp.array([1.0, 0.0, 1.0])
+        values = jnp.array([0.5, 0.5, 0.5, 0.0])
+        adv, ret = compute_gae(rewards, values, gamma=0.9, lam=0.8)
+        # manual reverse recursion
+        g = 0.0
+        expected = []
+        for t in reversed(range(3)):
+            delta = float(rewards[t]) + 0.9 * float(values[t + 1]) - float(values[t])
+            g = delta + 0.9 * 0.8 * g
+            expected.append(g)
+        expected = expected[::-1]
+        np.testing.assert_allclose(np.asarray(adv), expected, rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(ret), np.asarray(adv) + np.asarray(values[:-1]),
+            rtol=1e-6,
+        )
+
+    def test_ppo_loss_shapes_and_clip(self):
+        b, t = 2, 4
+        key = jax.random.PRNGKey(0)
+        lp = jax.random.normal(key, (b, t)) * 0.1
+        out = ppo_loss(
+            logprobs=lp,
+            old_logprobs=jnp.zeros((b, t)),
+            ref_logprobs=jnp.zeros((b, t)),
+            values=jnp.zeros((b, t)),
+            old_values=jnp.zeros((b, t)),
+            advantages=jnp.ones((b, t)),
+            returns=jnp.ones((b, t)),
+            mask=jnp.ones((b, t)),
+        )
+        assert np.isfinite(float(out.loss))
+        assert 0.0 <= float(out.clip_frac) <= 1.0
+
+    def test_replay_buffer(self):
+        buf = ReplayBuffer(capacity=8)
+        for i in range(6):
+            buf.add({"x": np.full((2,), i)})
+        batches = list(buf.sample_batches(2, epochs=1))
+        assert len(batches) == 3
+        assert batches[0]["x"].shape == (2, 2)
+
+
+class TestModelEngine:
+    def test_sampler_greedy(self):
+        cfg = RLConfig.from_dict(
+            {"roles": {"actor": {"learning_rate": 1e-5}}}
+        )
+        engine = ModelEngine(cfg)
+        vocab = 16
+
+        def forward(params, tokens):
+            # deterministic: logits favor (last_token + 1) % vocab
+            onehot = jax.nn.one_hot(
+                (tokens + 1) % vocab, vocab
+            )
+            return onehot * 10.0
+
+        sampler = engine.make_sampler(
+            forward, max_new_tokens=4, temperature=0.0
+        )
+        prompt = jnp.array([[3, 4]], dtype=jnp.int32)
+        out = sampler({}, prompt, jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(
+            np.asarray(out[0]), [3, 4, 5, 6, 7, 8]
+        )
