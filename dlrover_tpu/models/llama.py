@@ -249,6 +249,14 @@ def set_activation_rules(rules):
 def _current_rules():
     rules = _rules_holder["rules"]
     if rules is None:
+        rules = sh.active_rules()
+    if rules is None:
+        from dlrover_tpu.parallel.mesh import get_mesh_context
+
+        ctx = get_mesh_context()
+        if ctx is not None and ctx.rules is not None:
+            rules = ctx.rules
+    if rules is None:
         rules = sh.default_rules(fsdp=False)
     return rules
 
@@ -263,7 +271,14 @@ def forward(
     attention_fn = attention_fn or dot_product_attention
     dt = cfg.dtype
     b, s = tokens.shape
-    x = params["embed"].astype(dt)[tokens]
+    # Gather over an fsdp-sharded embed dim would force the partitioner
+    # to move the fsdp axis from dim -1 (table layout) to dim 0 (batch
+    # layout) through the gather — an involuntary full remat.  Voluntarily
+    # all-gather the (small) table's embed dim first; vocab stays sharded.
+    table = sh.apply_sharding_constraint(
+        params["embed"].astype(dt), (sh.VOCAB, None), _current_rules()
+    )
+    x = table[tokens]
     x = sh.apply_sharding_constraint(
         x, (sh.BATCH, sh.SEQ, sh.EMBED), _current_rules()
     )

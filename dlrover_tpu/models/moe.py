@@ -141,5 +141,13 @@ def set_moe_rules(rules):
 def _moe_rules():
     rules = _rules_holder["rules"]
     if rules is None:
+        rules = sh.active_rules()
+    if rules is None:
+        from dlrover_tpu.parallel.mesh import get_mesh_context
+
+        ctx = get_mesh_context()
+        if ctx is not None and ctx.rules is not None:
+            rules = ctx.rules
+    if rules is None:
         rules = sh.default_rules(fsdp=False, expert_parallel=True)
     return rules

@@ -46,6 +46,10 @@ class MeshContext:
 
     mesh: "object"  # jax.sharding.Mesh
     dims: List[Tuple[str, int]] = field(default_factory=list)
+    # active LogicalAxisRules; set by the strategy engine /
+    # build_train_step so in-model activation constraints resolve
+    # against the same table that sharded the params
+    rules: Optional[object] = None
 
     def axis_size(self, name: str) -> int:
         return dict(self.dims).get(name, 1)

@@ -18,6 +18,8 @@ table:
 so "auto_accelerate" becomes: pick a rule table, shard_pytree, jit.
 """
 
+import contextlib
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from dlrover_tpu.parallel.mesh import AxisName
@@ -102,6 +104,33 @@ def default_rules(
         # ZeRO-3: shard the big parameter dim over the fsdp axis
         rules.append((EMBED, AxisName.FSDP))
     return LogicalAxisRules(rules)
+
+
+_scope = threading.local()
+
+
+@contextlib.contextmanager
+def rules_scope(rules: "LogicalAxisRules"):
+    """Bind the active rule table for the duration of a trace.
+
+    ``build_train_step`` wraps its loss invocation in this scope so the
+    activation constraints a model emits are resolved against the same
+    table that sharded its params — captured at trace time, immune to
+    later builds mutating shared context (two train steps built against
+    different strategies each bake in their own rules)."""
+    stack = getattr(_scope, "stack", None)
+    if stack is None:
+        stack = _scope.stack = []
+    stack.append(rules)
+    try:
+        yield rules
+    finally:
+        stack.pop()
+
+
+def active_rules() -> Optional["LogicalAxisRules"]:
+    stack = getattr(_scope, "stack", None)
+    return stack[-1] if stack else None
 
 
 def filter_spec_for_mesh(spec, mesh):

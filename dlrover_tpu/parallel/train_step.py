@@ -22,6 +22,7 @@ from dlrover_tpu.parallel.sharding import (
     BATCH,
     LogicalAxisRules,
     logical_sharding,
+    rules_scope,
     shard_pytree,
 )
 
@@ -55,6 +56,9 @@ def build_train_step(
     batch_logical_axes=(BATCH,),
 ) -> TrainStepFns:
     mesh = mesh_ctx.mesh
+    # publish the rule table so in-model activation constraints
+    # (apply_sharding_constraint via _current_rules) match param shardings
+    mesh_ctx.rules = rules
 
     param_shardings = jax.tree_util.tree_map(
         lambda axes: logical_sharding(mesh, rules, axes),
@@ -104,7 +108,11 @@ def build_train_step(
     init_state = jax.jit(_init_state, out_shardings=state_shardings)
 
     def _loss_and_grad(params, batch):
-        return jax.value_and_grad(loss_fn)(params, batch)
+        # rules bound at trace time: the model's activation constraints
+        # resolve against this build's table even if another strategy
+        # is built before this step is first called
+        with rules_scope(rules):
+            return jax.value_and_grad(loss_fn)(params, batch)
 
     def _train_step(state, batch):
         params = state["params"]

@@ -1,0 +1,39 @@
+"""Dev tool: compile the multi-axis train step on a virtual CPU mesh and
+count SPMD involuntary-rematerialization warnings (VERDICT weak #2).
+
+Usage: python scripts/check_spmd_warnings.py [n_devices]
+Prints the warning count; exit code 1 when any are present.
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+
+child = os.environ.get("_SPMD_CHECK_CHILD")
+if not child:
+    env = dict(os.environ, _SPMD_CHECK_CHILD="1")
+    proc = subprocess.run(
+        [sys.executable, __file__, str(N)],
+        capture_output=True,
+        text=True,
+        env=env,
+    )
+    warnings = re.findall(
+        r"Involuntary full rematerialization.*?HLO operation %(\S+) =",
+        proc.stderr,
+    )
+    print(proc.stdout.strip())
+    for w in warnings:
+        print("REMAT:", w)
+    print(f"spmd_remat_warnings={len(warnings)} rc={proc.returncode}")
+    if proc.returncode != 0:
+        print(proc.stderr[-3000:])
+    sys.exit(1 if (warnings or proc.returncode) else 0)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import __graft_entry__ as g  # noqa: E402
+
+g.dryrun_multichip(N)
