@@ -1,0 +1,33 @@
+"""Content-hash-keyed rebuild check for the native components.
+
+Build outputs live under (gitignored) ``native/*/build``; binaries are
+rebuilt on first use.  The staleness check is keyed on a source content
+hash written to a ``<binary>.srchash`` stamp — mtimes are unreliable
+after git checkouts, which reset them unpredictably.
+"""
+
+import hashlib
+import os
+
+
+def _source_hash(src_path: str) -> str:
+    h = hashlib.sha256()
+    with open(src_path, "rb") as f:
+        h.update(f.read())
+    return h.hexdigest()
+
+
+def needs_rebuild(binary_path: str, src_path: str) -> bool:
+    if not os.path.exists(binary_path):
+        return True
+    stamp = binary_path + ".srchash"
+    try:
+        with open(stamp) as f:
+            return f.read().strip() != _source_hash(src_path)
+    except OSError:
+        return True
+
+
+def write_stamp(binary_path: str, src_path: str) -> None:
+    with open(binary_path + ".srchash", "w") as f:
+        f.write(_source_hash(src_path))

@@ -15,6 +15,7 @@ import time
 from typing import Dict, Optional
 
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.native_build import needs_rebuild, write_stamp
 
 BASE_PORT = 28888  # xpu_timer's port convention
 
@@ -105,12 +106,11 @@ class MetricsExporter:
     @staticmethod
     def build() -> str:
         os.makedirs(_BIN_DIR, exist_ok=True)
-        if not os.path.exists(_BIN) or os.path.getmtime(
-            _BIN
-        ) < os.path.getmtime(_SRC):
+        if needs_rebuild(_BIN, _SRC):
             cmd = ["g++", "-O2", "-std=c++17", "-o", _BIN, _SRC]
             logger.info("building metrics exporter: %s", " ".join(cmd))
             subprocess.run(cmd, check=True, capture_output=True)
+            write_stamp(_BIN, _SRC)
         return _BIN
 
     def start(self):

@@ -45,6 +45,7 @@ def _flash_fwd_kernel(
     causal: bool,
     block_q: int,
     block_k: int,
+    seq_len: int,
 ):
     qi = pl.program_id(2)
     kj = pl.program_id(3)
@@ -79,14 +80,31 @@ def _flash_fwd_kernel(
             * sm_scale
         )  # [BQ, BK]
 
-        if causal:
-            q_pos = qi * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
+        # bounds mask: the last K block is padded when seq_len is not a
+        # multiple of block_k; padded columns MUST NOT feed the softmax
+        # denominator, and padded V rows hold undefined data (possibly
+        # NaN — 0 * NaN = NaN would poison the accumulator), so both
+        # sides are masked.
+        padded_k = seq_len % block_k != 0
+        if padded_k:
+            row_valid = (
+                kj * block_k + lax.broadcasted_iota(jnp.int32, (block_k, 1), 0)
+                < seq_len
             )
+            v = jnp.where(row_valid, v, 0.0)
+        if causal or padded_k:
             k_pos = kj * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            keep = jnp.ones((block_q, block_k), dtype=bool)
+            if padded_k:
+                keep &= k_pos < seq_len
+            if causal:
+                q_pos = qi * block_q + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0
+                )
+                keep &= q_pos >= k_pos
+            s = jnp.where(keep, s, NEG_INF)
 
         m_prev = m_scr[:, :1]  # [BQ, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
@@ -141,6 +159,7 @@ def _flash_fwd(
         causal=causal,
         block_q=block_q,
         block_k=block_k,
+        seq_len=s,
     )
     # the kv index map folds the head group: no materialized repeat
     kv_spec = pl.BlockSpec(

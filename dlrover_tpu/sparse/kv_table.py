@@ -16,6 +16,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.native_build import needs_rebuild, write_stamp
 
 _REPO_ROOT = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -43,6 +44,7 @@ def _build_library() -> str:
     ]
     logger.info("building kv_table: %s", " ".join(cmd))
     subprocess.run(cmd, check=True, capture_output=True)
+    write_stamp(_LIB, _SRC)
     return _LIB
 
 
@@ -51,9 +53,7 @@ def _load_library():
     with _build_lock:
         if _lib_handle is not None:
             return _lib_handle
-        if not os.path.exists(_LIB) or os.path.getmtime(
-            _LIB
-        ) < os.path.getmtime(_SRC):
+        if needs_rebuild(_LIB, _SRC):
             _build_library()
         lib = ctypes.CDLL(_LIB)
         lib.kv_create.restype = ctypes.c_void_p

@@ -75,6 +75,7 @@ class CheckpointEngine:
         local_shard_num: int = 1,
         name: str = "default",
         storage=None,
+        step_sync_fn=None,
     ):
         self.checkpoint_dir = checkpoint_dir
         self._rank = process_rank
@@ -83,6 +84,11 @@ class CheckpointEngine:
         self._name = name
         self._storage = storage or get_checkpoint_storage()
         self._local_saver: Optional[AsyncCheckpointSaver] = None
+        # cross-rank restore-step consensus hook: (local_best) -> agreed
+        # step; default uses a jax multihost allgather when distributed
+        self._step_sync_fn = step_sync_fn
+        self._snapshot_thread = None
+        self._last_drain_ok = True
 
         # the saver serves shm/lock endpoints for global ranks
         # [node_rank*local_shard_num, ...); this process's rank must be
@@ -126,10 +132,78 @@ class CheckpointEngine:
             f"{EVENT_QUEUE}_{name}", create=False
         )
 
+    def preallocate_like(self, state) -> int:
+        """Create + fault in the shm segment sized for ``state`` ahead
+        of the first snapshot (moves ~80 s of first-save page allocation
+        off the training hot path; a preemption arriving before step 1
+        then still finds a live segment).  Returns the reserved bytes."""
+        import jax
+        import numpy as _np
+
+        total = sum(
+            leaf.size * _np.dtype(leaf.dtype).itemsize
+            for leaf in jax.tree_util.tree_leaves(state)
+            if hasattr(leaf, "size")
+        )
+        if total:
+            self._shm_handler.preallocate(total)
+        return total
+
     # -- save --------------------------------------------------------------
-    def save_to_memory(self, step: int, state) -> bool:
-        """Block only for device->host copy into shm."""
+    def save_to_memory(self, step: int, state,
+                       blocking: bool = True) -> bool:
+        """Snapshot ``state`` into shm.
+
+        ``blocking=True`` waits for the device->host copy (safe with
+        donated-buffer train steps: the snapshot completes before the
+        caller can dispatch a step that invalidates ``state``).
+        ``blocking=False`` launches all device->host transfers async and
+        drains them into shm on a background thread — training is
+        blocked only for the dispatch (~ms); the caller must keep
+        ``state`` alive and un-donated until the drain finishes
+        (``wait_for_snapshot``).
+        """
+        if not self._snapshot_slot_free(step):
+            return False
+        if blocking:
+            return self._drain_snapshot(step, state, None)
+        return self._launch_async_snapshot(step, state, None)
+
+    def _snapshot_slot_free(self, step: int) -> bool:
+        if self._snapshot_thread is not None:
+            if self._snapshot_thread.is_alive():
+                logger.warning(
+                    "rank %s: snapshot still draining; skip step %s",
+                    self._rank, step,
+                )
+                return False
+            self._snapshot_thread = None
+        return True
+
+    def _launch_async_snapshot(self, step: int, state,
+                               persist_dir: Optional[str]) -> bool:
+        # launch every transfer before returning so D2H overlaps with
+        # whatever the training loop does next
+        import threading
+
+        import jax
+
+        for leaf in jax.tree_util.tree_leaves(state):
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
+        self._snapshot_thread = threading.Thread(
+            target=self._drain_snapshot,
+            args=(step, state, persist_dir),
+            name=f"ckpt-snapshot-{step}",
+            daemon=True,
+        )
+        self._snapshot_thread.start()
+        return True
+
+    def _drain_snapshot(self, step: int, state,
+                        persist_dir: Optional[str]) -> bool:
         start = time.time()
+        self._last_drain_ok = False
         if not self._lock.acquire(timeout=60):
             logger.warning(
                 "rank %s: saver still busy; skip memory save of step %s",
@@ -144,48 +218,150 @@ class CheckpointEngine:
             "rank %s: step %s snapshot (%.1f MB) to shm in %.3fs",
             self._rank, step, nbytes / 1e6, time.time() - start,
         )
+        if persist_dir is not None:
+            self._event_queue.put(
+                CheckpointEvent(
+                    event_type="save", step=step,
+                    checkpoint_dir=persist_dir,
+                )
+            )
+        self._last_drain_ok = True
         return True
 
+    def wait_for_snapshot(self, timeout: Optional[float] = None) -> bool:
+        """Join an in-flight non-blocking snapshot drain.  Returns True
+        only when the drain actually wrote the snapshot (a drain that
+        lost the saver lock returns False so callers don't wait on a
+        persist that will never come)."""
+        t = self._snapshot_thread
+        if t is None:
+            return True
+        t.join(timeout)
+        return not t.is_alive() and self._last_drain_ok
+
     def save_to_storage(self, step: int, state,
-                        checkpoint_dir: Optional[str] = None) -> bool:
-        if not self.save_to_memory(step, state):
-            return False
-        self._event_queue.put(
-            CheckpointEvent(
-                event_type="save",
-                step=step,
-                checkpoint_dir=checkpoint_dir or self.checkpoint_dir,
+                        checkpoint_dir: Optional[str] = None,
+                        blocking: bool = True) -> bool:
+        target_dir = checkpoint_dir or self.checkpoint_dir
+        if blocking:
+            if not self.save_to_memory(step, state):
+                return False
+            self._event_queue.put(
+                CheckpointEvent(
+                    event_type="save", step=step,
+                    checkpoint_dir=target_dir,
+                )
             )
-        )
-        return True
+            return True
+        # async: the persist event must trail the shm write, so the
+        # drain thread enqueues it
+        if not self._snapshot_slot_free(step):
+            return False
+        return self._launch_async_snapshot(step, state, target_dir)
 
     # -- load --------------------------------------------------------------
     def load(self, target=None, checkpoint_dir: Optional[str] = None):
-        """Restore the newest state: shm first (seconds), storage next.
+        """Restore the newest globally-agreed state: shm first
+        (zero-copy views fed straight to device), storage next.
+
+        The restore step is reconciled across processes before any data
+        moves: after a node replacement, surviving ranks may hold a
+        newer uncommitted shm snapshot than the relaunched node's last
+        committed storage step — restoring it would silently resume a
+        mixed-step global state.  Every process restores
+        ``min over ranks of max(shm_step, storage_step)``.
 
         Returns (step, state) where state is ``target``-shaped if a
         target pytree was given, else {keypath: ndarray}; (-1, None)
         when nothing exists.
         """
-        step, arrays = self._shm_handler.load_state()
-        if step < 0:
-            step, arrays = self._load_from_storage(checkpoint_dir)
-        if step < 0:
+        shm_step = self._shm_handler.get_step()
+        storage_step, latest_dir = self._latest_storage_step(
+            checkpoint_dir
+        )
+        agreed = self._sync_restore_step(max(shm_step, storage_step))
+        if agreed < 0:
             return -1, None
+        zero_copy = False
+        if shm_step == agreed:
+            # zero-copy: views onto shm, batched device_put in
+            # restore_to_target (blocks before returning, so the next
+            # snapshot can't clobber the views mid-transfer)
+            zero_copy = target is not None
+            step, arrays = self._shm_handler.load_state(copy=not zero_copy)
+        elif storage_step == agreed:
+            step, arrays = self._read_storage_shard(latest_dir)
+        else:
+            step, arrays = self._load_storage_step(agreed, checkpoint_dir)
+        if step != agreed or not arrays:
+            # peers WILL resume from `agreed`; silently starting fresh
+            # here would be exactly the mixed-step divergence the
+            # consensus exists to prevent — fail loudly instead
+            raise RuntimeError(
+                f"rank {self._rank}: globally-agreed restore step "
+                f"{agreed} unavailable locally (shm={shm_step} "
+                f"storage={storage_step})"
+            )
         if target is not None:
-            return step, restore_to_target(target, arrays)
+            # copy_host guards non-device leaves from aliasing live shm
+            return step, restore_to_target(
+                target, arrays, copy_host=zero_copy
+            )
         return step, arrays
 
-    def _load_from_storage(self, checkpoint_dir: Optional[str] = None):
+    def _sync_restore_step(self, local_best: int) -> int:
+        """Cross-process consensus on the restore step (collective min
+        of each rank's best locally-available step)."""
+        if self._step_sync_fn is not None:
+            return self._step_sync_fn(local_best)
+        try:
+            import jax
+
+            if jax.process_count() > 1:
+                import jax.numpy as jnp
+                from jax.experimental import multihost_utils
+
+                steps = multihost_utils.process_allgather(
+                    jnp.int32(local_best)
+                )
+                return int(steps.min())
+        except Exception as exc:  # noqa: BLE001
+            logger.warning(
+                "restore-step sync failed (%s); using local step", exc
+            )
+        return local_best
+
+    def _latest_storage_step(self, checkpoint_dir: Optional[str] = None):
         root = checkpoint_dir or self.checkpoint_dir
         latest = find_latest_checkpoint(root, self._storage)
         if latest is None:
+            return -1, None
+        try:
+            step = int(os.path.basename(latest).split("-")[-1])
+        except ValueError:
+            step = -1
+        return step, latest
+
+    def _read_storage_shard(self, ckpt_path: Optional[str]):
+        if ckpt_path is None:
             return -1, {}
-        path = os.path.join(latest, f"shard_{self._rank}.drckpt")
+        path = os.path.join(ckpt_path, f"shard_{self._rank}.drckpt")
         if not self._storage.exists(path):
-            logger.warning("no shard file %s in %s", self._rank, latest)
+            logger.warning("no shard file %s in %s", self._rank, ckpt_path)
             return -1, {}
         return read_shard_file(path, self._storage)
+
+    def _load_storage_step(self, step: int,
+                           checkpoint_dir: Optional[str] = None):
+        """Read a specific committed step (an older step may be the
+        globally-agreed one when this rank's storage is ahead)."""
+        root = checkpoint_dir or self.checkpoint_dir
+        path = os.path.join(
+            root, f"{CheckpointConstant.CKPT_DIR_PREFIX}{step}"
+        )
+        if not self._storage.exists(path):
+            return -1, {}
+        return self._read_storage_shard(path)
 
     def latest_persisted_step(self) -> int:
         tracker = os.path.join(
@@ -203,6 +379,7 @@ class CheckpointEngine:
         return False
 
     def close(self):
+        self.wait_for_snapshot(timeout=300)
         self._shm_handler.close()
         self._lock.close()
         self._event_queue.close()

@@ -119,14 +119,30 @@ def _launch_local_master(node_num: int) -> Tuple[subprocess.Popen, str]:
     )
     addr = f"127.0.0.1:{port}"
     deadline = time.time() + 30
-    pattern = re.compile(r"DLROVER_TPU_MASTER_ADDR=(\S+)")
+    pattern = re.compile(rb"DLROVER_TPU_MASTER_ADDR=(\S+)")
+    # non-blocking reads on the RAW fd: a live master that never prints
+    # the address line must not hang the launcher past the deadline
+    # (the pre-computed 127.0.0.1:port stays the fallback).  select on
+    # the raw fd + os.read avoids both TextIOWrapper buffering (a line
+    # already buffered would never wake select) and readline blocking
+    # on a partial line.
+    import select as _select
+
+    fd = proc.stdout.fileno()
+    buf = b""
     while time.time() < deadline:
         if proc.poll() is not None:
             raise RuntimeError("local master exited during startup")
-        line = proc.stdout.readline()
-        m = pattern.search(line or "")
+        readable, _, _ = _select.select([fd], [], [], 0.5)
+        if not readable:
+            continue
+        chunk = os.read(fd, 4096)
+        if not chunk:
+            continue
+        buf += chunk
+        m = pattern.search(buf)
         if m:
-            addr = m.group(1)
+            addr = m.group(1).decode()
             break
     # stop consuming stdout; master logs go to stderr
     return proc, addr

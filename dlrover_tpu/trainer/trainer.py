@@ -100,6 +100,7 @@ class Trainer:
             if args.capture_loss_spikes
             else None
         )
+        self._snap_fn = None
         self._registry = None
         self._exporter = None
         if args.metrics_port:
@@ -122,12 +123,11 @@ class Trainer:
         )
         start_step = 0
         if self._engine is not None:
-            host = jax.device_get(self.state)
-            step, restored = self._engine.load(target=host)
+            # restore straight onto the initialized state's shardings
+            # (zero-copy shm views -> one batched device transfer)
+            step, restored = self._engine.load(target=self.state)
             if step >= 0 and restored is not None:
-                self.state = jax.device_put(
-                    restored, self._fns.state_shardings
-                )
+                self.state = restored
                 start_step = step
                 logger.info("resumed training from step %d", step)
         self.progress.global_step = start_step
@@ -141,11 +141,31 @@ class Trainer:
         to_memory = step % self._args.save_memory_interval == 0
         if not (to_storage or to_memory):
             return
-        host = jax.device_get(self.state)
+        # snapshot an on-device COPY (cheap HBM->HBM) so the async
+        # device->host drain can proceed while subsequent train steps
+        # donate and overwrite self.state's buffers
+        if self._snap_fn is None:
+            self._snap_fn = jax.jit(
+                lambda s: jax.tree_util.tree_map(jax.numpy.copy, s)
+            )
+        snap = self._snap_fn(self.state)
         if to_storage:
-            self._engine.save_to_storage(step, host)
+            self._engine.save_to_storage(step, snap, blocking=False)
         else:
-            self._engine.save_to_memory(step, host)
+            self._engine.save_to_memory(step, snap, blocking=False)
+
+    def _consume_metrics(self, step: int, metrics, batch, dt: float):
+        loss = float(metrics["loss"])
+        if self._spikes is not None:
+            self._spikes.observe(step, loss, batch)
+        if self._registry is not None:
+            self._registry.set_gauge("train_step", step)
+            self._registry.set_gauge("train_loss", loss)
+            self._registry.observe_duration("step_time", dt)
+        if step % self._args.log_interval == 0:
+            logger.info(
+                "step %d loss %.4f (%.3fs/step)", step, loss, dt
+            )
 
     # ------------------------------------------------------------- train
     def train(self):
@@ -157,6 +177,12 @@ class Trainer:
         step = start_step
         step_times = []
         try:
+            # metrics are read to host with a ONE-STEP delay: forcing
+            # float(loss) right after dispatch would block on the device
+            # result every step and serialize the async dispatch
+            # pipeline (round-1 advisor finding); by the time step N+1
+            # is dispatched, step N's metrics are already materialized.
+            pending = None  # (step, metrics, batch, dt)
             while step < self._args.max_steps:
                 for batch in self._data_iter_fn():
                     if step >= self._args.max_steps:
@@ -168,40 +194,32 @@ class Trainer:
                     self.state, metrics = self._fns.train_step(
                         self.state, device_batch
                     )
-                    loss = float(metrics["loss"])
                     dt = time.perf_counter() - t0
                     step += 1
                     step_times.append(dt)
                     self.progress.step_done()
                     self._hang.report_step(step)
-                    if self._spikes is not None:
-                        self._spikes.observe(step, loss, batch)
-                    if self._registry is not None:
-                        self._registry.set_gauge("train_step", step)
-                        self._registry.set_gauge("train_loss", loss)
-                        self._registry.observe_duration(
-                            "step_time", dt
-                        )
-                    if step % self._args.log_interval == 0:
-                        logger.info(
-                            "step %d loss %.4f (%.3fs/step)",
-                            step,
-                            loss,
-                            dt,
-                        )
+                    if pending is not None:
+                        self._consume_metrics(*pending)
+                    pending = (step, metrics, batch, dt)
                     self._maybe_checkpoint(step)
                 else:
                     continue
                 break
+            if pending is not None:
+                self._consume_metrics(*pending)
         finally:
             self._hang.stop()
             if self._exporter is not None:
                 self._exporter.stop()
             if self._engine is not None:
-                # final snapshot + persist
-                host = jax.device_get(self.state)
-                self._engine.save_to_storage(step, host)
-                self._engine.wait_for_persist(step, timeout=600)
+                # final snapshot + persist (blocking: the engine pulls
+                # device state itself).  An async drain from the last
+                # in-loop snapshot may still be running — join it first
+                # or the save slot is busy and the persist never comes.
+                self._engine.wait_for_snapshot(timeout=600)
+                if self._engine.save_to_storage(step, self.state):
+                    self._engine.wait_for_persist(step, timeout=600)
                 self._engine.close()
         return {
             "final_step": step,

@@ -132,6 +132,44 @@ class TestCheckpointerStandalone:
         assert float(np.asarray(restored["params"]["w"])[0, 0]) == 9.0
         ckpt.close()
 
+    def test_restore_step_consensus(self, tmp_ckpt_dir):
+        """After a node replacement, a rank holding a newer uncommitted
+        shm snapshot must fall back to the globally-agreed (committed)
+        step instead of silently resuming a mixed-step state."""
+        ckpt = Checkpointer(tmp_ckpt_dir, process_rank=0,
+                            process_count=1, node_rank=0, name="d5")
+        committed = make_state(step=1, scale=1.0)
+        newer = make_state(step=2, scale=9.0)
+        ckpt.save_checkpoint(1, committed, StorageType.DISK)
+        ckpt.wait_latest_checkpoint(1, timeout=30)
+        ckpt.save_checkpoint(2, newer, StorageType.MEMORY)
+        # simulate a relaunched peer whose best step is the committed 1
+        ckpt._engine._step_sync_fn = lambda local_best: min(local_best, 1)
+        step, restored = ckpt.load_checkpoint(target=newer)
+        assert step == 1
+        assert float(np.asarray(restored["params"]["w"])[0, 0]) == 1.0
+        ckpt.close()
+
+    def test_async_save_and_preallocate(self, tmp_ckpt_dir):
+        """Non-blocking snapshot: save_to_memory(blocking=False) returns
+        immediately; the drain thread completes the shm write."""
+        ckpt = Checkpointer(tmp_ckpt_dir, process_rank=0,
+                            process_count=1, node_rank=0, name="d6")
+        state = make_state(step=30, scale=3.0)
+        engine = ckpt._engine
+        assert engine.preallocate_like(state) > 0
+        assert engine.save_to_memory(30, state, blocking=False)
+        assert engine.wait_for_snapshot(timeout=30)
+        step, restored = ckpt.load_checkpoint(target=state)
+        assert step == 30
+        assert_state_equal(state, restored)
+        # async storage save: persist event trails the drain
+        state2 = make_state(step=31, scale=4.0)
+        assert engine.save_to_storage(31, state2, blocking=False)
+        assert engine.wait_for_snapshot(timeout=30)
+        assert ckpt.wait_latest_checkpoint(31, timeout=30)
+        ckpt.close()
+
     def test_multiple_steps_tracker(self, tmp_ckpt_dir):
         ckpt = Checkpointer(tmp_ckpt_dir, process_rank=0,
                             process_count=1, node_rank=0, name="d3")

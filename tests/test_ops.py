@@ -46,6 +46,24 @@ class TestFlashAttention:
             np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4
         )
 
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("s", [96, 100])
+    def test_unaligned_seq_len(self, causal, s):
+        """seq len not a multiple of block_k: padded K columns must not
+        leak into the softmax denominator (round-1 advisor finding)."""
+        b, h, d = 2, 2, 32
+        key = jax.random.PRNGKey(3)
+        kq, kk, kv = jax.random.split(key, 3)
+        q = jax.random.normal(kq, (b, s, h, d), dtype=jnp.float32)
+        k = jax.random.normal(kk, (b, s, h, d), dtype=jnp.float32)
+        v = jax.random.normal(kv, (b, s, h, d), dtype=jnp.float32)
+        out = flash_attention(q, k, v, causal=causal, block_q=64,
+                              block_k=64)
+        ref = dot_product_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4
+        )
+
     def test_gqa_broadcast(self):
         b, s, h, kv_h, d = 1, 64, 4, 2, 16
         key = jax.random.PRNGKey(1)
