@@ -1,0 +1,121 @@
+"""Strategy-driven kernel selection — the module-replace analog.
+
+Reference parity: ``atorch/atorch/auto/opt_lib/
+module_replace_optimization.py:179`` (swaps a model's attention modules
+for flash-attention implementations as an optimization pass).  On TPU
+there are no modules to rewrite: the model's ``forward`` takes a
+pluggable ``attention_fn``, and this pass picks the kernel that matches
+the active strategy:
+
+- sequence axis > 1  -> ring attention (``lax.ppermute`` KV rotation)
+  under ``shard_map``, seq-sharded end to end;
+- TPU backend        -> the Pallas flash-attention kernel;
+- otherwise          -> the dense reference kernel (XLA fuses it well
+  enough on CPU CI, and Pallas interpret mode would be slower).
+
+``dlrover_tpu.models.llama.forward`` resolves its default attention
+through :func:`select_attention` at trace time, so a train step built
+by ``auto_accelerate`` automatically runs the right kernel with no user
+plumbing (the same invisibility the reference achieves with module
+surgery).
+"""
+
+import os
+from functools import partial
+from typing import Optional
+
+import jax
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.parallel.mesh import AxisName, MeshContext
+from dlrover_tpu.parallel.sharding import (
+    BATCH,
+    HEADS,
+    KV_HEADS,
+    SEQ,
+    LogicalAxisRules,
+    filter_spec_for_mesh,
+)
+
+# test/override hook: "auto" | "1" (force flash) | "0" (force dense)
+FLASH_ENV = "DLROVER_TPU_FLASH_ATTENTION"
+
+
+def _flash_enabled(flash: Optional[bool]) -> bool:
+    if flash is not None:
+        return flash
+    env = os.getenv(FLASH_ENV, "auto").lower()
+    if env in ("1", "true", "on"):
+        return True
+    if env in ("0", "false", "off"):
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def select_attention(
+    mesh_ctx: Optional[MeshContext],
+    rules: Optional[LogicalAxisRules],
+    flash: Optional[bool] = None,
+):
+    """Return the attention kernel for the current strategy.
+
+    The returned callable has the model kernel signature
+    ``(q[B,S,H,D], k[B,S,KV,D], v, causal=True) -> [B,S,H,D]``.
+    """
+    import importlib
+
+    # the package re-exports the function under the same name as the
+    # module, so attribute-style imports resolve to the function
+    _fa = importlib.import_module("dlrover_tpu.ops.flash_attention")
+    _llama = importlib.import_module("dlrover_tpu.models.llama")
+
+    use_flash = _flash_enabled(flash)
+    inner = (
+        _fa.flash_attention if use_flash
+        else _llama.dot_product_attention
+    )
+
+    seq_size = (
+        mesh_ctx.axis_size(AxisName.SEQUENCE) if mesh_ctx else 1
+    )
+    if seq_size <= 1 or rules is None:
+        return inner
+    return _ring_under_shard_map(mesh_ctx, rules)
+
+
+def _ring_under_shard_map(mesh_ctx: MeshContext,
+                          rules: LogicalAxisRules):
+    """Ring attention over the sequence mesh axis, wrapped in shard_map
+    with specs matching the activation rule table (so it composes with
+    the surrounding GSPMD program)."""
+    from jax import shard_map
+
+    from dlrover_tpu.parallel.collectives import ring_attention
+
+    mesh = mesh_ctx.mesh
+    q_spec = filter_spec_for_mesh(
+        rules.spec((BATCH, SEQ, HEADS, None)), mesh
+    )
+    kv_spec = filter_spec_for_mesh(
+        rules.spec((BATCH, SEQ, KV_HEADS, None)), mesh
+    )
+    logger.info(
+        "module_replace: ring attention over %d-way seq axis "
+        "(q spec %s)", mesh_ctx.axis_size(AxisName.SEQUENCE), q_spec,
+    )
+
+    def attention(q, k, v, causal: bool = True):
+        ring = shard_map(
+            partial(
+                ring_attention,
+                axis_name=AxisName.SEQUENCE,
+                causal=causal,
+            ),
+            mesh=mesh,
+            in_specs=(q_spec, kv_spec, kv_spec),
+            out_specs=q_spec,
+            check_vma=False,
+        )
+        return ring(q, k, v)
+
+    return attention

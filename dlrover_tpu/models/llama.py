@@ -261,6 +261,17 @@ def _current_rules():
     return rules
 
 
+def _default_attention() -> AttentionFn:
+    """Strategy-selected kernel (the module-replace pass, resolved at
+    trace time): ring attention under seq>1 meshes, Pallas flash
+    attention on TPU, dense reference otherwise.  See
+    ``dlrover_tpu.accelerate.module_replace``."""
+    from dlrover_tpu.accelerate.module_replace import select_attention
+    from dlrover_tpu.parallel.mesh import get_mesh_context
+
+    return select_attention(get_mesh_context(), _current_rules())
+
+
 def forward(
     params: Dict,
     tokens: jnp.ndarray,
@@ -268,7 +279,8 @@ def forward(
     attention_fn: Optional[AttentionFn] = None,
 ) -> jnp.ndarray:
     """tokens [B, S] int32 -> logits [B, S, vocab] (fp32)."""
-    attention_fn = attention_fn or dot_product_attention
+    if attention_fn is None:
+        attention_fn = _default_attention()
     dt = cfg.dtype
     b, s = tokens.shape
     # Gather over an fsdp-sharded embed dim would force the partitioner

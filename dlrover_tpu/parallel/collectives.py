@@ -150,8 +150,10 @@ def ring_attention(
     running max/sum statistics so the softmax is exact (flash-attention
     style log-sum-exp accumulation).
 
-    Shapes (inside shard_map): q,k,v ``[B, S/p, H, D]``; returns the
-    context for the local Q chunk ``[B, S/p, H, D]``.
+    Shapes (inside shard_map): q ``[B, S/p, H, D]``, k/v
+    ``[B, S/p, KV, D]`` with KV dividing H (GQA: each KV head serves
+    ``H/KV`` query heads); returns the context for the local Q chunk
+    ``[B, S/p, H, D]``.
 
     ``causal`` masking uses the ring step to decide whole-block
     visibility: block j attends block i only when i <= j (diagonal
@@ -164,9 +166,9 @@ def ring_attention(
     q = q * scale
 
     b, s, h, d = q.shape
-
-    def qk(qc, kc):
-        return jnp.einsum("bqhd,bkhd->bhqk", qc, kc)
+    kv_heads = k.shape[2]
+    g = h // kv_heads
+    qg = q.reshape(b, s, kv_heads, g, d)
 
     neg_inf = jnp.finfo(jnp.float32).max * -1.0
 
@@ -175,17 +177,20 @@ def ring_attention(
         # after `step` rotations (shift=+1) the chunk we hold
         # originated `step` positions behind us on the ring
         src_idx = (my_idx - step) % n
-        logits = qk(q, kc).astype(jnp.float32)  # [b,h,q,k]
+        logits = jnp.einsum(
+            "bqkgd,bxkd->bkgqx", qg, kc,
+            preferred_element_type=jnp.float32,
+        ).astype(jnp.float32)  # [b,kv,g,q,x]
         if causal:
             q_pos = my_idx * s + jnp.arange(s)
             k_pos = src_idx * s + jnp.arange(s)
             mask = q_pos[:, None] >= k_pos[None, :]
-            logits = jnp.where(mask[None, None], logits, neg_inf)
+            logits = jnp.where(mask[None, None, None], logits, neg_inf)
         new_m = jnp.maximum(m, jnp.max(logits, axis=-1, keepdims=True))
         correction = jnp.exp(m - new_m)
         p = jnp.exp(logits - new_m)
-        acc = acc * correction.swapaxes(1, 2) + jnp.einsum(
-            "bhqk,bkhd->bqhd", p, vc.astype(jnp.float32)
+        acc = acc * correction + jnp.einsum(
+            "bkgqx,bxkd->bkgqd", p, vc.astype(jnp.float32)
         )
         denom = denom * correction + jnp.sum(p, axis=-1, keepdims=True)
         # rotate KV to the next ring position
@@ -194,16 +199,17 @@ def ring_attention(
         return (kc, vc, acc, new_m, denom), None
 
     acc0 = device_varying(
-        jnp.zeros((b, s, h, d), dtype=jnp.float32), axis_name
+        jnp.zeros((b, kv_heads, g, s, d), dtype=jnp.float32), axis_name
     )
     m0 = device_varying(
-        jnp.full((b, h, s, 1), neg_inf, dtype=jnp.float32), axis_name
+        jnp.full((b, kv_heads, g, s, 1), neg_inf, dtype=jnp.float32),
+        axis_name,
     )
     den0 = device_varying(
-        jnp.zeros((b, h, s, 1), dtype=jnp.float32), axis_name
+        jnp.zeros((b, kv_heads, g, s, 1), dtype=jnp.float32), axis_name
     )
     (kc, vc, acc, m, denom), _ = lax.scan(
         block, (k, v, acc0, m0, den0), jnp.arange(n)
     )
-    out = acc / denom.swapaxes(1, 2)
+    out = (acc / denom).transpose(0, 3, 1, 2, 4).reshape(b, s, h, d)
     return out.astype(q.dtype)

@@ -283,15 +283,20 @@ class CheckpointEngine:
         if agreed < 0:
             return -1, None
         zero_copy = False
+        step, arrays = -1, {}
         if shm_step == agreed:
             # zero-copy: views onto shm, batched device_put in
             # restore_to_target (blocks before returning, so the next
             # snapshot can't clobber the views mid-transfer)
             zero_copy = target is not None
             step, arrays = self._shm_handler.load_state(copy=not zero_copy)
-        elif storage_step == agreed:
+        if step != agreed and storage_step == agreed:
+            # shm miss (or invalidated between get_step and load_state):
+            # storage holds the agreed step too
+            zero_copy = False
             step, arrays = self._read_storage_shard(latest_dir)
-        else:
+        if step != agreed:
+            zero_copy = False
             step, arrays = self._load_storage_step(agreed, checkpoint_dir)
         if step != agreed or not arrays:
             # peers WILL resume from `agreed`; silently starting fresh
@@ -314,22 +319,25 @@ class CheckpointEngine:
         of each rank's best locally-available step)."""
         if self._step_sync_fn is not None:
             return self._step_sync_fn(local_best)
+        import jax
+
+        if jax.process_count() <= 1:
+            return local_best
         try:
-            import jax
+            import jax.numpy as jnp
+            from jax.experimental import multihost_utils
 
-            if jax.process_count() > 1:
-                import jax.numpy as jnp
-                from jax.experimental import multihost_utils
-
-                steps = multihost_utils.process_allgather(
-                    jnp.int32(local_best)
-                )
-                return int(steps.min())
-        except Exception as exc:  # noqa: BLE001
-            logger.warning(
-                "restore-step sync failed (%s); using local step", exc
+            steps = multihost_utils.process_allgather(
+                jnp.int32(local_best)
             )
-        return local_best
+            return int(steps.min())
+        except Exception as exc:
+            # a one-sided fallback to the local step would recreate the
+            # mixed-step divergence this sync exists to prevent (and
+            # peers may be blocked inside the collective) — fail loudly
+            raise RuntimeError(
+                f"rank {self._rank}: restore-step consensus failed"
+            ) from exc
 
     def _latest_storage_step(self, checkpoint_dir: Optional[str] = None):
         root = checkpoint_dir or self.checkpoint_dir
@@ -380,7 +388,18 @@ class CheckpointEngine:
 
     def close(self):
         self.wait_for_snapshot(timeout=300)
-        self._shm_handler.close()
+        t = self._snapshot_thread
+        if t is not None and t.is_alive():
+            # the drain thread still holds live views over the shm
+            # buffer — closing it now would raise BufferError (or let
+            # the drain write into an unlinked segment); leak the
+            # handle instead and let process exit reclaim it
+            logger.error(
+                "rank %s: snapshot drain still running after 300s; "
+                "leaving shm handle open", self._rank,
+            )
+        else:
+            self._shm_handler.close()
         self._lock.close()
         self._event_queue.close()
         if self._local_saver is not None:

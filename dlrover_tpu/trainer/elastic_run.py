@@ -138,7 +138,10 @@ def _launch_local_master(node_num: int) -> Tuple[subprocess.Popen, str]:
             continue
         chunk = os.read(fd, 4096)
         if not chunk:
-            continue
+            # EOF: stdout closed without the address line; select would
+            # report the fd readable forever — fall back to the
+            # precomputed address instead of hot-spinning
+            break
         buf += chunk
         m = pattern.search(buf)
         if m:

@@ -154,8 +154,11 @@ class Trainer:
         else:
             self._engine.save_to_memory(step, snap, blocking=False)
 
-    def _consume_metrics(self, step: int, metrics, batch, dt: float):
-        loss = float(metrics["loss"])
+    def _consume_metrics(self, step: int, metrics, batch) -> float:
+        loss = float(metrics["loss"])  # syncs on step completion
+        now = time.perf_counter()
+        dt = now - self._last_done
+        self._last_done = now
         if self._spikes is not None:
             self._spikes.observe(step, loss, batch)
         if self._registry is not None:
@@ -166,6 +169,7 @@ class Trainer:
             logger.info(
                 "step %d loss %.4f (%.3fs/step)", step, loss, dt
             )
+        return dt
 
     # ------------------------------------------------------------- train
     def train(self):
@@ -182,32 +186,36 @@ class Trainer:
             # result every step and serialize the async dispatch
             # pipeline (round-1 advisor finding); by the time step N+1
             # is dispatched, step N's metrics are already materialized.
-            pending = None  # (step, metrics, batch, dt)
+            # Step time is measured completion-to-completion inside
+            # _consume_metrics (float(loss) syncs on the device result)
+            # — dispatch latency alone would be ~ms regardless of the
+            # real step duration.
+            pending = None  # (step, metrics, batch)
+            self._last_done = time.perf_counter()
             while step < self._args.max_steps:
                 for batch in self._data_iter_fn():
                     if step >= self._args.max_steps:
                         break
-                    t0 = time.perf_counter()
                     device_batch = jax.device_put(
                         batch, batch_sharding
                     )
                     self.state, metrics = self._fns.train_step(
                         self.state, device_batch
                     )
-                    dt = time.perf_counter() - t0
                     step += 1
-                    step_times.append(dt)
                     self.progress.step_done()
                     self._hang.report_step(step)
                     if pending is not None:
-                        self._consume_metrics(*pending)
-                    pending = (step, metrics, batch, dt)
+                        step_times.append(
+                            self._consume_metrics(*pending)
+                        )
+                    pending = (step, metrics, batch)
                     self._maybe_checkpoint(step)
                 else:
                     continue
                 break
             if pending is not None:
-                self._consume_metrics(*pending)
+                step_times.append(self._consume_metrics(*pending))
         finally:
             self._hang.stop()
             if self._exporter is not None:
