@@ -161,6 +161,50 @@ def logical_sharding(mesh, rules: LogicalAxisRules, logical_axes):
     )
 
 
+def param_sharding_with_fsdp(
+    mesh,
+    rules: LogicalAxisRules,
+    logical_axes,
+    shape,
+    fsdp_axis: str = AxisName.FSDP,
+):
+    """Parameter sharding with shape-aware ZeRO-3 placement.
+
+    The rule table maps logical axes to mesh axes; on top of that, the
+    fsdp axis is placed on the param's LARGEST still-unsharded,
+    divisible dim (reference ``zero_optimization.py:240`` FSDP shards
+    the flattened param; the GSPMD dual is choosing the dim so every
+    parameter — not only those carrying a designated logical axis —
+    shards over fsdp, and the all-gather rides the biggest dim).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    spec = filter_spec_for_mesh(rules.spec(logical_axes), mesh)
+    mesh_axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    fsdp_size = mesh_axes.get(fsdp_axis, 1)
+    if fsdp_size <= 1:
+        return NamedSharding(mesh, spec)
+    used = set()
+    for e in spec:
+        for a in e if isinstance(e, tuple) else (e,):
+            if a is not None:
+                used.add(a)
+    if fsdp_axis in used:
+        return NamedSharding(mesh, spec)
+    # candidate dims: unsharded, divisible by the fsdp size; biggest wins
+    candidates = [
+        (dim_size, i)
+        for i, (dim_size, e) in enumerate(zip(shape, spec))
+        if e is None and dim_size % fsdp_size == 0 and dim_size > 1
+    ]
+    if not candidates:
+        return NamedSharding(mesh, spec)
+    _, dim = max(candidates)
+    entries = list(spec)
+    entries[dim] = fsdp_axis
+    return NamedSharding(mesh, PartitionSpec(*entries))
+
+
 def shard_pytree(pytree, axes_pytree, mesh, rules: LogicalAxisRules):
     """Produce a NamedSharding pytree from a logical-axes pytree with
     the same structure (the model exports the latter)."""
