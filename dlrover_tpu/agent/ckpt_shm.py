@@ -37,10 +37,13 @@ SHM_PREFIX = "dlrover_tpu_ckpt"
 _HDR = struct.Struct("<Q")
 
 
-def _flatten_with_paths(tree) -> List[Tuple[str, np.ndarray]]:
-    """Flatten a pytree to (keypath, host ndarray) pairs in a
-    deterministic order.  All device->host transfers are launched
-    async up front so they pipeline instead of serializing."""
+def _flatten_keyed(tree) -> List[Tuple[str, object]]:
+    """Flatten a pytree to (keypath, leaf) pairs in a deterministic
+    order, launching every device->host transfer async up front so the
+    copies pipeline instead of serializing.  Leaves stay un-materialized
+    (device arrays) — the caller drains each one straight into its final
+    destination, so at most ONE leaf-sized host buffer is live at a time
+    instead of a full extra copy of the state."""
     import jax
 
     flat, _ = jax.tree_util.tree_flatten_with_path(tree)
@@ -50,11 +53,7 @@ def _flatten_with_paths(tree) -> List[Tuple[str, np.ndarray]]:
                 leaf.copy_to_host_async()
             except Exception:  # noqa: BLE001 - deleted/donated buffer
                 pass
-    out = []
-    for path, leaf in flat:
-        key = jax.tree_util.keystr(path)
-        out.append((key, np.asarray(jax.device_get(leaf))))
-    return out
+    return [(jax.tree_util.keystr(path), leaf) for path, leaf in flat]
 
 
 def restore_to_target(target, arrays: Dict[str, np.ndarray],
@@ -123,15 +122,21 @@ class SharedMemoryHandler:
 
     # -- writer (training process) ----------------------------------------
     def save_state(self, step: int, tree) -> int:
-        """Snapshot a pytree into shm; returns total bytes written."""
-        pairs = _flatten_with_paths(tree)
+        """Snapshot a pytree into shm; returns total bytes written.
+
+        Single-pass drain: specs are computed from leaf metadata (no
+        transfer), then each leaf is materialized and copied into its
+        shm slot one at a time — peak extra host memory is one leaf,
+        not a full second copy of the state."""
+        pairs = _flatten_keyed(tree)
         specs = []
         offset = 0
-        for key, arr in pairs:
-            nbytes = arr.nbytes
-            specs.append(
-                (key, str(arr.dtype), tuple(arr.shape), offset, nbytes)
-            )
+        for key, leaf in pairs:
+            dtype = np.dtype(getattr(leaf, "dtype", None) or
+                             np.asarray(leaf).dtype)
+            shape = tuple(getattr(leaf, "shape", np.asarray(leaf).shape))
+            nbytes = int(dtype.itemsize * int(np.prod(shape or (1,))))
+            specs.append((key, str(dtype), shape, offset, nbytes))
             offset += nbytes
         total = offset
         self._ensure_shm(total)
@@ -139,13 +144,13 @@ class SharedMemoryHandler:
         # not present a half-old/half-new snapshot as restorable
         self.meta.set("valid", False)
         buf = self._shm.buf
-        for (key, arr), (_, _, _, off, nbytes) in zip(pairs, specs):
-            # single memcpy into shm: an ndarray view of the shm buffer
-            # avoids tobytes() materializing a second host copy of every
-            # leaf inside the snapshot window
-            dst = np.ndarray(arr.shape, dtype=arr.dtype, buffer=buf,
+        for (key, leaf), (_, dts, shape, off, nbytes) in zip(pairs, specs):
+            # one memcpy into shm per leaf; np.asarray reuses the host
+            # buffer the async copy already landed in, and it is dropped
+            # before the next leaf materializes
+            dst = np.ndarray(shape, dtype=np.dtype(dts), buffer=buf,
                              offset=off)
-            np.copyto(dst, arr)
+            np.copyto(dst, np.asarray(leaf))
         self.meta.update(
             {
                 "step": step,
@@ -175,6 +180,11 @@ class SharedMemoryHandler:
             )
             return
         start = _time.time()
+        # the segment is about to be (re)created and zero-filled: stale
+        # meta saying valid=True over a fresh all-zero buffer would let
+        # a restore present zeros as a real step-N checkpoint (also
+        # covers a crash mid-zeroing)
+        self.meta.set("valid", False)
         self._ensure_shm(nbytes)
         view = np.ndarray((self._shm.size,), dtype=np.uint8,
                           buffer=self._shm.buf)
@@ -239,12 +249,21 @@ class SharedMemoryHandler:
             return -1, {}
         arrays = {}
         buf = self._shm.buf
+        if copy:
+            # ONE bulk memcpy of the used region into a private buffer,
+            # then slice views onto it — orders of magnitude faster than
+            # a per-leaf view.copy() walk over the shm mapping, and the
+            # result is standalone (shm may be overwritten afterwards)
+            total = meta.get("total_bytes", 0)
+            private = np.empty(total, dtype=np.uint8)
+            np.copyto(private,
+                      np.ndarray((total,), dtype=np.uint8, buffer=buf))
+            buf = private.data
         for key, dtype, shape, off, nbytes in meta["specs"]:
-            view = np.ndarray(
+            arrays[key] = np.ndarray(
                 tuple(shape), dtype=np.dtype(dtype), buffer=buf,
                 offset=off,
             )
-            arrays[key] = view.copy() if copy else view
         return meta.get("step", -1), arrays
 
     def dump_to_file(self, path: str, storage) -> bool:

@@ -90,6 +90,27 @@ class MetricsRegistry:
             logger.warning("metrics flush failed: %s", e)
 
 
+_default_registry: Optional[MetricsRegistry] = None
+_default_registry_lock = threading.Lock()
+
+
+def get_registry() -> MetricsRegistry:
+    """Process-wide default registry (the Trainer installs its own as
+    the default when it starts, so library counters land in the same
+    exporter file)."""
+    global _default_registry
+    with _default_registry_lock:
+        if _default_registry is None:
+            _default_registry = MetricsRegistry()
+        return _default_registry
+
+
+def set_default_registry(registry: MetricsRegistry):
+    global _default_registry
+    with _default_registry_lock:
+        _default_registry = registry
+
+
 class MetricsExporter:
     """Builds (once) and supervises the native exporter daemon."""
 

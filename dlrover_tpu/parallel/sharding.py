@@ -77,6 +77,20 @@ class LogicalAxisRules:
     def extend(self, extra: Sequence[Tuple[str, Optional[object]]]):
         return LogicalAxisRules(list(extra) + self._rules)
 
+    def uses_axis(self, mesh_axis: str,
+                  exclude: Sequence[str] = (BATCH,)) -> bool:
+        """True when some rule (outside ``exclude``) targets
+        ``mesh_axis`` — i.e. the strategy actively shards params over
+        it (BATCH is excluded by default: it always carries the data
+        axes for activations regardless of the param strategy)."""
+        for name, axes in self._rules:
+            if name in exclude:
+                continue
+            flat = axes if isinstance(axes, tuple) else (axes,)
+            if mesh_axis in flat:
+                return True
+        return False
+
 
 def default_rules(
     fsdp: bool = True,

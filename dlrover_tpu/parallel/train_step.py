@@ -17,11 +17,12 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from dlrover_tpu.parallel.mesh import MeshContext
+from dlrover_tpu.parallel.mesh import AxisName, MeshContext
 from dlrover_tpu.parallel.sharding import (
     BATCH,
     LogicalAxisRules,
     logical_sharding,
+    param_sharding_with_fsdp,
     rules_scope,
     shard_pytree,
 )
@@ -60,11 +61,29 @@ def build_train_step(
     # (apply_sharding_constraint via _current_rules) match param shardings
     mesh_ctx.rules = rules
 
-    param_shardings = jax.tree_util.tree_map(
-        lambda axes: logical_sharding(mesh, rules, axes),
-        param_axes,
-        is_leaf=lambda x: isinstance(x, (tuple, type(None))),
-    )
+    _is_axes_leaf = lambda x: isinstance(x, (tuple, type(None)))  # noqa: E731
+    if rules.uses_axis(AxisName.FSDP):
+        # ZeRO-3 strategy: params whose logical axes don't map onto the
+        # fsdp axis still shard over it on their largest divisible dim
+        # (shape-aware placement — every param shards, the all-gather
+        # rides the biggest dim)
+        params_shape = jax.eval_shape(
+            init_params_fn, jax.ShapeDtypeStruct((2,), jnp.uint32)
+        )
+        param_shardings = jax.tree_util.tree_map(
+            lambda axes, leaf: param_sharding_with_fsdp(
+                mesh, rules, axes, leaf.shape
+            ),
+            param_axes,
+            params_shape,
+            is_leaf=_is_axes_leaf,
+        )
+    else:
+        param_shardings = jax.tree_util.tree_map(
+            lambda axes: logical_sharding(mesh, rules, axes),
+            param_axes,
+            is_leaf=_is_axes_leaf,
+        )
     batch_sharding = logical_sharding(mesh, rules, batch_logical_axes)
     replicated = logical_sharding(mesh, rules, ())
 

@@ -89,6 +89,11 @@ class CheckpointEngine:
         self._step_sync_fn = step_sync_fn
         self._snapshot_thread = None
         self._last_drain_ok = True
+        # saves dropped because the previous drain was still running or
+        # the saver held the lock — the effective RPO degrades with each
+        # skip, so it must be observable (exported as
+        # dlrover_tpu_ckpt_skipped_snapshots)
+        self.skipped_snapshots = 0
 
         # the saver serves shm/lock endpoints for global ranks
         # [node_rank*local_shard_num, ...); this process's rank must be
@@ -172,13 +177,26 @@ class CheckpointEngine:
     def _snapshot_slot_free(self, step: int) -> bool:
         if self._snapshot_thread is not None:
             if self._snapshot_thread.is_alive():
+                self._count_skip()
                 logger.warning(
-                    "rank %s: snapshot still draining; skip step %s",
-                    self._rank, step,
+                    "rank %s: snapshot still draining; skip step %s "
+                    "(%s skipped so far)",
+                    self._rank, step, self.skipped_snapshots,
                 )
                 return False
             self._snapshot_thread = None
         return True
+
+    def _count_skip(self):
+        self.skipped_snapshots += 1
+        try:
+            from dlrover_tpu.observability.metrics import get_registry
+
+            get_registry().inc_counter(
+                "dlrover_tpu_ckpt_skipped_snapshots"
+            )
+        except Exception:  # noqa: BLE001 - metrics must never break saves
+            pass
 
     def _launch_async_snapshot(self, step: int, state,
                                persist_dir: Optional[str]) -> bool:
@@ -205,6 +223,7 @@ class CheckpointEngine:
         start = time.time()
         self._last_drain_ok = False
         if not self._lock.acquire(timeout=60):
+            self._count_skip()
             logger.warning(
                 "rank %s: saver still busy; skip memory save of step %s",
                 self._rank, step,
@@ -391,17 +410,18 @@ class CheckpointEngine:
         t = self._snapshot_thread
         if t is not None and t.is_alive():
             # the drain thread still holds live views over the shm
-            # buffer — closing it now would raise BufferError (or let
-            # the drain write into an unlinked segment); leak the
-            # handle instead and let process exit reclaim it
+            # buffer and will touch the lock and event queue when it
+            # finishes — closing ANY of them now would make the drain
+            # fail on a closed handle (persist event lost) or raise
+            # BufferError; leak all three and let process exit reclaim
             logger.error(
                 "rank %s: snapshot drain still running after 300s; "
-                "leaving shm handle open", self._rank,
+                "leaving shm/lock/queue handles open", self._rank,
             )
         else:
             self._shm_handler.close()
-        self._lock.close()
-        self._event_queue.close()
+            self._lock.close()
+            self._event_queue.close()
         if self._local_saver is not None:
             self._local_saver.close(unlink=True)
             AsyncCheckpointSaver._instance = None

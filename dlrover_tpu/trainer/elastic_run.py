@@ -119,7 +119,10 @@ def _launch_local_master(node_num: int) -> Tuple[subprocess.Popen, str]:
     )
     addr = f"127.0.0.1:{port}"
     deadline = time.time() + 30
-    pattern = re.compile(rb"DLROVER_TPU_MASTER_ADDR=(\S+)")
+    # trailing whitespace required: a 4096-byte read chunk can split
+    # the line mid-address and \S+ would happily capture the prefix
+    # (e.g. '127.0' instead of '127.0.0.1:8080')
+    pattern = re.compile(rb"DLROVER_TPU_MASTER_ADDR=(\S+)\s")
     # non-blocking reads on the RAW fd: a live master that never prints
     # the address line must not hang the launcher past the deadline
     # (the pre-computed 127.0.0.1:port stays the fallback).  select on

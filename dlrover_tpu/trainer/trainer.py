@@ -107,9 +107,11 @@ class Trainer:
             from dlrover_tpu.observability.metrics import (
                 MetricsExporter,
                 MetricsRegistry,
+                set_default_registry,
             )
 
             self._registry = MetricsRegistry()
+            set_default_registry(self._registry)
             self._exporter = MetricsExporter(
                 self._registry,
                 rank=self._ctx.rank,

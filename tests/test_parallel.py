@@ -271,6 +271,48 @@ class TestLlama:
         assert np.isfinite(float(metrics2["loss"]))
         assert int(state["step"]) == 2
 
+    def test_shape_aware_fsdp_placement(self):
+        """Under an FSDP strategy, params whose logical axes don't map
+        to the fsdp axis still get sharded over it on their largest
+        divisible dim; non-divisible params replicate
+        (``param_sharding_with_fsdp`` wired through build_train_step)."""
+        ctx = create_parallel_mesh(
+            [(AxisName.DATA, 2), (AxisName.FSDP, 4)]
+        )
+        rules = sh.default_rules(fsdp=True)
+
+        def init_p(rng):
+            return {
+                "w": jnp.ones((8, 16), jnp.float32),
+                "b": jnp.zeros((3,), jnp.float32),
+            }
+
+        def loss(p, batch):
+            return jnp.mean((batch @ p["w"]).sum(-1)) + p["b"].sum()
+
+        fns = build_train_step(
+            loss_fn=loss,
+            optimizer=optax.sgd(1e-2),
+            init_params_fn=init_p,
+            param_axes={"w": (None, None), "b": (None,)},
+            mesh_ctx=ctx,
+            rules=rules,
+        )
+        w_spec = tuple(fns.state_shardings["params"]["w"].spec)
+        b_spec = tuple(fns.state_shardings["params"]["b"].spec)
+        # largest dim (16) carries the fsdp axis
+        assert AxisName.FSDP in w_spec and w_spec.index(
+            AxisName.FSDP
+        ) == 1, w_spec
+        # 3 is not divisible by 4: replicated
+        assert AxisName.FSDP not in b_spec, b_spec
+        state = fns.init_state(jax.random.PRNGKey(0))
+        batch = jax.device_put(
+            np.ones((8, 8), np.float32), fns.batch_sharding
+        )
+        state, m = fns.train_step(state, batch)
+        assert np.isfinite(float(m["loss"]))
+
     def test_dp_equals_fsdp_loss(self, tiny_cfg, tiny_batch):
         """Same math under different layouts: DP and FSDP+TP produce
         the same loss trajectory (race/consistency check the reference
