@@ -28,6 +28,7 @@ be normalized.
 
 import json
 import os
+import subprocess
 import sys
 import tempfile
 import time
@@ -37,7 +38,44 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 BASELINE_BLOCKING_S = 0.5  # reference flash-ckpt save blocking time
 
 
+def _run_train_bench() -> dict:
+    """Run bench_mfu.py in a subprocess (its model must release HBM
+    before the checkpoint bench allocates the 3 GB state) and return its
+    result dict: tokens_per_sec, mfu, hfu, config, chip, ..."""
+    if os.getenv("DLROVER_BENCH_SKIP_MFU"):
+        return {"skipped": True}
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_mfu.py"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, script],
+            capture_output=True,
+            text=True,
+            # bench_mfu worst case: 300s backend probe + 5 candidates
+            # x 900s each — give it headroom, don't kill a legitimate
+            # OOM-fallback chain mid-run
+            timeout=5400,
+        )
+        import bench_mfu
+
+        parsed = bench_mfu._parse_json_line(proc.stdout)
+        if parsed is not None:
+            out = dict(parsed.get("extras", {}))
+            out["vs_mfu_bar_0.40"] = parsed.get("vs_baseline")
+            return out
+        return {
+            "error": f"no JSON output (rc={proc.returncode})",
+            "stderr_tail": proc.stderr[-500:],
+        }
+    except Exception as e:  # noqa: BLE001
+        return {"error": str(e)}
+
+
 def main() -> int:
+    # training throughput first, in its own process (frees HBM on exit)
+    train_bench = _run_train_bench()
+
     import jax
     import jax.numpy as jnp
 
@@ -142,6 +180,7 @@ def main() -> int:
                     "first_save_total_s": round(first_total_s, 2),
                     "backend": jax.default_backend(),
                     "baseline_blocking_s": BASELINE_BLOCKING_S,
+                    "train": train_bench,
                 },
             }
         ),

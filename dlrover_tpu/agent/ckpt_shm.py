@@ -132,9 +132,16 @@ class SharedMemoryHandler:
         specs = []
         offset = 0
         for key, leaf in pairs:
-            dtype = np.dtype(getattr(leaf, "dtype", None) or
-                             np.asarray(leaf).dtype)
-            shape = tuple(getattr(leaf, "shape", np.asarray(leaf).shape))
+            # hasattr guards, NOT getattr defaults: a getattr default
+            # argument is evaluated eagerly, and np.asarray(leaf) on a
+            # jax array blocks on the D2H transfer and pins the host
+            # copy — for every leaf at once
+            if hasattr(leaf, "dtype") and hasattr(leaf, "shape"):
+                dtype = np.dtype(leaf.dtype)
+                shape = tuple(leaf.shape)
+            else:
+                arr = np.asarray(leaf)
+                dtype, shape = arr.dtype, arr.shape
             nbytes = int(dtype.itemsize * int(np.prod(shape or (1,))))
             specs.append((key, str(dtype), shape, offset, nbytes))
             offset += nbytes

@@ -61,21 +61,26 @@ def build_train_step(
     # (apply_sharding_constraint via _current_rules) match param shardings
     mesh_ctx.rules = rules
 
+    def _init_state(rng):
+        params = init_params_fn(rng)
+        return make_train_state(params, optimizer)
+
+    state_shape = jax.eval_shape(
+        _init_state, jax.ShapeDtypeStruct((2,), jnp.uint32)
+    )
+
     _is_axes_leaf = lambda x: isinstance(x, (tuple, type(None)))  # noqa: E731
     if rules.uses_axis(AxisName.FSDP):
         # ZeRO-3 strategy: params whose logical axes don't map onto the
         # fsdp axis still shard over it on their largest divisible dim
         # (shape-aware placement — every param shards, the all-gather
         # rides the biggest dim)
-        params_shape = jax.eval_shape(
-            init_params_fn, jax.ShapeDtypeStruct((2,), jnp.uint32)
-        )
         param_shardings = jax.tree_util.tree_map(
             lambda axes, leaf: param_sharding_with_fsdp(
                 mesh, rules, axes, leaf.shape
             ),
             param_axes,
-            params_shape,
+            state_shape["params"],
             is_leaf=_is_axes_leaf,
         )
     else:
@@ -111,13 +116,6 @@ def build_train_step(
             pick, opt_shape, is_leaf=is_params_like
         )
 
-    def _init_state(rng):
-        params = init_params_fn(rng)
-        return make_train_state(params, optimizer)
-
-    state_shape = jax.eval_shape(
-        _init_state, jax.ShapeDtypeStruct((2,), jnp.uint32)
-    )
     state_shardings = {
         "step": replicated,
         "params": param_shardings,

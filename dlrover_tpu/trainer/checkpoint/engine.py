@@ -418,10 +418,11 @@ class CheckpointEngine:
                 "rank %s: snapshot drain still running after 300s; "
                 "leaving shm/lock/queue handles open", self._rank,
             )
-        else:
-            self._shm_handler.close()
-            self._lock.close()
-            self._event_queue.close()
+            return  # saver side must stay up too: drain uses its
+            # locks/queue service and the shm segments it would unlink
+        self._shm_handler.close()
+        self._lock.close()
+        self._event_queue.close()
         if self._local_saver is not None:
             self._local_saver.close(unlink=True)
             AsyncCheckpointSaver._instance = None

@@ -101,6 +101,67 @@ class TestFlashAttention:
                 np.asarray(gf), np.asarray(gd), rtol=1e-3, atol=1e-3
             )
 
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("s", [64, 96, 100])
+    def test_gradients_padded_seq(self, causal, s):
+        """FA2 bwd kernels at seq lens that pad the last q AND k blocks:
+        uninitialized lse/delta rows must not leak into dk/dv."""
+        b, h, d = 2, 2, 32
+        key = jax.random.PRNGKey(7)
+        q = jax.random.normal(key, (b, s, h, d))
+        k = jax.random.normal(jax.random.fold_in(key, 1), (b, s, h, d))
+        v = jax.random.normal(jax.random.fold_in(key, 2), (b, s, h, d))
+
+        def f(attn):
+            def loss(q, k, v):
+                out = attn(q, k, v, causal=causal)
+                return jnp.sum(out * jnp.cos(out))
+
+            return loss
+
+        g_flash = jax.grad(
+            f(lambda q, k, v, causal: flash_attention(
+                q, k, v, causal=causal, block_q=64, block_k=64
+            )),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        g_dense = jax.grad(
+            f(dot_product_attention), argnums=(0, 1, 2)
+        )(q, k, v)
+        for gf, gd in zip(g_flash, g_dense):
+            # 3e-3: exp(s - lse) recompute rounds differently than the
+            # dense row softmax; pure fp32 numeric noise, no NaN path
+            np.testing.assert_allclose(
+                np.asarray(gf), np.asarray(gd), rtol=3e-3, atol=3e-3
+            )
+
+    def test_gradients_gqa(self):
+        """dk/dv must fold per-q-head grads back onto shared kv heads."""
+        b, s, h, kv_h, d = 1, 64, 4, 2, 16
+        key = jax.random.PRNGKey(9)
+        q = jax.random.normal(key, (b, s, h, d))
+        k = jax.random.normal(jax.random.fold_in(key, 1), (b, s, kv_h, d))
+        v = jax.random.normal(jax.random.fold_in(key, 2), (b, s, kv_h, d))
+
+        def loss(attn):
+            return lambda q, k, v: jnp.sum(
+                attn(q, k, v, causal=True) ** 2
+            )
+
+        g_flash = jax.grad(
+            loss(lambda q, k, v, causal: flash_attention(
+                q, k, v, causal=causal, block_q=32, block_k=32
+            )),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        g_dense = jax.grad(
+            loss(dot_product_attention), argnums=(0, 1, 2)
+        )(q, k, v)
+        for gf, gd in zip(g_flash, g_dense):
+            np.testing.assert_allclose(
+                np.asarray(gf), np.asarray(gd), rtol=1e-3, atol=1e-3
+            )
+
 
 class TestMoE:
     def test_forward_shape_and_aux(self):
