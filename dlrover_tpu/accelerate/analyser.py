@@ -24,6 +24,9 @@ class ModelProfile:
     optimizer_bytes: int = 0
     # rough activation bytes per sample at bf16 (caller-supplied)
     activation_bytes_per_sample: int = 0
+    # leading dim of the stacked "layers" subtree (0 = no stack):
+    # pipeline candidates must divide it evenly into stages
+    num_layers: int = 0
     extra: Dict = field(default_factory=dict)
 
     def train_state_bytes(self) -> int:
@@ -55,12 +58,18 @@ def analyse_model(
             int(np.prod(leaf.shape)) * leaf.dtype.itemsize
             for leaf in jax.tree_util.tree_leaves(opt_shapes)
         )
+    num_layers = 0
+    if isinstance(shapes, dict) and "layers" in shapes:
+        layer_leaves = jax.tree_util.tree_leaves(shapes["layers"])
+        if layer_leaves and layer_leaves[0].shape:
+            num_layers = int(layer_leaves[0].shape[0])
     return ModelProfile(
         num_params=num_params,
         param_bytes=param_bytes,
         largest_leaf=largest,
         leaf_count=len(leaves),
         optimizer_bytes=optimizer_bytes,
+        num_layers=num_layers,
     )
 
 
@@ -84,11 +93,13 @@ def fits_in_memory(
     tensor: int,
     batch_per_device: int = 1,
     headroom: float = 0.85,
+    pipe: int = 1,
 ) -> Tuple[bool, float]:
-    """Memory-fit model: params+opt shard over fsdp*tensor; activations
-    scale with the local batch.  Returns (fits, utilization)."""
+    """Memory-fit model: params+opt shard over fsdp*tensor*pipe;
+    activations scale with the local batch.  Returns
+    (fits, utilization)."""
     hbm = device_memory_bytes() * headroom
-    shard = max(fsdp * tensor, 1)
+    shard = max(fsdp * tensor * pipe, 1)
     state = profile.train_state_bytes() / shard
     acts = profile.activation_bytes_per_sample * batch_per_device
     used = state + acts

@@ -44,6 +44,10 @@ def _build_for_strategy(
     mesh_ctx = create_parallel_mesh(
         strategy.mesh_dims(), devices=devices
     )
+    if strategy.pipe > 1:
+        mesh_ctx.pipeline_microbatches = (
+            strategy.pipe_microbatches or 2 * strategy.pipe
+        )
     rules = default_rules(**strategy.rule_flags())
     fns = build_train_step(
         loss_fn=loss_fn,

@@ -39,6 +39,8 @@ from dlrover_tpu.parallel.sharding import (
 
 # test/override hook: "auto" | "1" (force flash) | "0" (force dense)
 FLASH_ENV = "DLROVER_TPU_FLASH_ATTENTION"
+# test/override hook: "auto" | "ring" | "ulysses"
+SP_KERNEL_ENV = "DLROVER_TPU_SP_KERNEL"
 
 
 def _flash_enabled(flash: Optional[bool]) -> bool:
@@ -50,6 +52,23 @@ def _flash_enabled(flash: Optional[bool]) -> bool:
     if env in ("0", "false", "off"):
         return False
     return jax.default_backend() == "tpu"
+
+
+def sp_kernel_choice(
+    seq_size: int, n_heads: int, n_kv_heads: int
+) -> str:
+    """Which sequence-parallel attention form to run: "ulysses" when
+    both head counts divide the seq axis (one all-to-all exchanging
+    seq<->head beats n ring hops on ICI — reference ships both as
+    selectable optimizations, ``sequence_parallel_optimization.py:9``),
+    "ring" otherwise (works for any head count, overlaps compute with
+    the ppermute rotation)."""
+    env = os.getenv(SP_KERNEL_ENV, "auto").lower()
+    if env in ("ring", "ulysses"):
+        return env
+    if n_heads % seq_size == 0 and n_kv_heads % seq_size == 0:
+        return "ulysses"
+    return "ring"
 
 
 def select_attention(
@@ -80,42 +99,184 @@ def select_attention(
     )
     if seq_size <= 1 or rules is None:
         return inner
-    return _ring_under_shard_map(mesh_ctx, rules)
+    return _sp_under_shard_map(mesh_ctx, rules, inner)
 
 
-def _ring_under_shard_map(mesh_ctx: MeshContext,
-                          rules: LogicalAxisRules):
-    """Ring attention over the sequence mesh axis, wrapped in shard_map
-    with specs matching the activation rule table (so it composes with
-    the surrounding GSPMD program)."""
-    from jax import shard_map
+def select_layer_executor(
+    mesh_ctx: Optional[MeshContext],
+    rules: Optional[LogicalAxisRules],
+):
+    """How the model's stacked layer dim is executed: a plain
+    ``lax.scan`` normally; the GPipe shard_map pipeline when the
+    strategy runs pipe > 1 (reference
+    ``pipeline_parallel_optimization.py:56`` — PiPPy graph-split; the
+    TPU-native form is SPMD microbatch ppermute,
+    ``dlrover_tpu.parallel.pipeline``).
 
-    from dlrover_tpu.parallel.collectives import ring_attention
+    Executor signature: ``(block, layers, x, *extras) -> x`` where
+    ``block(layer_params, x, *extras) -> x`` is one layer and
+    ``layers`` is the stacked param pytree (leading dim = layer)."""
+    pipe_size = (
+        mesh_ctx.axis_size(AxisName.PIPELINE) if mesh_ctx else 1
+    )
+    if pipe_size <= 1:
+        return _scan_layers
+    return _pipeline_executor(mesh_ctx)
+
+
+def _scan_layers(block, layers, x, *extras):
+    import jax
+
+    def body(h, lp):
+        return block(lp, h, *extras), None
+
+    h, _ = jax.lax.scan(body, x, layers)
+    return h
+
+
+def _pipeline_executor(mesh_ctx: MeshContext):
+    """GPipe over the "pipe" mesh axis: layers sharded into stages,
+    activations microbatched and rotated stage-to-stage with ppermute.
+    Partial-manual shard_map — only "pipe" is manual, every other mesh
+    axis stays auto so GSPMD keeps inserting the dp/fsdp/tp collectives
+    inside the stage body."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from dlrover_tpu.parallel.pipeline import (
+        merge_microbatches,
+        pipeline_spmd,
+        split_microbatches,
+    )
 
     mesh = mesh_ctx.mesh
+    n_stages = mesh_ctx.axis_size(AxisName.PIPELINE)
+    num_mb = mesh_ctx.pipeline_microbatches or 2 * n_stages
+    logger.info(
+        "module_replace: GPipe executor, %d stages x %d microbatches",
+        n_stages, num_mb,
+    )
+
+    def execute(block, layers, x, *extras):
+        import jax.numpy as jnp
+
+        # f32 at the shard_map boundary: the VJP psums the replicated
+        # input's cotangent over the manual pipe axis, and a bf16 psum
+        # under partial-manual shard_map crashes XLA CPU (same
+        # float-normalization bug as pipeline_spmd's broadcast)
+        x_dtype = x.dtype
+        upcast = x_dtype in (jnp.bfloat16, jnp.float16)
+
+        def run(layers_local, x_local, *extras_local):
+            x_local = x_local.astype(x_dtype)
+
+            def stage_fn(stage_layers, x_mb):
+                def body(h, lp):
+                    return block(lp, h, *extras_local), None
+
+                h, _ = jax.lax.scan(body, x_mb, stage_layers)
+                return h
+
+            mbs = split_microbatches(x_local, num_mb)
+            out = pipeline_spmd(
+                stage_fn, layers_local, mbs,
+                axis_name=AxisName.PIPELINE,
+            )
+            return merge_microbatches(out)
+
+        layer_specs = jax.tree_util.tree_map(
+            lambda _: P(AxisName.PIPELINE), layers
+        )
+        rep = P()
+        extras_specs = tuple(rep for _ in extras)
+        x_in = x.astype(jnp.float32) if upcast else x
+        extras_in = tuple(
+            e.astype(jnp.float32)
+            if e.dtype in (jnp.bfloat16, jnp.float16)
+            else e
+            for e in extras
+        )
+        return jax.shard_map(
+            run,
+            mesh=mesh,
+            in_specs=(layer_specs, rep) + extras_specs,
+            out_specs=rep,
+            axis_names={AxisName.PIPELINE},
+            check_vma=False,
+        )(layers, x_in, *extras_in)
+
+    return execute
+
+
+def _sp_under_shard_map(mesh_ctx: MeshContext,
+                        rules: LogicalAxisRules,
+                        inner_attention):
+    """Sequence-parallel attention over the seq mesh axis, wrapped in
+    shard_map with specs matching the activation rule table (so it
+    composes with the surrounding GSPMD program).
+
+    The SP form is picked per call site from the traced head counts
+    (:func:`sp_kernel_choice`): Ulysses all-to-all when heads divide
+    the axis, ring otherwise.  Ulysses runs ``inner_attention`` (the
+    Pallas flash kernel on TPU) on the gathered sequence; the ring's
+    per-block kernel is flash via ``flash_attention_lse``."""
+    from jax import shard_map
+
+    from dlrover_tpu.parallel.collectives import (
+        ring_attention,
+        ulysses_attention,
+    )
+
+    mesh = mesh_ctx.mesh
+    seq_size = mesh_ctx.axis_size(AxisName.SEQUENCE)
     q_spec = filter_spec_for_mesh(
         rules.spec((BATCH, SEQ, HEADS, None)), mesh
     )
     kv_spec = filter_spec_for_mesh(
         rules.spec((BATCH, SEQ, KV_HEADS, None)), mesh
     )
-    logger.info(
-        "module_replace: ring attention over %d-way seq axis "
-        "(q spec %s)", mesh_ctx.axis_size(AxisName.SEQUENCE), q_spec,
-    )
+
+    # inside the manual region the heads dim is already tensor-sharded
+    # (HEADS/KV_HEADS -> tensor axis): Ulysses' all_to_all must divide
+    # the LOCAL head count, not the global one
+    tp = mesh_ctx.axis_size(AxisName.TENSOR)
+
+    def _tp_split(logical) -> int:
+        target = rules.mesh_axes(logical)
+        flat = target if isinstance(target, tuple) else (target,)
+        return tp if AxisName.TENSOR in flat else 1
+
+    h_split = _tp_split(HEADS)
+    kv_split = _tp_split(KV_HEADS)
 
     def attention(q, k, v, causal: bool = True):
-        ring = shard_map(
-            partial(
+        choice = sp_kernel_choice(
+            seq_size, q.shape[2] // h_split, k.shape[2] // kv_split
+        )
+        logger.info(
+            "module_replace: %s attention over %d-way seq axis "
+            "(q spec %s)", choice, seq_size, q_spec,
+        )
+        if choice == "ulysses":
+            fn = partial(
+                ulysses_attention,
+                axis_name=AxisName.SEQUENCE,
+                inner_attention=inner_attention,
+                causal=causal,
+            )
+        else:
+            fn = partial(
                 ring_attention,
                 axis_name=AxisName.SEQUENCE,
                 causal=causal,
-            ),
+            )
+        sp = shard_map(
+            fn,
             mesh=mesh,
             in_specs=(q_spec, kv_spec, kv_spec),
             out_specs=q_spec,
             check_vma=False,
         )
-        return ring(q, k, v)
+        return sp(q, k, v)
 
     return attention

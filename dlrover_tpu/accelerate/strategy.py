@@ -31,6 +31,8 @@ class Strategy:
     pipe: int = 1
     remat: str = "full"
     num_micro_steps: int = 1
+    # GPipe microbatch count when pipe > 1 (0 -> auto: 2 x pipe)
+    pipe_microbatches: int = 0
     extras: Tuple = ()
 
     @property
@@ -60,6 +62,7 @@ class Strategy:
             "tensor_parallel": self.tensor > 1,
             "sequence_parallel": self.seq > 1,
             "expert_parallel": self.expert > 1,
+            "pipeline": self.pipe > 1,
         }
 
     def describe(self) -> str:
@@ -99,14 +102,21 @@ def generate_candidates(
     first (DP > FSDP > TP in preference — TP pays per-layer
     collectives, FSDP pays per-step gathers, DP only grad reduce)."""
     candidates = []
-    for tensor, fsdp_d in itertools.product(
-        _divisors(n_devices), _divisors(n_devices)
+    for tensor, fsdp_d, pipe in itertools.product(
+        _divisors(n_devices), _divisors(n_devices), (1, 2, 4)
     ):
         if tensor > max_tensor:
             continue
-        if n_devices % (tensor * fsdp_d) != 0:
+        if n_devices % (tensor * fsdp_d * pipe) != 0:
             continue
-        rest = n_devices // (tensor * fsdp_d)
+        if pipe > 1 and (
+            profile.num_layers == 0 or profile.num_layers % pipe != 0
+        ):
+            # stage dim must split a detected layer stack evenly; with
+            # no stack (num_layers=0) the LAYERS->PIPELINE rule shards
+            # nothing, so the pipe memory fold would be fictitious
+            continue
+        rest = n_devices // (tensor * fsdp_d * pipe)
         seq = 1
         expert = 1
         if long_context and rest % 2 == 0 and rest > 1:
@@ -121,6 +131,7 @@ def generate_candidates(
             tensor=tensor,
             seq=seq,
             expert=expert,
+            pipe=pipe,
         )
         fits, util = fits_in_memory(
             profile,
@@ -128,12 +139,15 @@ def generate_candidates(
             fsdp=fsdp_d,
             tensor=tensor,
             batch_per_device=batch_per_replica,
+            pipe=pipe,
         )
         if fits:
             candidates.append((s, util))
-    # rank: prefer less model-parallelism, then lower memory pressure
+    # rank: prefer less model-parallelism (pipe pays the bubble, TP
+    # pays per-layer collectives, FSDP per-step gathers, DP only the
+    # grad reduce), then lower memory pressure
     candidates.sort(
-        key=lambda su: (su[0].tensor, su[0].fsdp, su[1])
+        key=lambda su: (su[0].pipe, su[0].tensor, su[0].fsdp, su[1])
     )
     seen = set()
     unique = []

@@ -306,10 +306,18 @@ def forward(
             policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
         )
 
-    def scan_body(x, lp):
-        return block(lp, x, cos, sin), None
+    # strategy-selected layer executor: lax.scan normally, the GPipe
+    # shard_map pipeline when the mesh runs pipe > 1 (module-replace
+    # pass, resolved at trace time like the attention kernel)
+    from dlrover_tpu.accelerate.module_replace import (
+        select_layer_executor,
+    )
+    from dlrover_tpu.parallel.mesh import get_mesh_context
 
-    x, _ = lax.scan(scan_body, x, params["layers"])
+    execute_layers = select_layer_executor(
+        get_mesh_context(), _current_rules()
+    )
+    x = execute_layers(block, params["layers"], x, cos, sin)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = jnp.einsum(
         "bsd,dv->bsv",

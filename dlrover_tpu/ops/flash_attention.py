@@ -210,10 +210,15 @@ def _flash_fwd(
     )(q, k, v)
 
 
-def _bwd_block_math(q, k, v, do, lse, delta, keep, sm_scale):
+def _bwd_block_math(q, k, v, do, lse, delta, glse, keep, sm_scale):
     """Shared FA2 block algebra (fp32): returns (p, ds) for one
-    [BQ, BK] tile.  ``lse``/``delta`` are [BQ, 1]; ``keep`` is the
-    combined causal/bounds mask or None."""
+    [BQ, BK] tile.  ``lse``/``delta``/``glse`` are [BQ, 1]; ``keep`` is
+    the combined causal/bounds mask or None.
+
+    ``glse`` is the cotangent of the lse output (zero for the plain
+    attention path): ∂lse/∂s_j = p_j, so it folds into ds as
+    ``p∘(dp − Δ + glse)·scale`` — this is what makes the lse-returning
+    variant (ring attention's inner kernel) differentiable."""
     s = (
         jax.lax.dot_general(
             q, k,
@@ -230,7 +235,7 @@ def _bwd_block_math(q, k, v, do, lse, delta, keep, sm_scale):
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )  # [BQ, BK]
-    ds = p * (dp - delta) * sm_scale
+    ds = p * (dp - delta + glse) * sm_scale
     if keep is not None:
         # p=0 alone is not enough: out-of-range rows load garbage
         # lse/delta (possibly NaN), and 0 * NaN = NaN
@@ -263,7 +268,7 @@ def _bwd_masks(qi, kj, block_q, block_k, seq_len, causal):
 
 
 def _flash_bwd_dkv_kernel(
-    q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+    q_ref, do_ref, lse_ref, delta_ref, glse_ref, k_ref, v_ref,
     dk_ref, dv_ref, dk_scr, dv_scr,
     *, sm_scale, causal, block_q, block_k, seq_len,
 ):
@@ -301,7 +306,8 @@ def _flash_bwd_dkv_kernel(
             do = jnp.where(q_valid, do, 0)
         keep = _bwd_masks(qi, kj, block_q, block_k, seq_len, causal)
         p, ds = _bwd_block_math(
-            q, k, v, do, lse_ref[0, 0], delta_ref[0, 0], keep, sm_scale
+            q, k, v, do, lse_ref[0, 0], delta_ref[0, 0],
+            glse_ref[0, 0], keep, sm_scale,
         )
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do.dtype), do,
@@ -321,7 +327,7 @@ def _flash_bwd_dkv_kernel(
 
 
 def _flash_bwd_dq_kernel(
-    q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+    q_ref, do_ref, lse_ref, delta_ref, glse_ref, k_ref, v_ref,
     dq_ref, dq_scr,
     *, sm_scale, causal, block_q, block_k, seq_len,
 ):
@@ -354,7 +360,8 @@ def _flash_bwd_dq_kernel(
             k = jnp.where(k_valid, k, 0)
         keep = _bwd_masks(qi, kj, block_q, block_k, seq_len, causal)
         _, ds = _bwd_block_math(
-            q, k, v, do, lse_ref[0, 0], delta_ref[0, 0], keep, sm_scale
+            q, k, v, do, lse_ref[0, 0], delta_ref[0, 0],
+            glse_ref[0, 0], keep, sm_scale,
         )
         dq_scr[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k,
@@ -370,10 +377,13 @@ def _flash_bwd_dq_kernel(
 @functools.partial(
     jax.jit, static_argnames=("causal", "sm_scale", "block_q", "block_k")
 )
-def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k):
+def _flash_bwd(
+    q, k, v, out, lse, g, g_lse, causal, sm_scale, block_q, block_k
+):
     """FA2 backward: dq via one kernel (grid q-major), dk/dv via another
     (grid k-major); GQA dk/dv materialize per q-head then sum over the
-    head group."""
+    head group.  ``g_lse`` [B,H,S,1] is the lse-output cotangent (zeros
+    for the plain path)."""
     b, h, s, d = q.shape
     kv = k.shape[1]
     group = h // kv
@@ -387,6 +397,7 @@ def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k):
         axis=-1,
         keepdims=True,
     )  # [B, H, S, 1]
+    g_lse = g_lse.astype(jnp.float32)
 
     qd_spec = lambda qpos: pl.BlockSpec(  # noqa: E731
         (1, 1, block_q, d),
@@ -425,6 +436,7 @@ def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k):
             qd_spec("inner"),  # do
             row_spec("inner"),  # lse
             row_spec("inner"),  # delta
+            row_spec("inner"),  # glse
             kv_spec_for("outer"),  # k indexed by kj (grid dim 2)
             kv_spec_for("outer"),  # v
         ],
@@ -441,7 +453,7 @@ def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k):
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=_use_interpret(),
-    )(q, g, lse, delta, k, v)
+    )(q, g, lse, delta, g_lse, k, v)
 
     # GQA: fold per-q-head dk/dv back onto the kv heads
     if group > 1:
@@ -460,6 +472,7 @@ def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k):
             qd_spec("outer"),  # do
             row_spec("outer"),  # lse
             row_spec("outer"),  # delta
+            row_spec("outer"),  # glse
             kv_spec_for("inner"),  # k indexed by kj (grid dim 3)
             kv_spec_for("inner"),  # v
         ],
@@ -468,7 +481,7 @@ def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k):
         ),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_use_interpret(),
-    )(q, g, lse, delta, k, v)
+    )(q, g, lse, delta, g_lse, k, v)
 
     return (
         dq.astype(q.dtype),
@@ -490,12 +503,64 @@ def _fa_fwd(q, k, v, causal, sm_scale, block_q, block_k):
 
 def _fa_bwd(causal, sm_scale, block_q, block_k, res, g):
     q, k, v, out, lse = res
+    g_lse = jnp.zeros_like(lse)
     return _flash_bwd(
-        q, k, v, out, lse, g, causal, sm_scale, block_q, block_k
+        q, k, v, out, lse, g, g_lse, causal, sm_scale, block_q, block_k
     )
 
 
 _flash_attention_hsd.defvjp(_fa_fwd, _fa_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention_lse_hsd(q, k, v, causal, sm_scale, block_q, block_k):
+    return _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+
+
+def _fa_lse_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _fa_lse_bwd(causal, sm_scale, block_q, block_k, res, cts):
+    q, k, v, out, lse = res
+    g, g_lse = cts
+    return _flash_bwd(
+        q, k, v, out, lse, g, g_lse, causal, sm_scale, block_q, block_k
+    )
+
+
+_flash_attention_lse_hsd.defvjp(_fa_lse_fwd, _fa_lse_bwd)
+
+
+def flash_attention_lse(
+    q: jnp.ndarray,  # [B, S, H, D]
+    k: jnp.ndarray,  # [B, S, KV, D]
+    v: jnp.ndarray,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 512,
+):
+    """Like :func:`flash_attention` but also returns the per-row
+    log-sum-exp ``[B, S, H]`` — the residual that lets callers merge
+    partial attention over KV blocks exactly (ring attention's inner
+    kernel).  Differentiable in both outputs (the lse cotangent folds
+    into ds inside the backward kernels)."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    nh, nkv = q.shape[2], k.shape[2]
+    if nh % nkv != 0:
+        raise ValueError(f"heads {nh} not a multiple of kv {nkv}")
+    qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    out, lse = _flash_attention_lse_hsd(
+        qt, kt, vt, causal, sm_scale, block_q, block_k
+    )
+    # [B,H,S,D] -> [B,S,H,D]; lse [B,H,S,1] -> [B,S,H]
+    return (
+        jnp.swapaxes(out, 1, 2),
+        jnp.swapaxes(lse[..., 0], 1, 2),
+    )
 
 
 def flash_attention(

@@ -146,70 +146,85 @@ def ring_attention(
     (``distributed_attention.py:79``) — the reference all-gathers Q in
     micro-chunks and reduce-scatters the context; the TPU-idiomatic
     dual keeps Q resident and rotates the KV shard around the ring with
-    ``ppermute`` (one hop per step, overlapping compute), carrying
-    running max/sum statistics so the softmax is exact (flash-attention
-    style log-sum-exp accumulation).
+    ``ppermute`` (one hop per step, overlapping compute), merging each
+    block's contribution with log-sum-exp statistics so the softmax is
+    exact.
+
+    The per-block computation is the Pallas flash-attention kernel
+    (``flash_attention_lse`` — its lse output is exactly the residual
+    the merge needs); under ``causal``, blocks strictly above the
+    diagonal are skipped entirely (no QK^T, no PV — ~2x FLOPs saved),
+    the diagonal block runs the kernel's internal triangular mask, and
+    blocks below run unmasked.
 
     Shapes (inside shard_map): q ``[B, S/p, H, D]``, k/v
-    ``[B, S/p, KV, D]`` with KV dividing H (GQA: each KV head serves
-    ``H/KV`` query heads); returns the context for the local Q chunk
-    ``[B, S/p, H, D]``.
-
-    ``causal`` masking uses the ring step to decide whole-block
-    visibility: block j attends block i only when i <= j (diagonal
-    blocks use the intra-block triangular mask).
+    ``[B, S/p, KV, D]`` with KV dividing H (GQA handled inside the
+    kernel); returns the context for the local Q chunk.
     """
+    from dlrover_tpu.ops.flash_attention import flash_attention_lse
+
     n = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    q = q * scale
 
     b, s, h, d = q.shape
-    kv_heads = k.shape[2]
-    g = h // kv_heads
-    qg = q.reshape(b, s, kv_heads, g, d)
-
     neg_inf = jnp.finfo(jnp.float32).max * -1.0
 
+    def full_block(kv_pair):
+        kc, vc = kv_pair
+        return flash_attention_lse(q, kc, vc, causal=False,
+                                   sm_scale=scale)
+
+    def diag_block(kv_pair):
+        kc, vc = kv_pair
+        return flash_attention_lse(q, kc, vc, causal=True,
+                                   sm_scale=scale)
+
+    def skip_block(kv_pair):
+        # invisible under causal: contributes nothing (lse = -inf)
+        return (
+            jnp.zeros((b, s, h, d), dtype=q.dtype),
+            jnp.full((b, s, h), neg_inf, dtype=jnp.float32),
+        )
+
     def block(carry, step):
-        kc, vc, acc, m, denom = carry
+        kc, vc, acc, m_run, den = carry
         # after `step` rotations (shift=+1) the chunk we hold
         # originated `step` positions behind us on the ring
         src_idx = (my_idx - step) % n
-        logits = jnp.einsum(
-            "bqkgd,bxkd->bkgqx", qg, kc,
-            preferred_element_type=jnp.float32,
-        ).astype(jnp.float32)  # [b,kv,g,q,x]
         if causal:
-            q_pos = my_idx * s + jnp.arange(s)
-            k_pos = src_idx * s + jnp.arange(s)
-            mask = q_pos[:, None] >= k_pos[None, :]
-            logits = jnp.where(mask[None, None, None], logits, neg_inf)
-        new_m = jnp.maximum(m, jnp.max(logits, axis=-1, keepdims=True))
-        correction = jnp.exp(m - new_m)
-        p = jnp.exp(logits - new_m)
-        acc = acc * correction + jnp.einsum(
-            "bkgqx,bxkd->bkgqd", p, vc.astype(jnp.float32)
-        )
-        denom = denom * correction + jnp.sum(p, axis=-1, keepdims=True)
+            # whole-block visibility by ring position: src > my is
+            # strictly above the diagonal
+            branch = jnp.where(
+                src_idx > my_idx, 0, jnp.where(src_idx < my_idx, 1, 2)
+            )
+            out_i, lse_i = lax.switch(
+                branch, [skip_block, full_block, diag_block], (kc, vc)
+            )
+        else:
+            out_i, lse_i = full_block((kc, vc))
+        # online merge of normalized block outputs via lse
+        m_new = jnp.maximum(m_run, lse_i)
+        alpha = jnp.exp(m_run - m_new)[..., None]
+        beta = jnp.exp(lse_i - m_new)[..., None]
+        acc = acc * alpha + out_i.astype(jnp.float32) * beta
+        den = den * alpha[..., 0] + beta[..., 0]
         # rotate KV to the next ring position
         kc = ring_permute(kc, axis_name)
         vc = ring_permute(vc, axis_name)
-        return (kc, vc, acc, new_m, denom), None
+        return (kc, vc, acc, m_new, den), None
 
     acc0 = device_varying(
-        jnp.zeros((b, kv_heads, g, s, d), dtype=jnp.float32), axis_name
+        jnp.zeros((b, s, h, d), dtype=jnp.float32), axis_name
     )
     m0 = device_varying(
-        jnp.full((b, kv_heads, g, s, 1), neg_inf, dtype=jnp.float32),
-        axis_name,
+        jnp.full((b, s, h), neg_inf, dtype=jnp.float32), axis_name
     )
     den0 = device_varying(
-        jnp.zeros((b, kv_heads, g, s, 1), dtype=jnp.float32), axis_name
+        jnp.zeros((b, s, h), dtype=jnp.float32), axis_name
     )
-    (kc, vc, acc, m, denom), _ = lax.scan(
+    (kc, vc, acc, m_run, den), _ = lax.scan(
         block, (k, v, acc0, m0, den0), jnp.arange(n)
     )
-    out = (acc / denom).transpose(0, 3, 1, 2, 4).reshape(b, s, h, d)
-    return out.astype(q.dtype)
+    return (acc / den[..., None]).astype(q.dtype)

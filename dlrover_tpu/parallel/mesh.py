@@ -50,6 +50,10 @@ class MeshContext:
     # build_train_step so in-model activation constraints resolve
     # against the same table that sharded the params
     rules: Optional[object] = None
+    # pipeline microbatch count when pipe > 1 (set by the strategy
+    # engine; None -> 2 x pipe stages, a reasonable bubble/memory
+    # trade: bubble fraction (P-1)/(M+P-1))
+    pipeline_microbatches: Optional[int] = None
 
     def axis_size(self, name: str) -> int:
         return dict(self.dims).get(name, 1)

@@ -86,8 +86,14 @@ def pipeline_spmd(
     (_, out_buf), _ = lax.scan(
         tick, (incoming0, out_buf), jnp.arange(total_ticks)
     )
-    # only the last stage holds real outputs; broadcast over the axis
-    return lax.psum(out_buf, axis_name)
+    # only the last stage holds real outputs; broadcast over the axis.
+    # f32 for the collective: a bf16 psum under partial-manual
+    # shard_map trips an XLA CPU float-normalization bug ("Invalid
+    # binary instruction opcode copy"); the cast costs one convert on
+    # a buffer that crosses the network anyway
+    return lax.psum(
+        out_buf.astype(jnp.float32), axis_name
+    ).astype(microbatches.dtype)
 
 
 def split_microbatches(batch, num_microbatches: int):

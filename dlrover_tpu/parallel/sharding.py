@@ -97,12 +97,18 @@ def default_rules(
     tensor_parallel: bool = False,
     sequence_parallel: bool = False,
     expert_parallel: bool = False,
+    pipeline: bool = False,
 ) -> LogicalAxisRules:
     """The canonical rule tables (strategy selection in one place)."""
     rules: List[Tuple[str, Optional[object]]] = [
         # batch is always sharded over every data-flavored axis
         (BATCH, (AxisName.DATA, AxisName.FSDP)),
     ]
+    if pipeline:
+        # stacked layer dim becomes the stage dim; the layer executor
+        # (module_replace.select_layer_executor) runs the GPipe
+        # shard_map over it
+        rules.append((LAYERS, AxisName.PIPELINE))
     if sequence_parallel:
         rules.append((SEQ, AxisName.SEQUENCE))
     if tensor_parallel:
@@ -233,14 +239,45 @@ def shard_pytree(pytree, axes_pytree, mesh, rules: LogicalAxisRules):
 
 def apply_sharding_constraint(x, logical_axes, rules: LogicalAxisRules):
     """In-graph activation-sharding constraint; a no-op when no global
-    mesh is set (eager debugging / single device)."""
+    mesh is set (eager debugging / single device).
+
+    Inside a partial-manual ``shard_map`` region (the GPipe layer
+    executor runs the stage body with the "pipe" axis manual) the
+    constraint must be expressed against the ambient abstract mesh —
+    a NamedSharding over the outer all-Auto mesh trips a mesh-type
+    mismatch — with the manual axes dropped from the spec (the array
+    is already per-device along them)."""
     import jax
+    from jax.sharding import NamedSharding, PartitionSpec
 
     from dlrover_tpu.parallel.mesh import get_mesh_context
 
     ctx = get_mesh_context()
     if ctx is None:
         return x
+    spec = filter_spec_for_mesh(rules.spec(logical_axes), ctx.mesh)
+    try:
+        amesh = jax.sharding.get_abstract_mesh()
+        manual = {
+            name
+            for name, t in zip(amesh.axis_names, amesh.axis_types)
+            if "Manual" in str(t)
+        }
+    except Exception:  # noqa: BLE001
+        amesh, manual = None, set()
+    if manual:
+        entries = []
+        for e in spec:
+            flat = e if isinstance(e, tuple) else (e,)
+            keep = tuple(
+                a for a in flat if a is not None and a not in manual
+            )
+            entries.append(
+                keep if len(keep) > 1 else (keep[0] if keep else None)
+            )
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(amesh, PartitionSpec(*entries))
+        )
     return jax.lax.with_sharding_constraint(
-        x, logical_sharding(ctx.mesh, rules, logical_axes)
+        x, NamedSharding(ctx.mesh, spec)
     )

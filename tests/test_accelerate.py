@@ -83,7 +83,9 @@ class TestCandidates:
         cands = generate_candidates(big, 8)
         assert cands, "7B must have some fitting layout on 8 devices"
         for s in cands:
-            assert s.fsdp * s.tensor >= 8  # must shard the state
+            # every model-sharding axis counts (pipe splits the layer
+            # stack across stages)
+            assert s.fsdp * s.tensor * s.pipe >= 8
 
     def test_long_context_adds_seq_axis(self, tiny_cfg):
         profile = analyse_model(
@@ -187,9 +189,10 @@ class TestModuleReplace:
             loss_flash, loss_dense, rtol=2e-3, atol=2e-3
         )
 
-    def test_seq_parallel_uses_ring_and_matches(self, tiny_cfg):
-        """seq>1 strategy routes attention through the shard_map ring
-        kernel and matches the seq=1 dense loss."""
+    def test_seq_parallel_uses_sp_kernel_and_matches(self, tiny_cfg):
+        """seq>1 strategy routes attention through the shard_map SP
+        wrapper and matches the seq=1 dense loss.  tiny_cfg has
+        n_kv_heads=2 < seq=4, so the per-call choice is ring."""
         from dlrover_tpu.accelerate import module_replace
 
         result_sp = self._accelerate(
@@ -199,12 +202,79 @@ class TestModuleReplace:
             result_sp.mesh_ctx, result_sp.rules
         )
         assert fn.__qualname__.startswith(
-            "_ring_under_shard_map"
-        ), f"expected ring attention, got {fn}"
+            "_sp_under_shard_map"
+        ), f"expected SP attention wrapper, got {fn}"
+        # kv_heads=2 does not divide seq=4 -> ring; divisible -> ulysses
+        assert module_replace.sp_kernel_choice(4, 4, 2) == "ring"
+        assert module_replace.sp_kernel_choice(4, 8, 4) == "ulysses"
         loss_sp = self._step(result_sp)
 
         result_dp = self._accelerate(
             tiny_cfg, {"data": 8, "remat": "none"}
         )
+        loss_dp = self._step(result_dp)
+        np.testing.assert_allclose(loss_sp, loss_dp, rtol=2e-3)
+
+    def test_pipeline_parallel_matches_dp(self, tiny_cfg):
+        """pipe=2 strategy: layers sharded into stages, GPipe executor
+        under shard_map; loss matches the pure-dp run (VERDICT r2 #2 —
+        pipeline must compose through build_train_step)."""
+        result_pp = self._accelerate(
+            tiny_cfg, {"data": 4, "pipe": 2, "remat": "none"}
+        )
+        assert result_pp.strategy.pipe == 2
+        assert result_pp.mesh_ctx.pipeline_microbatches == 4
+        loss_pp = self._step(result_pp)
+
+        result_dp = self._accelerate(
+            tiny_cfg, {"data": 8, "remat": "none"}
+        )
+        loss_dp = self._step(result_dp)
+        np.testing.assert_allclose(loss_pp, loss_dp, rtol=2e-3)
+
+    def test_candidates_include_pipe(self):
+        """generate_candidates emits pipe>1 plans when the layer stack
+        divides evenly (ranked after non-pipe plans)."""
+        from dlrover_tpu.accelerate.analyser import ModelProfile
+        from dlrover_tpu.accelerate.strategy import generate_candidates
+
+        profile = ModelProfile(
+            num_params=1000, param_bytes=4000, largest_leaf=100,
+            leaf_count=4, optimizer_bytes=8000, num_layers=4,
+        )
+        cands = generate_candidates(profile, 8)
+        assert any(s.pipe > 1 for s in cands)
+        assert cands[0].pipe == 1  # bubble-free plans rank first
+        # layer stack of 3 cannot split into 2 or 4 stages
+        profile_odd = ModelProfile(
+            num_params=1000, param_bytes=4000, largest_leaf=100,
+            leaf_count=4, optimizer_bytes=8000, num_layers=3,
+        )
+        assert all(
+            s.pipe == 1 for s in generate_candidates(profile_odd, 8)
+        )
+
+    def test_sp_kernel_env_override(self, monkeypatch):
+        from dlrover_tpu.accelerate import module_replace
+
+        monkeypatch.setenv(module_replace.SP_KERNEL_ENV, "ring")
+        assert module_replace.sp_kernel_choice(4, 8, 8) == "ring"
+        monkeypatch.setenv(module_replace.SP_KERNEL_ENV, "ulysses")
+        assert module_replace.sp_kernel_choice(4, 6, 2) == "ulysses"
+
+    def test_seq_parallel_ulysses_selected_and_matches(self, tiny_cfg):
+        """With head counts divisible by the seq axis the SP wrapper
+        picks Ulysses; loss parity vs the data-parallel dense run."""
+        from dataclasses import replace as dc_replace
+
+        cfg = dc_replace(tiny_cfg, n_heads=4, n_kv_heads=4)
+        result_sp = self._accelerate(
+            cfg, {"data": 2, "seq": 4, "remat": "none"}
+        )
+        from dlrover_tpu.accelerate import module_replace
+
+        assert module_replace.sp_kernel_choice(4, 4, 4) == "ulysses"
+        loss_sp = self._step(result_sp)
+        result_dp = self._accelerate(cfg, {"data": 8, "remat": "none"})
         loss_dp = self._step(result_dp)
         np.testing.assert_allclose(loss_sp, loss_dp, rtol=2e-3)
