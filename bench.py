@@ -72,9 +72,38 @@ def _run_train_bench() -> dict:
         return {"error": str(e)}
 
 
+def _run_goodput_bench() -> dict:
+    """Run bench_goodput.py in a subprocess (it spawns its own elastic
+    launcher on CPU) and return its extras dict."""
+    if os.getenv("DLROVER_BENCH_SKIP_GOODPUT"):
+        return {"skipped": True}
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_goodput.py"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, script],
+            capture_output=True,
+            text=True,
+            timeout=900,
+        )
+        import bench_mfu
+
+        parsed = bench_mfu._parse_json_line(proc.stdout)
+        if parsed is not None:
+            return dict(parsed.get("extras", {}))
+        return {
+            "error": f"no JSON output (rc={proc.returncode})",
+            "stderr_tail": proc.stderr[-500:],
+        }
+    except Exception as e:  # noqa: BLE001
+        return {"error": str(e)}
+
+
 def main() -> int:
     # training throughput first, in its own process (frees HBM on exit)
     train_bench = _run_train_bench()
+    goodput_bench = _run_goodput_bench()
 
     import jax
     import jax.numpy as jnp
@@ -181,6 +210,7 @@ def main() -> int:
                     "backend": jax.default_backend(),
                     "baseline_blocking_s": BASELINE_BLOCKING_S,
                     "train": train_bench,
+                    "goodput": goodput_bench,
                 },
             }
         ),

@@ -1,0 +1,215 @@
+"""Goodput harness: measure useful-training-time ratio under worker
+kills.
+
+The reference's headline claim is goodput — 69% -> 95% on GLM-65B with
+fault tolerance (``README.md:56-58``) and the chaosblade kill-a-pod
+runbook (``docs/tech_report/fault_tolerance_exps.md:27-80``).  This
+harness reproduces that experiment at CI scale: launch a 2-process
+elastic run (``dlrover_tpu.run``), SIGKILL a worker at configured
+training steps, and measure
+
+- ``goodput``            = final_step x steady-state step time / wall
+                           clock from first to last completed step
+                           (restart + re-init + re-warmup overhead is
+                           the loss)
+- ``recovery_latency_s`` = per kill, wall time from the SIGKILL to the
+                           next completed step of the new incarnation
+- step continuity: every incarnation's first step must be exactly one
+  past a step that was flash-checkpointed (RPO 0 with per-step
+  blocking snapshots) — a gap or regression fails the run.
+
+Run standalone (prints one JSON line) or via ``run_goodput()`` from
+``bench.py``.  CPU-only by design: the metric exercises the control
+plane (agent restart, rendezvous, shm restore), not the chip.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+
+def _read_progress(path):
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    return out
+
+
+def run_goodput(
+    target_steps: int = 80,
+    kill_at_steps=(20, 50),
+    step_sleep: float = 0.1,
+    timeout: float = 600.0,
+) -> dict:
+    """Run the kill-and-recover experiment; returns the metrics dict.
+
+    Raises RuntimeError on harness failure (launcher died, steps not
+    reached, step continuity broken).
+    """
+    workdir = tempfile.mkdtemp(prefix="dlrover_goodput_")
+    progress = os.path.join(workdir, "progress.jsonl")
+    env = dict(
+        os.environ,
+        GOODPUT_TARGET_STEPS=str(target_steps),
+        GOODPUT_STEP_SLEEP=str(step_sleep),
+        GOODPUT_PROGRESS_FILE=progress,
+        GOODPUT_CKPT_DIR=os.path.join(workdir, "ckpt"),
+        DLROVER_TPU_SOCKET_DIR=os.path.join(workdir, "socks"),
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=REPO,
+    )
+    log_path = os.path.join(workdir, "launcher.log")
+    with open(log_path, "w") as log:
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "dlrover_tpu.run",
+                "--nnodes=1", "--nproc_per_node=2",
+                "--monitor_interval=0.3",
+                "--stop_timeout=2",
+                f"--max_restarts={len(kill_at_steps) + 2}",
+                os.path.join(REPO, "scripts", "goodput_train.py"),
+            ],
+            stdout=log,
+            stderr=subprocess.STDOUT,
+            env=env,
+            cwd=workdir,
+        )
+
+    kills = []  # (kill_time, last_step_seen, inc_at_kill)
+    pending = list(kill_at_steps)
+    deadline = time.time() + timeout
+    try:
+        while proc.poll() is None:
+            if time.time() > deadline:
+                raise RuntimeError("goodput harness timed out")
+            lines = _read_progress(progress)
+            if lines and pending:
+                max_step = max(e["step"] for e in lines)
+                max_inc = max(e["inc"] for e in lines)
+                # arm the next kill only after the previous kill's
+                # restart has been observed (a new incarnation logged
+                # progress) — otherwise a fast loop can blow through
+                # both thresholds inside one monitor interval
+                restart_seen = (
+                    not kills or max_inc > kills[-1][2]
+                )
+                if max_step >= pending[0] and restart_seen:
+                    # kill the most recent rank-1 worker
+                    rank1 = [e for e in lines if e["rank"] == 1]
+                    victim = (rank1 or lines)[-1]["pid"]
+                    try:
+                        os.kill(victim, signal.SIGKILL)
+                    except ProcessLookupError:
+                        pass
+                    kills.append((time.time(), max_step, max_inc))
+                    pending.pop(0)
+            time.sleep(0.1)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+    lines = _read_progress(progress)
+    if proc.returncode != 0:
+        tail = open(log_path).read()[-800:]
+        raise RuntimeError(
+            f"launcher exited {proc.returncode}; log tail:\n{tail}"
+        )
+    if not lines or max(e["step"] for e in lines) < target_steps:
+        raise RuntimeError("target steps never reached")
+
+    # continuity: an incarnation's first step is one past a snapshot
+    by_inc = {}
+    for e in lines:
+        if e["rank"] != 0:
+            continue
+        by_inc.setdefault(e["inc"], []).append(e)
+    prev_last = None
+    for inc in sorted(by_inc):
+        entries = sorted(by_inc[inc], key=lambda e: e["step"])
+        first = entries[0]["step"]
+        if prev_last is not None and first > prev_last + 1:
+            raise RuntimeError(
+                f"step gap across restart: {prev_last} -> {first}"
+            )
+        steps = [e["step"] for e in entries]
+        if steps != list(range(steps[0], steps[-1] + 1)):
+            raise RuntimeError(f"non-contiguous steps in inc {inc}")
+        prev_last = entries[-1]["step"]
+
+    # steady-state step time: median dt between consecutive rank-0
+    # steps within one incarnation (excludes restart gaps)
+    dts = []
+    for entries in by_inc.values():
+        entries = sorted(entries, key=lambda e: e["step"])
+        for a, b in zip(entries, entries[1:]):
+            dts.append(b["t"] - a["t"])
+    dts.sort()
+    if not dts:
+        raise RuntimeError("not enough progress samples")
+    step_time = dts[len(dts) // 2]
+
+    rank0 = sorted(
+        (e for e in lines if e["rank"] == 0), key=lambda e: e["t"]
+    )
+    wall = rank0[-1]["t"] - rank0[0]["t"]
+    useful = (target_steps - rank0[0]["step"]) * step_time
+    goodput = min(useful / wall, 1.0) if wall > 0 else 0.0
+
+    recoveries = []
+    for kill_t, _, inc_at_kill in kills:
+        # recovery = kill -> first completed step of a NEW incarnation
+        # (the old rank-0 keeps logging until the agent tears it down)
+        after = [
+            e
+            for e in lines
+            if e["t"] > kill_t and e["inc"] > inc_at_kill
+        ]
+        if after:
+            recoveries.append(min(e["t"] for e in after) - kill_t)
+
+    return {
+        "goodput": round(goodput, 4),
+        "steps": target_steps,
+        "kills": len(kills),
+        "restarts_observed": len(by_inc) - 1,
+        "step_time_s": round(step_time, 4),
+        "wall_s": round(wall, 2),
+        "recovery_latency_s": [round(r, 2) for r in recoveries],
+    }
+
+
+def main() -> int:
+    result = run_goodput()
+    print(
+        json.dumps(
+            {
+                "metric": "goodput_under_kills",
+                "value": result["goodput"],
+                "unit": "fraction",
+                "vs_baseline": round(result["goodput"] / 0.95, 3),
+                "extras": result,
+            }
+        ),
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -99,13 +99,10 @@ def select_attention(
     )
     if seq_size <= 1 or rules is None:
         return inner
-    return _sp_under_shard_map(mesh_ctx, rules, inner)
+    return _sp_under_shard_map(mesh_ctx, rules, inner, use_flash)
 
 
-def select_layer_executor(
-    mesh_ctx: Optional[MeshContext],
-    rules: Optional[LogicalAxisRules],
-):
+def select_layer_executor(mesh_ctx: Optional[MeshContext]):
     """How the model's stacked layer dim is executed: a plain
     ``lax.scan`` normally; the GPipe shard_map pipeline when the
     strategy runs pipe > 1 (reference
@@ -210,7 +207,8 @@ def _pipeline_executor(mesh_ctx: MeshContext):
 
 def _sp_under_shard_map(mesh_ctx: MeshContext,
                         rules: LogicalAxisRules,
-                        inner_attention):
+                        inner_attention,
+                        use_flash: bool = True):
     """Sequence-parallel attention over the seq mesh axis, wrapped in
     shard_map with specs matching the activation rule table (so it
     composes with the surrounding GSPMD program).
@@ -269,6 +267,7 @@ def _sp_under_shard_map(mesh_ctx: MeshContext,
                 ring_attention,
                 axis_name=AxisName.SEQUENCE,
                 causal=causal,
+                use_flash=use_flash,
             )
         sp = shard_map(
             fn,

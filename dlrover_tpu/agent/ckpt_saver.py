@@ -176,19 +176,21 @@ class AsyncCheckpointSaver:
                 ok = False
                 continue
             try:
-                shm_step = handler.get_step()
-                if shm_step != step:
+                if step not in handler.steps_available():
                     logger.error(
-                        "shm shard %s holds step %s, wanted %s; "
+                        "shm shard %s holds steps %s, wanted %s; "
                         "aborting this save",
-                        global_rank, shm_step, step,
+                        global_rank, handler.steps_available(), step,
                     )
                     ok = False
                     continue
                 path = os.path.join(
                     stage, f"shard_{global_rank}.drckpt"
                 )
-                ok = handler.dump_to_file(path, self._storage) and ok
+                ok = (
+                    handler.dump_to_file(path, self._storage, step=step)
+                    and ok
+                )
             finally:
                 lock.release()
         if not ok:
@@ -251,13 +253,24 @@ class AsyncCheckpointSaver:
 
     def save_shm_to_storage(self, reason: str = ""):
         """Emergency flush: persist whatever valid snapshot sits in shm
-        (called on SIGTERM / worker failure; reference ``:473-495``)."""
-        steps = [h.get_step() for h in self._shm_handlers]
-        valid = [s for s in steps if s >= 0]
-        if not valid:
+        (called on SIGTERM / worker failure; reference ``:473-495``).
+
+        Picks the NEWEST step available in every local shard's shm —
+        with double-buffered slots a kill that tore the shards (one at
+        N+1, one at N) still flushes a complete step N instead of
+        aborting on the mismatch."""
+        step_sets = [set(h.steps_available()) for h in self._shm_handlers]
+        if not step_sets or not all(step_sets):
             logger.info("no shm checkpoint to flush (%s)", reason)
             return False
-        step = max(valid)
+        common = set.intersection(*step_sets)
+        if not common:
+            logger.error(
+                "no step common to all %d shards (%s); nothing flushed",
+                len(step_sets), [sorted(s) for s in step_sets],
+            )
+            return False
+        step = max(common)
         if step <= self._latest_persisted_step:
             logger.info(
                 "shm step %s already persisted; skip flush", step

@@ -121,13 +121,25 @@ class SharedMemoryHandler:
         self.meta = SharedDict(f"ckpt_meta_{name}_{rank}", create=host)
 
     # -- writer (training process) ----------------------------------------
+    NUM_SLOTS = 2  # double-buffer: previous snapshot survives a crash
+    _ALIGN = 4096
+
     def save_state(self, step: int, tree) -> int:
         """Snapshot a pytree into shm; returns total bytes written.
 
         Single-pass drain: specs are computed from leaf metadata (no
         transfer), then each leaf is materialized and copied into its
         shm slot one at a time — peak extra host memory is one leaf,
-        not a full second copy of the state."""
+        not a full second copy of the state.
+
+        Double-buffered: consecutive saves alternate between two
+        regions of the segment, and the top-level meta keeps pointing
+        at the previous (complete) snapshot until the new one is fully
+        written.  A crash mid-write therefore never destroys the last
+        restorable state — the failure mode behind torn multi-rank
+        checkpoints (one rank at step N+1, a killed peer at N) becomes
+        recoverable: step N is still present in the survivor's other
+        slot."""
         pairs = _flatten_keyed(tree)
         specs = []
         offset = 0
@@ -146,30 +158,96 @@ class SharedMemoryHandler:
             specs.append((key, str(dtype), shape, offset, nbytes))
             offset += nbytes
         total = offset
-        self._ensure_shm(total)
-        # the buffer is about to be overwritten: a crash mid-write must
-        # not present a half-old/half-new snapshot as restorable
-        self.meta.set("valid", False)
+
+        meta_all = self.meta.get_all()
+        stride = int(meta_all.get("stride", 0))
+        slots = dict(meta_all.get("slots", {}))
+        last = int(meta_all.get("last_slot", self.NUM_SLOTS - 1))
+        if total > stride:
+            # state grew past the region stride: the segment will be
+            # unlinked and recreated zero-filled, so EVERY old snapshot
+            # dies — invalidate the meta BEFORE touching the segment
+            # (a crash between recreate and meta write must not present
+            # the zeroed buffer as the old step-N checkpoint)
+            stride = -(-total // self._ALIGN) * self._ALIGN
+            slots = {}
+            self.mark_invalid()
+        slot = (last + 1) % self.NUM_SLOTS
+        base = slot * stride
+
+        # before touching the region: repoint the restorable snapshot
+        # at the OTHER slot (or mark nothing-restorable when it holds
+        # no complete state) so a crash mid-write stays recoverable
+        slots[str(slot)] = {"valid": False}
+        other = slots.get(str((slot + 1) % self.NUM_SLOTS))
+        header = {"slots": slots, "stride": stride, "last_slot": last}
+        if other and other.get("valid"):
+            self.meta.update(
+                dict(
+                    header,
+                    step=other["step"],
+                    specs=other["specs"],
+                    total_bytes=other["total_bytes"],
+                    base=other["base"],
+                    valid=True,
+                )
+            )
+        else:
+            self.meta.update(dict(header, valid=False))
+
+        self._ensure_shm(self.NUM_SLOTS * stride)
         buf = self._shm.buf
         for (key, leaf), (_, dts, shape, off, nbytes) in zip(pairs, specs):
             # one memcpy into shm per leaf; np.asarray reuses the host
             # buffer the async copy already landed in, and it is dropped
             # before the next leaf materializes
             dst = np.ndarray(shape, dtype=np.dtype(dts), buffer=buf,
-                             offset=off)
+                             offset=base + off)
             np.copyto(dst, np.asarray(leaf))
+
+        slot_meta = {
+            "step": step,
+            "specs": specs,
+            "total_bytes": total,
+            "base": base,
+            "valid": True,
+        }
+        slots[str(slot)] = slot_meta
         self.meta.update(
-            {
-                "step": step,
-                "specs": specs,
-                "total_bytes": total,
-                "valid": True,
-            }
+            dict(
+                slot_meta,
+                slots=slots,
+                stride=stride,
+                last_slot=slot,
+            )
         )
         return total
 
     def mark_invalid(self):
-        self.meta.set("valid", False)
+        self.meta.update({"valid": False, "slots": {}})
+
+    def steps_available(self):
+        """Steps restorable from this segment, newest first (the active
+        snapshot plus the surviving previous slot)."""
+        meta = self.meta.get_all()
+        steps = set()
+        if meta.get("valid"):
+            steps.add(int(meta.get("step", -1)))
+        for slot_meta in meta.get("slots", {}).values():
+            if slot_meta.get("valid"):
+                steps.add(int(slot_meta.get("step", -1)))
+        return sorted((s for s in steps if s >= 0), reverse=True)
+
+    def _resolve_slot(self, meta: Dict, step: Optional[int]):
+        """Slot meta holding ``step`` (None = newest valid) or None."""
+        if step is None or (
+            meta.get("valid") and meta.get("step") == step
+        ):
+            return meta if meta.get("valid") else None
+        for slot_meta in meta.get("slots", {}).values():
+            if slot_meta.get("valid") and slot_meta.get("step") == step:
+                return slot_meta
+        return None
 
     def preallocate(self, nbytes: int):
         """Create the segment and fault in its pages ahead of the first
@@ -191,8 +269,10 @@ class SharedMemoryHandler:
         # meta saying valid=True over a fresh all-zero buffer would let
         # a restore present zeros as a real step-N checkpoint (also
         # covers a crash mid-zeroing)
-        self.meta.set("valid", False)
-        self._ensure_shm(nbytes)
+        self.mark_invalid()
+        stride = -(-nbytes // self._ALIGN) * self._ALIGN
+        self.meta.update({"stride": stride})
+        self._ensure_shm(self.NUM_SLOTS * stride)
         view = np.ndarray((self._shm.size,), dtype=np.uint8,
                           buffer=self._shm.buf)
         # touch every page (tmpfs allocates lazily); chunked fill keeps
@@ -240,7 +320,7 @@ class SharedMemoryHandler:
         return meta.get("step", -1)
 
     def load_state(
-        self, copy: bool = True
+        self, copy: bool = True, step: Optional[int] = None
     ) -> Tuple[int, Dict[str, np.ndarray]]:
         """Rebuild {keypath: ndarray} from shm.
 
@@ -248,11 +328,17 @@ class SharedMemoryHandler:
         shm may be overwritten afterwards).  ``copy=False`` returns
         zero-copy views directly onto the shm buffer — the fast restore
         path (feed them straight to ``jax.device_put`` and drop them
-        before the next snapshot overwrites the segment)."""
+        before the slot is reused, two snapshots later).
+
+        ``step`` selects a specific restorable step (either slot);
+        None = the newest complete snapshot."""
         meta = self.meta.get_all()
-        if not meta.get("valid"):
+        slot = self._resolve_slot(meta, step)
+        if slot is None:
             return -1, {}
-        if not self.attach(min_size=meta.get("total_bytes", 0)):
+        base = int(slot.get("base", 0))
+        total = slot.get("total_bytes", 0)
+        if not self.attach(min_size=base + total):
             return -1, {}
         arrays = {}
         buf = self._shm.buf
@@ -261,38 +347,49 @@ class SharedMemoryHandler:
             # then slice views onto it — orders of magnitude faster than
             # a per-leaf view.copy() walk over the shm mapping, and the
             # result is standalone (shm may be overwritten afterwards)
-            total = meta.get("total_bytes", 0)
             private = np.empty(total, dtype=np.uint8)
-            np.copyto(private,
-                      np.ndarray((total,), dtype=np.uint8, buffer=buf))
+            np.copyto(
+                private,
+                np.ndarray((total,), dtype=np.uint8, buffer=buf,
+                           offset=base),
+            )
             buf = private.data
-        for key, dtype, shape, off, nbytes in meta["specs"]:
+            base = 0
+        for key, dtype, shape, off, nbytes in slot["specs"]:
             arrays[key] = np.ndarray(
                 tuple(shape), dtype=np.dtype(dtype), buffer=buf,
-                offset=off,
+                offset=base + off,
             )
-        return meta.get("step", -1), arrays
+        return slot.get("step", -1), arrays
 
-    def dump_to_file(self, path: str, storage) -> bool:
-        """Persist header+raw shm bytes to ``path`` (agent side)."""
+    def dump_to_file(
+        self, path: str, storage, step: Optional[int] = None
+    ) -> bool:
+        """Persist header+raw shm bytes to ``path`` (agent side).
+        ``step`` selects which slot to persist (None = newest)."""
         meta = self.meta.get_all()
-        if not meta.get("valid") or not self.attach(
-            min_size=meta.get("total_bytes", 0)
-        ):
-            logger.warning("no valid shm checkpoint for rank %s",
-                           self._rank)
+        slot = self._resolve_slot(meta, step)
+        if slot is None:
+            logger.warning(
+                "no valid shm checkpoint for rank %s (step=%s)",
+                self._rank, step,
+            )
+            return False
+        base = int(slot.get("base", 0))
+        total = slot["total_bytes"]
+        if not self.attach(min_size=base + total):
+            logger.warning("shm segment missing for rank %s", self._rank)
             return False
         header = pickle.dumps(
-            {"step": meta["step"], "specs": meta["specs"]}
+            {"step": slot["step"], "specs": slot["specs"]}
         )
-        total = meta["total_bytes"]
         # stream header + a zero-copy view of the shm buffer so the
         # agent never materializes a second shard-sized bytes object
         storage.write_chunks(
             [
                 _HDR.pack(len(header)),
                 header,
-                memoryview(self._shm.buf)[:total],
+                memoryview(self._shm.buf)[base : base + total],
             ],
             path,
         )

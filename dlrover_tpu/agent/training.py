@@ -52,6 +52,12 @@ class ElasticLaunchConfig:
     comm_perf_test: bool = False
     max_restarts: int = 3
     monitor_interval: float = 5.0
+    # SIGTERM -> SIGKILL grace when stopping workers.  A worker blocked
+    # in a collective (the COMMON failure posture: every survivor of a
+    # peer crash is stalled in an allreduce/barrier) cannot run Python
+    # signal handlers, so it always eats the full grace period —
+    # recovery latency is dominated by this knob.
+    stop_timeout: float = 15.0
     node_rank: int = field(
         default_factory=lambda: int(os.getenv(NodeEnv.NODE_RANK, "0"))
     )
@@ -324,7 +330,9 @@ class ElasticTrainingAgent:
         node_unit = max(self._config.node_unit, 1)
         return waiting > 0 and waiting % node_unit == 0
 
-    def _stop_workers(self, timeout: float = 15.0):
+    def _stop_workers(self, timeout: Optional[float] = None):
+        if timeout is None:
+            timeout = self._config.stop_timeout
         for proc in self._procs:
             if proc.poll() is None:
                 proc.send_signal(signal.SIGTERM)

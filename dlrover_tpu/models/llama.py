@@ -314,9 +314,7 @@ def forward(
     )
     from dlrover_tpu.parallel.mesh import get_mesh_context
 
-    execute_layers = select_layer_executor(
-        get_mesh_context(), _current_rules()
-    )
+    execute_layers = select_layer_executor(get_mesh_context())
     x = execute_layers(block, params["layers"], x, cos, sin)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = jnp.einsum(

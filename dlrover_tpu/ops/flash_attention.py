@@ -235,7 +235,8 @@ def _bwd_block_math(q, k, v, do, lse, delta, glse, keep, sm_scale):
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )  # [BQ, BK]
-    ds = p * (dp - delta + glse) * sm_scale
+    correction = dp - delta if glse is None else dp - delta + glse
+    ds = p * correction * sm_scale
     if keep is not None:
         # p=0 alone is not enough: out-of-range rows load garbage
         # lse/delta (possibly NaN), and 0 * NaN = NaN
@@ -268,10 +269,15 @@ def _bwd_masks(qi, kj, block_q, block_k, seq_len, causal):
 
 
 def _flash_bwd_dkv_kernel(
-    q_ref, do_ref, lse_ref, delta_ref, glse_ref, k_ref, v_ref,
-    dk_ref, dv_ref, dk_scr, dv_scr,
-    *, sm_scale, causal, block_q, block_k, seq_len,
+    *refs, sm_scale, causal, block_q, block_k, seq_len, has_glse,
 ):
+    if has_glse:
+        (q_ref, do_ref, lse_ref, delta_ref, glse_ref, k_ref, v_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    else:
+        (q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+        glse_ref = None
     kj = pl.program_id(2)
     qi = pl.program_id(3)  # innermost: dk/dv accumulate across it
     nq = pl.num_programs(3)
@@ -307,7 +313,8 @@ def _flash_bwd_dkv_kernel(
         keep = _bwd_masks(qi, kj, block_q, block_k, seq_len, causal)
         p, ds = _bwd_block_math(
             q, k, v, do, lse_ref[0, 0], delta_ref[0, 0],
-            glse_ref[0, 0], keep, sm_scale,
+            glse_ref[0, 0] if glse_ref is not None else None,
+            keep, sm_scale,
         )
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do.dtype), do,
@@ -327,10 +334,15 @@ def _flash_bwd_dkv_kernel(
 
 
 def _flash_bwd_dq_kernel(
-    q_ref, do_ref, lse_ref, delta_ref, glse_ref, k_ref, v_ref,
-    dq_ref, dq_scr,
-    *, sm_scale, causal, block_q, block_k, seq_len,
+    *refs, sm_scale, causal, block_q, block_k, seq_len, has_glse,
 ):
+    if has_glse:
+        (q_ref, do_ref, lse_ref, delta_ref, glse_ref, k_ref, v_ref,
+         dq_ref, dq_scr) = refs
+    else:
+        (q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+         dq_ref, dq_scr) = refs
+        glse_ref = None
     qi = pl.program_id(2)
     kj = pl.program_id(3)  # innermost: dq accumulates across it
     nk = pl.num_programs(3)
@@ -361,7 +373,8 @@ def _flash_bwd_dq_kernel(
         keep = _bwd_masks(qi, kj, block_q, block_k, seq_len, causal)
         _, ds = _bwd_block_math(
             q, k, v, do, lse_ref[0, 0], delta_ref[0, 0],
-            glse_ref[0, 0], keep, sm_scale,
+            glse_ref[0, 0] if glse_ref is not None else None,
+            keep, sm_scale,
         )
         dq_scr[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k,
@@ -397,7 +410,10 @@ def _flash_bwd(
         axis=-1,
         keepdims=True,
     )  # [B, H, S, 1]
-    g_lse = g_lse.astype(jnp.float32)
+    has_glse = g_lse is not None
+    glse_in = (
+        (g_lse.astype(jnp.float32),) if has_glse else ()
+    )
 
     qd_spec = lambda qpos: pl.BlockSpec(  # noqa: E731
         (1, 1, block_q, d),
@@ -421,7 +437,22 @@ def _flash_bwd(
     common = dict(
         sm_scale=sm_scale, causal=causal,
         block_q=block_q, block_k=block_k, seq_len=s,
+        has_glse=has_glse,
     )
+
+    def _in_specs(qpos, kpos):
+        """q/do/lse/delta [+glse] then k/v; glse only when present so
+        the plain backward pays no extra buffer or VMEM load."""
+        specs = [
+            qd_spec(qpos),  # q
+            qd_spec(qpos),  # do
+            row_spec(qpos),  # lse
+            row_spec(qpos),  # delta
+        ]
+        if has_glse:
+            specs.append(row_spec(qpos))  # glse
+        specs += [kv_spec_for(kpos), kv_spec_for(kpos)]  # k, v
+        return specs
 
     # dk/dv: grid (b, h, kj, qi) — qi innermost accumulates in scratch
     dk_h, dv_h = pl.pallas_call(
@@ -431,15 +462,7 @@ def _flash_bwd(
             jax.ShapeDtypeStruct((b, h, s, d), jnp.float32),
         ),
         grid=(b, h, nk, nq),
-        in_specs=[
-            qd_spec("inner"),  # q indexed by qi (grid dim 3)
-            qd_spec("inner"),  # do
-            row_spec("inner"),  # lse
-            row_spec("inner"),  # delta
-            row_spec("inner"),  # glse
-            kv_spec_for("outer"),  # k indexed by kj (grid dim 2)
-            kv_spec_for("outer"),  # v
-        ],
+        in_specs=_in_specs("inner", "outer"),
         out_specs=(
             pl.BlockSpec(
                 (1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, i, 0)
@@ -453,7 +476,7 @@ def _flash_bwd(
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=_use_interpret(),
-    )(q, g, lse, delta, g_lse, k, v)
+    )(q, g, lse, delta, *glse_in, k, v)
 
     # GQA: fold per-q-head dk/dv back onto the kv heads
     if group > 1:
@@ -467,21 +490,13 @@ def _flash_bwd(
         functools.partial(_flash_bwd_dq_kernel, **common),
         out_shape=jax.ShapeDtypeStruct((b, h, s, d), jnp.float32),
         grid=(b, h, nq, nk),
-        in_specs=[
-            qd_spec("outer"),  # q indexed by qi (grid dim 2)
-            qd_spec("outer"),  # do
-            row_spec("outer"),  # lse
-            row_spec("outer"),  # delta
-            row_spec("outer"),  # glse
-            kv_spec_for("inner"),  # k indexed by kj (grid dim 3)
-            kv_spec_for("inner"),  # v
-        ],
+        in_specs=_in_specs("outer", "inner"),
         out_specs=pl.BlockSpec(
             (1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)
         ),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_use_interpret(),
-    )(q, g, lse, delta, g_lse, k, v)
+    )(q, g, lse, delta, *glse_in, k, v)
 
     return (
         dq.astype(q.dtype),
@@ -503,9 +518,8 @@ def _fa_fwd(q, k, v, causal, sm_scale, block_q, block_k):
 
 def _fa_bwd(causal, sm_scale, block_q, block_k, res, g):
     q, k, v, out, lse = res
-    g_lse = jnp.zeros_like(lse)
     return _flash_bwd(
-        q, k, v, out, lse, g, g_lse, causal, sm_scale, block_q, block_k
+        q, k, v, out, lse, g, None, causal, sm_scale, block_q, block_k
     )
 
 

@@ -132,6 +132,39 @@ def grad_sync(grads, axis_names):
     )
 
 
+def _dense_block_lse(q, k, v, causal: bool, scale: float):
+    """Dense (out, lse) for one KV block — the ring's inner kernel when
+    flash attention is disabled (DLROVER_TPU_FLASH_ATTENTION=0).
+    q [B,S,H,D], k/v [B,X,KV,D]; lse [B,S,H]."""
+    b, s, h, d = q.shape
+    kv = k.shape[2]
+    g = h // kv
+    qg = q.reshape(b, s, kv, g, d)
+    logits = (
+        jnp.einsum(
+            "bqkgd,bxkd->bqkgx", qg, k,
+            preferred_element_type=jnp.float32,
+        ).astype(jnp.float32)
+        * scale
+    )
+    if causal:
+        x = k.shape[1]
+        mask = jnp.arange(s)[:, None] >= jnp.arange(x)[None, :]
+        logits = jnp.where(
+            mask[None, :, None, None], logits,
+            jnp.finfo(jnp.float32).max * -1.0,
+        )
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)  # [b,s,kv,g]
+    p = jnp.exp(logits - lse[..., None])
+    out = jnp.einsum(
+        "bqkgx,bxkd->bqkgd", p, v.astype(jnp.float32)
+    )
+    return (
+        out.reshape(b, s, h, d).astype(q.dtype),
+        lse.reshape(b, s, h),
+    )
+
+
 def ring_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -139,6 +172,7 @@ def ring_attention(
     axis_name: str,
     causal: bool = False,
     scale: Optional[float] = None,
+    use_flash: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Blockwise ring attention over a sequence-sharded mesh axis.
 
@@ -163,6 +197,11 @@ def ring_attention(
     """
     from dlrover_tpu.ops.flash_attention import flash_attention_lse
 
+    if use_flash is None:
+        from dlrover_tpu.accelerate.module_replace import _flash_enabled
+
+        use_flash = _flash_enabled(None)
+
     n = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     if scale is None:
@@ -171,15 +210,20 @@ def ring_attention(
     b, s, h, d = q.shape
     neg_inf = jnp.finfo(jnp.float32).max * -1.0
 
+    def inner(qq, kc, vc, causal_):
+        if use_flash:
+            return flash_attention_lse(
+                qq, kc, vc, causal=causal_, sm_scale=scale
+            )
+        return _dense_block_lse(qq, kc, vc, causal_, scale)
+
     def full_block(kv_pair):
         kc, vc = kv_pair
-        return flash_attention_lse(q, kc, vc, causal=False,
-                                   sm_scale=scale)
+        return inner(q, kc, vc, False)
 
     def diag_block(kv_pair):
         kc, vc = kv_pair
-        return flash_attention_lse(q, kc, vc, causal=True,
-                                   sm_scale=scale)
+        return inner(q, kc, vc, True)
 
     def skip_block(kv_pair):
         # invisible under causal: contributes nothing (lse = -inf)

@@ -37,6 +37,22 @@ from dlrover_tpu.agent.ckpt_shm import (
 )
 
 
+def _newest_common_step(pairs) -> int:
+    """Max step present in every rank's availability row ([P, 2] of
+    {shm_step, storage_step}), or -1 when no step is restorable on all
+    ranks (a torn post-crash state: everyone starts fresh together)."""
+    import numpy as np
+
+    rows = np.asarray(pairs)
+    candidates = sorted(
+        {int(v) for v in rows.reshape(-1) if v >= 0}, reverse=True
+    )
+    for c in candidates:
+        if all((row == c).any() for row in rows):
+            return c
+    return -1
+
+
 def _agent_factory_queue_exists() -> bool:
     """True only if an agent is actually listening — a stale socket
     file from a SIGKILLed agent must not make the standalone path
@@ -287,28 +303,32 @@ class CheckpointEngine:
         moves: after a node replacement, surviving ranks may hold a
         newer uncommitted shm snapshot than the relaunched node's last
         committed storage step — restoring it would silently resume a
-        mixed-step global state.  Every process restores
-        ``min over ranks of max(shm_step, storage_step)``.
+        mixed-step global state.  Every process restores the newest
+        step available on ALL ranks (each rank's set = its two shm
+        slots + its latest committed storage step).
 
         Returns (step, state) where state is ``target``-shaped if a
         target pytree was given, else {keypath: ndarray}; (-1, None)
         when nothing exists.
         """
-        shm_step = self._shm_handler.get_step()
+        shm_steps = self._shm_handler.steps_available()
+        shm_step = shm_steps[0] if shm_steps else -1
         storage_step, latest_dir = self._latest_storage_step(
             checkpoint_dir
         )
-        agreed = self._sync_restore_step(max(shm_step, storage_step))
+        agreed = self._sync_restore_step(shm_steps, storage_step)
         if agreed < 0:
             return -1, None
         zero_copy = False
         step, arrays = -1, {}
-        if shm_step == agreed:
+        if agreed in shm_steps:
             # zero-copy: views onto shm, batched device_put in
             # restore_to_target (blocks before returning, so the next
             # snapshot can't clobber the views mid-transfer)
             zero_copy = target is not None
-            step, arrays = self._shm_handler.load_state(copy=not zero_copy)
+            step, arrays = self._shm_handler.load_state(
+                copy=not zero_copy, step=agreed
+            )
         if step != agreed and storage_step == agreed:
             # shm miss (or invalidated between get_step and load_state):
             # storage holds the agreed step too
@@ -333,23 +353,40 @@ class CheckpointEngine:
             )
         return step, arrays
 
-    def _sync_restore_step(self, local_best: int) -> int:
-        """Cross-process consensus on the restore step (collective min
-        of each rank's best locally-available step)."""
+    def _sync_restore_step(self, shm_steps, storage_step: int) -> int:
+        """Cross-process consensus on the restore step: the NEWEST step
+        that every rank can actually restore.
+
+        min-of-maxes is not enough: after a mid-save crash the shards
+        can be torn — rank 0's newest shm slot holds step N+1 while the
+        relaunched rank 1 holds step N; the min (N) must be restored
+        from rank 0's OTHER slot (the double buffer keeps it).  Each
+        rank publishes its availability set {shm slots, storage_step}
+        and all pick the max step present in every set (-1 = none:
+        every rank starts fresh, consistently)."""
+        avail = [
+            *shm_steps[: SharedMemoryHandler.NUM_SLOTS],
+            storage_step,
+        ]
+        # fixed-width row for the allgather
+        width = SharedMemoryHandler.NUM_SLOTS + 1
+        avail += [-1] * (width - len(avail))
         if self._step_sync_fn is not None:
-            return self._step_sync_fn(local_best)
+            return self._step_sync_fn(
+                shm_steps[0] if shm_steps else -1, storage_step
+            )
         import jax
 
         if jax.process_count() <= 1:
-            return local_best
+            return max(avail)
         try:
             import jax.numpy as jnp
             from jax.experimental import multihost_utils
 
-            steps = multihost_utils.process_allgather(
-                jnp.int32(local_best)
-            )
-            return int(steps.min())
+            rows = multihost_utils.process_allgather(
+                jnp.array(avail, jnp.int32)
+            )  # [P, width]
+            return _newest_common_step(rows)
         except Exception as exc:
             # a one-sided fallback to the local step would recreate the
             # mixed-step divergence this sync exists to prevent (and

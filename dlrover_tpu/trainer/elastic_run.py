@@ -61,6 +61,12 @@ def parse_args(argv=None):
     parser.add_argument("--rdzv_timeout", type=int, default=600)
     parser.add_argument("--monitor_interval", type=float, default=3.0)
     parser.add_argument(
+        "--stop_timeout", type=float, default=15.0,
+        help="SIGTERM->SIGKILL grace when stopping workers; workers "
+        "blocked in collectives always eat the full grace, so this "
+        "bounds restart latency",
+    )
+    parser.add_argument(
         "--network-check",
         "--network_check",
         dest="network_check",
@@ -209,6 +215,7 @@ def run(args) -> int:
         network_check=args.network_check,
         max_restarts=args.max_restarts,
         monitor_interval=args.monitor_interval,
+        stop_timeout=args.stop_timeout,
         node_rank=node_rank,
         compile_cache_dir=args.compile_cache_dir,
     )

@@ -1,0 +1,129 @@
+"""Worker script for the goodput harness (``bench_goodput.py``).
+
+A tiny data-parallel train loop under ``dlrover_tpu.run``: every step
+is flash-checkpointed to shared memory (blocking, so RPO = 0 steps)
+and appended to a progress file the harness tails.  On restart after a
+kill the engine's consensus restore resumes from the last snapshot —
+the harness asserts step continuity across incarnations.
+
+Reference role: the chaosblade fault-tolerance experiments
+(``docs/tech_report/fault_tolerance_exps.md:27-80``) — kill a worker,
+training resumes from the checkpoint without losing the job.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from dlrover_tpu.trainer.elastic import init_distributed
+
+ctx = init_distributed()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+
+from dlrover_tpu.parallel.mesh import AxisName, create_parallel_mesh  # noqa: E402
+from dlrover_tpu.trainer.checkpoint.engine import CheckpointEngine  # noqa: E402
+
+TARGET = int(os.environ["GOODPUT_TARGET_STEPS"])
+STEP_SLEEP = float(os.environ.get("GOODPUT_STEP_SLEEP", "0.05"))
+PROGRESS = os.environ["GOODPUT_PROGRESS_FILE"]
+CKPT_DIR = os.environ["GOODPUT_CKPT_DIR"]
+
+
+def log_progress(step: int) -> None:
+    line = json.dumps(
+        {
+            "pid": os.getpid(),
+            "rank": ctx.rank,
+            "inc": ctx.restart_count,
+            "step": step,
+            "t": time.time(),
+        }
+    )
+    with open(PROGRESS, "a") as f:
+        f.write(line + "\n")
+
+
+def main() -> int:
+    create_parallel_mesh([(AxisName.DATA, -1)])
+    optimizer = optax.adam(1e-2)
+    params = {"w": jnp.eye(32), "b": jnp.zeros((32,))}
+    state = {
+        "params": params,
+        "opt_state": optimizer.init(params),
+        "step": 0,
+    }
+
+    engine = CheckpointEngine(
+        checkpoint_dir=CKPT_DIR,
+        process_rank=ctx.rank,
+        process_count=ctx.world_size,
+        node_rank=ctx.node_rank,
+        local_shard_num=int(
+            os.getenv("DLROVER_TPU_LOCAL_PROCESS_COUNT", "1")
+        ),
+    )
+    ck_step, restored = engine.load(target=jax.device_get(state))
+    if ck_step >= 0:
+        state = restored
+        print(
+            f"[goodput rank {ctx.rank} inc {ctx.restart_count}] "
+            f"resumed from step {ck_step}",
+            flush=True,
+        )
+
+    def loss_fn(params, x):
+        h = jnp.tanh(x @ params["w"] + params["b"])
+        return jnp.mean(h * h)
+
+    @jax.jit
+    def train_step(state, x):
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"], x)
+        updates, opt_state = optimizer.update(
+            grads, state["opt_state"], state["params"]
+        )
+        return {
+            "params": optax.apply_updates(state["params"], updates),
+            "opt_state": opt_state,
+            "step": state["step"] + 1,
+        }, loss
+
+    distributed = ctx.master_addr and ctx.world_size > 1
+
+    def step_barrier():
+        """Couple the ranks like a real data-parallel grad allreduce
+        does: when a peer dies, the survivors stall here until the
+        agent tears them down and restarts the group — that stalled
+        time is exactly the goodput loss being measured."""
+        if distributed:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("goodput_step")
+
+    step = int(state["step"])
+    x = jax.random.normal(jax.random.PRNGKey(ctx.rank), (16, 32))
+    while step < TARGET:
+        step_barrier()
+        state, loss = train_step(state, x)
+        jax.block_until_ready(state)
+        time.sleep(STEP_SLEEP)  # simulated per-step device work
+        step += 1
+        # blocking memory snapshot: RPO 0 — resume must be step+1
+        engine.save_to_memory(step, jax.device_get(state))
+        engine.wait_for_snapshot()
+        log_progress(step)
+
+    engine.close()
+    print(f"[goodput rank {ctx.rank}] done at step {step}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

