@@ -72,6 +72,9 @@ def run_goodput(
         DLROVER_TPU_SOCKET_DIR=os.path.join(workdir, "socks"),
         JAX_PLATFORMS="cpu",
         PYTHONPATH=REPO,
+        # one device per proc: a test conftest's 8-virtual-device
+        # XLA_FLAGS would leak in and slow every worker down
+        XLA_FLAGS="",
     )
     log_path = os.path.join(workdir, "launcher.log")
     with open(log_path, "w") as log:

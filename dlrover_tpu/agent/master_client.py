@@ -91,6 +91,13 @@ class MasterClient:
             )
         )
 
+    def report_node_topology(self, node_rank: int, levels) -> bool:
+        """Report this node's interconnect position (outermost level
+        first) for topology-aware rank sorting."""
+        return self._channel.report(
+            msg.NodeTopology(node_rank=node_rank, levels=tuple(levels))
+        )
+
     def join_rendezvous(
         self,
         node_rank: int,

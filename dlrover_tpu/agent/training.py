@@ -115,6 +115,16 @@ class MasterRendezvousHandler:
         self._poll = poll_interval
 
     def next_rendezvous(self):
+        # topology hint (e.g. "superpod0/pod1/slice2") enables
+        # topology-aware rank sorting on the master; absent = no-op
+        topo = os.getenv("DLROVER_TPU_TOPOLOGY", "")
+        if topo:
+            try:
+                self._client.report_node_topology(
+                    self._node_rank, tuple(topo.split("/"))
+                )
+            except Exception as e:  # noqa: BLE001
+                logger.warning("topology report failed: %s", e)
         rdzv_round = self._client.join_rendezvous(
             self._node_rank, self._local_world_size, self._rdzv_name
         )
@@ -185,9 +195,12 @@ class ElasticTrainingAgent:
         return rnd, world
 
     def _assign_worker_ranks(self, world: Dict[int, int]):
-        """Global process ranks from the sorted node world (reference
-        ``_assign_worker_ranks`` ``training.py:486``)."""
-        sorted_nodes = sorted(world)
+        """Global process ranks from the node world, in the MASTER's
+        order (reference ``_assign_worker_ranks`` ``training.py:486``).
+        The master emits the world topology-sorted (interconnect
+        neighbors adjacent); dict insertion order survives the pickled
+        transport, so the received order IS the rank order."""
+        sorted_nodes = list(world)
         world_size = sum(world.values())
         rank_offset = 0
         for nr in sorted_nodes:

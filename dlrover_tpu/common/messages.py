@@ -335,6 +335,16 @@ class NodeAddress(Message):
 
 
 @dataclass
+class NodeTopology(Message):
+    """Interconnect position of a node (outermost level first, e.g.
+    superpod/pod/slice) — feeds topology-aware rank sorting
+    (reference ``net_topology.py:20`` NodeTopologyMeta)."""
+
+    node_rank: int = 0
+    levels: Tuple = ()
+
+
+@dataclass
 class NetworkStatus(Message):
     node_rank: int = 0
     succeeded: bool = False

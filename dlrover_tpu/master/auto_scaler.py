@@ -24,12 +24,14 @@ class AllreduceAutoScaler:
         scaler: Scaler,
         speed_monitor=None,
         job_manager=None,
+        rendezvous_manager=None,
         interval: float = 60.0,
     ):
         self._optimizer = optimizer
         self._scaler = scaler
         self._speed_monitor = speed_monitor
         self._job_manager = job_manager
+        self._rdzv_manager = rendezvous_manager
         self._interval = interval
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -62,10 +64,47 @@ class AllreduceAutoScaler:
         if speed > 0 and worker_num > 0:
             self._optimizer.record_speed(worker_num, speed)
 
+    def _collect_stragglers(self):
+        """Feed the health-check rounds' straggler verdict to the
+        straggler-migrate algorithm.  Ranks are mapped to node NAMES
+        (the scaler removes pods by name; an unmapped rank is skipped
+        rather than producing an un-executable plan)."""
+        if self._rdzv_manager is None:
+            return
+        try:
+            stragglers, _ = self._rdzv_manager.check_straggler()
+        except Exception:  # noqa: BLE001
+            return
+        if not stragglers:
+            return
+        names = []
+        rank_to_name = {}
+        if self._job_manager is not None:
+            for node in self._job_manager.get_running_nodes():
+                key = (
+                    node.rank_index
+                    if node.rank_index is not None
+                    else node.id
+                )
+                if node.name:
+                    rank_to_name[key] = node.name
+        for rank in stragglers:
+            name = rank_to_name.get(rank)
+            if name:
+                names.append(name)
+            else:
+                logger.warning(
+                    "straggler rank %s has no known node name; "
+                    "skipping migration", rank,
+                )
+        if names:
+            self._optimizer.report_stragglers(names)
+
     def _loop(self):
         while not self._stopped.wait(self._interval):
             try:
                 self._collect_speed()
+                self._collect_stragglers()
                 plan = self._optimizer.generate_plan(JobStage.RUNNING)
                 if plan and not plan.is_empty():
                     logger.info("auto-scaler executing plan: %s", plan)

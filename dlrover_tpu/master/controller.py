@@ -1,0 +1,285 @@
+"""ElasticJob / ScalePlan controller — the operator's reconcile loops.
+
+Reference parity: the Go kubebuilder operator
+(``dlrover/go/operator/pkg/controllers/elasticjob_controller.go:85,182``
+— reconcile ElasticJob by creating the job master pod;
+``scaleplan_controller.go:79,95`` — apply a ScalePlan's replica specs /
+create / remove / migrate pods).  Behavior parity in Python: a
+poll-and-reconcile loop over the CRDs (same shapes as ``k8s/crds/``),
+driving pods through the same ``k8sClient`` surface the scalers use —
+so the whole control plane runs without any Go build.
+
+The client is duck-typed (``scheduler.kubernetes.k8sClient`` in
+production, a fake in tests), needing:
+``list_pods/create_pod/delete_pod`` and
+``list_custom_resource/update_custom_resource_status``.
+"""
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import NodeType
+from dlrover_tpu.common.log import default_logger as logger
+
+GROUP = "elastic.dlrover-tpu.io"
+VERSION = "v1alpha1"
+ELASTICJOB_PLURAL = "elasticjobs"
+SCALEPLAN_PLURAL = "scaleplans"
+
+MASTER_SUFFIX = "-dlrover-master"
+
+
+def master_pod_manifest(job: Dict) -> Dict:
+    """Master pod for an ElasticJob (ref ``pkg/controllers/master/
+    master.go`` — image/env from the job spec, master command)."""
+    name = job["metadata"]["name"]
+    spec = job.get("spec", {})
+    replica_specs = spec.get("replicaSpecs", {})
+    worker = replica_specs.get(NodeType.WORKER, {})
+    template = worker.get("template", {}) or {}
+    image = "python:3.12"
+    containers = (
+        template.get("spec", {}).get("containers") or [{}]
+    )
+    if containers and containers[0].get("image"):
+        image = containers[0]["image"]
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": f"{name}{MASTER_SUFFIX}",
+            "labels": {
+                "job": name,
+                "node-type": "master",
+                "app": "dlrover-tpu",
+            },
+            "ownerReferences": [
+                {
+                    "apiVersion": f"{GROUP}/{VERSION}",
+                    "kind": "ElasticJob",
+                    "name": name,
+                    "uid": job["metadata"].get("uid", ""),
+                }
+            ],
+        },
+        "spec": {
+            "restartPolicy": "Never",
+            "containers": [
+                {
+                    "name": "master",
+                    "image": image,
+                    "command": [
+                        "python", "-m", "dlrover_tpu.master.main",
+                        "--platform", "k8s",
+                        "--job_name", name,
+                    ],
+                }
+            ],
+        },
+    }
+
+
+def worker_pod_manifest(job_name: str, node_id: int,
+                        resource: Optional[Dict] = None) -> Dict:
+    manifest = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": f"{job_name}-worker-{node_id}",
+            "labels": {
+                "job": job_name,
+                "node-type": NodeType.WORKER,
+                "node-id": str(node_id),
+                "app": "dlrover-tpu",
+            },
+        },
+        "spec": {
+            "restartPolicy": "Never",
+            "containers": [
+                {"name": "worker", "image": "python:3.12"}
+            ],
+        },
+    }
+    if resource:
+        manifest["spec"]["containers"][0]["resources"] = {
+            "requests": dict(resource)
+        }
+    return manifest
+
+
+class ElasticJobController:
+    """Poll-and-reconcile controller for both CRDs."""
+
+    def __init__(self, client, resync_interval: float = 5.0):
+        self._client = client
+        self._interval = resync_interval
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- ElasticJob ------------------------------------------------------
+    def reconcile_elasticjob(self, job: Dict):
+        """Ensure the job's master pod exists (the master then owns
+        worker lifecycle through its scaler — exactly the reference
+        split: operator creates the master, master creates workers)."""
+        name = job["metadata"]["name"]
+        phase = (job.get("status") or {}).get("phase", "")
+        if phase in ("Succeeded", "Failed"):
+            return
+        master_name = f"{name}{MASTER_SUFFIX}"
+        pods = self._pods_by_name(f"job={name}")
+        if master_name not in pods:
+            logger.info("reconcile ElasticJob %s: creating master", name)
+            self._client.create_pod(master_pod_manifest(job))
+            self._set_status(
+                ELASTICJOB_PLURAL, name, {"phase": "Running"}
+            )
+
+    # -- ScalePlan -------------------------------------------------------
+    def reconcile_scaleplan(self, plan: Dict):
+        """Apply a ScalePlan: replica targets, explicit creates,
+        removals and migrations (ref ``scaleplan_controller.go:95``)."""
+        name = plan["metadata"]["name"]
+        status = plan.get("status") or {}
+        if status.get("phase") == "Succeeded":
+            return
+        spec = plan.get("spec", {})
+        owner = spec.get("ownerJob", "")
+
+        # replica targets: diff current worker pods against the target
+        replica_specs = spec.get("replicaResourceSpecs", {}) or {}
+        worker_target = replica_specs.get(NodeType.WORKER, {})
+        target = worker_target.get("replicas")
+        if target is not None:
+            self._scale_workers(
+                owner, int(target), worker_target.get("resource")
+            )
+
+        for pod in spec.get("createPods", []) or []:
+            self._client.create_pod(
+                worker_pod_manifest(
+                    owner,
+                    int(pod.get("id", self._next_worker_id(owner))),
+                    pod.get("resource"),
+                )
+            )
+        for pod_name in spec.get("removePods", []) or []:
+            self._delete_quietly(pod_name)
+        for old_name, res in (spec.get("migratePods") or {}).items():
+            # create the replacement first, then drain the old pod
+            self._client.create_pod(
+                worker_pod_manifest(
+                    owner, self._next_worker_id(owner),
+                    res if isinstance(res, dict) else None,
+                )
+            )
+            self._delete_quietly(old_name)
+        self._set_status(SCALEPLAN_PLURAL, name, {"phase": "Succeeded"})
+
+    def _scale_workers(self, job_name: str, target: int,
+                       resource: Optional[Dict]):
+        workers = self._worker_pods(job_name)
+        current = len(workers)
+        if current < target:
+            existing = {
+                int(p["metadata"]["labels"].get("node-id", -1))
+                for p in workers.values()
+            }
+            nid = 0
+            for _ in range(target - current):
+                while nid in existing:
+                    nid += 1
+                existing.add(nid)
+                self._client.create_pod(
+                    worker_pod_manifest(job_name, nid, resource)
+                )
+        elif current > target:
+            # remove the highest node-ids first (stable rank prefix)
+            doomed = sorted(
+                workers.values(),
+                key=lambda p: int(
+                    p["metadata"]["labels"].get("node-id", 0)
+                ),
+                reverse=True,
+            )[: current - target]
+            for pod in doomed:
+                self._delete_quietly(pod["metadata"]["name"])
+
+    # -- loop ------------------------------------------------------------
+    def reconcile_once(self):
+        for job in self._list(ELASTICJOB_PLURAL):
+            try:
+                self.reconcile_elasticjob(job)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("ElasticJob reconcile failed: %s", e)
+        for plan in self._list(SCALEPLAN_PLURAL):
+            try:
+                self.reconcile_scaleplan(plan)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("ScalePlan reconcile failed: %s", e)
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="elasticjob-controller", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stopped.set()
+
+    def _loop(self):
+        while not self._stopped.wait(self._interval):
+            try:
+                self.reconcile_once()
+            except Exception as e:  # noqa: BLE001
+                logger.warning("reconcile cycle failed: %s", e)
+
+    # -- client helpers --------------------------------------------------
+    def _list(self, plural: str) -> List[Dict]:
+        try:
+            out = self._client.list_custom_resource(
+                GROUP, VERSION, plural
+            )
+        except Exception:  # noqa: BLE001
+            return []
+        return list(out.get("items", []))
+
+    def _set_status(self, plural: str, name: str, status: Dict):
+        try:
+            self._client.update_custom_resource_status(
+                GROUP, VERSION, plural, name, {"status": status}
+            )
+        except Exception as e:  # noqa: BLE001
+            logger.warning("status update failed for %s: %s", name, e)
+
+    def _pods_by_name(self, selector: str) -> Dict[str, Dict]:
+        pods = self._client.list_pods(selector)
+        if isinstance(pods, dict):
+            items = pods.get("items", [])
+        else:  # kubernetes client object (V1PodList)
+            items = pods.items
+        out = {}
+        for p in items:
+            d = p if isinstance(p, dict) else p.to_dict()
+            out[d["metadata"]["name"]] = d
+        return out
+
+    def _worker_pods(self, job_name: str) -> Dict[str, Dict]:
+        return self._pods_by_name(
+            f"job={job_name},node-type={NodeType.WORKER}"
+        )
+
+    def _next_worker_id(self, job_name: str) -> int:
+        ids = [
+            int(p["metadata"]["labels"].get("node-id", -1))
+            for p in self._worker_pods(job_name).values()
+        ]
+        return (max(ids) + 1) if ids else 0
+
+    def _delete_quietly(self, pod_name: str):
+        try:
+            self._client.delete_pod(pod_name)
+        except Exception as e:  # noqa: BLE001
+            logger.warning("delete %s failed: %s", pod_name, e)

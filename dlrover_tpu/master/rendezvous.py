@@ -47,6 +47,21 @@ class RendezvousManager(metaclass=ABCMeta):
         self._start_rdzv_time = 0.0
         self._latest_rdzv_nodes: List[int] = []
         self._ckpt_steps: Dict[int, int] = {}
+        # node_rank -> interconnect hierarchy labels (outermost first);
+        # fed by NodeTopology reports, consumed at round completion
+        self._node_topology: Dict[int, tuple] = {}
+
+    def set_node_topology(self, node_rank: int, levels: tuple):
+        with self._lock:
+            self._node_topology[node_rank] = tuple(levels)
+
+    def _topology_order(self, ranks: List[int]) -> List[int]:
+        """Caller holds the lock."""
+        if not self._node_topology:
+            return ranks
+        from dlrover_tpu.master.net_topology import order_by_topology
+
+        return order_by_topology(ranks, self._node_topology)
 
     def update_rdzv_params(self, min_nodes: int, max_nodes: int,
                            waiting_timeout: float, node_unit: int):
@@ -112,6 +127,11 @@ class RendezvousManager(metaclass=ABCMeta):
             usable = (waiting // self._node_unit) * self._node_unit
             usable = min(usable, self._rdzv_params.max_nodes)
             ranks = sorted(self._waiting_nodes.keys())[:usable]
+            # topology-aware ordering: neighbors on the interconnect
+            # get adjacent global ranks (the world dict's insertion
+            # order IS the rank order the agents apply); numeric order
+            # when no topology was reported
+            ranks = self._topology_order(ranks)
             self._rdzv_nodes = {
                 r: self._waiting_nodes[r] for r in ranks
             }

@@ -1,20 +1,30 @@
-"""Resource optimization: throughput-driven scale plans.
+"""Resource optimization: the Brain algorithm set for allreduce jobs.
 
-Reference parity: ``dlrover/python/master/resource/optimizer.py``
-(``ResourceOptimizer`` ABC), ``local_optimizer.py:66``
-(``PSLocalOptimizer``: stage-based plans, worker-speed-ratio scaling
-``:250``, OOM recovery ``:98``) and the Go Brain's
-``optimize_job_worker_resource.go`` linear-throughput extrapolation.
+Reference parity: the Go Brain service's optimizer-algorithm plugin
+registry (``dlrover/go/brain/pkg/optimizer/implementation/
+optalgorithm/``) restricted to the allreduce-relevant set, plus
+``dlrover/python/master/resource/local_optimizer.py:66``
+(``PSLocalOptimizer`` stage plans / OOM recovery ``:98`` /
+worker-speed-ratio scaling ``:250``):
+
+- worker-create        (``optimize_job_worker_create_resource.go``):
+  the initial scale plan.
+- worker-resource      (``optimize_job_worker_resource.go:400``):
+  linear-model throughput extrapolation from SpeedMonitor samples —
+  grow while the marginal speedup stays near-linear, settle back to
+  the best-known world size on diminishing returns.
+- worker-oom           (``optimize_job_worker_create_oom_resource.go``):
+  relaunch OOM-killed workers with grown host memory.
+- straggler-migrate    (``optimize_job_hot_ps_resource.go`` dual for
+  allreduce): migrate nodes the network-check rounds flagged slow.
 
 TPU form: the unit of scaling is a whole TPU-VM worker (chips come in
-fixed slices), so plans adjust *worker count* within [min, max] using
-the marginal-throughput estimate from SpeedMonitor samples, plus the
-OOM ladder (grow host memory for the relaunched worker).
+fixed slices), so plans adjust *worker count* within [min, max].
 """
 
 from abc import ABCMeta, abstractmethod
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 from dlrover_tpu.common.constants import NodeType
 from dlrover_tpu.common.log import default_logger as logger
@@ -27,25 +37,203 @@ class SpeedSample:
     records_per_sec: float
 
 
+class JobStage:
+    CREATE = "create"
+    RUNNING = "running"
+
+
+@dataclass
+class JobMeta:
+    """Everything an optimize algorithm may consult — the Brain's
+    datastore row for one job, assembled by the auto-scaler each
+    cycle."""
+
+    stage: str = JobStage.RUNNING
+    min_workers: int = 1
+    max_workers: int = 1
+    current_workers: int = 0
+    # best observed throughput per world size (records/sec)
+    speed_samples: Dict[int, float] = field(default_factory=dict)
+    # node names the health-check rounds flagged as stragglers
+    stragglers: List[str] = field(default_factory=list)
+    # node name -> current memory MB for OOM-killed workers
+    oom_nodes: Dict[str, int] = field(default_factory=dict)
+
+
+class OptimizeAlgorithm(metaclass=ABCMeta):
+    """One pluggable optimization rule (Brain's ``OptimizeAlgorithm``
+    interface; plugins registered by name)."""
+
+    name: str = ""
+
+    @abstractmethod
+    def optimize(self, meta: JobMeta) -> Optional[ScalePlan]:
+        ...
+
+
+_ALGORITHMS: Dict[str, type] = {}
+
+
+def register_algorithm(cls):
+    _ALGORITHMS[cls.name] = cls
+    return cls
+
+
+def get_algorithm(name: str) -> Optional[type]:
+    return _ALGORITHMS.get(name)
+
+
+@register_algorithm
+class WorkerCreateResource(OptimizeAlgorithm):
+    """Initial plan: launch the full worker window (elasticity shrinks
+    later if throughput says so)."""
+
+    name = "optimize_worker_create_resource"
+
+    def optimize(self, meta: JobMeta) -> Optional[ScalePlan]:
+        if meta.stage != JobStage.CREATE:
+            return None
+        plan = ScalePlan()
+        plan.node_group_resources[NodeType.WORKER] = {
+            "count": meta.max_workers
+        }
+        return plan
+
+
+@register_algorithm
+class WorkerResource(OptimizeAlgorithm):
+    """Throughput-driven worker-count tuning.
+
+    The stop/settle decision uses the LOCAL throughput slope between
+    the two largest observed world sizes (the reference's
+    worker-speed-ratio compares speed before/after the last grow
+    step): while the marginal throughput of one more worker stays
+    above ``min_marginal_gain x (current per-worker speed)``, grow by
+    up to 25% of the current size per cycle; once returns diminish,
+    settle at the best-known size and stop growing."""
+
+    name = "optimize_worker_resource"
+
+    def __init__(self, min_marginal_gain: float = 0.6,
+                 growth_ratio: float = 0.25):
+        self._gain = min_marginal_gain
+        self._growth = growth_ratio
+
+    @staticmethod
+    def _best_known(meta: JobMeta) -> int:
+        best_n, best_v = meta.min_workers, 0.0
+        for n, v in meta.speed_samples.items():
+            if v > best_v:
+                best_n, best_v = n, v
+        return best_n
+
+    def optimize(self, meta: JobMeta) -> Optional[ScalePlan]:
+        if meta.stage != JobStage.RUNNING:
+            return None
+        samples = meta.speed_samples
+        if not samples:
+            return None
+        sizes = sorted(samples)
+        current = sizes[-1]
+        if len(sizes) >= 2:
+            # stop/settle decision uses the LOCAL slope between the two
+            # largest observed sizes (the reference's worker-speed-ratio
+            # compares speed before/after the last grow step) — a global
+            # least-squares fit smooths the knee away and keeps growing
+            # past it
+            n0, n1 = sizes[-2], sizes[-1]
+            local_slope = (samples[n1] - samples[n0]) / (n1 - n0)
+            per_worker_now = samples[current] / current
+            # marginal value of one more worker, as a fraction of the
+            # current per-worker throughput (1.0 == perfectly linear)
+            marginal = local_slope / max(per_worker_now, 1e-9)
+            if marginal < self._gain:
+                best_n = self._best_known(meta)
+                if best_n < current:
+                    plan = ScalePlan()
+                    plan.node_group_resources[NodeType.WORKER] = {
+                        "count": max(best_n, meta.min_workers)
+                    }
+                    logger.info(
+                        "scale back to %d workers (marginal %.2f)",
+                        best_n, marginal,
+                    )
+                    return plan
+                return None  # diminishing returns: stop growing
+        if current < meta.max_workers:
+            step = max(1, int(current * self._growth))
+            plan = ScalePlan()
+            plan.node_group_resources[NodeType.WORKER] = {
+                "count": min(current + step, meta.max_workers)
+            }
+            return plan
+        return None
+
+
+@register_algorithm
+class WorkerOomResource(OptimizeAlgorithm):
+    """Relaunch OOM-killed workers with grown host memory
+    (reference ``local_optimizer.py:98`` OOM ladder)."""
+
+    name = "optimize_worker_oom_resource"
+
+    def __init__(self, oom_memory_factor: float = 1.5):
+        self._factor = oom_memory_factor
+
+    def optimize(self, meta: JobMeta) -> Optional[ScalePlan]:
+        if not meta.oom_nodes:
+            return None
+        plan = ScalePlan()
+        for node, memory_mb in meta.oom_nodes.items():
+            plan.remove_nodes.append(node)
+            plan.launch_nodes.append(
+                {
+                    "type": NodeType.WORKER,
+                    "memory": int(memory_mb * self._factor),
+                }
+            )
+        return plan
+
+
+@register_algorithm
+class StragglerMigrate(OptimizeAlgorithm):
+    """Migrate nodes the health-check rounds flagged slow (the
+    allreduce dual of hot-PS migration: a synchronous mesh runs at the
+    slowest node's speed, so one straggler taxes the whole job)."""
+
+    name = "optimize_straggler_migrate"
+
+    def optimize(self, meta: JobMeta) -> Optional[ScalePlan]:
+        if not meta.stragglers:
+            return None
+        plan = ScalePlan()
+        for node in meta.stragglers:
+            plan.migrate_nodes[str(node)] = {"type": NodeType.WORKER}
+        return plan
+
+
+def merge_plans(plans: List[Optional[ScalePlan]]) -> Optional[ScalePlan]:
+    merged = ScalePlan()
+    for p in plans:
+        if p is None:
+            continue
+        merged.node_group_resources.update(p.node_group_resources)
+        merged.launch_nodes.extend(p.launch_nodes)
+        merged.remove_nodes.extend(p.remove_nodes)
+        merged.migrate_nodes.update(p.migrate_nodes)
+    return None if merged.is_empty() else merged
+
+
 class ResourceOptimizer(metaclass=ABCMeta):
     @abstractmethod
     def generate_plan(self, stage: str) -> Optional[ScalePlan]:
         ...
 
 
-class JobStage:
-    CREATE = "create"
-    RUNNING = "running"
-
-
 class LocalAllreduceOptimizer(ResourceOptimizer):
-    """Worker-count optimizer from observed throughput scaling.
-
-    Strategy (mirrors the reference's worker-speed-ratio logic): keep a
-    throughput sample per world size; scale up while the marginal
-    speedup of the last grow step exceeded ``min_marginal_gain`` of
-    linear; scale back to the best-known size otherwise.
-    """
+    """The local Brain: runs the registered algorithm set against the
+    job's observed state (reference ``BrainResoureOptimizer`` role
+    without the external service; same algorithms, in-process)."""
 
     def __init__(
         self,
@@ -56,74 +244,57 @@ class LocalAllreduceOptimizer(ResourceOptimizer):
     ):
         self._min = min_workers
         self._max = max_workers
-        self._gain = min_marginal_gain
-        self._oom_factor = oom_memory_factor
         self._samples: Dict[int, float] = {}
+        self._stragglers: List[str] = []
+        self._oom_nodes: Dict[str, int] = {}
+        self._algorithms: List[OptimizeAlgorithm] = [
+            WorkerCreateResource(),
+            WorkerResource(min_marginal_gain=min_marginal_gain),
+            WorkerOomResource(oom_memory_factor=oom_memory_factor),
+            StragglerMigrate(),
+        ]
+        self._oom_factor = oom_memory_factor
 
+    # -- observation feeds (the Brain's datastore writes) ---------------
     def record_speed(self, worker_num: int, records_per_sec: float):
         if worker_num <= 0 or records_per_sec <= 0:
             return
-        # keep the best observed throughput per world size
         prev = self._samples.get(worker_num, 0.0)
         self._samples[worker_num] = max(prev, records_per_sec)
 
-    def _best_known(self) -> Tuple[int, float]:
-        best_n, best_v = self._min, 0.0
-        for n, v in self._samples.items():
-            if v > best_v:
-                best_n, best_v = n, v
-        return best_n, best_v
+    def report_stragglers(self, nodes: List[str]):
+        self._stragglers = list(nodes)
+
+    def report_oom(self, node_name: str, current_memory_mb: int):
+        self._oom_nodes[node_name] = current_memory_mb
+
+    # -- plan generation -------------------------------------------------
+    def _meta(self, stage: str) -> JobMeta:
+        sizes = sorted(self._samples)
+        return JobMeta(
+            stage=stage,
+            min_workers=self._min,
+            max_workers=self._max,
+            current_workers=sizes[-1] if sizes else 0,
+            speed_samples=dict(self._samples),
+            stragglers=list(self._stragglers),
+            oom_nodes=dict(self._oom_nodes),
+        )
 
     def generate_plan(self, stage: str) -> Optional[ScalePlan]:
-        if stage == JobStage.CREATE:
-            plan = ScalePlan()
-            plan.node_group_resources[NodeType.WORKER] = {
-                "count": self._max
-            }
-            return plan
-        if not self._samples:
-            return None
-        sizes = sorted(self._samples)
-        current = sizes[-1]
-        if len(sizes) >= 2:
-            n0, n1 = sizes[-2], sizes[-1]
-            v0, v1 = self._samples[n0], self._samples[n1]
-            linear = v0 * n1 / n0
-            marginal = (v1 - v0) / max(linear - v0, 1e-9)
-            if marginal < self._gain:
-                # diminishing returns: settle at the best-known size,
-                # never grow further
-                best_n, _ = self._best_known()
-                if best_n < current:
-                    plan = ScalePlan()
-                    plan.node_group_resources[NodeType.WORKER] = {
-                        "count": max(best_n, self._min)
-                    }
-                    logger.info(
-                        "scale back to %d workers (marginal %.2f)",
-                        best_n,
-                        marginal,
-                    )
-                    return plan
-                return None
-        if current < self._max:
-            plan = ScalePlan()
-            plan.node_group_resources[NodeType.WORKER] = {
-                "count": min(current + 1, self._max)
-            }
-            return plan
-        return None
+        meta = self._meta(stage)
+        plans = [alg.optimize(meta) for alg in self._algorithms]
+        plan = merge_plans(plans)
+        # one-shot signals are consumed by the plan they produced
+        self._stragglers = []
+        self._oom_nodes = {}
+        return plan
 
     def oom_recovery_plan(self, node_name: str,
                           current_memory_mb: int) -> ScalePlan:
-        """Relaunch an OOM-killed worker with grown host memory
-        (reference ``local_optimizer.py:98``)."""
-        plan = ScalePlan()
-        plan.remove_nodes.append(node_name)
-        plan.launch_nodes.append(
-            {
-                "type": NodeType.WORKER,
-                "memory": int(current_memory_mb * self._oom_factor),
-            }
-        )
+        """Immediate OOM relaunch plan (outside the periodic cycle)."""
+        self.report_oom(node_name, current_memory_mb)
+        meta = self._meta(JobStage.RUNNING)
+        plan = WorkerOomResource(self._oom_factor).optimize(meta)
+        self._oom_nodes = {}
         return plan

@@ -199,6 +199,17 @@ class MasterServicer:
                     request.node_type, request.node_id, request.addr
                 )
             return True
+        if isinstance(request, msg.NodeTopology):
+            manager = self._rdzv_managers.get(
+                RendezvousName.ELASTIC_TRAINING
+            )
+            if manager is not None and hasattr(
+                manager, "set_node_topology"
+            ):
+                manager.set_node_topology(
+                    request.node_rank, tuple(request.levels)
+                )
+            return True
         if isinstance(request, msg.NetworkStatus):
             manager = self._rdzv_managers.get(
                 RendezvousName.NETWORK_CHECK

@@ -114,6 +114,19 @@ class k8sClient:
             group, version, self.namespace, plural, name
         )
 
+    def list_custom_resource(self, group: str, version: str,
+                             plural: str):
+        return self.custom.list_namespaced_custom_object(
+            group, version, self.namespace, plural
+        )
+
+    def update_custom_resource_status(self, group: str, version: str,
+                                      plural: str, name: str,
+                                      body: Dict):
+        return self.custom.patch_namespaced_custom_object_status(
+            group, version, self.namespace, plural, name, body
+        )
+
 
 def new_job_args(platform: str = "local", job_name: str = "job",
                  **kwargs) -> JobArgs:

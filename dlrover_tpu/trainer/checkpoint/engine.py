@@ -100,8 +100,11 @@ class CheckpointEngine:
         self._name = name
         self._storage = storage or get_checkpoint_storage()
         self._local_saver: Optional[AsyncCheckpointSaver] = None
-        # cross-rank restore-step consensus hook: (local_best) -> agreed
-        # step; default uses a jax multihost allgather when distributed
+        # cross-rank restore-step consensus hook:
+        # (avail_row: List[int]) -> agreed step, where avail_row is
+        # this rank's full availability set (shm slots + storage step,
+        # -1 padded); default uses a jax multihost allgather when
+        # distributed
         self._step_sync_fn = step_sync_fn
         self._snapshot_thread = None
         self._last_drain_ok = True
@@ -372,9 +375,10 @@ class CheckpointEngine:
         width = SharedMemoryHandler.NUM_SLOTS + 1
         avail += [-1] * (width - len(avail))
         if self._step_sync_fn is not None:
-            return self._step_sync_fn(
-                shm_steps[0] if shm_steps else -1, storage_step
-            )
+            # the hook sees the FULL availability row — a consensus
+            # restricted to the newest shm slot could pick a step this
+            # rank only holds in its second buffer
+            return self._step_sync_fn(avail)
         import jax
 
         if jax.process_count() <= 1:

@@ -1,0 +1,229 @@
+"""ElasticJob/ScalePlan controller + topology-aware rank sorting.
+
+Reference parity tests: the Go operator's envtest suite
+(``dlrover/go/operator/pkg/controllers/suite_test.go``) behaviors —
+ElasticJob reconcile creates the master pod
+(``elasticjob_controller.go:182``), ScalePlan reconcile applies replica
+specs / create / remove / migrate (``scaleplan_controller.go:95``) —
+against a fake in-memory k8s client; and ``DpTopologySorter``
+(``net_topology.py:50``) rank ordering.
+"""
+
+import sys
+import os
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from dlrover_tpu.master.controller import (  # noqa: E402
+    ELASTICJOB_PLURAL,
+    GROUP,
+    MASTER_SUFFIX,
+    SCALEPLAN_PLURAL,
+    ElasticJobController,
+)
+from dlrover_tpu.master.net_topology import (  # noqa: E402
+    DpTopologySorter,
+    NodeTopologyMeta,
+    StaticTopologyQuerier,
+    order_by_topology,
+)
+
+
+class FakeK8sClient:
+    """In-memory pods + CRD store matching the duck-typed surface."""
+
+    def __init__(self):
+        self.pods = {}  # name -> manifest
+        self.crds = {ELASTICJOB_PLURAL: {}, SCALEPLAN_PLURAL: {}}
+
+    # pods
+    def create_pod(self, manifest):
+        self.pods[manifest["metadata"]["name"]] = manifest
+
+    def delete_pod(self, name):
+        self.pods.pop(name, None)
+
+    def list_pods(self, label_selector=""):
+        wanted = dict(
+            kv.split("=") for kv in label_selector.split(",") if kv
+        )
+        items = [
+            p
+            for p in self.pods.values()
+            if all(
+                p["metadata"]["labels"].get(k) == v
+                for k, v in wanted.items()
+            )
+        ]
+        return {"items": items}
+
+    # CRDs
+    def add_crd(self, plural, obj):
+        self.crds[plural][obj["metadata"]["name"]] = obj
+
+    def list_custom_resource(self, group, version, plural):
+        return {"items": list(self.crds[plural].values())}
+
+    def update_custom_resource_status(
+        self, group, version, plural, name, body
+    ):
+        self.crds[plural][name].setdefault("status", {}).update(
+            body["status"]
+        )
+
+
+def make_job(name="job1", replicas=2):
+    return {
+        "metadata": {"name": name, "uid": "u1"},
+        "spec": {
+            "replicaSpecs": {
+                "worker": {
+                    "replicas": replicas,
+                    "template": {
+                        "spec": {"containers": [{"image": "img:1"}]}
+                    },
+                }
+            }
+        },
+    }
+
+
+class TestElasticJobReconcile:
+    def test_creates_master_pod(self):
+        client = FakeK8sClient()
+        client.add_crd(ELASTICJOB_PLURAL, make_job())
+        ctl = ElasticJobController(client)
+        ctl.reconcile_once()
+        master = client.pods.get(f"job1{MASTER_SUFFIX}")
+        assert master is not None
+        assert master["spec"]["containers"][0]["image"] == "img:1"
+        assert "dlrover_tpu.master.main" in " ".join(
+            master["spec"]["containers"][0]["command"]
+        )
+        assert (
+            client.crds[ELASTICJOB_PLURAL]["job1"]["status"]["phase"]
+            == "Running"
+        )
+        # idempotent: a second pass creates nothing new
+        n = len(client.pods)
+        ctl.reconcile_once()
+        assert len(client.pods) == n
+
+    def test_finished_job_not_reconciled(self):
+        client = FakeK8sClient()
+        job = make_job()
+        job["status"] = {"phase": "Succeeded"}
+        client.add_crd(ELASTICJOB_PLURAL, job)
+        ElasticJobController(client).reconcile_once()
+        assert not client.pods
+
+
+class TestScalePlanReconcile:
+    def _plan(self, name="plan1", **spec):
+        return {"metadata": {"name": name}, "spec": dict(spec)}
+
+    def test_replica_target_scales_up_and_down(self):
+        client = FakeK8sClient()
+        ctl = ElasticJobController(client)
+        client.add_crd(
+            SCALEPLAN_PLURAL,
+            self._plan(
+                ownerJob="job1",
+                replicaResourceSpecs={"worker": {"replicas": 3}},
+            ),
+        )
+        ctl.reconcile_once()
+        workers = client.list_pods("job=job1,node-type=worker")["items"]
+        assert len(workers) == 3
+        assert (
+            client.crds[SCALEPLAN_PLURAL]["plan1"]["status"]["phase"]
+            == "Succeeded"
+        )
+        # scale down via a second plan: highest node-ids removed
+        client.add_crd(
+            SCALEPLAN_PLURAL,
+            self._plan(
+                name="plan2",
+                ownerJob="job1",
+                replicaResourceSpecs={"worker": {"replicas": 1}},
+            ),
+        )
+        ctl.reconcile_once()
+        workers = client.list_pods("job=job1,node-type=worker")["items"]
+        assert len(workers) == 1
+        assert workers[0]["metadata"]["labels"]["node-id"] == "0"
+
+    def test_remove_and_migrate(self):
+        client = FakeK8sClient()
+        ctl = ElasticJobController(client)
+        client.add_crd(
+            SCALEPLAN_PLURAL,
+            self._plan(
+                ownerJob="j",
+                replicaResourceSpecs={"worker": {"replicas": 2}},
+            ),
+        )
+        ctl.reconcile_once()
+        client.add_crd(
+            SCALEPLAN_PLURAL,
+            self._plan(
+                name="mig",
+                ownerJob="j",
+                migratePods={"j-worker-0": {"cpu": "4"}},
+            ),
+        )
+        ctl.reconcile_once()
+        names = set(client.pods)
+        assert "j-worker-0" not in names  # old pod drained
+        assert len(
+            client.list_pods("job=j,node-type=worker")["items"]
+        ) == 2  # replacement created first
+
+
+class TestTopologySort:
+    def test_order_by_topology_groups_switches(self):
+        levels = {
+            0: ("pod1", "slice0"),
+            1: ("pod0", "slice1"),
+            2: ("pod0", "slice1"),
+            3: ("pod1", "slice0"),
+            4: (),  # unknown topology: appended last, numeric order
+        }
+        assert order_by_topology([0, 1, 2, 3, 4], levels) == [
+            1, 2, 0, 3, 4,
+        ]
+
+    def test_dp_sorter_renumbers(self):
+        nodes = {
+            0: NodeTopologyMeta(node_rank=0, levels=("b", "x")),
+            1: NodeTopologyMeta(node_rank=1, levels=("a", "y")),
+            2: NodeTopologyMeta(node_rank=2, levels=("a", "y")),
+        }
+        out = DpTopologySorter().sort(nodes)
+        assert [m.levels for m in out.values()] == [
+            ("a", "y"), ("a", "y"), ("b", "x"),
+        ]
+        assert list(out.keys()) == [0, 1, 2]
+
+    def test_static_querier(self):
+        q = StaticTopologyQuerier({"n0": ("pod0", "slice1")})
+        assert q.query("n0") == ("pod0", "slice1")
+        assert q.query("nope") is None
+
+    def test_rendezvous_orders_world_by_topology(self):
+        from dlrover_tpu.master.rendezvous import (
+            ElasticTrainingRendezvousManager,
+        )
+
+        mgr = ElasticTrainingRendezvousManager()
+        mgr.update_rdzv_params(4, 4, 0.1, 1)
+        mgr.set_node_topology(0, ("pod1",))
+        mgr.set_node_topology(1, ("pod0",))
+        mgr.set_node_topology(2, ("pod1",))
+        mgr.set_node_topology(3, ("pod0",))
+        for r in range(4):
+            mgr.join_rendezvous(r, 4)
+        rnd, group, world = mgr.get_comm_world(0)
+        assert list(world) == [1, 3, 0, 2]  # pod0 pair first

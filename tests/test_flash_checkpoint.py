@@ -151,8 +151,8 @@ class TestCheckpointerStandalone:
         )
 
         ckpt._engine._step_sync_fn = (
-            lambda shm, storage: _newest_common_step(
-                [[shm, storage], [1, 1]]
+            lambda avail: _newest_common_step(
+                [avail, [1, 1, 1]]
             )
         )
         step, restored = ckpt.load_checkpoint(target=newer)

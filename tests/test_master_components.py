@@ -93,6 +93,79 @@ class TestResourceOptimizer:
         assert plan.launch_nodes[0]["memory"] == 16384
 
 
+class TestBrainAlgorithms:
+    """The Brain optimizer-algorithm set (ref go/brain optalgorithm/)."""
+
+    def test_registry_has_algorithm_set(self):
+        from dlrover_tpu.master.resource_optimizer import get_algorithm
+
+        for name in (
+            "optimize_worker_create_resource",
+            "optimize_worker_resource",
+            "optimize_worker_oom_resource",
+            "optimize_straggler_migrate",
+        ):
+            assert get_algorithm(name) is not None
+
+    def test_scale_up_stops_at_diminishing_returns(self):
+        """Synthetic speed curve with a knee at 4 workers: growth stops
+        there even though max_workers allows 16 (ref
+        optimize_job_worker_resource.go:400 linear extrapolation)."""
+        from dlrover_tpu.master.resource_optimizer import JobStage
+
+        opt = LocalAllreduceOptimizer(min_workers=1, max_workers=16)
+        # near-linear up to 4, flat after
+        curve = {1: 100.0, 2: 195.0, 3: 288.0, 4: 375.0}
+        for n, v in curve.items():
+            opt.record_speed(n, v)
+        plan = opt.generate_plan(JobStage.RUNNING)
+        assert plan is not None  # still near-linear: grow
+        grown = plan.node_group_resources[NodeType.WORKER]["count"]
+        assert 4 < grown <= 16
+        # after growing, throughput barely moves: growth must stop
+        opt.record_speed(grown, 385.0)
+        plan = opt.generate_plan(JobStage.RUNNING)
+        if plan is not None:
+            count = plan.node_group_resources[NodeType.WORKER]["count"]
+            assert count <= grown  # settle/shrink, never grow further
+
+    def test_straggler_migrate_plan(self):
+        from dlrover_tpu.master.resource_optimizer import JobStage
+
+        opt = LocalAllreduceOptimizer(min_workers=1, max_workers=4)
+        opt.report_stragglers(["3"])
+        plan = opt.generate_plan(JobStage.RUNNING)
+        assert plan is not None and "3" in plan.migrate_nodes
+        # one-shot: consumed by the plan
+        assert opt.generate_plan(JobStage.RUNNING) is None
+
+    def test_auto_scaler_maps_straggler_rank_to_node_name(self):
+        from dlrover_tpu.common.node import Node
+        from dlrover_tpu.master.resource_optimizer import JobStage
+
+        class FakeRdzv:
+            def check_straggler(self):
+                return [7], ""
+
+        class FakeJobManager:
+            def get_running_nodes(self):
+                return [Node(node_id=7, name="worker-pod-7")]
+
+        opt = LocalAllreduceOptimizer(min_workers=1, max_workers=4)
+        scaler = InMemoryScaler()
+        auto = AllreduceAutoScaler(
+            opt,
+            scaler,
+            job_manager=FakeJobManager(),
+            rendezvous_manager=FakeRdzv(),
+            interval=3600,
+        )
+        auto._collect_stragglers()
+        plan = opt.generate_plan(JobStage.RUNNING)
+        # the plan carries the pod NAME the scaler can actually delete
+        assert plan is not None and "worker-pod-7" in plan.migrate_nodes
+
+
 class TestAutoScaler:
     def test_initial_plan_executes(self):
         opt = LocalAllreduceOptimizer(min_workers=1, max_workers=2)
