@@ -61,6 +61,10 @@ class AllreduceAutoScaler:
         worker_num = 0
         if self._job_manager is not None:
             worker_num = len(self._job_manager.get_running_nodes())
+        if worker_num > 0:
+            # the optimizer's settle decision needs the ACTUAL world
+            # size even when no fresh speed sample exists this cycle
+            self._optimizer.set_current_workers(worker_num)
         if speed > 0 and worker_num > 0:
             self._optimizer.record_speed(worker_num, speed)
 

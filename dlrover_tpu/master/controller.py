@@ -30,6 +30,17 @@ SCALEPLAN_PLURAL = "scaleplans"
 MASTER_SUFFIX = "-dlrover-master"
 
 
+def _pod_resource(node_spec: Dict) -> Optional[Dict]:
+    """Resource hints out of an optimizer node spec ({"type", "memory"
+    (MB), "cpu", ...}) — non-resource keys dropped."""
+    if not isinstance(node_spec, dict):
+        return None
+    resource = {
+        k: v for k, v in node_spec.items() if k in ("memory", "cpu")
+    }
+    return resource or None
+
+
 def master_pod_manifest(job: Dict) -> Dict:
     """Master pod for an ElasticJob (ref ``pkg/controllers/master/
     master.go`` — image/env from the job spec, master command)."""
@@ -80,9 +91,42 @@ def master_pod_manifest(job: Dict) -> Dict:
     }
 
 
-def worker_pod_manifest(job_name: str, node_id: int,
-                        resource: Optional[Dict] = None) -> Dict:
-    manifest = {
+def worker_pod_manifest(
+    job_name: str,
+    node_id: int,
+    resource: Optional[Dict] = None,
+    template: Optional[Dict] = None,
+) -> Dict:
+    """Worker pod from the ElasticJob's worker template (image /
+    command / env carried over, like ``TpuPodScaler._pod_manifest``),
+    plus the rank contract env vars agents expect."""
+    tmpl_spec = (template or {}).get("spec", {}) or {}
+    containers = tmpl_spec.get("containers") or [{}]
+    base = dict(containers[0]) if containers else {}
+    container = {
+        "name": base.get("name", "worker"),
+        "image": base.get("image", "python:3.12"),
+    }
+    for key in ("command", "args", "env", "resources"):
+        if base.get(key):
+            container[key] = base[key]
+    env = list(container.get("env", []))
+    env += [
+        {"name": "DLROVER_TPU_JOB_NAME", "value": job_name},
+        {"name": "NODE_RANK", "value": str(node_id)},
+    ]
+    container["env"] = env
+    if resource:
+        # optimizer resource hints: numbers are MB of host memory
+        requests = dict(
+            container.get("resources", {}).get("requests", {})
+        )
+        if "memory" in resource:
+            requests["memory"] = f"{int(resource['memory'])}Mi"
+        if "cpu" in resource:
+            requests["cpu"] = str(resource["cpu"])
+        container["resources"] = {"requests": requests}
+    return {
         "apiVersion": "v1",
         "kind": "Pod",
         "metadata": {
@@ -96,16 +140,9 @@ def worker_pod_manifest(job_name: str, node_id: int,
         },
         "spec": {
             "restartPolicy": "Never",
-            "containers": [
-                {"name": "worker", "image": "python:3.12"}
-            ],
+            "containers": [container],
         },
     }
-    if resource:
-        manifest["spec"]["containers"][0]["resources"] = {
-            "requests": dict(resource)
-        }
-    return manifest
 
 
 class ElasticJobController:
@@ -116,6 +153,10 @@ class ElasticJobController:
         self._interval = resync_interval
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # plans already applied by THIS controller: a failed Succeeded
+        # status patch must not re-execute create/migrate next resync
+        # (fresh worker ids each pass -> unbounded pod growth)
+        self._applied_plans: set = set()
 
     # -- ElasticJob ------------------------------------------------------
     def reconcile_elasticjob(self, job: Dict):
@@ -138,46 +179,84 @@ class ElasticJobController:
     # -- ScalePlan -------------------------------------------------------
     def reconcile_scaleplan(self, plan: Dict):
         """Apply a ScalePlan: replica targets, explicit creates,
-        removals and migrations (ref ``scaleplan_controller.go:95``)."""
+        removals and migrations (ref ``scaleplan_controller.go:95``).
+
+        Field dialect matches what the in-repo producers emit:
+        ``ElasticJobScaler`` writes the optimizer's
+        ``node_group_resources`` verbatim, so replica targets are
+        accepted as ``replicas`` OR ``count``; ``launch_nodes`` entries
+        carry ``{"type", "memory"(MB), ...}``; ``migratePods`` values
+        are node specs (``{"type": ...}``), not k8s resources."""
         name = plan["metadata"]["name"]
         status = plan.get("status") or {}
         if status.get("phase") == "Succeeded":
             return
+        if name in self._applied_plans:
+            # applied but the status patch failed: retry only the patch
+            self._set_status(
+                SCALEPLAN_PLURAL, name, {"phase": "Succeeded"}
+            )
+            return
         spec = plan.get("spec", {})
         owner = spec.get("ownerJob", "")
+        template = self._worker_template(owner)
 
         # replica targets: diff current worker pods against the target
         replica_specs = spec.get("replicaResourceSpecs", {}) or {}
         worker_target = replica_specs.get(NodeType.WORKER, {})
-        target = worker_target.get("replicas")
+        target = worker_target.get(
+            "replicas", worker_target.get("count")
+        )
         if target is not None:
             self._scale_workers(
-                owner, int(target), worker_target.get("resource")
+                owner, int(target), worker_target.get("resource"),
+                template,
             )
 
         for pod in spec.get("createPods", []) or []:
+            if "id" in pod:
+                node_id = int(pod["id"])
+            else:
+                node_id = self._next_worker_id(owner)
             self._client.create_pod(
                 worker_pod_manifest(
-                    owner,
-                    int(pod.get("id", self._next_worker_id(owner))),
-                    pod.get("resource"),
+                    owner, node_id, _pod_resource(pod), template
                 )
             )
         for pod_name in spec.get("removePods", []) or []:
             self._delete_quietly(pod_name)
-        for old_name, res in (spec.get("migratePods") or {}).items():
+        for old_name, node_spec in (spec.get("migratePods") or {}).items():
             # create the replacement first, then drain the old pod
             self._client.create_pod(
                 worker_pod_manifest(
-                    owner, self._next_worker_id(owner),
-                    res if isinstance(res, dict) else None,
+                    owner,
+                    self._next_worker_id(owner),
+                    _pod_resource(node_spec),
+                    template,
                 )
             )
             self._delete_quietly(old_name)
+        self._applied_plans.add(name)
         self._set_status(SCALEPLAN_PLURAL, name, {"phase": "Succeeded"})
 
+    def _worker_template(self, job_name: str) -> Optional[Dict]:
+        """The owner ElasticJob's worker pod template (workers must run
+        the job's image/command, not a placeholder)."""
+        if not job_name:
+            return None
+        for job in self._list(ELASTICJOB_PLURAL):
+            if job["metadata"]["name"] == job_name:
+                return (
+                    job.get("spec", {})
+                    .get("replicaSpecs", {})
+                    .get(NodeType.WORKER, {})
+                    .get("template")
+                )
+        return None
+
     def _scale_workers(self, job_name: str, target: int,
-                       resource: Optional[Dict]):
+                       resource: Optional[Dict],
+                       template: Optional[Dict] = None):
         workers = self._worker_pods(job_name)
         current = len(workers)
         if current < target:
@@ -191,7 +270,9 @@ class ElasticJobController:
                     nid += 1
                 existing.add(nid)
                 self._client.create_pod(
-                    worker_pod_manifest(job_name, nid, resource)
+                    worker_pod_manifest(
+                        job_name, nid, resource, template
+                    )
                 )
         elif current > target:
             # remove the highest node-ids first (stable rank prefix)

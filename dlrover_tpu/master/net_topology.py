@@ -61,31 +61,19 @@ def order_by_topology(ranks, levels_map: Dict[int, Tuple[str, ...]]):
 
 class DpTopologySorter:
     """Sort nodes so interconnect neighbors get adjacent ranks (ref
-    ``DpTopologySorter:50``): group by hierarchy labels outermost-in;
-    nodes with unknown topology keep their original relative order at
-    the end (never block the job on missing metadata)."""
+    ``DpTopologySorter:50``); thin object facade over
+    :func:`order_by_topology` (one ordering logic path)."""
 
     def sort(
         self, nodes: Dict[int, NodeTopologyMeta]
     ) -> Dict[int, NodeTopologyMeta]:
         """node_rank -> meta, returns the same metas re-ranked."""
-        known: List[Tuple[Tuple[str, ...], int, NodeTopologyMeta]] = []
-        unknown: List[Tuple[int, NodeTopologyMeta]] = []
-        for rank in sorted(nodes):
-            meta = nodes[rank]
-            if meta.levels:
-                known.append((meta.levels, rank, meta))
-            else:
-                unknown.append((rank, meta))
-        known.sort(key=lambda e: (e[0], e[1]))
+        order = order_by_topology(
+            sorted(nodes), {r: m.levels for r, m in nodes.items()}
+        )
         out: Dict[int, NodeTopologyMeta] = {}
-        new_rank = 0
-        for _, _, meta in known:
+        for new_rank, orig_rank in enumerate(order):
+            meta = nodes[orig_rank]
             meta.node_rank = new_rank
             out[new_rank] = meta
-            new_rank += 1
-        for _, meta in unknown:
-            meta.node_rank = new_rank
-            out[new_rank] = meta
-            new_rank += 1
         return out

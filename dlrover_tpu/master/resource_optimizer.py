@@ -120,12 +120,19 @@ class WorkerResource(OptimizeAlgorithm):
         self._growth = growth_ratio
 
     @staticmethod
-    def _best_known(meta: JobMeta) -> int:
-        best_n, best_v = meta.min_workers, 0.0
-        for n, v in meta.speed_samples.items():
-            if v > best_v:
-                best_n, best_v = n, v
-        return best_n
+    def _best_known(meta: JobMeta, tolerance: float = 0.05) -> int:
+        """The SMALLEST size within ``tolerance`` of the peak observed
+        throughput — scale-back exists to shed workers that buy almost
+        nothing, so near-ties resolve to fewer workers."""
+        if not meta.speed_samples:
+            return meta.min_workers
+        peak = max(meta.speed_samples.values())
+        ok = [
+            n
+            for n, v in meta.speed_samples.items()
+            if v >= (1.0 - tolerance) * peak
+        ]
+        return min(ok) if ok else meta.min_workers
 
     def optimize(self, meta: JobMeta) -> Optional[ScalePlan]:
         if meta.stage != JobStage.RUNNING:
@@ -134,7 +141,10 @@ class WorkerResource(OptimizeAlgorithm):
         if not samples:
             return None
         sizes = sorted(samples)
-        current = sizes[-1]
+        # the ACTUAL world size, not the max-ever-sampled one: after a
+        # settle the stale larger sample would otherwise re-emit the
+        # same scale-back plan every cycle forever
+        current = meta.current_workers or sizes[-1]
         if len(sizes) >= 2:
             # stop/settle decision uses the LOCAL slope between the two
             # largest observed sizes (the reference's worker-speed-ratio
@@ -143,13 +153,14 @@ class WorkerResource(OptimizeAlgorithm):
             # past it
             n0, n1 = sizes[-2], sizes[-1]
             local_slope = (samples[n1] - samples[n0]) / (n1 - n0)
-            per_worker_now = samples[current] / current
+            ref = current if current in samples else n1
+            per_worker_now = samples[ref] / ref
             # marginal value of one more worker, as a fraction of the
             # current per-worker throughput (1.0 == perfectly linear)
             marginal = local_slope / max(per_worker_now, 1e-9)
             if marginal < self._gain:
                 best_n = self._best_known(meta)
-                if best_n < current:
+                if best_n != current:
                     plan = ScalePlan()
                     plan.node_group_resources[NodeType.WORKER] = {
                         "count": max(best_n, meta.min_workers)
@@ -245,6 +256,7 @@ class LocalAllreduceOptimizer(ResourceOptimizer):
         self._min = min_workers
         self._max = max_workers
         self._samples: Dict[int, float] = {}
+        self._current_workers = 0
         self._stragglers: List[str] = []
         self._oom_nodes: Dict[str, int] = {}
         self._algorithms: List[OptimizeAlgorithm] = [
@@ -261,6 +273,11 @@ class LocalAllreduceOptimizer(ResourceOptimizer):
             return
         prev = self._samples.get(worker_num, 0.0)
         self._samples[worker_num] = max(prev, records_per_sec)
+        self._current_workers = worker_num
+
+    def set_current_workers(self, worker_num: int):
+        if worker_num > 0:
+            self._current_workers = worker_num
 
     def report_stragglers(self, nodes: List[str]):
         self._stragglers = list(nodes)
@@ -275,7 +292,8 @@ class LocalAllreduceOptimizer(ResourceOptimizer):
             stage=stage,
             min_workers=self._min,
             max_workers=self._max,
-            current_workers=sizes[-1] if sizes else 0,
+            current_workers=self._current_workers
+            or (sizes[-1] if sizes else 0),
             speed_samples=dict(self._samples),
             stragglers=list(self._stragglers),
             oom_nodes=dict(self._oom_nodes),

@@ -155,6 +155,71 @@ class TestScalePlanReconcile:
         assert len(workers) == 1
         assert workers[0]["metadata"]["labels"]["node-id"] == "0"
 
+    def test_scaler_dialect_count_and_template(self):
+        """Plans written by ElasticJobScaler use 'count' and the
+        workers must run the owner job's template, not a placeholder."""
+        client = FakeK8sClient()
+        client.add_crd(ELASTICJOB_PLURAL, make_job(name="jobx"))
+        ctl = ElasticJobController(client)
+        client.add_crd(
+            SCALEPLAN_PLURAL,
+            self._plan(
+                ownerJob="jobx",
+                replicaResourceSpecs={"worker": {"count": 2}},
+            ),
+        )
+        ctl.reconcile_once()
+        workers = client.list_pods("job=jobx,node-type=worker")["items"]
+        assert len(workers) == 2
+        c = workers[0]["spec"]["containers"][0]
+        assert c["image"] == "img:1"  # from the ElasticJob template
+        env = {e["name"]: e["value"] for e in c["env"]}
+        assert env["DLROVER_TPU_JOB_NAME"] == "jobx"
+        assert "NODE_RANK" in env
+
+    def test_oom_launch_carries_memory(self):
+        client = FakeK8sClient()
+        ctl = ElasticJobController(client)
+        client.add_crd(
+            SCALEPLAN_PLURAL,
+            self._plan(
+                ownerJob="j2",
+                createPods=[{"type": "worker", "memory": 24576}],
+            ),
+        )
+        ctl.reconcile_once()
+        workers = client.list_pods("job=j2,node-type=worker")["items"]
+        reqs = workers[0]["spec"]["containers"][0]["resources"][
+            "requests"
+        ]
+        assert reqs["memory"] == "24576Mi"
+
+    def test_plan_not_reapplied_after_status_patch_failure(self):
+        client = FakeK8sClient()
+        fails = {"n": 0}
+        orig = client.update_custom_resource_status
+
+        def flaky(*args, **kwargs):
+            if fails["n"] == 0:
+                fails["n"] += 1
+                raise RuntimeError("transient apiserver error")
+            return orig(*args, **kwargs)
+
+        client.update_custom_resource_status = flaky
+        ctl = ElasticJobController(client)
+        client.add_crd(
+            SCALEPLAN_PLURAL,
+            self._plan(ownerJob="j3", createPods=[{"type": "worker"}]),
+        )
+        ctl.reconcile_once()  # applies; status patch fails
+        n_pods = len(client.pods)
+        ctl.reconcile_once()  # must only retry the patch, not re-create
+        assert len(client.pods) == n_pods
+        assert (
+            client.crds[SCALEPLAN_PLURAL]["plan1"]["status"]["phase"]
+            == "Succeeded"
+        )
+
     def test_remove_and_migrate(self):
         client = FakeK8sClient()
         ctl = ElasticJobController(client)

@@ -139,6 +139,23 @@ class TestBrainAlgorithms:
         # one-shot: consumed by the plan
         assert opt.generate_plan(JobStage.RUNNING) is None
 
+    def test_settled_size_does_not_reemit_plan(self):
+        """Once the world actually runs at the settled size, stale
+        larger samples must not re-emit the same plan every cycle."""
+        from dlrover_tpu.master.resource_optimizer import JobStage
+
+        opt = LocalAllreduceOptimizer(min_workers=1, max_workers=16)
+        opt.record_speed(4, 375.0)
+        opt.record_speed(8, 380.0)  # doubling bought ~nothing
+        plan = opt.generate_plan(JobStage.RUNNING)
+        assert plan is not None  # scale back to the best-known size 4
+        count = plan.node_group_resources[NodeType.WORKER]["count"]
+        assert count == 4
+        # after the world is actually AT the best-known size, the
+        # stale 8-worker sample must not re-emit the plan forever
+        opt.set_current_workers(4)
+        assert opt.generate_plan(JobStage.RUNNING) is None
+
     def test_auto_scaler_maps_straggler_rank_to_node_name(self):
         from dlrover_tpu.common.node import Node
         from dlrover_tpu.master.resource_optimizer import JobStage

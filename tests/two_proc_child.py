@@ -1,0 +1,117 @@
+"""Child process for the two-process jax.distributed test.
+
+Each rank: initialize jax.distributed on localhost CPU, run the
+checkpoint engine's REAL collective restore consensus (no injected
+step_sync_fn), then exercise a replica push + post-wipe gather over
+the TCP replica protocol.  Results land in a per-rank JSON file the
+parent asserts on.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+RANK = int(sys.argv[1])
+WORKDIR = sys.argv[2]
+COORD = sys.argv[3]
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=COORD, num_processes=2, process_id=RANK
+    )
+    import numpy as np
+
+    from dlrover_tpu.agent.replica import (
+        ReplicaManager,
+        ReplicaService,
+    )
+    from dlrover_tpu.trainer.checkpoint.engine import CheckpointEngine
+
+    result = {"rank": RANK}
+
+    # --- consensus over the real process_allgather ------------------
+    engine = CheckpointEngine(
+        checkpoint_dir=os.path.join(WORKDIR, "ckpt"),
+        process_rank=RANK,
+        process_count=2,
+        node_rank=RANK,  # two one-process "nodes" (the replica story)
+        local_shard_num=1,
+        name="twoproc",
+    )
+    state5 = {"w": np.full((8,), 5.0, dtype=np.float32)}
+    state6 = {"w": np.full((8,), 6.0, dtype=np.float32)}
+    engine.save_to_memory(5, state5)
+    engine.wait_for_snapshot()
+    if RANK == 0:
+        # rank 0 runs ahead: dual slots now hold {6, 5}; rank 1 holds
+        # only {5} — the agreed step must be 5, restored from rank 0's
+        # SECOND slot (the exact torn-shard scenario)
+        engine.save_to_memory(6, state6)
+        engine.wait_for_snapshot()
+    step, arrays = engine.load()
+    result["agreed_step"] = step
+    result["restored_value"] = (
+        float(next(iter(arrays.values()))[0]) if arrays else None
+    )
+    engine.close()
+
+    # --- replica push + post-wipe gather ----------------------------
+    service = ReplicaService(host="127.0.0.1")
+    service.start()
+    # publish ports through the filesystem (the master's NodeAddress
+    # registry in production)
+    with open(os.path.join(WORKDIR, f"replica_port_{RANK}"), "w") as f:
+        f.write(str(service.port))
+    deadline = time.time() + 30
+    ports = {}
+    while time.time() < deadline and len(ports) < 2:
+        for r in (0, 1):
+            p = os.path.join(WORKDIR, f"replica_port_{r}")
+            if r not in ports and os.path.exists(p):
+                content = open(p).read().strip()
+                if content:
+                    ports[r] = int(content)
+        time.sleep(0.05)
+    peers = {r: f"127.0.0.1:{p}" for r, p in ports.items()}
+
+    manager = ReplicaManager(
+        node_rank=RANK, service=service, peer_addrs_fn=lambda: peers
+    )
+    payload = f"shard-of-rank-{RANK}".encode() * 100
+    service.put_local(RANK, payload)
+    pushed = manager.backup(payload)
+    result["replicas_pushed"] = pushed
+
+    # barrier so both pushes land before any wipe
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices("replica_pushed")
+
+    if RANK == 1:
+        # simulate the relaunched node: local store wiped, shard must
+        # come back from the peer (reference replica.py gather:193)
+        service._store.clear()
+        restored = manager.restore()
+        result["replica_restored"] = (
+            restored == payload if restored is not None else False
+        )
+    multihost_utils.sync_global_devices("replica_done")
+    service.stop()
+
+    with open(os.path.join(WORKDIR, f"result_{RANK}.json"), "w") as f:
+        json.dump(result, f)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
