@@ -94,13 +94,18 @@ def fits_in_memory(
     batch_per_device: int = 1,
     headroom: float = 0.85,
     pipe: int = 1,
+    micro_steps: int = 1,
 ) -> Tuple[bool, float]:
     """Memory-fit model: params+opt shard over fsdp*tensor*pipe;
-    activations scale with the local batch.  Returns
-    (fits, utilization)."""
+    activations scale with the local batch divided by gradient-
+    accumulation micro steps.  Returns (fits, utilization)."""
     hbm = device_memory_bytes() * headroom
     shard = max(fsdp * tensor * pipe, 1)
     state = profile.train_state_bytes() / shard
-    acts = profile.activation_bytes_per_sample * batch_per_device
+    acts = (
+        profile.activation_bytes_per_sample
+        * batch_per_device
+        / max(micro_steps, 1)
+    )
     used = state + acts
     return used <= hbm, used / hbm

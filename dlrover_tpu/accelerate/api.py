@@ -15,7 +15,6 @@ from typing import Callable, Optional
 import jax
 
 from dlrover_tpu.accelerate.analyser import analyse_model
-from dlrover_tpu.accelerate.dry_runner import pick_best
 from dlrover_tpu.accelerate.strategy import Strategy, generate_candidates
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.parallel.mesh import create_parallel_mesh
@@ -72,8 +71,15 @@ def auto_accelerate(
     dry_run: bool = False,
     long_context: bool = False,
     moe: bool = False,
+    batch_per_replica: int = 1,
+    seq_len: int = 2048,
 ) -> AccelerateResult:
     """Args mirror ``build_train_step`` plus search knobs.
+
+    ``batch_per_replica``/``seq_len`` describe the actual workload —
+    the candidate cost model and the gradient-accumulation (micro
+    step) search evaluate at these values, so passing the real numbers
+    is what makes the ranking workload-aware.
 
     ``sample_batch_fn(batch_sharding) -> batch`` enables the timed dry
     run; without it (or with dry_run=False) the top-ranked memory-fit
@@ -92,6 +98,8 @@ def auto_accelerate(
             len(devices),
             long_context=long_context,
             moe=moe,
+            batch_per_replica=batch_per_replica,
+            seq_len=seq_len,
         )
         if not candidates:
             raise RuntimeError(
@@ -108,7 +116,9 @@ def auto_accelerate(
                 batch = sample_batch_fn(fns.batch_sharding)
                 return fns.train_step, state, batch
 
-            strategy, timings = pick_best(build, candidates)
+            from dlrover_tpu.accelerate.search import successive_halving
+
+            strategy, timings = successive_halving(build, candidates)
             if strategy is None:
                 strategy = candidates[0]
         else:

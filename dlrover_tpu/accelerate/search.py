@@ -1,0 +1,96 @@
+"""Strategy search over timed dry runs.
+
+Reference parity: the acceleration engine's strategy-generation
+search (``atorch/atorch/auto/engine/sg_algo/combination_sg.py``,
+``bayes_opt_sg.py`` + vendored HEBO).  The reference searches a large
+mixed space (wrap classes, fp modes, tunable knobs) where a GP
+surrogate earns its keep; a TPU strategy space is a handful of mesh
+factorizations already ranked by an analytic cost model, so the right
+search is **successive halving**: race all finalists for one cheap
+step, keep the best half, re-race the survivors with more steps —
+compile time dominates, so every candidate pays compilation exactly
+once and the extra steps only go to plausible winners.
+"""
+
+import math
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+
+from dlrover_tpu.accelerate.strategy import Strategy
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class _Runner:
+    """One built candidate: compiled step + live (donated) state.
+
+    The train step donates its state buffer, so the state must be
+    threaded across rounds — each timing call leaves the runner with
+    the latest state instead of rebuilding (and recompiling) the
+    candidate."""
+
+    def __init__(self, step_fn, state, batch):
+        self.step_fn = step_fn
+        self.state = state
+        self.batch = batch
+
+    def timed_steps(self, steps: int) -> float:
+        state, metrics = self.step_fn(self.state, self.batch)  # warmup
+        jax.block_until_ready(metrics)
+        start = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = self.step_fn(state, self.batch)
+        jax.block_until_ready(metrics)
+        self.state = state
+        return (time.perf_counter() - start) / steps
+
+
+def successive_halving(
+    build_fn: Callable,
+    candidates: List[Strategy],
+    max_candidates: int = 6,
+    first_steps: int = 1,
+    final_steps: int = 5,
+) -> Tuple[Optional[Strategy], Dict[str, List[float]]]:
+    """Race the top candidates, halving the field each round while
+    doubling the measured steps; every candidate compiles exactly once
+    (runners are cached across rounds).  Returns
+    (winner, {strategy: [per-round step seconds]})."""
+    field = list(candidates[:max_candidates])
+    runners: Dict[int, _Runner] = {}
+    timings: Dict[str, List[float]] = {}
+    steps = first_steps
+    rounds = max(1, math.ceil(math.log2(max(len(field), 1))))
+    for rnd in range(rounds):
+        scored = []
+        for s in field:
+            try:
+                runner = runners.get(id(s))
+                if runner is None:
+                    runner = _Runner(*build_fn(s))
+                    runners[id(s)] = runner
+                t = runner.timed_steps(steps)
+            except Exception as e:  # noqa: BLE001
+                logger.warning(
+                    "strategy %s failed dry run: %s", s.describe(), e
+                )
+                t = None
+            timings.setdefault(s.describe(), []).append(
+                t if t is not None else float("nan")
+            )
+            if t is not None:
+                scored.append((t, s))
+        if not scored:
+            return None, timings
+        scored.sort(key=lambda ts: ts[0])
+        keep = max(1, len(scored) // 2)
+        field = [s for _, s in scored[:keep]]
+        logger.info(
+            "search round %d (%d steps): kept %s",
+            rnd, steps, [s.describe() for s in field],
+        )
+        if len(field) == 1:
+            break
+        steps = min(final_steps, steps * 2)
+    return field[0], timings

@@ -90,6 +90,76 @@ def _divisors(n: int) -> List[int]:
     return [d for d in range(1, n + 1) if n % d == 0]
 
 
+# coarse v5e-class hardware constants for RANKING (not prediction):
+# only the ordering of candidates matters, so absolute calibration is
+# irrelevant as long as the compute/comm ratio is in the right regime
+_PEAK_FLOPS = 197e12
+_ICI_BW = 4.5e10  # bytes/sec one direction, per link
+
+
+def estimate_step_cost(
+    s: Strategy,
+    profile: ModelProfile,
+    batch_per_replica: int = 1,
+    seq_len: int = 2048,
+) -> float:
+    """Relative per-step wall-clock estimate for ranking candidates
+    (reference role: the Brain's throughput model + the MIP planner's
+    objective, ``mip_tp_planner.py:496``, collapsed to the terms that
+    matter on a TPU mesh):
+
+    Configs are compared at a FIXED global batch (the user's effective
+    batch): per-token compute is then identical across factorizations
+    (6N/n_devices per device), so the ranking is decided by what each
+    strategy ADDS —
+
+    - DP/FSDP grad reduce: ~2x grad bytes over ICI when dp*fsdp > 1
+    - FSDP param all-gathers: ~2x param bytes more (fwd + bwd)
+    - TP: per-layer activation reductions (4 per layer, bf16)
+    - pipe: the GPipe bubble scales compute by (1 + (P-1)/M)
+    - seq/expert: all-to-all / ring hops on activations
+    """
+    # fixed global token count (pure-DP framing: per-device batch x
+    # devices); any constant works — only the ordering matters
+    global_tokens = batch_per_replica * seq_len * max(s.n_devices, 1)
+    model_shard = max(s.tensor * s.pipe, 1)
+    compute = (
+        6.0 * profile.num_params * global_tokens
+        / max(s.n_devices, 1) / _PEAK_FLOPS
+    )
+    if s.pipe > 1:
+        micro = s.pipe_microbatches or 2 * s.pipe
+        compute *= 1.0 + (s.pipe - 1) / max(micro, 1)
+    tokens = batch_per_replica * seq_len  # per-device activation traffic
+
+    comm = 0.0
+    grad_bytes = profile.num_params * 4.0 / model_shard
+    if s.data * s.fsdp > 1:
+        comm += 2.0 * grad_bytes / _ICI_BW
+    if s.fsdp > 1:
+        comm += 2.0 * profile.num_params * 4.0 / model_shard / _ICI_BW
+    # one layer-boundary activation tensor [tokens, hidden] in bf16:
+    # the whole-model census is ~7 live tensors per layer, so divide
+    # it back out; floor at a 1k-hidden model
+    hidden_bytes = max(
+        profile.activation_bytes_per_sample
+        / max(seq_len, 1) / max(profile.num_layers, 1) / 7.0,
+        2.0 * 1024,
+    )
+    act_bytes = tokens * hidden_bytes
+    if s.tensor > 1:
+        comm += 4.0 * max(profile.num_layers, 1) * act_bytes / _ICI_BW
+    if s.pipe > 1:
+        # stage-boundary activation hops: every microbatch crosses
+        # P-1 boundaries forward and backward
+        comm += 4.0 * (s.pipe - 1) / s.pipe * act_bytes / _ICI_BW
+    if s.seq > 1:
+        comm += 2.0 * s.seq * act_bytes / _ICI_BW
+    if s.expert > 1:
+        comm += 2.0 * act_bytes / _ICI_BW
+    return compute + comm
+
+
 def generate_candidates(
     profile: ModelProfile,
     n_devices: int,
@@ -97,10 +167,17 @@ def generate_candidates(
     long_context: bool = False,
     moe: bool = False,
     batch_per_replica: int = 1,
+    seq_len: int = 2048,
 ) -> List[Strategy]:
-    """Mesh factorizations that fit memory, cheapest-communication
-    first (DP > FSDP > TP in preference — TP pays per-layer
-    collectives, FSDP pays per-step gathers, DP only grad reduce)."""
+    """Mesh factorizations that fit memory, ranked by the workload
+    cost model (:func:`estimate_step_cost` — compute shard + grad
+    reduce + FSDP gathers + TP reductions + pipe bubble, evaluated at
+    the actual batch/seq).
+
+    A factorization whose activations overflow at micro_steps=1 is
+    retried with gradient accumulation (2/4/8 micro steps) — the
+    reference searches micro-batching as part of the strategy space,
+    not as a user afterthought."""
     candidates = []
     for tensor, fsdp_d, pipe in itertools.product(
         _divisors(n_devices), _divisors(n_devices), (1, 2, 4)
@@ -125,29 +202,38 @@ def generate_candidates(
         if moe and rest % 2 == 0 and rest > 1:
             expert = 2
             rest //= 2
-        s = Strategy(
-            data=rest,
-            fsdp=fsdp_d,
-            tensor=tensor,
-            seq=seq,
-            expert=expert,
-            pipe=pipe,
-        )
-        fits, util = fits_in_memory(
-            profile,
-            n_devices,
-            fsdp=fsdp_d,
-            tensor=tensor,
-            batch_per_device=batch_per_replica,
-            pipe=pipe,
-        )
-        if fits:
-            candidates.append((s, util))
-    # rank: prefer less model-parallelism (pipe pays the bubble, TP
-    # pays per-layer collectives, FSDP per-step gathers, DP only the
-    # grad reduce), then lower memory pressure
+        for micro in (1, 2, 4, 8):
+            if batch_per_replica % micro != 0 and micro > 1:
+                continue
+            fits, util = fits_in_memory(
+                profile,
+                n_devices,
+                fsdp=fsdp_d,
+                tensor=tensor,
+                batch_per_device=batch_per_replica,
+                pipe=pipe,
+                micro_steps=micro,
+            )
+            if fits:
+                s = Strategy(
+                    data=rest,
+                    fsdp=fsdp_d,
+                    tensor=tensor,
+                    seq=seq,
+                    expert=expert,
+                    pipe=pipe,
+                    num_micro_steps=micro,
+                )
+                candidates.append((s, util))
+                break  # smallest micro count that fits wins
+
+    # rank by modeled step time; memory utilization breaks ties
+    # (sort keys are computed once per element)
     candidates.sort(
-        key=lambda su: (su[0].pipe, su[0].tensor, su[0].fsdp, su[1])
+        key=lambda su: (
+            estimate_step_cost(su[0], profile, batch_per_replica, seq_len),
+            su[1],
+        )
     )
     seen = set()
     unique = []

@@ -117,15 +117,18 @@ def worker_pod_manifest(
     ]
     container["env"] = env
     if resource:
-        # optimizer resource hints: numbers are MB of host memory
-        requests = dict(
-            container.get("resources", {}).get("requests", {})
-        )
+        # optimizer resource hints: numbers are MB of host memory.
+        # Merge INTO the template's resources — replacing the block
+        # would drop limits like google.com/tpu and schedule a
+        # replacement worker with no chips
+        resources = dict(container.get("resources", {}))
+        requests = dict(resources.get("requests", {}))
         if "memory" in resource:
             requests["memory"] = f"{int(resource['memory'])}Mi"
         if "cpu" in resource:
             requests["cpu"] = str(resource["cpu"])
-        container["resources"] = {"requests": requests}
+        resources["requests"] = requests
+        container["resources"] = resources
     return {
         "apiVersion": "v1",
         "kind": "Pod",
@@ -153,10 +156,11 @@ class ElasticJobController:
         self._interval = resync_interval
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        # plans already applied by THIS controller: a failed Succeeded
-        # status patch must not re-execute create/migrate next resync
-        # (fresh worker ids each pass -> unbounded pod growth)
-        self._applied_plans: set = set()
+        # plans already applied (or attempted) by THIS controller,
+        # keyed by (name, uid) -> outcome phase: a failed status patch
+        # must retry only the patch, and a mid-apply failure must not
+        # re-execute creates with fresh worker ids every resync
+        self._applied_plans: Dict[tuple, str] = {}
 
     # -- ElasticJob ------------------------------------------------------
     def reconcile_elasticjob(self, job: Dict):
@@ -188,15 +192,24 @@ class ElasticJobController:
         carry ``{"type", "memory"(MB), ...}``; ``migratePods`` values
         are node specs (``{"type": ...}``), not k8s resources."""
         name = plan["metadata"]["name"]
+        # key by (name, uid): a deleted-and-recreated plan with a
+        # reused name is a NEW plan, not an applied one
+        plan_key = (name, plan["metadata"].get("uid", ""))
         status = plan.get("status") or {}
-        if status.get("phase") == "Succeeded":
+        if status.get("phase") in ("Succeeded", "Failed"):
             return
-        if name in self._applied_plans:
+        if plan_key in self._applied_plans:
             # applied but the status patch failed: retry only the patch
             self._set_status(
-                SCALEPLAN_PLURAL, name, {"phase": "Succeeded"}
+                SCALEPLAN_PLURAL, name,
+                {"phase": self._applied_plans[plan_key]},
             )
             return
+        # at-most-once: mark BEFORE applying — a mid-apply failure must
+        # not re-execute creates with fresh worker ids every resync
+        # (unbounded pod growth); a partially-applied plan is surfaced
+        # as Failed instead of silently retried
+        self._applied_plans[plan_key] = "Failed"
         spec = plan.get("spec", {})
         owner = spec.get("ownerJob", "")
         template = self._worker_template(owner)
@@ -236,7 +249,7 @@ class ElasticJobController:
                 )
             )
             self._delete_quietly(old_name)
-        self._applied_plans.add(name)
+        self._applied_plans[plan_key] = "Succeeded"
         self._set_status(SCALEPLAN_PLURAL, name, {"phase": "Succeeded"})
 
     def _worker_template(self, job_name: str) -> Optional[Dict]:
@@ -293,7 +306,17 @@ class ElasticJobController:
                 self.reconcile_elasticjob(job)
             except Exception as e:  # noqa: BLE001
                 logger.warning("ElasticJob reconcile failed: %s", e)
-        for plan in self._list(SCALEPLAN_PLURAL):
+        plans = self._list(SCALEPLAN_PLURAL)
+        live = {
+            (p["metadata"]["name"], p["metadata"].get("uid", ""))
+            for p in plans
+        }
+        # prune bookkeeping for deleted plans (a recreated name+uid is
+        # a fresh plan and must be applied)
+        self._applied_plans = {
+            k: v for k, v in self._applied_plans.items() if k in live
+        }
+        for plan in plans:
             try:
                 self.reconcile_scaleplan(plan)
             except Exception as e:  # noqa: BLE001

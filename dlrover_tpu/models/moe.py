@@ -83,11 +83,33 @@ def _top_k_gating(
 
 
 def moe_forward(
-    params: Dict, x: jnp.ndarray, cfg: MoEConfig
+    params: Dict,
+    x: jnp.ndarray,
+    cfg: MoEConfig,
+    impl: str = "auto",
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """x [B, S, D] -> (y [B, S, D], aux_loss scalar).
 
-    Dense-dispatch formulation: tokens -> per-expert capacity buffers
+    ``impl``: "dense" (one-hot capacity dispatch — composes with the
+    expert mesh axis through GSPMD), "grouped" (dropless grouped-GEMM
+    over sorted tokens — the fast single-device/expert-replicated
+    path, ref ``grouped_gemm_moe.py``), or "auto" (grouped when no
+    expert mesh axis is active, dense otherwise)."""
+    if impl == "auto":
+        from dlrover_tpu.parallel.mesh import AxisName, get_mesh_context
+
+        ctx = get_mesh_context()
+        ep = ctx.axis_size(AxisName.EXPERT) if ctx else 1
+        impl = "dense" if ep > 1 else "grouped"
+    if impl == "grouped":
+        return moe_forward_grouped(params, x, cfg)
+    return _moe_forward_dense(params, x, cfg)
+
+
+def _moe_forward_dense(
+    params: Dict, x: jnp.ndarray, cfg: MoEConfig
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Dense-dispatch formulation: tokens -> per-expert capacity buffers
     via one-hot combine/dispatch tensors (static shapes; GSPMD shards
     the expert dim)."""
     b, s, d = x.shape
@@ -129,6 +151,56 @@ def moe_forward(
     )
     y = jnp.einsum("tec,ecd->td", combine, expert_out)
     return y.reshape(b, s, d), aux * cfg.router_aux_weight
+
+
+def moe_forward_grouped(
+    params: Dict, x: jnp.ndarray, cfg: MoEConfig
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Dropless grouped-GEMM MoE (megablocks formulation, ref
+    ``grouped_gemm_moe.py``): token replicas sorted by expert feed ONE
+    ragged GEMM per projection — no capacity buffers, no dropped
+    tokens, no one-hot dispatch FLOPs.
+
+    Capacity semantics differ from the dense path by design: every
+    routed token is processed (megablocks' selling point); the dense
+    path drops tokens past the capacity factor."""
+    b, s, d = x.shape
+    t = b * s
+    e, k = cfg.num_experts, cfg.top_k
+    dt = cfg.dtype
+
+    from dlrover_tpu.ops.grouped_gemm import (
+        grouped_gemm,
+        sort_tokens_by_expert,
+    )
+
+    flat = x.reshape(t, d)
+    logits = flat.astype(jnp.float32) @ params["router"]
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_vals, top_idx = jax.lax.top_k(probs, k)  # [T,k]
+    gate_vals = top_vals / jnp.maximum(
+        jnp.sum(top_vals, axis=-1, keepdims=True), 1e-9
+    )
+    # same load-balancing loss as the dense path
+    one_hot_top1 = jax.nn.one_hot(top_idx[:, 0], e, dtype=probs.dtype)
+    density = jnp.mean(one_hot_top1, axis=0)
+    density_proxy = jnp.mean(probs, axis=0)
+    aux = jnp.sum(density * density_proxy) * (e**2) / e
+
+    expert_ids = top_idx.reshape(-1)  # [T*k]
+    order, group_sizes = sort_tokens_by_expert(expert_ids, e)
+    tok_of_replica = jnp.arange(t * k) // k
+    sorted_tok = tok_of_replica[order]
+    sorted_in = flat.astype(dt)[sorted_tok]  # [T*k, D]
+
+    gate_h = jax.nn.silu(
+        grouped_gemm(sorted_in, params["w_gate"], group_sizes)
+    )
+    up_h = grouped_gemm(sorted_in, params["w_up"], group_sizes)
+    out = grouped_gemm(gate_h * up_h, params["w_down"], group_sizes)
+    out = out * gate_vals.reshape(-1)[order][:, None].astype(dt)
+    y = jnp.zeros((t, d), out.dtype).at[sorted_tok].add(out)
+    return y.astype(dt).reshape(b, s, d), aux * cfg.router_aux_weight
 
 
 _rules_holder = {"rules": None}

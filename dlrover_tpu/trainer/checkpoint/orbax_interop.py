@@ -34,17 +34,27 @@ from dlrover_tpu.agent.ckpt_shm import read_shard_file
 from dlrover_tpu.common.constants import CheckpointConstant
 from dlrover_tpu.common.log import default_logger as logger
 
-_KEY_TOKEN = re.compile(r"\['([^']*)'\]|\[(\d+)\]")
+_KEY_TOKEN = re.compile(
+    r"\['([^']*)'\]"  # dict key: ['name']
+    r"|\[(\d+)\]"  # sequence index: [0]
+    r"|\.([A-Za-z_][A-Za-z0-9_]*)"  # namedtuple/dataclass field: .mu
+)
 
 
 def _parse_keystr(keystr: str):
-    """``"['a'][0]['b']"`` -> ("a", 0, "b")."""
+    """``"['opt'].mu['w'][0]"`` -> ("opt", "mu", "w", 0).
+
+    Attribute tokens (optax namedtuple states, flax dataclasses) become
+    dict keys in the exported tree — dropping them would collide
+    sibling fields (``.mu``/``.nu``) onto one path."""
     tokens = []
     for m in _KEY_TOKEN.finditer(keystr):
         if m.group(1) is not None:
             tokens.append(m.group(1))
-        else:
+        elif m.group(2) is not None:
             tokens.append(int(m.group(2)))
+        else:
+            tokens.append(m.group(3))
     return tuple(tokens)
 
 

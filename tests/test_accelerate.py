@@ -87,6 +87,68 @@ class TestCandidates:
             # stack across stages)
             assert s.fsdp * s.tensor * s.pipe >= 8
 
+    def test_cost_model_is_workload_aware(self):
+        """The ranking depends on the actual workload (round-2 weak
+        #6): at a compute-dominated batch, DP beats FSDP (gathers), TP
+        (per-layer reductions) and PP (bubble); at a tiny global batch
+        the grad allreduce dominates and model-sharded plans close the
+        gap — the ordering is batch-dependent, not lexicographic."""
+        from dlrover_tpu.accelerate.strategy import (
+            Strategy,
+            estimate_step_cost,
+        )
+
+        profile = ModelProfile(
+            num_params=7_000_000_000,
+            param_bytes=28_000_000_000,
+            largest_leaf=1,
+            leaf_count=100,
+            optimizer_bytes=56_000_000_000,
+            num_layers=32,
+            # ~7 live bf16 [seq, 4096] tensors per layer per sample
+            activation_bytes_per_sample=32 * 7 * 2048 * 4096 * 2,
+        )
+
+        def costs(batch):
+            return {
+                name: estimate_step_cost(
+                    Strategy(**dims), profile, batch, 2048
+                )
+                for name, dims in {
+                    "dp": dict(data=8),
+                    "fsdp": dict(fsdp=8),
+                    "tp": dict(tensor=8),
+                    "pp": dict(data=2, pipe=4),
+                }.items()
+            }
+
+        big = costs(32)  # compute-dominated
+        assert big["dp"] < big["fsdp"]
+        assert big["dp"] < big["tp"]
+        assert big["dp"] < big["pp"]
+        small = costs(1)  # grad-sync-dominated
+        # the gap between dp and grad-sharded pp flips with batch
+        assert (big["pp"] - big["dp"]) > 0
+        assert (small["pp"] - small["dp"]) < (big["pp"] - big["dp"])
+
+    def test_micro_steps_emitted_when_activations_overflow(self):
+        """Activations past HBM at micro=1 produce a gradient-
+        accumulation candidate instead of no candidate."""
+        profile = ModelProfile(
+            num_params=1_000_000,
+            param_bytes=4_000_000,
+            largest_leaf=100,
+            leaf_count=4,
+            optimizer_bytes=8_000_000,
+            num_layers=4,
+            # 10 GB of activations per sample: batch 8 needs >= 8
+            # micro steps to fit a 16 GB HBM device
+            activation_bytes_per_sample=10 * (1 << 30),
+        )
+        cands = generate_candidates(profile, 8, batch_per_replica=8)
+        assert cands, "accumulation should rescue the fit"
+        assert all(s.num_micro_steps >= 8 for s in cands)
+
     def test_long_context_adds_seq_axis(self, tiny_cfg):
         profile = analyse_model(
             lambda rng: init_params(rng, tiny_cfg), optax.adamw(1e-3)
@@ -113,6 +175,34 @@ class TestAutoAccelerate:
             {"tokens": tokens}, result.fns.batch_sharding
         )
         state, metrics = result.fns.train_step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))
+
+    def test_dry_run_search_picks_and_runs(self, tiny_cfg):
+        """auto_accelerate(dry_run=True) races candidates through the
+        successive-halving search; the winner trains."""
+        result = auto_accelerate(
+            loss_fn=lambda p, b: loss_fn(p, b, tiny_cfg),
+            optimizer=optax.adamw(1e-3),
+            init_params_fn=lambda rng: init_params(rng, tiny_cfg),
+            param_axes=param_logical_axes(tiny_cfg),
+            sample_batch_fn=lambda sharding: jax.device_put(
+                {"tokens": jnp.ones((8, 17), dtype=jnp.int32)}, sharding
+            ),
+            dry_run=True,
+            batch_per_replica=1,
+            seq_len=16,
+        )
+        # timings recorded per raced strategy, at least one finite
+        assert result.timings
+        assert any(
+            t == t for ts in result.timings.values() for t in ts
+        )
+        state = result.fns.init_state(jax.random.PRNGKey(0))
+        batch = jax.device_put(
+            {"tokens": jnp.ones((8, 17), dtype=jnp.int32)},
+            result.fns.batch_sharding,
+        )
+        _, metrics = result.fns.train_step(state, batch)
         assert np.isfinite(float(metrics["loss"]))
 
     def test_full_auto_picks_and_runs(self, tiny_cfg):

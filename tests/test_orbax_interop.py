@@ -29,6 +29,29 @@ def sock_dir(monkeypatch):
 
 
 class TestKeystrUnflatten:
+    def test_namedtuple_attribute_tokens(self):
+        """optax states flatten to attribute-style keystrs (.mu/.nu):
+        both must survive as distinct paths, not collide."""
+        import jax
+        import optax
+
+        params = {"w": np.ones((2, 2), np.float32)}
+        opt_state = optax.adam(1e-3).init(params)
+        state = {"opt": opt_state, "params": params}
+        flat, _ = jax.tree_util.tree_flatten_with_path(state)
+        arrays = {
+            jax.tree_util.keystr(path): np.asarray(leaf)
+            for path, leaf in flat
+        }
+        tree = unflatten_keystrs(arrays)
+        # mu and nu are distinct branches (ScaleByAdamState fields)
+        opt = tree["opt"]
+        assert isinstance(opt, list)
+        adam_state = opt[0]
+        assert "mu" in adam_state and "nu" in adam_state
+        assert adam_state["mu"]["w"].shape == (2, 2)
+        assert "count" in adam_state
+
     def test_nested_dicts_and_lists(self):
         flat = {
             "['params']['w']": np.ones((2,)),
