@@ -102,6 +102,12 @@ def fits_in_memory(
     hbm = device_memory_bytes() * headroom
     shard = max(fsdp * tensor * pipe, 1)
     state = profile.train_state_bytes() / shard
+    if micro_steps > 1:
+        # the accumulation train path carries a full fp32 param-shaped
+        # grad_sum through its scan, on top of the per-micro-step
+        # gradients — unmodeled it OOMs exactly the candidates that
+        # accumulation was supposed to rescue
+        state += profile.num_params * 4.0 / shard
     acts = (
         profile.activation_bytes_per_sample
         * batch_per_device

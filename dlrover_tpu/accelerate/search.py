@@ -13,37 +13,11 @@ once and the extra steps only go to plausible winners.
 """
 
 import math
-import time
 from typing import Callable, Dict, List, Optional, Tuple
 
-import jax
-
+from dlrover_tpu.accelerate.dry_runner import time_strategy
 from dlrover_tpu.accelerate.strategy import Strategy
 from dlrover_tpu.common.log import default_logger as logger
-
-
-class _Runner:
-    """One built candidate: compiled step + live (donated) state.
-
-    The train step donates its state buffer, so the state must be
-    threaded across rounds — each timing call leaves the runner with
-    the latest state instead of rebuilding (and recompiling) the
-    candidate."""
-
-    def __init__(self, step_fn, state, batch):
-        self.step_fn = step_fn
-        self.state = state
-        self.batch = batch
-
-    def timed_steps(self, steps: int) -> float:
-        state, metrics = self.step_fn(self.state, self.batch)  # warmup
-        jax.block_until_ready(metrics)
-        start = time.perf_counter()
-        for _ in range(steps):
-            state, metrics = self.step_fn(state, self.batch)
-        jax.block_until_ready(metrics)
-        self.state = state
-        return (time.perf_counter() - start) / steps
 
 
 def successive_halving(
@@ -54,28 +28,25 @@ def successive_halving(
     final_steps: int = 5,
 ) -> Tuple[Optional[Strategy], Dict[str, List[float]]]:
     """Race the top candidates, halving the field each round while
-    doubling the measured steps; every candidate compiles exactly once
-    (runners are cached across rounds).  Returns
-    (winner, {strategy: [per-round step seconds]})."""
+    doubling the measured steps; returns
+    (winner, {strategy: [per-round step seconds]}).
+
+    Memory discipline: exactly ONE candidate's train state is live at a
+    time — each timing builds, measures, and drops the candidate
+    (``time_strategy``).  Candidates were admitted by a memory model
+    sized for a single train state at 85% HBM, so caching runners
+    across rounds (to save recompiles) would OOM on the second build;
+    survivors pay a recompile per round instead, which the halving
+    keeps to ~log2(field) extra compiles on the plausible winners
+    only."""
     field = list(candidates[:max_candidates])
-    runners: Dict[int, _Runner] = {}
     timings: Dict[str, List[float]] = {}
     steps = first_steps
     rounds = max(1, math.ceil(math.log2(max(len(field), 1))))
     for rnd in range(rounds):
         scored = []
         for s in field:
-            try:
-                runner = runners.get(id(s))
-                if runner is None:
-                    runner = _Runner(*build_fn(s))
-                    runners[id(s)] = runner
-                t = runner.timed_steps(steps)
-            except Exception as e:  # noqa: BLE001
-                logger.warning(
-                    "strategy %s failed dry run: %s", s.describe(), e
-                )
-                t = None
+            t = time_strategy(build_fn, s, warmup=1, steps=steps)
             timings.setdefault(s.describe(), []).append(
                 t if t is not None else float("nan")
             )

@@ -205,14 +205,17 @@ class ElasticJobController:
                 {"phase": self._applied_plans[plan_key]},
             )
             return
-        # at-most-once: mark BEFORE applying — a mid-apply failure must
-        # not re-execute creates with fresh worker ids every resync
-        # (unbounded pod growth); a partially-applied plan is surfaced
-        # as Failed instead of silently retried
-        self._applied_plans[plan_key] = "Failed"
         spec = plan.get("spec", {})
         owner = spec.get("ownerJob", "")
+        # reads first: a transient list failure here must stay
+        # retryable (nothing has been mutated yet)
         template = self._worker_template(owner)
+        # at-most-once from HERE: mark before the first mutation — a
+        # mid-apply failure must not re-execute creates with fresh
+        # worker ids every resync (unbounded pod growth); a partially-
+        # applied plan is surfaced as Failed instead of silently
+        # retried
+        self._applied_plans[plan_key] = "Failed"
 
         # replica targets: diff current worker pods against the target
         replica_specs = spec.get("replicaResourceSpecs", {}) or {}

@@ -20,7 +20,7 @@ Design notes (TPU):
 
 from dataclasses import dataclass, field
 from functools import partial
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -324,6 +324,93 @@ def forward(
         preferred_element_type=jnp.float32,
     )
     return logits
+
+
+# ------------------------------------------------------ KV-cache decode
+
+
+def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int) -> Dict:
+    """Per-layer K/V cache for autoregressive decode, stacked on the
+    layer dim like the params ([L, B, max_len, KV, head_dim])."""
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, dtype=cfg.dtype),
+        "v": jnp.zeros(shape, dtype=cfg.dtype),
+    }
+
+
+def decode_step(
+    params: Dict,
+    tokens: jnp.ndarray,  # [B] current position's token ids
+    cache: Dict,
+    pos: jnp.ndarray,  # scalar int32: position being decoded
+    cfg: LlamaConfig,
+) -> Tuple[jnp.ndarray, Dict]:
+    """One cached decode step: logits [B, vocab] for position ``pos``
+    plus the updated cache.  The inference dual of ``forward`` — prior
+    positions' K/V are read from the cache instead of recomputed, so a
+    T-token generation costs O(T) attention instead of O(T^2) forward
+    passes (the vLLM-style serving path, on the training mesh)."""
+    dt = cfg.dtype
+    b = tokens.shape[0]
+    nh, nkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    x = params["embed"].astype(dt)[tokens][:, None]  # [B,1,D]
+    cos, sin = rope_frequencies(cfg, pos[None])  # [1, hd/2]
+
+    def body(x, layer_in):
+        lp, k_cache, v_cache = layer_in
+
+        def proj(a, w):
+            return jnp.matmul(
+                a, w.astype(dt), preferred_element_type=jnp.float32
+            ).astype(dt)
+
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = apply_rope(proj(h, lp["wq"]).reshape(b, 1, nh, hd), cos, sin)
+        k = apply_rope(
+            proj(h, lp["wk"]).reshape(b, 1, nkv, hd), cos, sin
+        )
+        v = proj(h, lp["wv"]).reshape(b, 1, nkv, hd)
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k, (0, pos, 0, 0)
+        )
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v, (0, pos, 0, 0)
+        )
+        # attention of the single query over the cached prefix
+        group = nh // nkv
+        qg = q.reshape(b, nkv, group, hd)
+        logits = jnp.einsum(
+            "bkgd,bskd->bkgs", qg, k_cache,
+            preferred_element_type=jnp.float32,
+        ) * (hd**-0.5)
+        valid = (
+            jnp.arange(k_cache.shape[1]) <= pos
+        )  # causal: prefix only
+        logits = jnp.where(valid[None, None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        attn = jnp.einsum(
+            "bkgs,bskd->bkgd", probs.astype(dt), v_cache,
+            preferred_element_type=jnp.float32,
+        ).astype(dt)
+        x = x + proj(
+            attn.reshape(b, 1, nh * hd), lp["wo"]
+        )
+        h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(proj(h, lp["w_gate"]))
+        up = proj(h, lp["w_up"])
+        x = x + proj(gate * up, lp["w_down"])
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, params["lm_head"].astype(dt),
+        preferred_element_type=jnp.float32,
+    )
+    return logits[:, 0], {"k": new_k, "v": new_v}
 
 
 def loss_fn(

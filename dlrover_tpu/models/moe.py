@@ -96,11 +96,15 @@ def moe_forward(
     path, ref ``grouped_gemm_moe.py``), or "auto" (grouped when no
     expert mesh axis is active, dense otherwise)."""
     if impl == "auto":
-        from dlrover_tpu.parallel.mesh import AxisName, get_mesh_context
+        from dlrover_tpu.parallel.mesh import get_mesh_context
 
         ctx = get_mesh_context()
-        ep = ctx.axis_size(AxisName.EXPERT) if ctx else 1
-        impl = "dense" if ep > 1 else "grouped"
+        # grouped only when tokens are NOT sharded: its global
+        # argsort/scatter over the flattened token dim would force
+        # GSPMD to gather every token on a dp/fsdp/tp mesh (and it
+        # changes capacity semantics — dropless vs dropping)
+        single = ctx is None or ctx.num_devices <= 1
+        impl = "grouped" if single else "dense"
     if impl == "grouped":
         return moe_forward_grouped(params, x, cfg)
     return _moe_forward_dense(params, x, cfg)

@@ -1,0 +1,201 @@
+"""RLHF PPO orchestration over the per-role engine.
+
+Reference parity: ``atorch/atorch/rl/main.py`` + the model engine /
+trainer split (``rl/model_engine/model_engine.py``) — four roles
+(actor / critic / ref / reward), rollout generation on an inference
+backend with weights synced from the trainer, experience-making with
+KL-shaped rewards and GAE, then clipped-PPO updates of actor and
+critic through their own accelerated train steps.
+
+Model-agnostic: the caller supplies ``actor_forward(params, tokens) ->
+logits`` and ``critic_value(params, tokens) -> values [B, S]``; roles
+are built through :class:`dlrover_tpu.rl.engine.ModelEngine`, so each
+role gets its own parallelization strategy.
+"""
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.rl.config import RLConfig
+from dlrover_tpu.rl.engine import ModelEngine
+from dlrover_tpu.rl.inference import InferenceBackend
+from dlrover_tpu.rl.ppo import ReplayBuffer, compute_gae
+
+
+def token_logprobs(logits: jnp.ndarray, tokens: jnp.ndarray):
+    """Next-token logprob per position: [B, S, V], [B, S] -> [B, S-1]."""
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), -1)
+    return jnp.take_along_axis(
+        logp, tokens[:, 1:, None], axis=-1
+    )[..., 0]
+
+
+def actor_ppo_loss(
+    logits, batch, clip_ratio: float = 0.2, kl_coef: float = 0.1
+):
+    """Clipped surrogate + KL penalty (the policy half of
+    ``ppo.ppo_loss``; the value half lives in the critic's loss)."""
+    mask = batch["mask"]
+    msum = jnp.maximum(jnp.sum(mask), 1.0)
+    logp = token_logprobs(logits, batch["tokens"])
+    adv = batch["advantages"]
+    amean = jnp.sum(adv * mask) / msum
+    astd = jnp.sqrt(
+        jnp.sum(((adv - amean) ** 2) * mask) / msum + 1e-8
+    )
+    adv = (adv - amean) / astd
+    ratio = jnp.exp(logp - batch["old_logp"])
+    unclipped = ratio * adv
+    clipped = jnp.clip(ratio, 1 - clip_ratio, 1 + clip_ratio) * adv
+    policy_loss = -jnp.sum(jnp.minimum(unclipped, clipped) * mask) / msum
+    kl = jnp.sum((logp - batch["ref_logp"]) * mask) / msum
+    return policy_loss + kl_coef * kl
+
+
+def critic_value_loss(values, batch, value_clip: float = 0.2):
+    """Clipped value regression (the value half of ``ppo.ppo_loss``)."""
+    mask = batch["mask"]
+    msum = jnp.maximum(jnp.sum(mask), 1.0)
+    v = values[:, :-1]
+    old_v = batch["old_values"]
+    v_clip = old_v + jnp.clip(v - old_v, -value_clip, value_clip)
+    returns = batch["returns"]
+    return 0.5 * jnp.sum(
+        jnp.maximum((v - returns) ** 2, (v_clip - returns) ** 2) * mask
+    ) / msum
+
+
+class RLHFTrainer:
+    """Rollout -> experience -> PPO epochs, over engine-built roles."""
+
+    def __init__(
+        self,
+        config: RLConfig,
+        engine: ModelEngine,
+        backend: InferenceBackend,
+        actor_forward: Callable,
+        critic_value: Callable,
+        reward_fn: Callable,  # (tokens [B, S]) -> [B] sequence reward
+        prompt_len: int,
+    ):
+        self.config = config
+        self.engine = engine
+        self.backend = backend
+        self._actor_forward = actor_forward
+        self._critic_value = critic_value
+        self._reward_fn = reward_fn
+        self._prompt_len = prompt_len
+        self.buffer = ReplayBuffer()
+        # frozen reference policy = actor params at construction
+        self._ref_params = jax.tree_util.tree_map(
+            jnp.copy, engine.states["actor"]["params"]
+        )
+        self._logp_fn = jax.jit(
+            lambda p, t: token_logprobs(actor_forward(p, t), t)
+        )
+        self._value_fn = jax.jit(critic_value)
+
+    # -- experience ------------------------------------------------------
+    def make_experience(self, prompts: jnp.ndarray, rng) -> Dict:
+        """Generate responses, score them, compute advantages, fill the
+        replay buffer; returns rollout stats."""
+        ppo = self.config.ppo
+        actor_params = self.engine.states["actor"]["params"]
+        self.backend.sync_weights(actor_params)
+        tokens = np.asarray(self.backend.generate(prompts, rng))
+
+        mask = np.zeros(tokens.shape[:2], np.float32)
+        mask[:, self._prompt_len :] = 1.0
+        mask_t = mask[:, 1:]  # aligned with next-token logprobs
+
+        old_logp = np.asarray(self._logp_fn(actor_params, tokens))
+        ref_logp = np.asarray(self._logp_fn(self._ref_params, tokens))
+        values = np.asarray(
+            self._value_fn(
+                self.engine.states["critic"]["params"], tokens
+            )
+        )
+        seq_reward = np.asarray(self._reward_fn(tokens))
+
+        b, total = tokens.shape
+        for i in range(b):
+            # KL-shaped per-token rewards, sequence reward at the end
+            r = -ppo.kl_coef * (old_logp[i] - ref_logp[i]) * mask_t[i]
+            last = int(mask_t[i].nonzero()[0][-1]) if mask_t[i].any() else total - 2
+            r[last] += float(seq_reward[i])
+            adv, ret = compute_gae(
+                jnp.asarray(r), jnp.asarray(values[i]),
+                gamma=ppo.gamma, lam=ppo.lam,
+            )
+            self.buffer.add(
+                {
+                    "tokens": tokens[i],
+                    "mask": mask_t[i],
+                    "old_logp": old_logp[i],
+                    "ref_logp": ref_logp[i],
+                    "old_values": values[i, :-1],
+                    "advantages": np.asarray(adv),
+                    "returns": np.asarray(ret),
+                }
+            )
+        return {
+            "mean_reward": float(seq_reward.mean()),
+            "mean_kl": float(
+                ((old_logp - ref_logp) * mask_t).sum()
+                / max(mask_t.sum(), 1.0)
+            ),
+        }
+
+    # -- optimization ----------------------------------------------------
+    def train_on_buffer(self, batch_size: int) -> Dict:
+        """PPO epochs over the buffered experience through each role's
+        accelerated train step."""
+        stats = {"actor_loss": [], "critic_loss": []}
+        actor = self.engine.roles["actor"].fns
+        critic = self.engine.roles["critic"].fns
+        for batch in self.buffer.sample_batches(
+            batch_size, epochs=self.config.ppo.ppo_epochs
+        ):
+            batch = {k: jnp.asarray(v) for k, v in batch.items()}
+            self.engine.states["actor"], m_a = actor.train_step(
+                self.engine.states["actor"],
+                jax.device_put(batch, actor.batch_sharding),
+            )
+            stats["actor_loss"].append(float(m_a["loss"]))
+            self.engine.states["critic"], m_c = critic.train_step(
+                self.engine.states["critic"],
+                jax.device_put(batch, critic.batch_sharding),
+            )
+            stats["critic_loss"].append(float(m_c["loss"]))
+        self.buffer.clear()
+        return {
+            k: float(np.mean(v)) if v else 0.0
+            for k, v in stats.items()
+        }
+
+    def train(
+        self,
+        prompt_batches,
+        rng,
+        minibatch_size: Optional[int] = None,
+    ):
+        """The outer PPO loop (reference ``rl/main.py``)."""
+        ppo = self.config.ppo
+        minibatch_size = minibatch_size or ppo.rollout_batch
+        history = []
+        for step, prompts in enumerate(prompt_batches):
+            rng, sub = jax.random.split(rng)
+            roll = self.make_experience(jnp.asarray(prompts), sub)
+            opt = self.train_on_buffer(minibatch_size)
+            logger.info(
+                "rlhf step %d: reward %.4f kl %.4f actor %.4f "
+                "critic %.4f",
+                step, roll["mean_reward"], roll["mean_kl"],
+                opt["actor_loss"], opt["critic_loss"],
+            )
+            history.append({**roll, **opt})
+        return history

@@ -1,0 +1,114 @@
+"""Coworker data plane: CPU-side preprocessing served over TCP,
+round-robin trainer pulls with failover (ref
+``coworker_data_service.py:43``, ``coworker_dataset.py:13``)."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from dlrover_tpu.data.coworker import (  # noqa: E402
+    CoworkerClient,
+    CoworkerDataset,
+    CoworkerServer,
+    decode_batch,
+    encode_batch,
+)
+
+
+def preprocess(item):
+    return {"x": np.full((4,), float(item)), "y": np.int32(item)}
+
+
+class TestWireFormat:
+    def test_roundtrip_no_pickle(self):
+        batch = {"a": np.arange(6).reshape(2, 3), "b": np.float32(1.5)}
+        out = decode_batch(encode_batch(batch))
+        np.testing.assert_array_equal(out["a"], batch["a"])
+        assert float(out["b"]) == 1.5
+
+
+class TestCoworkerPlane:
+    def test_pull_from_two_coworkers_round_robin(self):
+        s1 = CoworkerServer(range(0, 3), preprocess)
+        s2 = CoworkerServer(range(10, 13), preprocess)
+        s1.start()
+        s2.start()
+        try:
+            client = CoworkerClient(
+                [f"127.0.0.1:{s1.port}", f"127.0.0.1:{s2.port}"],
+                timeout=10,
+            )
+            seen = [b["y"].item() for b in CoworkerDataset(client)]
+            assert sorted(seen) == [0, 1, 2, 10, 11, 12]
+            # values came interleaved from both coworkers
+            assert any(v < 10 for v in seen[:2])
+            assert any(v >= 10 for v in seen[:2])
+        finally:
+            s1.stop()
+            s2.stop()
+
+    def test_failover_when_coworker_dies(self):
+        s1 = CoworkerServer(range(0, 2), preprocess)
+        s2 = CoworkerServer(range(10, 14), preprocess)
+        s1.start()
+        s2.start()
+        dead_port = s1.port
+        s1.stop()  # dies before serving anything
+        try:
+            client = CoworkerClient(
+                [f"127.0.0.1:{dead_port}", f"127.0.0.1:{s2.port}"],
+                timeout=5,
+            )
+            seen = [b["y"].item() for b in CoworkerDataset(client)]
+            assert sorted(seen) == [10, 11, 12, 13]
+        finally:
+            s2.stop()
+
+    def test_crashed_pipeline_not_mistaken_for_end_of_data(self):
+        """A preprocessing failure must surface as an error, not a
+        silently truncated epoch."""
+        import pytest
+
+        def bad_preprocess(item):
+            raise ValueError("corrupt record")
+
+        s = CoworkerServer(range(3), bad_preprocess)
+        s.start()
+        try:
+            client = CoworkerClient(
+                [f"127.0.0.1:{s.port}"], timeout=10
+            )
+            with pytest.raises(RuntimeError, match="coworker"):
+                # poll until the fill loop has registered the failure
+                for _ in range(20):
+                    client.next_batch()
+        finally:
+            s.stop()
+
+    def test_registration_via_kv_store(self):
+        class FakeMaster:
+            def __init__(self):
+                self.kv = {}
+
+            def kv_store_set(self, key, value):
+                self.kv[key] = value
+                return True
+
+            def kv_store_get(self, key):
+                return self.kv.get(key, b"")
+
+        master = FakeMaster()
+        s = CoworkerServer(range(3), preprocess)
+        s.start()
+        try:
+            assert s.register(master, 0, advertise_host="127.0.0.1")
+            client = CoworkerClient.from_master(master, timeout=10)
+            batch = client.next_batch()
+            assert batch is not None and batch["x"].shape == (4,)
+        finally:
+            s.stop()

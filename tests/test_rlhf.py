@@ -1,0 +1,135 @@
+"""RLHF engine depth: KV-cache inference backend parity and the full
+per-role PPO orchestration (ref ``rl/model_engine/model_engine.py``,
+``rl/inference_backend/vllm_backend.py``, ``rl/main.py``)."""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from dlrover_tpu.models.llama import (  # noqa: E402
+    LlamaConfig,
+    forward,
+    init_params,
+    param_logical_axes,
+)
+from dlrover_tpu.rl.config import RLConfig  # noqa: E402
+from dlrover_tpu.rl.engine import ModelEngine  # noqa: E402
+from dlrover_tpu.rl.inference import (  # noqa: E402
+    JitSamplerBackend,
+    KVCacheBackend,
+)
+from dlrover_tpu.rl.trainer import (  # noqa: E402
+    RLHFTrainer,
+    actor_ppo_loss,
+    critic_value_loss,
+)
+
+CFG = LlamaConfig.tiny(remat="none")
+
+
+def actor_forward(params, tokens):
+    return forward(params, tokens, CFG, attention_fn=None)
+
+
+class TestKVCacheBackend:
+    def test_greedy_matches_full_forward_sampler(self):
+        """Cached decode must generate the same tokens as the O(T^2)
+        full-forward sampler under greedy decoding."""
+        params = init_params(jax.random.PRNGKey(0), CFG)
+        prompts = jnp.array(
+            [[5, 7, 11, 13], [2, 3, 4, 5]], dtype=jnp.int32
+        )
+        rng = jax.random.PRNGKey(1)
+
+        full = JitSamplerBackend(
+            actor_forward, max_new_tokens=6, temperature=0.0
+        )
+        cached = KVCacheBackend(CFG, max_new_tokens=6, temperature=0.0)
+        out_full = np.asarray(full.generate(prompts, rng, params))
+        out_cached = np.asarray(cached.generate(prompts, rng, params))
+        np.testing.assert_array_equal(out_full, out_cached)
+
+
+class TestRLHFOrchestration:
+    @pytest.mark.timeout(600)
+    def test_end_to_end_ppo_step(self):
+        """Roles built with their own strategies, rollout through the
+        KV-cache backend, experience with KL-shaped rewards, PPO epochs
+        update both actor and critic."""
+        config = RLConfig.from_dict(
+            {
+                "roles": {
+                    "actor": {"strategy": {"data": 8, "remat": "none"}},
+                    "critic": {"strategy": {"data": 8, "remat": "none"}},
+                },
+                "ppo": {"rollout_batch": 8, "ppo_epochs": 1},
+            }
+        )
+        engine = ModelEngine(config)
+        engine.build_role(
+            "actor",
+            loss_fn=lambda p, b: actor_ppo_loss(
+                actor_forward(p, b["tokens"]), b
+            ),
+            optimizer=optax.adam(1e-4),
+            init_params_fn=lambda rng: init_params(rng, CFG),
+            param_axes=param_logical_axes(CFG),
+        )
+
+        def critic_init(rng):
+            return {
+                "emb": jax.random.normal(
+                    rng, (CFG.vocab_size, 8), jnp.float32
+                )
+                * 0.1,
+                "w": jnp.zeros((8,), jnp.float32),
+            }
+
+        def critic_value(p, tokens):
+            return jnp.einsum(
+                "bse,e->bs", p["emb"][tokens], p["w"]
+            )
+
+        engine.build_role(
+            "critic",
+            loss_fn=lambda p, b: critic_value_loss(
+                critic_value(p, b["tokens"]), b
+            ),
+            optimizer=optax.adam(1e-3),
+            init_params_fn=critic_init,
+            param_axes={"emb": (None, None), "w": (None,)},
+        )
+        engine.init_role_state("actor", jax.random.PRNGKey(0))
+        engine.init_role_state("critic", jax.random.PRNGKey(1))
+
+        backend = KVCacheBackend(CFG, max_new_tokens=4, temperature=1.0)
+        trainer = RLHFTrainer(
+            config,
+            engine,
+            backend,
+            actor_forward=actor_forward,
+            critic_value=critic_value,
+            reward_fn=lambda tokens: np.asarray(tokens[:, -1] % 3,
+                                                np.float32),
+            prompt_len=4,
+        )
+        prompts = np.tile(
+            np.arange(4, dtype=np.int32)[None], (8, 1)
+        ) + np.arange(8, dtype=np.int32)[:, None]
+        history = trainer.train([prompts], jax.random.PRNGKey(2))
+        assert len(history) == 1
+        step = history[0]
+        assert np.isfinite(step["actor_loss"])
+        assert np.isfinite(step["critic_loss"])
+        assert np.isfinite(step["mean_reward"])
+        # the actor actually moved
+        assert step["actor_loss"] != 0.0
