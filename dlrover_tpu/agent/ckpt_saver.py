@@ -107,6 +107,18 @@ class AsyncCheckpointSaver:
 
     def close(self, unlink: bool = False):
         self.stop()
+        # join the event loop BEFORE closing shm: a persist in flight
+        # holds memoryview slices of the segments (dump_to_file), and
+        # closing under it raises BufferError "exported pointers exist"
+        t = self._persist_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=60)
+            if t.is_alive():
+                logger.error(
+                    "ckpt saver event loop still busy after 60s; "
+                    "leaking shm handles for process-exit reclaim"
+                )
+                return
         for handler in self._shm_handlers:
             handler.close(unlink=unlink)
         for lock in self._locks:

@@ -15,34 +15,16 @@ Protocol (one request per connection):
 """
 
 import socket
-import struct
 import threading
 from typing import Callable, Dict, Optional
 
 from dlrover_tpu.common.env import get_free_port
 from dlrover_tpu.common.log import default_logger as logger
-
-_LEN = struct.Struct(">Q")
-
-
-def _recv_exact(conn: socket.socket, n: int) -> bytes:
-    buf = b""
-    while len(buf) < n:
-        chunk = conn.recv(min(1 << 20, n - len(buf)))
-        if not chunk:
-            raise ConnectionError("peer closed mid-message")
-        buf += chunk
-    return buf
-
-
-def _recv_line(conn: socket.socket) -> str:
-    buf = b""
-    while not buf.endswith(b"\n"):
-        c = conn.recv(1)
-        if not c:
-            raise ConnectionError("peer closed mid-line")
-        buf += c
-    return buf.decode().strip()
+from dlrover_tpu.common.netio import (
+    LEN as _LEN,
+    recv_exact as _recv_exact,
+    recv_line as _recv_line,
+)
 
 
 class ReplicaService:

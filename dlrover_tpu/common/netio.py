@@ -1,0 +1,27 @@
+"""Shared length-prefixed TCP wire helpers (replica + coworker data
+planes)."""
+
+import socket
+import struct
+
+LEN = struct.Struct(">Q")
+
+
+def recv_exact(conn: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed mid-message")
+        buf += chunk
+    return buf
+
+
+def recv_line(conn: socket.socket) -> str:
+    buf = b""
+    while not buf.endswith(b"\n"):
+        c = conn.recv(1)
+        if not c:
+            raise ConnectionError("peer closed mid-line")
+        buf += c
+    return buf.decode().strip()

@@ -30,7 +30,6 @@ Protocol (one request per connection, like the replica service):
 import io
 import queue
 import socket
-import struct
 import threading
 from typing import Callable, Dict, Iterable, List, Optional
 
@@ -38,10 +37,19 @@ import numpy as np
 
 from dlrover_tpu.common.env import get_free_port
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.netio import (
+    LEN as _LEN,
+    recv_exact as _recv_exact,
+    recv_line as _recv_line,
+)
 
-_LEN = struct.Struct(">Q")
 _ERR_SENTINEL = (1 << 64) - 1
 KV_PREFIX = "coworker/"
+
+
+class CoworkerFailedError(RuntimeError):
+    """The coworker's preprocessing pipeline crashed (distinct from
+    being unreachable, which failover tolerates)."""
 
 
 def encode_batch(batch: Dict[str, np.ndarray]) -> bytes:
@@ -54,16 +62,6 @@ def encode_batch(batch: Dict[str, np.ndarray]) -> bytes:
 def decode_batch(payload: bytes) -> Dict[str, np.ndarray]:
     with np.load(io.BytesIO(payload), allow_pickle=False) as z:
         return {k: z[k] for k in z.files}
-
-
-def _recv_exact(conn: socket.socket, n: int) -> bytes:
-    buf = b""
-    while len(buf) < n:
-        chunk = conn.recv(min(1 << 20, n - len(buf)))
-        if not chunk:
-            raise ConnectionError("peer closed mid-message")
-        buf += chunk
-    return buf
 
 
 class CoworkerServer:
@@ -149,13 +147,11 @@ class CoworkerServer:
                 conn.close()
 
     def _handle(self, conn: socket.socket):
-        line = b""
-        while not line.endswith(b"\n"):
-            c = conn.recv(1)
-            if not c:
-                return
-            line += c
-        if line.strip() != b"GET":
+        try:
+            line = _recv_line(conn)
+        except ConnectionError:
+            return
+        if line != "GET":
             return
         payload = None
         while payload is None and not self._stopped.is_set():
@@ -165,16 +161,30 @@ class CoworkerServer:
                 if self._exhausted.is_set() and self._queue.empty():
                     break
         if payload is None:
-            # a crashed pipeline must not look like a clean end of the
-            # data source — the client turns the sentinel into failover
-            conn.sendall(
-                _LEN.pack(
-                    _ERR_SENTINEL if self._failed.is_set() else 0
-                )
-            )
+            if self._failed.is_set():
+                # a crashed pipeline must not look like a clean end of
+                # the source — the client raises on the sentinel
+                conn.sendall(_LEN.pack(_ERR_SENTINEL))
+            elif self._exhausted.is_set():
+                conn.sendall(_LEN.pack(0))  # clean end of data
+            # stopping with data still queued: close WITHOUT replying —
+            # the client treats the broken connection as unreachable
+            # and fails over, never as end-of-data
             return
-        conn.sendall(_LEN.pack(len(payload)))
-        conn.sendall(payload)
+        try:
+            conn.sendall(_LEN.pack(len(payload)))
+            conn.sendall(payload)
+        except (OSError, ConnectionError):
+            # the client vanished mid-send (timeout/restart): the batch
+            # was popped but not delivered — put it back so the sample
+            # is not silently dropped from the epoch
+            try:
+                self._queue.put_nowait(payload)
+            except queue.Full:
+                logger.warning(
+                    "dropping one batch: send failed and queue full"
+                )
+            raise
 
     # -- registration -----------------------------------------------------
     def register(self, master_client, coworker_id: int,
@@ -199,7 +209,8 @@ class CoworkerClient:
         self._addrs = list(addrs)
         self._timeout = timeout
         self._next = 0
-        self._dead: set = set()
+        self._dead: set = set()  # unreachable (tolerated: failover)
+        self._failed: set = set()  # reported pipeline FAILURE
 
     @classmethod
     def from_master(cls, master_client, max_coworkers: int = 64,
@@ -221,7 +232,7 @@ class CoworkerClient:
             conn.sendall(b"GET\n")
             size = _LEN.unpack(_recv_exact(conn, _LEN.size))[0]
             if size == _ERR_SENTINEL:
-                raise ConnectionError(
+                raise CoworkerFailedError(
                     f"coworker {addr} reports preprocessing failure"
                 )
             if size == 0:
@@ -243,6 +254,11 @@ class CoworkerClient:
             addr = self._addrs[idx]
             try:
                 batch = self._fetch(addr)
+            except CoworkerFailedError as e:
+                logger.error("coworker %s: %s", addr, e)
+                self._dead.add(idx)
+                self._failed.add(idx)
+                continue
             except (OSError, ConnectionError) as e:
                 logger.warning(
                     "coworker %s unreachable (%s); failing over",
@@ -254,10 +270,21 @@ class CoworkerClient:
                 exhausted += 1
                 continue
             return batch
-        if exhausted == 0 and len(self._dead) >= n:
+        if self._failed:
+            # ANY coworker that reported a preprocessing failure means
+            # part of the dataset was dropped — surfacing end-of-epoch
+            # here would silently truncate training data
             raise RuntimeError(
-                "every coworker failed (none exhausted cleanly); "
-                "refusing to present a crashed pipeline as end-of-data"
+                f"coworker(s) {sorted(self._failed)} reported "
+                "preprocessing failures; refusing to present a crashed "
+                "pipeline as end-of-data"
+            )
+        if exhausted == 0 and len(self._dead) >= n:
+            # no coworker ever finished cleanly and all are gone: a
+            # fully-dead data plane is an outage, not end-of-epoch
+            raise RuntimeError(
+                "all coworkers unreachable with none cleanly "
+                "exhausted; data plane is down"
             )
         return None
 

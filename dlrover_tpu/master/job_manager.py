@@ -101,6 +101,26 @@ class JobManager(metaclass=ABCMeta):
         self._stopped = False
         self._paral_config = ParallelConfig()
         self._restart_verdicts: Dict[int, bool] = {}
+        from dlrover_tpu.master.error_monitor import ErrorMonitor
+        from dlrover_tpu.master.node_managers import NodeGroupRegistry
+
+        self._error_monitor = ErrorMonitor()
+        self._node_groups = NodeGroupRegistry()
+        self._stop_reason: Optional[str] = None
+
+    @property
+    def error_monitor(self):
+        return self._error_monitor
+
+    @property
+    def node_groups(self):
+        return self._node_groups
+
+    def should_stop_job(self) -> Optional[str]:
+        """Non-None when a failure classification or a critical node
+        group decided the job cannot continue (checked by the master's
+        supervision loop)."""
+        return self._stop_reason
 
     def add_node_event_callback(self, callback: NodeEventCallback):
         self._event_callbacks.append(callback)
@@ -168,6 +188,7 @@ class JobManager(metaclass=ABCMeta):
                 if event.event_type == NodeEventType.DELETED:
                     node.is_released = True
                 fire = True
+            self._node_groups.route(node)
         if fire:
             self._fire_callbacks(node, new_status)
 
@@ -229,6 +250,13 @@ class JobManager(metaclass=ABCMeta):
             "training failure on %s-%s (restart %s, level %s): %s",
             node_type, node_id, restart_count, level, error_data,
         )
+        # classify the failure and record the recommended recovery
+        # rung (error monitor — ref monitor/error_monitor.py)
+        action = None
+        if self._error_monitor is not None:
+            action = self._error_monitor.report(
+                node_id, node_type, error_data
+            )
         with self._lock:
             node = self._nodes.get(node_id)
             if node is None:
@@ -236,6 +264,30 @@ class JobManager(metaclass=ABCMeta):
             if level == TrainingExceptionLevel.NODE_ERROR:
                 node.set_exit_reason(NodeExitReason.HARDWARE_ERROR)
                 self._restart_verdicts[node_id] = True
+            elif action is not None:
+                from dlrover_tpu.master.error_monitor import (
+                    RecoveryAction,
+                )
+
+                if action == RecoveryAction.RELAUNCH_NODE:
+                    node.set_exit_reason(NodeExitReason.HARDWARE_ERROR)
+                    self._restart_verdicts[node_id] = True
+                elif action == RecoveryAction.GROW_MEMORY:
+                    node.set_exit_reason(NodeExitReason.OOM)
+                    self._restart_verdicts[node_id] = True
+                elif action == RecoveryAction.STOP_JOB:
+                    # deterministic user-code failure: burning the
+                    # relaunch budget on it wastes cluster time
+                    self._stop_reason = (
+                        f"node {node_id}: repeated user-code failure"
+                    )
+            # critical-group accounting (chief semantics)
+            self._node_groups.route(node)
+            if self._node_groups.job_should_stop(node):
+                self._stop_reason = (
+                    f"critical {node.type} node {node_id} exhausted "
+                    "its relaunch budget"
+                )
 
     def should_restart_node(self, node_type: str, node_id: int) -> bool:
         return self._restart_verdicts.pop(node_id, False)

@@ -171,6 +171,12 @@ class DistributedJobMaster(JobMaster):
                 else:
                     self.request_stop(True, JobExitReason.SUCCEEDED)
                 break
+            stop_reason = self.job_manager.should_stop_job()
+            if stop_reason:
+                logger.error("stopping job: %s", stop_reason)
+                self.request_stop(False, JobExitReason.WORKER_ERROR)
+                exit_code = 1
+                break
             if self.speed_monitor.step_is_stagnant():
                 logger.warning("global step stagnant: possible hang")
                 self.request_stop(False, JobExitReason.HANG_ERROR)

@@ -98,6 +98,16 @@ class RLHFTrainer:
             lambda p, t: token_logprobs(actor_forward(p, t), t)
         )
         self._value_fn = jax.jit(critic_value)
+        ppo = config.ppo
+        # batched GAE: one dispatch for the whole rollout instead of a
+        # per-sample trace/transfer loop
+        self._gae_fn = jax.jit(
+            jax.vmap(
+                lambda r, v: compute_gae(
+                    r, v, gamma=ppo.gamma, lam=ppo.lam
+                )
+            )
+        )
 
     # -- experience ------------------------------------------------------
     def make_experience(self, prompts: jnp.ndarray, rng) -> Dict:
@@ -122,15 +132,19 @@ class RLHFTrainer:
         seq_reward = np.asarray(self._reward_fn(tokens))
 
         b, total = tokens.shape
+        # KL-shaped per-token rewards, sequence reward at the last
+        # response token — vectorized over the rollout
+        r = -ppo.kl_coef * (old_logp - ref_logp) * mask_t
+        has_resp = mask_t.any(axis=1)
+        last = np.where(
+            has_resp,
+            (mask_t * np.arange(total - 1)[None]).argmax(axis=1),
+            total - 2,
+        )
+        r[np.arange(b), last] += seq_reward
+        adv, ret = self._gae_fn(jnp.asarray(r), jnp.asarray(values))
+        adv, ret = np.asarray(adv), np.asarray(ret)
         for i in range(b):
-            # KL-shaped per-token rewards, sequence reward at the end
-            r = -ppo.kl_coef * (old_logp[i] - ref_logp[i]) * mask_t[i]
-            last = int(mask_t[i].nonzero()[0][-1]) if mask_t[i].any() else total - 2
-            r[last] += float(seq_reward[i])
-            adv, ret = compute_gae(
-                jnp.asarray(r), jnp.asarray(values[i]),
-                gamma=ppo.gamma, lam=ppo.lam,
-            )
             self.buffer.add(
                 {
                     "tokens": tokens[i],
@@ -138,8 +152,8 @@ class RLHFTrainer:
                     "old_logp": old_logp[i],
                     "ref_logp": ref_logp[i],
                     "old_values": values[i, :-1],
-                    "advantages": np.asarray(adv),
-                    "returns": np.asarray(ret),
+                    "advantages": adv[i],
+                    "returns": ret[i],
                 }
             )
         return {

@@ -100,6 +100,16 @@ def _load_library():
         ]
         lib.kv_evict_below.restype = ctypes.c_int64
         lib.kv_evict_below.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.kv_version.restype = ctypes.c_uint64
+        lib.kv_version.argtypes = [ctypes.c_void_p]
+        lib.kv_export_delta.restype = ctypes.c_int64
+        lib.kv_export_delta.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64,
+        ]
         _lib_handle = lib
         return lib
 
@@ -226,3 +236,44 @@ class KvTable:
                 self._handle, ctypes.c_uint64(min_frequency)
             )
         )
+
+    # -- delta checkpointing ----------------------------------------------
+    @property
+    def version(self) -> int:
+        """Current mutation stamp; pass to :meth:`export_delta` later
+        to persist only rows touched in between (reference delta
+        export, ``kv_variable_ops.py:198-273``)."""
+        return int(self._lib.kv_version(self._handle))
+
+    def export_delta(
+        self, since_version: int
+    ) -> Tuple[np.ndarray, np.ndarray, int]:
+        """(keys, values, cut_version) for rows updated after
+        ``since_version`` — incremental checkpoints write this instead
+        of the full table."""
+        cut = self.version
+        count = int(
+            self._lib.kv_export_delta(
+                self._handle,
+                ctypes.c_uint64(since_version),
+                None,
+                None,
+                0,
+            )
+        )
+        keys = np.empty(count, dtype=np.int64)
+        values = np.empty((count, self.dim), dtype=np.float32)
+        if count:
+            written = int(
+                self._lib.kv_export_delta(
+                    self._handle,
+                    ctypes.c_uint64(since_version),
+                    _i64_ptr(keys),
+                    _f32_ptr(values),
+                    count,
+                )
+            )
+            if written < 0:
+                raise RuntimeError("kv_export_delta capacity race")
+            keys, values = keys[:written], values[:written]
+        return keys, values, cut

@@ -1,10 +1,16 @@
-"""Sparse optimizers over the KvTable (Adam / Adagrad family).
+"""Sparse optimizers over the KvTable.
 
 Reference parity: tfplus's sparse training kernels
-(``kv_variable/kernels/training_ops.cc`` — Adagrad, Adam, GroupAdam
-etc. applied per touched row).  Moments live in sibling KvTables so
-state grows with the touched-id set, exactly like the reference's
-slot variables.
+(``kv_variable/kernels/training_ops.cc:7236`` — Adagrad, Adam,
+GroupAdam, GroupAdagrad, SparseGroupFtrl, RectifiedAdam applied per
+touched row).  Moments live in sibling KvTables so state grows with
+the touched-id set, exactly like the reference's slot variables.
+
+The "Group" family adds group-lasso regularization at embedding-row
+granularity: after the base update, each row is shrunk toward zero as
+a whole (``w *= max(0, 1 - lr*l21/||w||)``) so unused/noisy ids prune
+to exact zeros — the feature-selection behavior the reference's group
+optimizers exist for.
 """
 
 from typing import Dict
@@ -12,6 +18,27 @@ from typing import Dict
 import numpy as np
 
 from dlrover_tpu.sparse.kv_table import KvTable
+
+
+def _dedup(keys: np.ndarray, grads: np.ndarray, dim: int):
+    keys = np.asarray(keys, dtype=np.int64).reshape(-1)
+    grads = np.asarray(grads, dtype=np.float32).reshape(keys.size, dim)
+    uniq, inverse = np.unique(keys, return_inverse=True)
+    g = np.zeros((uniq.size, dim), dtype=np.float32)
+    np.add.at(g, inverse, grads)
+    return uniq, g
+
+
+def _group_shrink(table: KvTable, keys: np.ndarray, strength: float):
+    """Row-wise group-lasso proximal step: shrink each touched row
+    toward zero as a unit; rows whose norm falls below the threshold
+    become exact zeros (feature pruning)."""
+    if strength <= 0:
+        return
+    w = table.gather(keys, count_frequency=False)
+    norms = np.linalg.norm(w, axis=1, keepdims=True)
+    scale = np.maximum(0.0, 1.0 - strength / np.maximum(norms, 1e-12))
+    table.scatter(keys, w * scale)
 
 
 class SparseAdam:
@@ -31,13 +58,7 @@ class SparseAdam:
         self._step = 0
 
     def update(self, keys: np.ndarray, grads: np.ndarray):
-        keys = np.asarray(keys, dtype=np.int64).reshape(-1)
-        grads = np.asarray(grads, dtype=np.float32).reshape(
-            keys.size, self.table.dim
-        )
-        uniq, inverse = np.unique(keys, return_inverse=True)
-        g = np.zeros((uniq.size, self.table.dim), dtype=np.float32)
-        np.add.at(g, inverse, grads)
+        uniq, g = _dedup(keys, grads, self.table.dim)
 
         self._step += 1
         m = self._m.gather(uniq, count_frequency=False)
@@ -50,6 +71,7 @@ class SparseAdam:
         bc2 = 1 - self.b2**self._step
         update = self.lr * (m / bc1) / (np.sqrt(v / bc2) + self.eps)
         self.table.scatter(uniq, update, op=KvTable.SCATTER_SUB)
+        return uniq
 
     def state_dict(self) -> Dict:
         mk, mv = self._m.export()
@@ -75,15 +97,130 @@ class SparseAdagrad:
         self._accum = KvTable(table.dim)
 
     def update(self, keys: np.ndarray, grads: np.ndarray):
-        keys = np.asarray(keys, dtype=np.int64).reshape(-1)
-        grads = np.asarray(grads, dtype=np.float32).reshape(
-            keys.size, self.table.dim
-        )
-        uniq, inverse = np.unique(keys, return_inverse=True)
-        g = np.zeros((uniq.size, self.table.dim), dtype=np.float32)
-        np.add.at(g, inverse, grads)
+        uniq, g = _dedup(keys, grads, self.table.dim)
         acc = self._accum.gather(uniq, count_frequency=False)
         acc = acc + g * g
         self._accum.scatter(uniq, acc)
         update = self.lr * g / (np.sqrt(acc) + self.eps)
         self.table.scatter(uniq, update, op=KvTable.SCATTER_SUB)
+        return uniq
+
+
+class SparseGroupAdam(SparseAdam):
+    """Adam + row-wise group lasso (ref ``GroupAdam``)."""
+
+    def __init__(self, table: KvTable, learning_rate: float = 1e-3,
+                 l21: float = 0.0, **kwargs):
+        super().__init__(table, learning_rate, **kwargs)
+        self.l21 = l21
+
+    def update(self, keys: np.ndarray, grads: np.ndarray):
+        uniq = super().update(keys, grads)
+        _group_shrink(self.table, uniq, self.lr * self.l21)
+        return uniq
+
+
+class SparseGroupAdagrad(SparseAdagrad):
+    """Adagrad + row-wise group lasso (ref ``GroupAdagrad``)."""
+
+    def __init__(self, table: KvTable, learning_rate: float = 0.1,
+                 l21: float = 0.0, **kwargs):
+        super().__init__(table, learning_rate, **kwargs)
+        self.l21 = l21
+
+    def update(self, keys: np.ndarray, grads: np.ndarray):
+        uniq = super().update(keys, grads)
+        _group_shrink(self.table, uniq, self.lr * self.l21)
+        return uniq
+
+
+class SparseGroupFtrl:
+    """FTRL-proximal with per-row group lasso (ref ``SparseGroupFtrl``
+    ``training_ops.cc``): z/n accumulators per touched row; a row whose
+    ||z|| stays under the l21 threshold snaps to exact zero."""
+
+    def __init__(
+        self,
+        table: KvTable,
+        learning_rate: float = 0.1,
+        beta: float = 1.0,
+        l1: float = 0.0,
+        l2: float = 0.0,
+        l21: float = 0.0,
+    ):
+        self.table = table
+        self.lr = learning_rate
+        self.beta = beta
+        self.l1, self.l2, self.l21 = l1, l2, l21
+        self._z = KvTable(table.dim)
+        self._n = KvTable(table.dim)
+
+    def update(self, keys: np.ndarray, grads: np.ndarray):
+        uniq, g = _dedup(keys, grads, self.table.dim)
+        w = self.table.gather(uniq, count_frequency=False)
+        z = self._z.gather(uniq, count_frequency=False)
+        n = self._n.gather(uniq, count_frequency=False)
+        n_new = n + g * g
+        sigma = (np.sqrt(n_new) - np.sqrt(n)) / self.lr
+        z = z + g - sigma * w
+        self._z.scatter(uniq, z)
+        self._n.scatter(uniq, n_new)
+
+        # per-coordinate l1 shrink, then per-row group threshold
+        z_shrunk = np.sign(z) * np.maximum(np.abs(z) - self.l1, 0.0)
+        denom = (self.beta + np.sqrt(n_new)) / self.lr + self.l2
+        row_norm = np.linalg.norm(
+            z_shrunk, axis=1, keepdims=True
+        )
+        group_scale = np.maximum(
+            0.0, 1.0 - self.l21 / np.maximum(row_norm, 1e-12)
+        )
+        w_new = -(z_shrunk * group_scale) / denom
+        self.table.scatter(uniq, w_new)
+        return uniq
+
+    def state_dict(self) -> Dict:
+        zk, zv = self._z.export()
+        nk, nv = self._n.export()
+        return {
+            "z_keys": zk, "z_values": zv,
+            "n_keys": nk, "n_values": nv,
+        }
+
+    def load_state_dict(self, state: Dict):
+        self._z.import_(state["z_keys"], state["z_values"])
+        self._n.import_(state["n_keys"], state["n_values"])
+
+
+class SparseRAdam(SparseAdam):
+    """Rectified Adam (ref ``RectifiedAdam`` sparse kernel): the
+    adaptive term is variance-rectified and disabled during the early
+    steps where the second-moment estimate is unreliable."""
+
+    def update(self, keys: np.ndarray, grads: np.ndarray):
+        uniq, g = _dedup(keys, grads, self.table.dim)
+
+        self._step += 1
+        t = self._step
+        m = self._m.gather(uniq, count_frequency=False)
+        v = self._v.gather(uniq, count_frequency=False)
+        m = self.b1 * m + (1 - self.b1) * g
+        v = self.b2 * v + (1 - self.b2) * g * g
+        self._m.scatter(uniq, m)
+        self._v.scatter(uniq, v)
+
+        rho_inf = 2.0 / (1.0 - self.b2) - 1.0
+        b2t = self.b2**t
+        rho = rho_inf - 2.0 * t * b2t / (1.0 - b2t)
+        m_hat = m / (1 - self.b1**t)
+        if rho > 4.0:
+            r = np.sqrt(
+                ((rho - 4) * (rho - 2) * rho_inf)
+                / ((rho_inf - 4) * (rho_inf - 2) * rho)
+            )
+            v_hat = np.sqrt(v / (1 - b2t))
+            update = self.lr * r * m_hat / (v_hat + self.eps)
+        else:
+            update = self.lr * m_hat  # un-adapted SGD-with-momentum
+        self.table.scatter(uniq, update, op=KvTable.SCATTER_SUB)
+        return uniq

@@ -97,6 +97,19 @@ class CheckpointEngine:
         self._rank = process_rank
         self._world = process_count
         self._node_rank = node_rank
+        if name == "default" and checkpoint_dir:
+            # namespace the shm/lock/queue names by checkpoint dir:
+            # /dev/shm is machine-global, so two jobs both called
+            # "default" would collide — observed as one job's exit
+            # (close(unlink=True)) deleting the other's live 3 GB
+            # snapshot segment.  Hashing the dir keeps the name stable
+            # across restarts of the SAME job (resume depends on it).
+            import hashlib
+
+            digest = hashlib.sha1(
+                os.path.abspath(checkpoint_dir).encode()
+            ).hexdigest()[:8]
+            name = f"d{digest}"
         self._name = name
         self._storage = storage or get_checkpoint_storage()
         self._local_saver: Optional[AsyncCheckpointSaver] = None

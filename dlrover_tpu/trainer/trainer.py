@@ -45,6 +45,11 @@ class TrainingArgs:
     capture_loss_spikes: bool = False
     spike_dir: str = ""
     metrics_port: int = 0  # 0 = no exporter daemon
+    # snapshot buffering: "auto" picks "copy" (one on-device state
+    # copy, non-blocking drain — transient 2x state HBM) when it fits,
+    # "staged" (leaf-wise device->host, extra HBM = one leaf, but the
+    # step blocks for the transfer) near HBM capacity
+    snapshot_mode: str = "auto"
     extra: dict = field(default_factory=dict)
 
 
@@ -101,6 +106,9 @@ class Trainer:
             else None
         )
         self._snap_fn = None
+        self._snapshot_mode = (
+            None if args.snapshot_mode == "auto" else args.snapshot_mode
+        )
         self._registry = None
         self._exporter = None
         if args.metrics_port:
@@ -136,6 +144,52 @@ class Trainer:
         return start_step
 
     # ------------------------------------------------------------- save
+    def _resolve_snapshot_mode(self) -> str:
+        """"copy" when a second on-device state fits comfortably,
+        "staged" otherwise (round-2 advisor: the full jnp.copy is a 2x
+        HBM transient — fatal near capacity; the staged path trades
+        step blocking for bounded memory)."""
+        mode = self._args.snapshot_mode
+        if mode != "auto":
+            return mode
+        from dlrover_tpu.accelerate.analyser import device_memory_bytes
+
+        def per_device_bytes(leaf):
+            """What ONE device actually holds: full size when the leaf
+            is replicated (dp-only state!), its shard when sharded —
+            dividing the global size by device count would claim a
+            replicated 10 GB state costs 1.25 GB/device and pick
+            "copy" exactly where it OOMs."""
+            try:
+                by_device = {}
+                for s in leaf.addressable_shards:
+                    by_device[s.device] = (
+                        by_device.get(s.device, 0) + s.data.nbytes
+                    )
+                if by_device:
+                    return max(by_device.values())
+            except Exception:  # noqa: BLE001
+                pass
+            return leaf.size * leaf.dtype.itemsize
+
+        state_bytes = sum(
+            per_device_bytes(leaf)
+            for leaf in jax.tree_util.tree_leaves(self.state)
+        )
+        # a copy is safe when state + its copy stay under ~80% of HBM
+        fits = 2 * state_bytes <= 0.8 * device_memory_bytes()
+        return "copy" if fits else "staged"
+
+    @staticmethod
+    def _staged_device_get(state):
+        """Leaf-wise synchronous device->host: zero extra HBM, at the
+        cost of blocking the step for the full transfer.  Runs inline
+        on the training thread, so no later train step can donate the
+        buffers mid-pull — no on-device pinning copy is needed."""
+        import numpy as np
+
+        return jax.tree_util.tree_map(np.asarray, state)
+
     def _maybe_checkpoint(self, step: int):
         if self._engine is None:
             return
@@ -143,14 +197,22 @@ class Trainer:
         to_memory = step % self._args.save_memory_interval == 0
         if not (to_storage or to_memory):
             return
-        # snapshot an on-device COPY (cheap HBM->HBM) so the async
-        # device->host drain can proceed while subsequent train steps
-        # donate and overwrite self.state's buffers
-        if self._snap_fn is None:
-            self._snap_fn = jax.jit(
-                lambda s: jax.tree_util.tree_map(jax.numpy.copy, s)
-            )
-        snap = self._snap_fn(self.state)
+        if self._snapshot_mode is None:
+            self._snapshot_mode = self._resolve_snapshot_mode()
+            logger.info("snapshot mode: %s", self._snapshot_mode)
+        if self._snapshot_mode == "staged":
+            # bounded memory: state is already on host, the engine
+            # drain is a pure shm memcpy
+            snap = self._staged_device_get(self.state)
+        else:
+            # snapshot an on-device COPY (cheap HBM->HBM) so the async
+            # device->host drain can proceed while subsequent train
+            # steps donate and overwrite self.state's buffers
+            if self._snap_fn is None:
+                self._snap_fn = jax.jit(
+                    lambda s: jax.tree_util.tree_map(jax.numpy.copy, s)
+                )
+            snap = self._snap_fn(self.state)
         if to_storage:
             self._engine.save_to_storage(step, snap, blocking=False)
         else:

@@ -11,6 +11,7 @@
 //
 // Build: g++ -O2 -shared -fPIC -o libkvtable.so kv_table.cc -lpthread
 
+#include <atomic>
 #include <cstdint>
 #include <cstring>
 #include <memory>
@@ -26,6 +27,10 @@ constexpr int kNumShards = 16;  // lock striping
 struct Row {
   std::unique_ptr<float[]> data;
   uint64_t frequency = 0;
+  // global update stamp for delta export (reference delta
+  // import/export, kv_variable_ops.py:198-273): rows touched after a
+  // cut can be exported alone
+  uint64_t version = 0;
 };
 
 struct Shard {
@@ -37,6 +42,7 @@ struct KvTable {
   int dim;
   float init_stddev;
   uint64_t seed;
+  std::atomic<uint64_t> version{0};  // bumped by every mutation
   Shard shards[kNumShards];
 
   explicit KvTable(int d, float stddev, uint64_t s)
@@ -104,6 +110,7 @@ void kv_gather(void* handle, const int64_t* keys, int64_t n, float* out,
       Row row;
       row.data.reset(new float[dim]);
       t->init_row(key, row.data.get());
+      row.version = ++t->version;
       it = s.map.emplace(key, std::move(row)).first;
     }
     if (count_freq) it->second.frequency++;
@@ -140,7 +147,39 @@ void kv_scatter(void* handle, const int64_t* keys, int64_t n,
         for (int j = 0; j < dim; ++j) dst[j] -= src[j];
         break;
     }
+    it->second.version = ++t->version;
   }
+}
+
+// The current mutation stamp; pair with kv_export_delta to persist
+// only rows touched since the last cut (delta checkpointing).
+uint64_t kv_version(void* handle) {
+  return static_cast<KvTable*>(handle)->version.load();
+}
+
+// Export rows with version > since_version (two-call protocol like
+// kv_export).  Reference: delta export switches
+// (tfplus kv_variable_ops.py:198-273).
+int64_t kv_export_delta(void* handle, uint64_t since_version,
+                        int64_t* keys, float* values,
+                        int64_t capacity) {
+  auto* t = static_cast<KvTable*>(handle);
+  const int dim = t->dim;
+  int64_t count = 0;
+  for (auto& s : t->shards) {
+    std::lock_guard<std::mutex> lk(s.mu);
+    for (auto& kvp : s.map) {
+      if (kvp.second.version <= since_version) continue;
+      if (keys != nullptr) {
+        if (count >= capacity) return -1;  // caller buffer too small
+        keys[count] = kvp.first;
+        std::memcpy(values + count * dim, kvp.second.data.get(),
+                    sizeof(float) * dim);
+      }
+      ++count;
+    }
+  }
+  return count;
 }
 
 uint64_t kv_frequency(void* handle, int64_t key) {

@@ -207,6 +207,113 @@ class TestWatcher:
         assert received and received[0].node.id == 0
 
 
+class TestErrorMonitor:
+    """Log-based failure classification -> recovery ladder rung
+    (ref monitor/error_monitor.py + the 75%-process-restart finding)."""
+
+    def test_classification_to_actions(self):
+        from dlrover_tpu.master.error_monitor import (
+            ErrorMonitor,
+            RecoveryAction,
+        )
+
+        mon = ErrorMonitor()
+        assert (
+            mon.report(0, "worker", "RESOURCE_EXHAUSTED: out of memory")
+            == RecoveryAction.GROW_MEMORY
+        )
+        assert (
+            mon.report(1, "worker", "TPU device lost: chip failure")
+            == RecoveryAction.RELAUNCH_NODE
+        )
+        assert (
+            mon.report(2, "worker", "connection reset by peer")
+            == RecoveryAction.RESTART_PROCESS
+        )
+        assert (
+            mon.report(3, "worker", "maintenance event: preempted")
+            == RecoveryAction.RELAUNCH_NODE
+        )
+        assert mon.summary()["oom"] == 1
+
+    def test_repeated_user_code_errors_stop_job(self):
+        from dlrover_tpu.master.error_monitor import (
+            ErrorMonitor,
+            RecoveryAction,
+        )
+
+        mon = ErrorMonitor(user_code_threshold=3)
+        tb = "Traceback (most recent call last)\nValueError: bad"
+        # deterministic bug: first two failures retry, the third stops
+        assert mon.report(0, "worker", tb) == (
+            RecoveryAction.RESTART_PROCESS
+        )
+        assert mon.report(0, "worker", tb) == (
+            RecoveryAction.RESTART_PROCESS
+        )
+        assert mon.report(0, "worker", tb) == RecoveryAction.STOP_JOB
+
+
+class TestNodeTypeManagers:
+    """Chief/worker/evaluator accounting (ref node/worker.py)."""
+
+    def test_chief_failure_is_fatal_after_budget(self):
+        from dlrover_tpu.master.node_managers import NodeGroupRegistry
+
+        reg = NodeGroupRegistry(max_relaunch_count=1)
+        chief = Node(
+            node_type=NodeType.CHIEF, node_id=0,
+            status=NodeStatus.FAILED,
+        )
+        reg.route(chief)
+        assert not reg.job_should_stop(chief)  # budget left
+        chief.inc_relaunch_count()
+        assert reg.job_should_stop(chief)  # budget exhausted + critical
+
+    def test_worker_failure_never_fatal(self):
+        from dlrover_tpu.master.node_managers import NodeGroupRegistry
+
+        reg = NodeGroupRegistry(max_relaunch_count=0)
+        worker = Node(node_type=NodeType.WORKER, node_id=1,
+                      status=NodeStatus.FAILED)
+        reg.route(worker)
+        assert not reg.job_should_stop(worker)
+
+    def test_training_finished_ignores_evaluators(self):
+        from dlrover_tpu.master.node_managers import NodeGroupRegistry
+
+        reg = NodeGroupRegistry()
+        w = Node(node_type=NodeType.WORKER, node_id=0,
+                 status=NodeStatus.SUCCEEDED)
+        e = Node(node_type=NodeType.EVALUATOR, node_id=10,
+                 status=NodeStatus.RUNNING)
+        reg.route(w)
+        reg.route(e)
+        assert reg.training_finished()
+        assert reg.manager(NodeType.EVALUATOR).wait_for_evaluation()
+
+    def test_job_manager_classifies_oom(self):
+        """The failure report path feeds the error monitor and marks
+        the node's exit reason."""
+        from dlrover_tpu.common.constants import (
+            NodeExitReason,
+            TrainingExceptionLevel,
+        )
+        from dlrover_tpu.master.job_manager import LocalJobManager
+
+        mgr = LocalJobManager()
+        node = Node(node_type=NodeType.WORKER, node_id=0,
+                    status=NodeStatus.RUNNING)
+        mgr._nodes[0] = node
+        mgr.handle_training_failure(
+            NodeType.WORKER, 0, 0,
+            "RESOURCE_EXHAUSTED: out of memory allocating 3GB",
+            TrainingExceptionLevel.PROCESS_ERROR,
+        )
+        assert node.exit_reason == NodeExitReason.OOM
+        assert mgr.error_monitor.summary()["oom"] == 1
+
+
 class TestDiagnosis:
     def test_oom_inference(self):
         mgr = DiagnosisManager()

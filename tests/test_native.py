@@ -143,6 +143,82 @@ class TestSparseOptimizers:
         )
         assert losses[-1] < 0.1 * losses[0]
 
+    def test_sparse_radam(self):
+        from dlrover_tpu.sparse.optimizers import SparseRAdam
+
+        # RAdam deliberately under-steps early (rectification ramps the
+        # adaptive term in) — allow a looser convergence bar
+        losses = self._fit(lambda t: SparseRAdam(t, learning_rate=0.5))
+        assert losses[-1] < 0.2 * losses[0]
+
+    def test_sparse_group_ftrl_converges(self):
+        from dlrover_tpu.sparse.optimizers import SparseGroupFtrl
+
+        losses = self._fit(
+            lambda t: SparseGroupFtrl(t, learning_rate=1.0)
+        )
+        assert losses[-1] < 0.1 * losses[0]
+
+    def test_group_lasso_prunes_untrained_rows(self):
+        """Strong group regularization drives rows with tiny gradients
+        to exact zeros (the feature-selection contract of the Group
+        family) while strongly-pulled rows survive."""
+        from dlrover_tpu.sparse.optimizers import SparseGroupAdam
+
+        table = KvTable(4, init_stddev=0.1, seed=5)
+        opt = SparseGroupAdam(table, learning_rate=0.1, l21=1.0)
+        ids = np.arange(4, dtype=np.int64)
+        strong_target = np.full((2, 4), 5.0, dtype=np.float32)
+        for _ in range(60):
+            rows = table.gather(ids, count_frequency=False)
+            grad = np.zeros((4, 4), dtype=np.float32)
+            # rows 0-1 pulled hard toward 5; rows 2-3 receive no
+            # gradient (untouched features) and must be pruned by the
+            # group penalty. (Adam is scale-invariant, so even tiny
+            # CONSTANT gradients read as full-size signal — zero is
+            # the honest model of an unused id.)
+            grad[:2] = 2 * (rows[:2] - strong_target) / 8
+            opt.update(ids, grad)
+        rows = table.gather(ids, count_frequency=False)
+        assert np.abs(rows[2:]).max() == 0.0  # pruned to exact zero
+        assert np.abs(rows[:2]).min() > 0.5  # survivors keep signal
+
+    def test_delta_export(self):
+        """Incremental checkpointing: only rows touched after the cut
+        are exported (ref tfplus delta export)."""
+        table = KvTable(2, init_stddev=0.0)
+        table.scatter(np.array([1, 2]), np.ones((2, 2), np.float32))
+        cut = table.version
+        keys, values, _ = table.export_delta(cut)
+        assert keys.size == 0  # nothing touched since the cut
+        table.scatter(
+            np.array([2, 3]), np.full((2, 2), 7.0, np.float32)
+        )
+        keys, values, cut2 = table.export_delta(cut)
+        assert sorted(keys.tolist()) == [2, 3]
+        assert float(values[0, 0]) == 7.0
+        assert cut2 > cut
+        # the delta replays onto a fresh table
+        t2 = KvTable(2)
+        t2.import_(keys, values)
+        np.testing.assert_array_equal(
+            t2.gather(np.array([2]), count_frequency=False)[0],
+            [7.0, 7.0],
+        )
+
+    def test_group_ftrl_state_roundtrip(self):
+        from dlrover_tpu.sparse.optimizers import SparseGroupFtrl
+
+        table = KvTable(2, init_stddev=0.1, seed=1)
+        opt = SparseGroupFtrl(table, learning_rate=0.5)
+        opt.update(np.array([1, 2]), np.ones((2, 2), np.float32))
+        state = opt.state_dict()
+        table2 = KvTable(2)
+        opt2 = SparseGroupFtrl(table2, learning_rate=0.5)
+        opt2.load_state_dict(state)
+        zk, zv = opt2._z.export()
+        assert set(zk.tolist()) == {1, 2}
+
 
 class TestMetricsExporter:
     def test_registry_and_daemon(self, tmp_path):
