@@ -114,10 +114,18 @@ class AsyncCheckpointSaver:
         if t is not None and t.is_alive():
             t.join(timeout=60)
             if t.is_alive():
+                # the handles must stay open (the stuck persist holds
+                # buffer views), but named POSIX shm is NOT reclaimed
+                # at process exit — unlink the names now (safe while
+                # mapped) so the multi-GB segments die with the last
+                # process instead of squatting in /dev/shm until reboot
                 logger.error(
                     "ckpt saver event loop still busy after 60s; "
-                    "leaking shm handles for process-exit reclaim"
+                    "unlinking shm names, leaving handles open"
                 )
+                if unlink:
+                    for handler in self._shm_handlers:
+                        handler.unlink_name()
                 return
         for handler in self._shm_handlers:
             handler.close(unlink=unlink)

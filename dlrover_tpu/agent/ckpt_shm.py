@@ -395,6 +395,21 @@ class SharedMemoryHandler:
         )
         return True
 
+    def unlink_name(self):
+        """Remove the segment's /dev/shm name WITHOUT closing the
+        mapping (POSIX: safe while mapped; the memory dies when the
+        last process unmaps).  For teardown paths that must leave live
+        buffer views untouched."""
+        try:
+            if self._shm is not None:
+                self._shm.unlink()
+            else:
+                SharedMemory(self._shm_name).unlink()
+        except FileNotFoundError:
+            pass
+        except Exception as e:  # noqa: BLE001
+            logger.warning("unlink of %s failed: %s", self._shm_name, e)
+
     def close(self, unlink: bool = False):
         if self._shm is not None:
             self._shm.close()

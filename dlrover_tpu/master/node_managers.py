@@ -26,7 +26,11 @@ class TrainingNodeManager:
     # a failure of this group kills the job (chief semantics)
     critical = False
 
-    def __init__(self, max_relaunch_count: int = 3):
+    def __init__(self, max_relaunch_count: Optional[int] = None):
+        """``max_relaunch_count`` overrides the per-node budget when
+        given; None (default) honors each Node's own configured
+        ``max_relaunch_count`` — a registry-level default would
+        silently diverge from the env-configured budget."""
         self._nodes: Dict[int, Node] = {}
         self._max_relaunch = max_relaunch_count
 
@@ -58,12 +62,14 @@ class TrainingNodeManager:
         )
 
     def relaunchable(self, node: Node) -> bool:
-        """May this node be relaunched after a failure? (budget per
-        node — ref ``Node`` relaunch bookkeeping)."""
-        return (
-            node.relaunchable
-            and node.relaunch_count < self._max_relaunch
-        )
+        """May this node be relaunched after a failure? Delegates to
+        the node's OWN budget (ref ``Node`` relaunch bookkeeping)
+        unless the registry pins an override."""
+        if not node.relaunchable:
+            return False
+        if self._max_relaunch is not None:
+            return node.relaunch_count < self._max_relaunch
+        return not node.exceeded_max_relaunch()
 
     def failure_is_fatal(self, node: Node) -> bool:
         """Does this failure end the job?"""
@@ -110,7 +116,7 @@ class NodeGroupRegistry:
     """Routes nodes to their per-type manager (the reference keeps one
     manager per replica group inside DistributedJobManager)."""
 
-    def __init__(self, max_relaunch_count: int = 3):
+    def __init__(self, max_relaunch_count: Optional[int] = None):
         self._managers: Dict[str, TrainingNodeManager] = {}
         self._max_relaunch = max_relaunch_count
 

@@ -22,43 +22,68 @@ from jax.experimental.pallas import tpu as pltpu
 BLOCK = 1024
 _LANES = 128
 _SUBLANES = BLOCK // _LANES
+# Mosaic requires the scales output's second-minor block dim to be a
+# multiple of 8 (or the whole array): handle 8 quant blocks per kernel
+# invocation so the scales block is a legal (8, 1)
+_GROUP = 8
 
 
 def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _quant_kernel(x_ref, q_ref, scale_ref):
-    x = x_ref[:].astype(jnp.float32)  # [S, 128]
-    absmax = jnp.max(jnp.abs(x))
-    scale = jnp.maximum(absmax / 127.0, 1e-12)
-    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
-    q_ref[:] = q
-    scale_ref[0, 0] = scale
+def _quant_kernel(x_ref, q_ref, scale_ref, *, group: int):
+    # x: [group * _SUBLANES, 128]; static unrolled loop per quant
+    # block — no in-kernel reshapes, one scalar scale store per block
+    for g in range(group):
+        lo, hi = g * _SUBLANES, (g + 1) * _SUBLANES
+        x = x_ref[lo:hi].astype(jnp.float32)
+        absmax = jnp.max(jnp.abs(x))
+        scale = jnp.maximum(absmax / 127.0, 1e-12)
+        q_ref[lo:hi] = jnp.clip(
+            jnp.round(x / scale), -127, 127
+        ).astype(jnp.int8)
+        scale_ref[g, 0] = scale
 
 
-def _dequant_kernel(q_ref, scale_ref, x_ref):
-    x_ref[:] = q_ref[:].astype(jnp.float32) * scale_ref[0, 0]
+def _dequant_kernel(q_ref, scale_ref, x_ref, *, group: int):
+    for g in range(group):
+        lo, hi = g * _SUBLANES, (g + 1) * _SUBLANES
+        x_ref[lo:hi] = (
+            q_ref[lo:hi].astype(jnp.float32) * scale_ref[g, 0]
+        )
+
+
+def _group_for(n_blocks: int) -> int:
+    """Scales block legality: second-minor block dim must be a
+    multiple of 8 OR the whole array dim — small tensors use one
+    whole-array invocation instead of paying 8-block padding."""
+    return n_blocks if n_blocks < _GROUP else _GROUP
 
 
 @jax.jit
 def _quantize_2d(x):
     n_blocks = x.shape[0] // _SUBLANES
-    grid = (n_blocks,)
+    group = _group_for(n_blocks)
     q, scales = pl.pallas_call(
-        _quant_kernel,
+        functools.partial(_quant_kernel, group=group),
         out_shape=(
             jax.ShapeDtypeStruct(x.shape, jnp.int8),
             jax.ShapeDtypeStruct((n_blocks, 1), jnp.float32),
         ),
-        grid=grid,
+        grid=(n_blocks // group,),
         in_specs=[
-            pl.BlockSpec((_SUBLANES, _LANES), lambda i: (i, 0)),
+            pl.BlockSpec(
+                (group * _SUBLANES, _LANES), lambda i: (i, 0)
+            ),
         ],
         out_specs=(
-            pl.BlockSpec((_SUBLANES, _LANES), lambda i: (i, 0)),
             pl.BlockSpec(
-                (1, 1), lambda i: (i, 0), memory_space=pltpu.SMEM
+                (group * _SUBLANES, _LANES), lambda i: (i, 0)
+            ),
+            pl.BlockSpec(
+                (group, 1), lambda i: (i, 0),
+                memory_space=pltpu.SMEM,
             ),
         ),
         interpret=_use_interpret(),
@@ -69,17 +94,23 @@ def _quantize_2d(x):
 @jax.jit
 def _dequantize_2d(q, scales):
     n_blocks = q.shape[0] // _SUBLANES
+    group = _group_for(n_blocks)
     return pl.pallas_call(
-        _dequant_kernel,
+        functools.partial(_dequant_kernel, group=group),
         out_shape=jax.ShapeDtypeStruct(q.shape, jnp.float32),
-        grid=(n_blocks,),
+        grid=(n_blocks // group,),
         in_specs=[
-            pl.BlockSpec((_SUBLANES, _LANES), lambda i: (i, 0)),
             pl.BlockSpec(
-                (1, 1), lambda i: (i, 0), memory_space=pltpu.SMEM
+                (group * _SUBLANES, _LANES), lambda i: (i, 0)
+            ),
+            pl.BlockSpec(
+                (group, 1), lambda i: (i, 0),
+                memory_space=pltpu.SMEM,
             ),
         ],
-        out_specs=pl.BlockSpec((_SUBLANES, _LANES), lambda i: (i, 0)),
+        out_specs=pl.BlockSpec(
+            (group * _SUBLANES, _LANES), lambda i: (i, 0)
+        ),
         interpret=_use_interpret(),
     )(q, scales)
 
@@ -87,6 +118,11 @@ def _dequantize_2d(q, scales):
 def _pad_to_blocks(flat):
     n = flat.shape[0]
     padded = ((n + BLOCK - 1) // BLOCK) * BLOCK
+    n_blocks = padded // BLOCK
+    if n_blocks > _GROUP and n_blocks % _GROUP:
+        # large tensors round their BLOCK count to a full kernel group
+        n_blocks += _GROUP - (n_blocks % _GROUP)
+        padded = n_blocks * BLOCK
     if padded != n:
         flat = jnp.pad(flat, (0, padded - n))
     return flat, n

@@ -85,11 +85,19 @@ def quantized_moments(
         def moment_update(g, mu_q, nu_q):
             g = g.astype(jnp.float32)
             mu = b1 * _dequant(mu_q) + (1 - b1) * g
-            nu = b2 * _dequant(nu_q) + (1 - b2) * g * g
+            # nu is stored as sqrt(nu): linear int8 on raw nu
+            # underflows small second moments to zero inside a block
+            # dominated by one large value (blockwise absmax scale) and
+            # the rsqrt then explodes the update — compressing the
+            # dynamic range by storing the root keeps 1e-8-class
+            # moments representable (the reference's low-bit optimizers
+            # use nonlinear quantization maps for the same reason)
+            nu_root = _dequant(nu_q)
+            nu = b2 * nu_root * nu_root + (1 - b2) * g * g
             update = -(learning_rate) * (
                 (mu / bc1) / (jnp.sqrt(nu / bc2) + eps)
             )
-            return update, _quant(mu), _quant(nu)
+            return update, _quant(mu), _quant(jnp.sqrt(nu))
 
         out = jax.tree_util.tree_map(
             moment_update, grads, state.mu, state.nu

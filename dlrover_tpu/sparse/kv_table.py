@@ -246,34 +246,44 @@ class KvTable:
         return int(self._lib.kv_version(self._handle))
 
     def export_delta(
-        self, since_version: int
+        self, since_version: int, max_retries: int = 8
     ) -> Tuple[np.ndarray, np.ndarray, int]:
         """(keys, values, cut_version) for rows updated after
         ``since_version`` — incremental checkpoints write this instead
-        of the full table."""
-        cut = self.version
-        count = int(
-            self._lib.kv_export_delta(
-                self._handle,
-                ctypes.c_uint64(since_version),
-                None,
-                None,
-                0,
+        of the full table.
+
+        Concurrent-training safe: the delta grows between the sizing
+        call and the copy whenever a training thread touches rows, so
+        the copy allocates headroom and retries with a fresh (larger)
+        count when it still loses the race."""
+        headroom = 1024
+        for _ in range(max_retries):
+            cut = self.version
+            count = int(
+                self._lib.kv_export_delta(
+                    self._handle,
+                    ctypes.c_uint64(since_version),
+                    None,
+                    None,
+                    0,
+                )
             )
-        )
-        keys = np.empty(count, dtype=np.int64)
-        values = np.empty((count, self.dim), dtype=np.float32)
-        if count:
+            capacity = count + headroom
+            keys = np.empty(capacity, dtype=np.int64)
+            values = np.empty((capacity, self.dim), dtype=np.float32)
             written = int(
                 self._lib.kv_export_delta(
                     self._handle,
                     ctypes.c_uint64(since_version),
                     _i64_ptr(keys),
                     _f32_ptr(values),
-                    count,
+                    capacity,
                 )
-            )
-            if written < 0:
-                raise RuntimeError("kv_export_delta capacity race")
-            keys, values = keys[:written], values[:written]
-        return keys, values, cut
+            ) if capacity else 0
+            if written >= 0:
+                return keys[:written], values[:written], cut
+            headroom *= 4  # lost the race: grow and recount
+        raise RuntimeError(
+            "kv_export_delta kept losing the sizing race; table is "
+            "being mutated faster than it can be scanned"
+        )
