@@ -121,7 +121,8 @@ class AsyncCheckpointSaver:
                 # process instead of squatting in /dev/shm until reboot
                 logger.error(
                     "ckpt saver event loop still busy after 60s; "
-                    "unlinking shm names, leaving handles open"
+                    "leaving handles open%s",
+                    ", unlinking shm names" if unlink else "",
                 )
                 if unlink:
                     for handler in self._shm_handlers:

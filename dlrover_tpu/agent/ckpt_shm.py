@@ -404,7 +404,9 @@ class SharedMemoryHandler:
             if self._shm is not None:
                 self._shm.unlink()
             else:
-                SharedMemory(self._shm_name).unlink()
+                shm = SharedMemory(self._shm_name)
+                shm.unlink()
+                shm.close()  # drop the just-created mapping
         except FileNotFoundError:
             pass
         except Exception as e:  # noqa: BLE001

@@ -553,8 +553,8 @@ def flash_attention_lse(
     v: jnp.ndarray,
     causal: bool = True,
     sm_scale: Optional[float] = None,
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
 ):
     """Like :func:`flash_attention` but also returns the per-row
     log-sum-exp ``[B, S, H]`` — the residual that lets callers merge
@@ -563,6 +563,10 @@ def flash_attention_lse(
     into ds inside the backward kernels)."""
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
+    if block_q is None:
+        block_q = _default_blocks(q.shape[1])
+    if block_k is None:
+        block_k = _default_blocks(q.shape[1])
     nh, nkv = q.shape[2], k.shape[2]
     if nh % nkv != 0:
         raise ValueError(f"heads {nh} not a multiple of kv {nkv}")
@@ -577,24 +581,38 @@ def flash_attention_lse(
     )
 
 
+def _default_blocks(seq_len: int) -> int:
+    """Measured on v5e ([.,.,8,128] bf16 fwd+bwd): 512x512 wins at
+    seq 2048 (4.9 vs 6.6 ms for 1024s); 1024x1024 wins at seq 16384
+    (8.4 vs 12.6 ms) — bigger tiles amortize grid overhead once the
+    KV loop is long."""
+    return 1024 if seq_len >= 8192 else 512
+
+
 def flash_attention(
     q: jnp.ndarray,  # [B, S, H, D]
     k: jnp.ndarray,  # [B, S, KV, D]
     v: jnp.ndarray,
     causal: bool = True,
     sm_scale: Optional[float] = None,
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
 ) -> jnp.ndarray:
     """Drop-in replacement for
     ``dlrover_tpu.models.llama.dot_product_attention`` (same [B,S,H,D]
     layout + GQA broadcast).
 
-    Default blocks 512x512: measured on v5e at [8,2048,8,128] bf16,
-    fwd+bwd runs 7.6x faster than 128x128 (1.8 ms vs 13.5 ms) and 4.4x
-    faster than the dense XLA path."""
+    Default blocks are sequence-adaptive (512 short / 1024 long, see
+    ``_default_blocks``); at [8,2048,8,128] bf16 the tuned kernel runs
+    fwd+bwd 7.6x faster than naive 128x128 blocking and 4.4x faster
+    than the dense XLA path, and stays functional to 32k tokens on one
+    chip where dense attention cannot materialize the score matrix."""
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
+    if block_q is None:
+        block_q = _default_blocks(q.shape[1])
+    if block_k is None:
+        block_k = _default_blocks(q.shape[1])
     nh, nkv = q.shape[2], k.shape[2]
     if nh % nkv != 0:
         raise ValueError(f"heads {nh} not a multiple of kv {nkv}")

@@ -131,6 +131,12 @@ def _pad_to_blocks(flat):
 def quantize_blockwise(x: jnp.ndarray):
     """Any-shape fp array -> (int8 payload [P/128,128], scales, meta)."""
     flat = x.reshape(-1).astype(jnp.float32)
+    if flat.shape[0] == 0:  # zero-size leaf: nothing to quantize
+        return (
+            jnp.zeros((0, _LANES), jnp.int8),
+            jnp.zeros((0, 1), jnp.float32),
+            (x.shape, 0),
+        )
     flat, n = _pad_to_blocks(flat)
     x2 = flat.reshape(-1, _LANES)
     q, scales = _quantize_2d(x2)
@@ -139,5 +145,7 @@ def quantize_blockwise(x: jnp.ndarray):
 
 def dequantize_blockwise(q, scales, meta, dtype=jnp.float32):
     shape, n = meta
+    if n == 0:
+        return jnp.zeros(shape, dtype)
     out = _dequantize_2d(q, scales).reshape(-1)[:n]
     return out.reshape(shape).astype(dtype)

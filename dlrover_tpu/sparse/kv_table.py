@@ -102,6 +102,14 @@ def _load_library():
         lib.kv_evict_below.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.kv_version.restype = ctypes.c_uint64
         lib.kv_version.argtypes = [ctypes.c_void_p]
+        lib.kv_enable_spill.restype = ctypes.c_int
+        lib.kv_enable_spill.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+        ]
+        lib.kv_spill_below.restype = ctypes.c_int64
+        lib.kv_spill_below.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.kv_spilled_count.restype = ctypes.c_uint64
+        lib.kv_spilled_count.argtypes = [ctypes.c_void_p]
         lib.kv_export_delta.restype = ctypes.c_int64
         lib.kv_export_delta.argtypes = [
             ctypes.c_void_p,
@@ -237,6 +245,40 @@ class KvTable:
             )
         )
 
+    # -- hybrid storage (disk tier) ----------------------------------------
+    def enable_spill(self, path: str):
+        """Attach a disk tier: cold rows move there via
+        :meth:`spill_below` and fault back into RAM on access
+        (reference hybrid storage, ``hybrid_embedding/
+        table_manager.h:547``)."""
+        rc = self._lib.kv_enable_spill(
+            self._handle, path.encode()
+        )
+        if rc == -2:
+            raise RuntimeError(
+                "rows are already spilled; rotating the spill file "
+                "would destroy them — gather them back or export first"
+            )
+        if rc != 0:
+            raise OSError(f"cannot open spill file {path}")
+
+    def spill_below(self, min_frequency: int) -> int:
+        """Move rows colder than ``min_frequency`` to the disk tier
+        (unlike :meth:`evict_below`, nothing is lost); returns the
+        spilled count."""
+        n = int(
+            self._lib.kv_spill_below(
+                self._handle, ctypes.c_uint64(min_frequency)
+            )
+        )
+        if n < 0:
+            raise RuntimeError("spill tier not enabled")
+        return n
+
+    @property
+    def spilled_count(self) -> int:
+        return int(self._lib.kv_spilled_count(self._handle))
+
     # -- delta checkpointing ----------------------------------------------
     @property
     def version(self) -> int:
@@ -279,7 +321,7 @@ class KvTable:
                     _f32_ptr(values),
                     capacity,
                 )
-            ) if capacity else 0
+            )
             if written >= 0:
                 return keys[:written], values[:written], cut
             headroom *= 4  # lost the race: grow and recount

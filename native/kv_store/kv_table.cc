@@ -11,12 +11,16 @@
 //
 // Build: g++ -O2 -shared -fPIC -o libkvtable.so kv_table.cc -lpthread
 
+#include <fcntl.h>
+#include <unistd.h>
+
 #include <atomic>
 #include <cstdint>
 #include <cstring>
 #include <memory>
 #include <mutex>
 #include <random>
+#include <string>
 #include <unordered_map>
 #include <vector>
 
@@ -38,15 +42,62 @@ struct Shard {
   std::unordered_map<int64_t, Row> map;
 };
 
+// Disk tier for cold rows (reference hybrid storage,
+// tfplus hybrid_embedding/table_manager.h:547): spilled rows live in
+// a record file as [frequency u64][version u64][dim floats]; a gather
+// miss faults the row back into RAM.  Freed slots are recycled through
+// a free list so spill/fault-back cycles don't grow the file without
+// bound.  Lock order: shard mutex -> spill mutex (all paths; whole-
+// table scans take every shard lock first, then spill).
+struct SpillTier {
+  std::mutex mu;
+  int fd = -1;
+  int64_t next_offset = 0;
+  std::unordered_map<int64_t, int64_t> index;  // key -> file offset
+  std::vector<int64_t> free_offsets;  // recycled record slots
+
+  ~SpillTier() {
+    if (fd >= 0) ::close(fd);
+  }
+};
+
 struct KvTable {
   int dim;
   float init_stddev;
   uint64_t seed;
   std::atomic<uint64_t> version{0};  // bumped by every mutation
   Shard shards[kNumShards];
+  SpillTier spill;
 
   explicit KvTable(int d, float stddev, uint64_t s)
       : dim(d), init_stddev(stddev), seed(s) {}
+
+  size_t record_bytes() const {
+    return 2 * sizeof(uint64_t) + sizeof(float) * dim;
+  }
+
+  // Try to fault a spilled row back in; returns true when found.
+  // Caller holds the SHARD lock for `key`.
+  bool fault_in(int64_t key, Row* row) {
+    std::lock_guard<std::mutex> lk(spill.mu);
+    if (spill.fd < 0) return false;
+    auto it = spill.index.find(key);
+    if (it == spill.index.end()) return false;
+    std::vector<char> buf(record_bytes());
+    if (::pread(spill.fd, buf.data(), buf.size(), it->second) !=
+        static_cast<ssize_t>(buf.size())) {
+      return false;
+    }
+    std::memcpy(&row->frequency, buf.data(), sizeof(uint64_t));
+    std::memcpy(&row->version, buf.data() + sizeof(uint64_t),
+                sizeof(uint64_t));
+    row->data.reset(new float[dim]);
+    std::memcpy(row->data.get(), buf.data() + 2 * sizeof(uint64_t),
+                sizeof(float) * dim);
+    spill.free_offsets.push_back(it->second);  // recycle the slot
+    spill.index.erase(it);  // RAM copy is authoritative again
+    return true;
+  }
 
   Shard& shard_for(int64_t key) {
     // mix bits so sequential ids spread across shards
@@ -63,6 +114,20 @@ struct KvTable {
     std::mt19937_64 gen(seed ^ static_cast<uint64_t>(key));
     std::normal_distribution<float> dist(0.0f, init_stddev);
     for (int i = 0; i < dim; ++i) out[i] = dist(gen);
+  }
+};
+
+// Hold every shard lock (in index order) for a whole-table scan, so
+// concurrent fault-ins / spills cannot move rows between the RAM and
+// disk passes (a row migrating mid-scan would be missed or counted
+// twice).  Lock order stays shard(s) -> spill: other threads hold at
+// most one shard before spill, and cannot acquire it while the scan
+// holds all of them.
+struct AllShardsLock {
+  std::vector<std::unique_lock<std::mutex>> locks;
+  explicit AllShardsLock(KvTable* t) {
+    locks.reserve(kNumShards);
+    for (auto& s : t->shards) locks.emplace_back(s.mu);
   }
 };
 
@@ -103,15 +168,20 @@ void kv_gather(void* handle, const int64_t* keys, int64_t n, float* out,
     std::lock_guard<std::mutex> lk(s.mu);
     auto it = s.map.find(key);
     if (it == s.map.end()) {
-      if (!insert_missing) {
+      Row row;
+      if (t->fault_in(key, &row)) {
+        // cold row comes back from the disk tier with its frequency
+        row.version = ++t->version;
+        it = s.map.emplace(key, std::move(row)).first;
+      } else if (!insert_missing) {
         std::memset(out + i * dim, 0, sizeof(float) * dim);
         continue;
+      } else {
+        row.data.reset(new float[dim]);
+        t->init_row(key, row.data.get());
+        row.version = ++t->version;
+        it = s.map.emplace(key, std::move(row)).first;
       }
-      Row row;
-      row.data.reset(new float[dim]);
-      t->init_row(key, row.data.get());
-      row.version = ++t->version;
-      it = s.map.emplace(key, std::move(row)).first;
     }
     if (count_freq) it->second.frequency++;
     std::memcpy(out + i * dim, it->second.data.get(),
@@ -133,7 +203,9 @@ void kv_scatter(void* handle, const int64_t* keys, int64_t n,
     auto it = s.map.find(key);
     if (it == s.map.end()) {
       Row row;
-      row.data.reset(new float[dim]());
+      if (!t->fault_in(key, &row)) {  // updating a spilled row must
+        row.data.reset(new float[dim]());  // not silently reset it
+      }
       it = s.map.emplace(key, std::move(row)).first;
     }
     float* dst = it->second.data.get();
@@ -166,8 +238,8 @@ int64_t kv_export_delta(void* handle, uint64_t since_version,
   auto* t = static_cast<KvTable*>(handle);
   const int dim = t->dim;
   int64_t count = 0;
+  AllShardsLock all(t);  // atomic view (see kv_export)
   for (auto& s : t->shards) {
-    std::lock_guard<std::mutex> lk(s.mu);
     for (auto& kvp : s.map) {
       if (kvp.second.version <= since_version) continue;
       if (keys != nullptr) {
@@ -177,6 +249,33 @@ int64_t kv_export_delta(void* handle, uint64_t since_version,
                     sizeof(float) * dim);
       }
       ++count;
+    }
+  }
+  // spilled rows keep their version: one updated after the cut and
+  // spilled since must still reach the incremental checkpoint
+  {
+    std::lock_guard<std::mutex> lk(t->spill.mu);
+    if (t->spill.fd >= 0) {
+      std::vector<char> buf(t->record_bytes());
+      for (auto& kvp : t->spill.index) {
+        if (::pread(t->spill.fd, buf.data(), buf.size(),
+                    kvp.second) !=
+            static_cast<ssize_t>(buf.size())) {
+          continue;
+        }
+        uint64_t ver;
+        std::memcpy(&ver, buf.data() + sizeof(uint64_t),
+                    sizeof(uint64_t));
+        if (ver <= since_version) continue;
+        if (keys != nullptr) {
+          if (count >= capacity) return -1;
+          keys[count] = kvp.first;
+          std::memcpy(values + count * dim,
+                      buf.data() + 2 * sizeof(uint64_t),
+                      sizeof(float) * dim);
+        }
+        ++count;
+      }
     }
   }
   return count;
@@ -198,8 +297,8 @@ int64_t kv_export(void* handle, uint64_t min_frequency, int64_t* keys,
   auto* t = static_cast<KvTable*>(handle);
   const int dim = t->dim;
   int64_t count = 0;
+  AllShardsLock all(t);  // atomic view: no RAM<->disk moves mid-scan
   for (auto& s : t->shards) {
-    std::lock_guard<std::mutex> lk(s.mu);
     for (auto& kvp : s.map) {
       if (kvp.second.frequency < min_frequency) continue;
       if (keys != nullptr) {
@@ -211,6 +310,32 @@ int64_t kv_export(void* handle, uint64_t min_frequency, int64_t* keys,
       ++count;
     }
   }
+  // disk-tier rows are part of the table: a checkpoint must include
+  // them (spilled != deleted)
+  {
+    std::lock_guard<std::mutex> lk(t->spill.mu);
+    if (t->spill.fd >= 0) {
+      std::vector<char> buf(t->record_bytes());
+      for (auto& kvp : t->spill.index) {
+        if (::pread(t->spill.fd, buf.data(), buf.size(),
+                    kvp.second) !=
+            static_cast<ssize_t>(buf.size())) {
+          continue;
+        }
+        uint64_t freq;
+        std::memcpy(&freq, buf.data(), sizeof(uint64_t));
+        if (freq < min_frequency) continue;
+        if (keys != nullptr) {
+          if (count >= capacity) return -1;
+          keys[count] = kvp.first;
+          std::memcpy(values + count * dim,
+                      buf.data() + 2 * sizeof(uint64_t),
+                      sizeof(float) * dim);
+        }
+        ++count;
+      }
+    }
+  }
   return count;
 }
 
@@ -218,6 +343,77 @@ int64_t kv_export(void* handle, uint64_t min_frequency, int64_t* keys,
 void kv_import(void* handle, const int64_t* keys, int64_t n,
                const float* values) {
   kv_scatter(handle, keys, n, values, /*op=*/0);
+}
+
+// Enable the disk tier: cold rows spill to `path` and fault back on
+// access (reference hybrid storage).  Returns 0 on success, -2 when
+// rows are already spilled (rotating the file would destroy them —
+// fault everything back or export first).
+int kv_enable_spill(void* handle, const char* path) {
+  auto* t = static_cast<KvTable*>(handle);
+  std::lock_guard<std::mutex> lk(t->spill.mu);
+  if (!t->spill.index.empty()) return -2;
+  if (t->spill.fd >= 0) ::close(t->spill.fd);
+  t->spill.fd = ::open(path, O_RDWR | O_CREAT | O_TRUNC, 0600);
+  t->spill.next_offset = 0;
+  t->spill.free_offsets.clear();
+  return t->spill.fd >= 0 ? 0 : -1;
+}
+
+// Move rows with frequency < min_frequency to the disk tier (instead
+// of destroying them like kv_evict_below).  Returns spilled count,
+// -1 when the tier is not enabled.
+int64_t kv_spill_below(void* handle, uint64_t min_frequency) {
+  auto* t = static_cast<KvTable*>(handle);
+  {
+    std::lock_guard<std::mutex> lk(t->spill.mu);
+    if (t->spill.fd < 0) return -1;
+  }
+  const size_t rec = t->record_bytes();
+  std::vector<char> buf(rec);
+  int64_t spilled = 0;
+  for (auto& s : t->shards) {
+    std::lock_guard<std::mutex> lk(s.mu);
+    for (auto it = s.map.begin(); it != s.map.end();) {
+      if (it->second.frequency >= min_frequency) {
+        ++it;
+        continue;
+      }
+      std::memcpy(buf.data(), &it->second.frequency, sizeof(uint64_t));
+      std::memcpy(buf.data() + sizeof(uint64_t),
+                  &it->second.version, sizeof(uint64_t));
+      std::memcpy(buf.data() + 2 * sizeof(uint64_t),
+                  it->second.data.get(), sizeof(float) * t->dim);
+      {
+        std::lock_guard<std::mutex> sk(t->spill.mu);
+        int64_t off;
+        bool recycled = !t->spill.free_offsets.empty();
+        if (recycled) {
+          off = t->spill.free_offsets.back();
+          t->spill.free_offsets.pop_back();
+        } else {
+          off = t->spill.next_offset;
+        }
+        if (::pwrite(t->spill.fd, buf.data(), rec, off) !=
+            static_cast<ssize_t>(rec)) {
+          if (recycled) t->spill.free_offsets.push_back(off);
+          ++it;
+          continue;  // disk full/IO error: keep the row in RAM
+        }
+        t->spill.index[it->first] = off;
+        if (!recycled) t->spill.next_offset += static_cast<int64_t>(rec);
+      }
+      it = s.map.erase(it);
+      ++spilled;
+    }
+  }
+  return spilled;
+}
+
+uint64_t kv_spilled_count(void* handle) {
+  auto* t = static_cast<KvTable*>(handle);
+  std::lock_guard<std::mutex> lk(t->spill.mu);
+  return t->spill.index.size();
 }
 
 // Remove keys below a frequency threshold (under-frequency eviction,

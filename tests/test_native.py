@@ -183,6 +183,38 @@ class TestSparseOptimizers:
         assert np.abs(rows[2:]).max() == 0.0  # pruned to exact zero
         assert np.abs(rows[:2]).min() > 0.5  # survivors keep signal
 
+    def test_hybrid_storage_spill_and_fault_back(self, tmp_path):
+        """Cold rows spill to disk and fault back with value AND
+        frequency intact; exports still see spilled rows (spilled is
+        not deleted)."""
+        table = KvTable(2, init_stddev=0.0)
+        table.enable_spill(str(tmp_path / "spill.bin"))
+        hot = np.array([1], dtype=np.int64)
+        cold = np.array([2], dtype=np.int64)
+        table.scatter(hot, np.full((1, 2), 10.0, np.float32))
+        table.scatter(cold, np.full((1, 2), 20.0, np.float32))
+        for _ in range(5):
+            table.gather(hot)  # heat up key 1
+        table.gather(cold)  # freq 1
+        n = table.spill_below(3)
+        assert n == 1 and table.spilled_count == 1
+        assert len(table) == 1  # only the hot row in RAM
+        # full export still includes the spilled row
+        keys, values = table.export()
+        assert sorted(keys.tolist()) == [1, 2]
+        # access faults it back with value and frequency
+        row = table.gather(cold, count_frequency=False)
+        np.testing.assert_array_equal(row[0], [20.0, 20.0])
+        assert table.spilled_count == 0
+        assert table.frequency(2) == 1  # survived the round trip
+        # scatter on a spilled row must not reset it
+        table.spill_below(3)
+        table.scatter(cold, np.ones((1, 2), np.float32),
+                      op=KvTable.SCATTER_ADD)
+        np.testing.assert_array_equal(
+            table.gather(cold, count_frequency=False)[0], [21.0, 21.0]
+        )
+
     def test_delta_export(self):
         """Incremental checkpointing: only rows touched after the cut
         are exported (ref tfplus delta export)."""
