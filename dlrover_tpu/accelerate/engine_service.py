@@ -1,0 +1,157 @@
+"""Strategy-generation service: acceleration decisions as an RPC.
+
+Reference parity: ``atorch/atorch/auto/engine/acceleration_engine.py:13``
+— the reference spawns a gRPC service (``engine/servicer.py`` +
+``engine/client.py``) whose executor walks ANALYSE → candidate
+generation → DRYRUN tasks so a whole cluster shares one strategy
+brain.  The TPU form rides the same 2-RPC pickled-dataclass transport
+the master uses (``common/comm.py``): a client submits a model profile
+(abstract shapes — no weights cross the wire), the service answers
+with ranked, memory-fit, workload-aware candidates; timed dry runs
+stay client-side where the devices are (the reference's dry-run
+workers are device-local too).
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.accelerate.analyser import ModelProfile
+from dlrover_tpu.accelerate.strategy import (
+    Strategy,
+    generate_candidates,
+)
+from dlrover_tpu.common.comm import MasterChannel, build_master_server
+from dlrover_tpu.common.env import get_free_port
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.messages import (
+    BoolResponse,
+    Message,
+    deserialize_message,
+)
+
+
+@dataclass
+class StrategyRequest(Message):
+    """Client -> service: the analysed model + workload shape."""
+
+    num_params: int = 0
+    param_bytes: int = 0
+    optimizer_bytes: int = 0
+    activation_bytes_per_sample: int = 0
+    num_layers: int = 0
+    n_devices: int = 1
+    batch_per_replica: int = 1
+    seq_len: int = 2048
+    long_context: bool = False
+    moe: bool = False
+    max_candidates: int = 8
+
+
+@dataclass
+class StrategyResponse(Message):
+    """Ranked candidates as Strategy kwargs dicts (wire-stable)."""
+
+    candidates: List = field(default_factory=list)
+
+
+def _strategy_to_dict(s: Strategy) -> Dict:
+    return {
+        "data": s.data,
+        "fsdp": s.fsdp,
+        "tensor": s.tensor,
+        "seq": s.seq,
+        "expert": s.expert,
+        "pipe": s.pipe,
+        "remat": s.remat,
+        "num_micro_steps": s.num_micro_steps,
+    }
+
+
+class StrategyService:
+    """The in-process brain behind the RPC surface (usable directly —
+    the service wrapper only adds the wire)."""
+
+    def generate(self, req: StrategyRequest) -> StrategyResponse:
+        profile = ModelProfile(
+            num_params=req.num_params,
+            param_bytes=req.param_bytes,
+            largest_leaf=0,
+            leaf_count=0,
+            optimizer_bytes=req.optimizer_bytes,
+            activation_bytes_per_sample=(
+                req.activation_bytes_per_sample
+            ),
+            num_layers=req.num_layers,
+        )
+        cands = generate_candidates(
+            profile,
+            req.n_devices,
+            long_context=req.long_context,
+            moe=req.moe,
+            batch_per_replica=req.batch_per_replica,
+            seq_len=req.seq_len,
+        )[: req.max_candidates]
+        return StrategyResponse(
+            candidates=[_strategy_to_dict(s) for s in cands]
+        )
+
+
+def start_strategy_service(
+    port: int = 0,
+) -> Tuple[object, int]:
+    """Start the service; returns (grpc server, port)."""
+    port = port or get_free_port()
+    brain = StrategyService()
+
+    def report_fn(envelope):
+        return BoolResponse(success=True)
+
+    def get_fn(envelope):
+        req = deserialize_message(envelope.data)
+        if isinstance(req, StrategyRequest):
+            return brain.generate(req)
+        return None
+
+    server = build_master_server(port, report_fn, get_fn)
+    server.start()
+    logger.info("strategy service on port %d", port)
+    return server, port
+
+
+class StrategyClient:
+    """Client side: profile in, ranked Strategy list out."""
+
+    def __init__(self, addr: str):
+        self._channel = MasterChannel(addr)
+
+    def request_candidates(
+        self,
+        profile: ModelProfile,
+        n_devices: int,
+        batch_per_replica: int = 1,
+        seq_len: int = 2048,
+        long_context: bool = False,
+        moe: bool = False,
+    ) -> List[Strategy]:
+        resp = self._channel.get(
+            StrategyRequest(
+                num_params=profile.num_params,
+                param_bytes=profile.param_bytes,
+                optimizer_bytes=profile.optimizer_bytes,
+                activation_bytes_per_sample=(
+                    profile.activation_bytes_per_sample
+                ),
+                num_layers=profile.num_layers,
+                n_devices=n_devices,
+                batch_per_replica=batch_per_replica,
+                seq_len=seq_len,
+                long_context=long_context,
+                moe=moe,
+            )
+        )
+        if resp is None:
+            return []
+        return [Strategy(**kw) for kw in resp.candidates]
+
+    def close(self):
+        self._channel.close()

@@ -214,6 +214,10 @@ class KvTable:
                 0,
             )
         )
+        if count == -2:
+            raise RuntimeError(
+                "spill record unreadable; export would be incomplete"
+            )
         keys = np.empty(count, dtype=np.int64)
         values = np.empty((count, self.dim), dtype=np.float32)
         if count:
@@ -226,6 +230,11 @@ class KvTable:
                     count,
                 )
             )
+            if written == -2:
+                raise RuntimeError(
+                    "spill record unreadable; export would be "
+                    "incomplete"
+                )
             if written < 0:
                 raise RuntimeError("kv_export capacity race")
             keys, values = keys[:written], values[:written]
@@ -310,6 +319,11 @@ class KvTable:
                     0,
                 )
             )
+            if count == -2:
+                raise RuntimeError(
+                    "spill record unreadable; delta export would be "
+                    "incomplete"
+                )
             capacity = count + headroom
             keys = np.empty(capacity, dtype=np.int64)
             values = np.empty((capacity, self.dim), dtype=np.float32)
@@ -322,6 +336,11 @@ class KvTable:
                     capacity,
                 )
             )
+            if written == -2:
+                raise RuntimeError(
+                    "spill record unreadable; delta export would be "
+                    "incomplete"
+                )
             if written >= 0:
                 return keys[:written], values[:written], cut
             headroom *= 4  # lost the race: grow and recount

@@ -16,6 +16,7 @@
 
 #include <atomic>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <memory>
 #include <mutex>
@@ -84,19 +85,34 @@ struct KvTable {
     auto it = spill.index.find(key);
     if (it == spill.index.end()) return false;
     std::vector<char> buf(record_bytes());
-    if (::pread(spill.fd, buf.data(), buf.size(), it->second) !=
-        static_cast<ssize_t>(buf.size())) {
-      return false;
+    bool ok =
+        ::pread(spill.fd, buf.data(), buf.size(), it->second) ==
+        static_cast<ssize_t>(buf.size());
+    if (ok) {
+      std::memcpy(&row->frequency, buf.data(), sizeof(uint64_t));
+      std::memcpy(&row->version, buf.data() + sizeof(uint64_t),
+                  sizeof(uint64_t));
+      row->data.reset(new float[dim]);
+      std::memcpy(row->data.get(), buf.data() + 2 * sizeof(uint64_t),
+                  sizeof(float) * dim);
+    } else {
+      // unreadable record: the row's data is gone either way, but the
+      // index entry MUST go too — keeping it while the caller inserts
+      // a fresh RAM row would leave the key resident in both tiers
+      // (double export, spilled_count stuck, enable_spill blocked)
+      std::fprintf(
+          stderr,
+          "kv_table: spill read of key %lld failed; row lost\n",
+          static_cast<long long>(key));
     }
-    std::memcpy(&row->frequency, buf.data(), sizeof(uint64_t));
-    std::memcpy(&row->version, buf.data() + sizeof(uint64_t),
-                sizeof(uint64_t));
-    row->data.reset(new float[dim]);
-    std::memcpy(row->data.get(), buf.data() + 2 * sizeof(uint64_t),
-                sizeof(float) * dim);
     spill.free_offsets.push_back(it->second);  // recycle the slot
-    spill.index.erase(it);  // RAM copy is authoritative again
-    return true;
+    spill.index.erase(it);  // RAM side is authoritative again
+    return ok;
+  }
+
+  bool spill_enabled() {
+    std::lock_guard<std::mutex> lk(spill.mu);
+    return spill.fd >= 0;
   }
 
   Shard& shard_for(int64_t key) {
@@ -229,56 +245,19 @@ uint64_t kv_version(void* handle) {
   return static_cast<KvTable*>(handle)->version.load();
 }
 
+static int64_t kv_export_impl(KvTable* t, bool by_version,
+                              uint64_t threshold, int64_t* keys,
+                              float* values, int64_t capacity);
+
 // Export rows with version > since_version (two-call protocol like
 // kv_export).  Reference: delta export switches
 // (tfplus kv_variable_ops.py:198-273).
 int64_t kv_export_delta(void* handle, uint64_t since_version,
                         int64_t* keys, float* values,
                         int64_t capacity) {
-  auto* t = static_cast<KvTable*>(handle);
-  const int dim = t->dim;
-  int64_t count = 0;
-  AllShardsLock all(t);  // atomic view (see kv_export)
-  for (auto& s : t->shards) {
-    for (auto& kvp : s.map) {
-      if (kvp.second.version <= since_version) continue;
-      if (keys != nullptr) {
-        if (count >= capacity) return -1;  // caller buffer too small
-        keys[count] = kvp.first;
-        std::memcpy(values + count * dim, kvp.second.data.get(),
-                    sizeof(float) * dim);
-      }
-      ++count;
-    }
-  }
-  // spilled rows keep their version: one updated after the cut and
-  // spilled since must still reach the incremental checkpoint
-  {
-    std::lock_guard<std::mutex> lk(t->spill.mu);
-    if (t->spill.fd >= 0) {
-      std::vector<char> buf(t->record_bytes());
-      for (auto& kvp : t->spill.index) {
-        if (::pread(t->spill.fd, buf.data(), buf.size(),
-                    kvp.second) !=
-            static_cast<ssize_t>(buf.size())) {
-          continue;
-        }
-        uint64_t ver;
-        std::memcpy(&ver, buf.data() + sizeof(uint64_t),
-                    sizeof(uint64_t));
-        if (ver <= since_version) continue;
-        if (keys != nullptr) {
-          if (count >= capacity) return -1;
-          keys[count] = kvp.first;
-          std::memcpy(values + count * dim,
-                      buf.data() + 2 * sizeof(uint64_t),
-                      sizeof(float) * dim);
-        }
-        ++count;
-      }
-    }
-  }
-  return count;
+  return kv_export_impl(static_cast<KvTable*>(handle),
+                        /*by_version=*/true, since_version, keys,
+                        values, capacity);
 }
 
 uint64_t kv_frequency(void* handle, int64_t key) {
@@ -292,26 +271,54 @@ uint64_t kv_frequency(void* handle, int64_t key) {
 // Export keys whose frequency >= min_frequency (reference
 // frequency-filtered delta export).  Two-call protocol: pass
 // keys=nullptr to get the count, then allocate and call again.
-int64_t kv_export(void* handle, uint64_t min_frequency, int64_t* keys,
-                  float* values, int64_t capacity) {
-  auto* t = static_cast<KvTable*>(handle);
+// Shared scan core for full/delta exports.  by_version selects the
+// filter: frequency >= threshold (full) or version > threshold
+// (delta).  Returns count, -1 when the caller's buffer is too small,
+// -2 when a spill-record read failed (a silently incomplete
+// checkpoint would surface as degraded quality after restore — the
+// caller must see the error).
+static int64_t kv_export_impl(KvTable* t, bool by_version,
+                              uint64_t threshold, int64_t* keys,
+                              float* values, int64_t capacity) {
   const int dim = t->dim;
   int64_t count = 0;
-  AllShardsLock all(t);  // atomic view: no RAM<->disk moves mid-scan
-  for (auto& s : t->shards) {
+  const bool spill_on = t->spill_enabled();
+
+  auto scan_shard = [&](Shard& s) -> bool {
     for (auto& kvp : s.map) {
-      if (kvp.second.frequency < min_frequency) continue;
+      if (by_version) {
+        if (kvp.second.version <= threshold) continue;
+      } else {
+        if (kvp.second.frequency < threshold) continue;
+      }
       if (keys != nullptr) {
-        if (count >= capacity) return -1;  // caller buffer too small
+        if (count >= capacity) return false;
         keys[count] = kvp.first;
         std::memcpy(values + count * dim, kvp.second.data.get(),
                     sizeof(float) * dim);
       }
       ++count;
     }
+    return true;
+  };
+
+  if (!spill_on) {
+    // no disk tier: per-shard locking so training threads on other
+    // shards keep running during the export
+    for (auto& s : t->shards) {
+      std::lock_guard<std::mutex> lk(s.mu);
+      if (!scan_shard(s)) return -1;
+    }
+    return count;
   }
-  // disk-tier rows are part of the table: a checkpoint must include
-  // them (spilled != deleted)
+
+  // with a disk tier the view must be atomic (a row faulting between
+  // the RAM and spill passes would be missed or double-counted):
+  // freeze every shard, then scan both tiers
+  AllShardsLock all(t);
+  for (auto& s : t->shards) {
+    if (!scan_shard(s)) return -1;
+  }
   {
     std::lock_guard<std::mutex> lk(t->spill.mu);
     if (t->spill.fd >= 0) {
@@ -320,11 +327,17 @@ int64_t kv_export(void* handle, uint64_t min_frequency, int64_t* keys,
         if (::pread(t->spill.fd, buf.data(), buf.size(),
                     kvp.second) !=
             static_cast<ssize_t>(buf.size())) {
-          continue;
+          return -2;  // unreadable spill record: surface, don't skip
         }
-        uint64_t freq;
+        uint64_t freq, ver;
         std::memcpy(&freq, buf.data(), sizeof(uint64_t));
-        if (freq < min_frequency) continue;
+        std::memcpy(&ver, buf.data() + sizeof(uint64_t),
+                    sizeof(uint64_t));
+        if (by_version) {
+          if (ver <= threshold) continue;
+        } else {
+          if (freq < threshold) continue;
+        }
         if (keys != nullptr) {
           if (count >= capacity) return -1;
           keys[count] = kvp.first;
@@ -337,6 +350,13 @@ int64_t kv_export(void* handle, uint64_t min_frequency, int64_t* keys,
     }
   }
   return count;
+}
+
+int64_t kv_export(void* handle, uint64_t min_frequency, int64_t* keys,
+                  float* values, int64_t capacity) {
+  return kv_export_impl(static_cast<KvTable*>(handle),
+                        /*by_version=*/false, min_frequency, keys,
+                        values, capacity);
 }
 
 // Bulk import (checkpoint restore): assign n rows.

@@ -149,6 +149,34 @@ class TestCandidates:
         assert cands, "accumulation should rescue the fit"
         assert all(s.num_micro_steps >= 8 for s in cands)
 
+    def test_strategy_service_round_trip(self):
+        """The strategy brain as an RPC (ref AccelerationEngine's gRPC
+        service): profile in over the 2-RPC transport, ranked
+        memory-fit candidates out."""
+        from dlrover_tpu.accelerate.engine_service import (
+            StrategyClient,
+            start_strategy_service,
+        )
+
+        server, port = start_strategy_service()
+        try:
+            client = StrategyClient(f"127.0.0.1:{port}")
+            big = ModelProfile(
+                num_params=7_000_000_000,
+                param_bytes=28_000_000_000,
+                largest_leaf=1,
+                leaf_count=1,
+                optimizer_bytes=56_000_000_000,
+            )
+            cands = client.request_candidates(big, 8)
+            assert cands
+            # the 7B rule: every fitting plan shards the train state
+            for s in cands:
+                assert s.fsdp * s.tensor * s.pipe >= 8
+            client.close()
+        finally:
+            server.stop(0)
+
     def test_long_context_adds_seq_axis(self, tiny_cfg):
         profile = analyse_model(
             lambda rng: init_params(rng, tiny_cfg), optax.adamw(1e-3)
