@@ -3,3 +3,8 @@ from dlrover_tpu.accelerate.api import (  # noqa: F401
     auto_accelerate,
 )
 from dlrover_tpu.accelerate.strategy import Strategy, load_strategy  # noqa: F401
+from dlrover_tpu.accelerate.engine_service import (  # noqa: F401
+    StrategyClient,
+    start_strategy_service,
+)
+from dlrover_tpu.accelerate.search import successive_halving  # noqa: F401

@@ -55,16 +55,11 @@ class StrategyResponse(Message):
 
 
 def _strategy_to_dict(s: Strategy) -> Dict:
-    return {
-        "data": s.data,
-        "fsdp": s.fsdp,
-        "tensor": s.tensor,
-        "seq": s.seq,
-        "expert": s.expert,
-        "pipe": s.pipe,
-        "remat": s.remat,
-        "num_micro_steps": s.num_micro_steps,
-    }
+    # asdict stays exact as Strategy grows fields (a hand-rolled list
+    # would silently drop e.g. pipe_microbatches on the wire)
+    import dataclasses
+
+    return dataclasses.asdict(s)
 
 
 class StrategyService:

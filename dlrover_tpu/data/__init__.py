@@ -6,3 +6,8 @@ from dlrover_tpu.data.shm_dataloader import (  # noqa: F401
     ShmDataLoader,
     ShmBatchWriter,
 )
+from dlrover_tpu.data.coworker import (  # noqa: F401
+    CoworkerClient,
+    CoworkerDataset,
+    CoworkerServer,
+)

@@ -5,3 +5,9 @@ from dlrover_tpu.rl.ppo import (  # noqa: F401
     ppo_loss,
     ReplayBuffer,
 )
+from dlrover_tpu.rl.inference import (  # noqa: F401
+    InferenceBackend,
+    JitSamplerBackend,
+    KVCacheBackend,
+)
+from dlrover_tpu.rl.trainer import RLHFTrainer  # noqa: F401

@@ -85,9 +85,13 @@ struct KvTable {
     auto it = spill.index.find(key);
     if (it == spill.index.end()) return false;
     std::vector<char> buf(record_bytes());
-    bool ok =
-        ::pread(spill.fd, buf.data(), buf.size(), it->second) ==
-        static_cast<ssize_t>(buf.size());
+    bool ok = false;
+    for (int attempt = 0; attempt < 3 && !ok; ++attempt) {
+      // retry transient failures (EINTR, short reads): erasing the
+      // index on a recoverable flake would orphan an intact record
+      ok = ::pread(spill.fd, buf.data(), buf.size(), it->second) ==
+           static_cast<ssize_t>(buf.size());
+    }
     if (ok) {
       std::memcpy(&row->frequency, buf.data(), sizeof(uint64_t));
       std::memcpy(&row->version, buf.data() + sizeof(uint64_t),
@@ -309,7 +313,12 @@ static int64_t kv_export_impl(KvTable* t, bool by_version,
       std::lock_guard<std::mutex> lk(s.mu);
       if (!scan_shard(s)) return -1;
     }
-    return count;
+    if (!t->spill_enabled()) return count;
+    // the tier was enabled (and possibly spilled into) DURING the
+    // fast scan: rows may have moved to disk behind us — redo the
+    // whole export atomically (enable is one-way, so one redo is
+    // final)
+    count = 0;
   }
 
   // with a disk tier the view must be atomic (a row faulting between
