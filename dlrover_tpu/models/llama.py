@@ -148,10 +148,12 @@ def count_params(params) -> int:
 
 
 def rms_norm(x, weight, eps: float):
-    dtype = x.dtype
-    x = x.astype(jnp.float32)
-    var = jnp.mean(x * x, axis=-1, keepdims=True)
-    return (x * lax.rsqrt(var + eps)).astype(dtype) * weight.astype(dtype)
+    # fused Pallas forward on TPU (saved-rstd backward); plain XLA
+    # elsewhere — see ops/fused.py.  Both paths scale in fp32 and
+    # cast once, so values are identical across backends.
+    from dlrover_tpu.ops.fused import rms_norm as _fused
+
+    return _fused(x, weight, eps)
 
 
 def rope_frequencies(cfg: LlamaConfig, positions):
@@ -272,13 +274,15 @@ def _default_attention() -> AttentionFn:
     return select_attention(get_mesh_context(), _current_rules())
 
 
-def forward(
+def forward_hidden(
     params: Dict,
     tokens: jnp.ndarray,
     cfg: LlamaConfig,
     attention_fn: Optional[AttentionFn] = None,
 ) -> jnp.ndarray:
-    """tokens [B, S] int32 -> logits [B, S, vocab] (fp32)."""
+    """tokens [B, S] int32 -> final-norm hidden states [B, S, D]
+    (``cfg.dtype``) — the pre-lm-head activations, so the loss can fuse
+    the vocab projection (``ops.fused.fused_linear_cross_entropy``)."""
     if attention_fn is None:
         attention_fn = _default_attention()
     dt = cfg.dtype
@@ -316,11 +320,21 @@ def forward(
 
     execute_layers = select_layer_executor(get_mesh_context())
     x = execute_layers(block, params["layers"], x, cos, sin)
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return rms_norm(x, params["final_norm"], cfg.norm_eps)
+
+
+def forward(
+    params: Dict,
+    tokens: jnp.ndarray,
+    cfg: LlamaConfig,
+    attention_fn: Optional[AttentionFn] = None,
+) -> jnp.ndarray:
+    """tokens [B, S] int32 -> logits [B, S, vocab] (fp32)."""
+    x = forward_hidden(params, tokens, cfg, attention_fn)
     logits = jnp.einsum(
         "bsd,dv->bsv",
         x,
-        params["lm_head"].astype(dt),
+        params["lm_head"].astype(cfg.dtype),
         preferred_element_type=jnp.float32,
     )
     return logits
@@ -413,24 +427,44 @@ def decode_step(
     return logits[:, 0], {"k": new_k, "v": new_v}
 
 
+# fused CE kicks in for real vocabularies; tiny test configs keep the
+# dense form so the loss is bit-identical to the naive reference
+_FUSED_CE_MIN_VOCAB = 8192
+
+
 def loss_fn(
     params: Dict,
     batch: Dict,
     cfg: LlamaConfig,
     attention_fn: Optional[AttentionFn] = None,
+    fused_ce: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Next-token cross entropy; batch = {"tokens": [B, S+1]} or
-    {"inputs", "targets"} (+ optional "mask")."""
+    {"inputs", "targets"} (+ optional "mask").
+
+    ``fused_ce`` (default: auto — on when vocab >= 8192) routes the
+    lm-head projection through
+    ``ops.fused.fused_linear_cross_entropy`` so fp32 logits are never
+    materialized at [B, S, V] — the dominant activation at long seq."""
     if "inputs" in batch:
         inputs, targets = batch["inputs"], batch["targets"]
     else:
         inputs, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
+    mask = batch.get("mask")
+    if fused_ce is None:
+        fused_ce = cfg.vocab_size >= _FUSED_CE_MIN_VOCAB
+    if fused_ce:
+        from dlrover_tpu.ops.fused import fused_linear_cross_entropy
+
+        hidden = forward_hidden(params, inputs, cfg, attention_fn)
+        return fused_linear_cross_entropy(
+            hidden, params["lm_head"], targets, mask
+        )
     logits = forward(params, inputs, cfg, attention_fn)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(
         logp, targets[..., None], axis=-1
     ).squeeze(-1)
-    mask = batch.get("mask")
     if mask is not None:
         return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
     return jnp.mean(nll)

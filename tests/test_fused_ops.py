@@ -1,0 +1,199 @@
+"""Fused-op tests: Pallas RMSNorm kernel (interpret mode on CPU) and
+the chunked fused linear-cross-entropy vs the dense reference."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.ops.fused import (
+    _rms_fwd_pallas,
+    _rms_plain,
+    fused_linear_cross_entropy,
+    layer_norm,
+    rms_norm,
+)
+
+
+def _naive_rms(x, w, eps):
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps) * w.astype(jnp.float32)).astype(
+        x.dtype
+    )
+
+
+class TestRmsNorm:
+    def test_kernel_matches_plain(self):
+        key = jax.random.PRNGKey(0)
+        x = jax.random.normal(key, (16, 256), jnp.float32)
+        w = jax.random.normal(jax.random.PRNGKey(1), (256,)) * 0.1 + 1.0
+        y_k, rstd_k = _rms_fwd_pallas(x, w, 1e-5)
+        y_p, rstd_p = _rms_plain(x, w, 1e-5)
+        np.testing.assert_allclose(y_k, y_p, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(
+            rstd_k.reshape(-1), rstd_p.reshape(-1), rtol=1e-6
+        )
+
+    def test_value_and_grad_match_autodiff(self):
+        key = jax.random.PRNGKey(2)
+        x = jax.random.normal(key, (4, 12, 256), jnp.float32)
+        w = jax.random.normal(jax.random.PRNGKey(3), (256,)) * 0.1 + 1.0
+
+        def loss_fused(x, w):
+            return jnp.sum(jnp.sin(rms_norm(x, w, 1e-5)))
+
+        def loss_naive(x, w):
+            return jnp.sum(jnp.sin(_naive_rms(x, w, 1e-5)))
+
+        v1, (gx1, gw1) = jax.value_and_grad(loss_fused, (0, 1))(x, w)
+        v2, (gx2, gw2) = jax.value_and_grad(loss_naive, (0, 1))(x, w)
+        np.testing.assert_allclose(v1, v2, rtol=1e-5)
+        np.testing.assert_allclose(gx1, gx2, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(gw1, gw2, rtol=1e-4, atol=1e-5)
+
+    def test_odd_shapes_fall_back(self):
+        x = jax.random.normal(jax.random.PRNGKey(4), (3, 100))
+        w = jnp.ones((100,))
+        y = rms_norm(x, w, 1e-5)
+        np.testing.assert_allclose(
+            y, _naive_rms(x, w, 1e-5), rtol=1e-6
+        )
+
+    def test_layer_norm(self):
+        x = jax.random.normal(jax.random.PRNGKey(5), (6, 64))
+        w = jnp.full((64,), 1.5)
+        b = jnp.full((64,), 0.25)
+        y = layer_norm(x, w, b, 1e-5)
+        assert np.allclose(np.mean(np.asarray(y - 0.25), axis=-1), 0, atol=1e-4)
+        assert y.shape == x.shape
+
+
+def _dense_ce(hidden, w, targets, mask=None):
+    logits = jnp.matmul(
+        hidden, w.astype(hidden.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(
+        logp, targets[..., None], axis=-1
+    ).squeeze(-1)
+    if mask is not None:
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+    return jnp.mean(nll)
+
+
+class TestFusedLinearCE:
+    def _data(self, n=70, d=32, v=97, seed=0):
+        ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+        hidden = jax.random.normal(ks[0], (n, d), jnp.float32)
+        w = jax.random.normal(ks[1], (d, v), jnp.float32) * 0.05
+        targets = jax.random.randint(ks[2], (n,), 0, v)
+        return hidden, w, targets
+
+    @pytest.mark.parametrize("chunk", [16, 64, 512])
+    def test_matches_dense(self, chunk):
+        hidden, w, targets = self._data()
+        got = fused_linear_cross_entropy(
+            hidden, w, targets, chunk_rows=chunk
+        )
+        want = _dense_ce(hidden, w, targets)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_mask_and_grads_match_dense(self):
+        hidden, w, targets = self._data(n=48)
+        mask = (jnp.arange(48) % 3 != 0).astype(jnp.float32)
+
+        f1 = lambda h, w: fused_linear_cross_entropy(
+            h, w, targets, mask, chunk_rows=16
+        )
+        f2 = lambda h, w: _dense_ce(h, w, targets, mask)
+        v1, (gh1, gw1) = jax.value_and_grad(f1, (0, 1))(hidden, w)
+        v2, (gh2, gw2) = jax.value_and_grad(f2, (0, 1))(hidden, w)
+        np.testing.assert_allclose(v1, v2, rtol=1e-5)
+        np.testing.assert_allclose(gh1, gh2, rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(gw1, gw2, rtol=1e-4, atol=1e-6)
+
+    def test_batched_shape(self):
+        hidden, w, targets = self._data(n=64)
+        got = fused_linear_cross_entropy(
+            hidden.reshape(4, 16, -1),
+            w,
+            targets.reshape(4, 16),
+            chunk_rows=32,
+        )
+        want = _dense_ce(hidden, w, targets)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+class TestLlamaFusedLoss:
+    def test_fused_ce_under_tensor_parallel_mesh(self):
+        """Fused CE with a VOCAB-sharded lm_head: the per-chunk
+        logsumexp crosses the tensor axis, so GSPMD must insert the
+        reductions; loss must match the dense path."""
+        import optax
+
+        from dlrover_tpu.models.llama import (
+            LlamaConfig,
+            init_params,
+            loss_fn,
+            param_logical_axes,
+        )
+        from dlrover_tpu.parallel import sharding as sh
+        from dlrover_tpu.parallel.mesh import (
+            AxisName,
+            create_parallel_mesh,
+            destroy_parallel_mesh,
+        )
+        from dlrover_tpu.parallel.train_step import build_train_step
+
+        cfg = LlamaConfig.tiny(dtype=jnp.float32)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (4, 33), 0, cfg.vocab_size
+        )
+        losses = {}
+        try:
+            for fused in (False, True):
+                ctx = create_parallel_mesh(
+                    [(AxisName.DATA, 4), (AxisName.TENSOR, 2)]
+                )
+                rules = sh.default_rules(tensor_parallel=True)
+                fns = build_train_step(
+                    loss_fn=lambda p, b: loss_fn(
+                        p, b, cfg, fused_ce=fused
+                    ),
+                    optimizer=optax.sgd(1e-2),
+                    init_params_fn=lambda rng: init_params(rng, cfg),
+                    param_axes=param_logical_axes(cfg),
+                    mesh_ctx=ctx,
+                    rules=rules,
+                )
+                state = fns.init_state(jax.random.PRNGKey(0))
+                batch = jax.device_put(
+                    {"tokens": tokens}, fns.batch_sharding
+                )
+                _, metrics = fns.train_step(state, batch)
+                losses[fused] = float(metrics["loss"])
+                destroy_parallel_mesh()
+        finally:
+            destroy_parallel_mesh()
+        np.testing.assert_allclose(
+            losses[True], losses[False], rtol=1e-4
+        )
+
+    def test_loss_fn_fused_matches_dense(self):
+        from dlrover_tpu.models.llama import (
+            LlamaConfig,
+            init_params,
+            loss_fn,
+        )
+
+        cfg = LlamaConfig.tiny(vocab_size=101, dtype=jnp.float32)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (2, 17), 0, cfg.vocab_size
+        )
+        batch = {"tokens": tokens}
+        dense = loss_fn(params, batch, cfg, fused_ce=False)
+        fused = loss_fn(params, batch, cfg, fused_ce=True)
+        np.testing.assert_allclose(fused, dense, rtol=1e-5)
