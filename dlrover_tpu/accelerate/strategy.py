@@ -97,26 +97,34 @@ _PEAK_FLOPS = 197e12
 _ICI_BW = 4.5e10  # bytes/sec one direction, per link
 
 
-def estimate_step_cost(
+# ordered addends of the step-cost model; the calibrated dim planner
+# (accelerate/dim_planner.py) fits a per-term coefficient to each
+FEATURE_NAMES = (
+    "compute",
+    "dp_reduce",
+    "fsdp_gather",
+    "tp_reduce",
+    "pipe_hop",
+    "sp_hop",
+    "ep_hop",
+)
+
+
+def strategy_cost_terms(
     s: Strategy,
     profile: ModelProfile,
     batch_per_replica: int = 1,
     seq_len: int = 2048,
-) -> float:
-    """Relative per-step wall-clock estimate for ranking candidates
-    (reference role: the Brain's throughput model + the MIP planner's
-    objective, ``mip_tp_planner.py:496``, collapsed to the terms that
-    matter on a TPU mesh):
+) -> List[float]:
+    """Per-term second estimates, ordered as ``FEATURE_NAMES``:
 
-    Configs are compared at a FIXED global batch (the user's effective
-    batch): per-token compute is then identical across factorizations
-    (6N/n_devices per device), so the ranking is decided by what each
-    strategy ADDS —
-
+    - compute: 6N FLOPs/token shard, scaled by the GPipe bubble
+      (1 + (P-1)/M) when pipe > 1
     - DP/FSDP grad reduce: ~2x grad bytes over ICI when dp*fsdp > 1
     - FSDP param all-gathers: ~2x param bytes more (fwd + bwd)
     - TP: per-layer activation reductions (4 per layer, bf16)
-    - pipe: the GPipe bubble scales compute by (1 + (P-1)/M)
+    - pipe: stage-boundary activation hops (every microbatch crosses
+      P-1 boundaries forward and backward)
     - seq/expert: all-to-all / ring hops on activations
     """
     # fixed global token count (pure-DP framing: per-device batch x
@@ -132,12 +140,7 @@ def estimate_step_cost(
         compute *= 1.0 + (s.pipe - 1) / max(micro, 1)
     tokens = batch_per_replica * seq_len  # per-device activation traffic
 
-    comm = 0.0
     grad_bytes = profile.num_params * 4.0 / model_shard
-    if s.data * s.fsdp > 1:
-        comm += 2.0 * grad_bytes / _ICI_BW
-    if s.fsdp > 1:
-        comm += 2.0 * profile.num_params * 4.0 / model_shard / _ICI_BW
     # one layer-boundary activation tensor [tokens, hidden] in bf16:
     # the whole-model census is ~7 live tensors per layer, so divide
     # it back out; floor at a 1k-hidden model
@@ -147,17 +150,41 @@ def estimate_step_cost(
         2.0 * 1024,
     )
     act_bytes = tokens * hidden_bytes
+
+    terms = [compute, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0]
+    if s.data * s.fsdp > 1:
+        terms[1] = 2.0 * grad_bytes / _ICI_BW
+    if s.fsdp > 1:
+        terms[2] = 2.0 * profile.num_params * 4.0 / model_shard / _ICI_BW
     if s.tensor > 1:
-        comm += 4.0 * max(profile.num_layers, 1) * act_bytes / _ICI_BW
+        terms[3] = 4.0 * max(profile.num_layers, 1) * act_bytes / _ICI_BW
     if s.pipe > 1:
-        # stage-boundary activation hops: every microbatch crosses
-        # P-1 boundaries forward and backward
-        comm += 4.0 * (s.pipe - 1) / s.pipe * act_bytes / _ICI_BW
+        terms[4] = 4.0 * (s.pipe - 1) / s.pipe * act_bytes / _ICI_BW
     if s.seq > 1:
-        comm += 2.0 * s.seq * act_bytes / _ICI_BW
+        terms[5] = 2.0 * s.seq * act_bytes / _ICI_BW
     if s.expert > 1:
-        comm += 2.0 * act_bytes / _ICI_BW
-    return compute + comm
+        terms[6] = 2.0 * act_bytes / _ICI_BW
+    return terms
+
+
+def estimate_step_cost(
+    s: Strategy,
+    profile: ModelProfile,
+    batch_per_replica: int = 1,
+    seq_len: int = 2048,
+) -> float:
+    """Relative per-step wall-clock estimate for ranking candidates
+    (reference role: the Brain's throughput model + the MIP planner's
+    objective, ``mip_tp_planner.py:496``, collapsed to the terms that
+    matter on a TPU mesh — see :func:`strategy_cost_terms`).
+
+    Configs are compared at a FIXED global batch (the user's effective
+    batch): per-token compute is then identical across factorizations
+    (6N/n_devices per device), so the ranking is decided by what each
+    strategy ADDS."""
+    return float(
+        sum(strategy_cost_terms(s, profile, batch_per_replica, seq_len))
+    )
 
 
 def generate_candidates(

@@ -1,0 +1,235 @@
+"""Sparse-table checkpoint manager: full + delta chains.
+
+Reference parity: tfplus's saver integration
+(``tfplus/tfplus/kv_variable/python/ops/checkpoint_manager.py`` and the
+delta-export switches of ``kv_variable_ops.py:198-273``) — KvVariable
+tables checkpoint **incrementally**: a full export periodically, then
+only the rows touched since the previous save.
+
+The TPU-build form works over the same pluggable
+:class:`~dlrover_tpu.common.storage.CheckpointStorage` the flash
+checkpoint uses, with the same two-phase commit discipline (write into
+a hidden tmp dir, rename to the committed name) so a crash mid-save
+never corrupts a restore source.  Layout::
+
+    <dir>/step-00000010/manifest.json   # kind: full | delta(base_step)
+                        <table>.keys.npy
+                        <table>.values.npy
+
+Restore walks the chain: the newest full save at-or-before the
+requested step, then every delta after it in step order, applied with
+``KvTable.import_`` (last-writer-wins per row — delta semantics).
+"""
+
+import io
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.storage import (
+    CheckpointStorage,
+    PosixDiskStorage,
+)
+
+_STEP_PREFIX = "step-"
+_TMP_PREFIX = "._tmp-"
+
+
+def _step_dir(step: int) -> str:
+    return f"{_STEP_PREFIX}{step:08d}"
+
+
+def _npy_bytes(arr: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, arr)
+    return buf.getvalue()
+
+
+def _npy_load(raw: bytes) -> np.ndarray:
+    return np.load(io.BytesIO(raw), allow_pickle=False)
+
+
+class SparseCheckpointManager:
+    """Checkpoint a named set of :class:`KvTable`-like objects
+    (anything with ``export``/``export_delta``/``import_``/
+    ``version``).
+
+    ``full_every`` controls the chain length: every N-th save is a
+    full export, the rest are deltas against the previous save's cut
+    version.  ``max_chains_to_keep`` bounds disk: cleanup removes the
+    oldest full save together with its dependent deltas.
+    """
+
+    def __init__(
+        self,
+        ckpt_dir: str,
+        storage: Optional[CheckpointStorage] = None,
+        full_every: int = 5,
+        max_chains_to_keep: int = 2,
+    ):
+        self.dir = ckpt_dir
+        self.storage = storage or PosixDiskStorage()
+        self.full_every = max(1, full_every)
+        self.max_chains = max(1, max_chains_to_keep)
+        self.storage.safe_makedirs(ckpt_dir)
+        # per-table cut version of the LAST committed save; deltas
+        # export rows touched after it
+        self._last_cut: Dict[str, int] = {}
+        self._saves_since_full = 0
+        self._last_step: Optional[int] = None
+
+    # ------------------------------------------------------------ save
+
+    def save(
+        self,
+        step: int,
+        tables: Dict,
+        full: Optional[bool] = None,
+    ) -> str:
+        """Persist ``tables`` at ``step``; returns the committed dir.
+
+        ``full=None`` -> automatic cadence (first save and every
+        ``full_every``-th are full)."""
+        if full is None:
+            full = (
+                not self._last_cut
+                or self._saves_since_full >= self.full_every - 1
+            )
+        kind = "full" if full else "delta"
+        manifest = {
+            "step": step,
+            "kind": kind,
+            "base_step": self._last_step if not full else None,
+            "tables": {},
+        }
+        tmp = os.path.join(self.dir, _TMP_PREFIX + _step_dir(step))
+        final = os.path.join(self.dir, _step_dir(step))
+        self.storage.safe_makedirs(tmp)
+        cuts: Dict[str, int] = {}
+        for name, table in tables.items():
+            if full:
+                cut = table.version
+                keys, values = table.export()
+            else:
+                since = self._last_cut.get(name, 0)
+                keys, values, cut = table.export_delta(since)
+            cuts[name] = cut
+            self.storage.write(
+                _npy_bytes(keys), os.path.join(tmp, f"{name}.keys.npy")
+            )
+            self.storage.write(
+                _npy_bytes(values),
+                os.path.join(tmp, f"{name}.values.npy"),
+            )
+            manifest["tables"][name] = {
+                "count": int(keys.size),
+                "dim": int(values.shape[1]) if values.ndim == 2 else 0,
+                "cut_version": int(cut),
+            }
+        self.storage.write_json(
+            manifest, os.path.join(tmp, "manifest.json")
+        )
+        self.storage.safe_move(tmp, final)  # commit
+        self._last_cut = cuts
+        self._last_step = step
+        self._saves_since_full = 0 if full else self._saves_since_full + 1
+        logger.info(
+            "sparse ckpt %s save at step %s (%s rows)",
+            kind,
+            step,
+            sum(m["count"] for m in manifest["tables"].values()),
+        )
+        self._cleanup()
+        return final
+
+    # --------------------------------------------------------- restore
+
+    def _manifests(self) -> List[dict]:
+        out = []
+        for entry in sorted(self.storage.listdir(self.dir)):
+            if not entry.startswith(_STEP_PREFIX):
+                continue
+            m = self.storage.read_json(
+                os.path.join(self.dir, entry, "manifest.json")
+            )
+            if m is not None:
+                out.append(m)
+        return out
+
+    def latest_step(self) -> Optional[int]:
+        manifests = self._manifests()
+        return manifests[-1]["step"] if manifests else None
+
+    def restore(self, tables: Dict, step: Optional[int] = None):
+        """Load the newest save at-or-before ``step`` (default: the
+        newest committed save) into ``tables``; returns the restored
+        step or None when nothing is committed."""
+        manifests = self._manifests()
+        if step is not None:
+            manifests = [m for m in manifests if m["step"] <= step]
+        if not manifests:
+            return None
+        target = manifests[-1]
+        # chain: newest full at-or-before target, then deltas upward
+        chain: List[dict] = []
+        for m in reversed(manifests):
+            if m["step"] > target["step"]:
+                continue
+            chain.append(m)
+            if m["kind"] == "full":
+                break
+        else:
+            if not chain or chain[-1]["kind"] != "full":
+                raise RuntimeError(
+                    "sparse ckpt chain has no full base — cleanup "
+                    "removed it or the first save was a delta"
+                )
+        chain.reverse()
+        for m in chain:
+            d = os.path.join(self.dir, _step_dir(m["step"]))
+            for name, table in tables.items():
+                if name not in m["tables"]:
+                    continue
+                keys = _npy_load(
+                    self.storage.read(
+                        os.path.join(d, f"{name}.keys.npy"), "rb"
+                    )
+                )
+                values = _npy_load(
+                    self.storage.read(
+                        os.path.join(d, f"{name}.values.npy"), "rb"
+                    )
+                )
+                if keys.size:
+                    table.import_(keys, values)
+        # future deltas continue from the restored chain's head
+        self._last_cut = {
+            name: meta["cut_version"]
+            for name, meta in target["tables"].items()
+        }
+        self._last_step = target["step"]
+        self._saves_since_full = 0
+        return target["step"]
+
+    # --------------------------------------------------------- cleanup
+
+    def _cleanup(self):
+        """Drop the oldest full-save chains beyond ``max_chains``;
+        a delta is only ever deleted together with (or before) its
+        base, so every surviving save remains restorable."""
+        manifests = self._manifests()
+        full_steps = [
+            m["step"] for m in manifests if m["kind"] == "full"
+        ]
+        if len(full_steps) <= self.max_chains:
+            return
+        # keep the newest max_chains fulls; everything strictly older
+        # than the oldest kept full (fulls AND their deltas) goes
+        cutoff = sorted(full_steps)[-self.max_chains]
+        for m in manifests:
+            if m["step"] < cutoff:
+                self.storage.safe_rmtree(
+                    os.path.join(self.dir, _step_dir(m["step"]))
+                )

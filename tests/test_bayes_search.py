@@ -1,0 +1,102 @@
+"""BO strategy-tunable search: GP sanity, EI behavior, convergence on
+a synthetic cost surface, failed-build handling, Strategy integration."""
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.accelerate.bayes_search import (
+    BayesOpt,
+    GaussianProcess,
+    expected_improvement,
+    tune_strategy,
+)
+from dlrover_tpu.accelerate.strategy import Strategy
+
+
+class TestGP:
+    def test_interpolates_observations(self):
+        x = np.array([[0.0], [0.5], [1.0]])
+        y = np.array([1.0, 0.2, 0.9])
+        gp = GaussianProcess()
+        gp.fit(x, y)
+        mean, std = gp.predict(x)
+        np.testing.assert_allclose(mean, y, atol=0.05)
+        assert np.all(std < 0.1)
+
+    def test_uncertainty_grows_away_from_data(self):
+        gp = GaussianProcess()
+        gp.fit(np.array([[0.0], [0.1]]), np.array([1.0, 1.1]))
+        _, std_near = gp.predict(np.array([[0.05]]))
+        _, std_far = gp.predict(np.array([[1.0]]))
+        assert std_far[0] > std_near[0] * 2
+
+
+def test_expected_improvement_prefers_low_mean_high_std():
+    mean = np.array([0.5, 0.5, 0.2])
+    std = np.array([0.01, 0.30, 0.01])
+    ei = expected_improvement(mean, std, best=0.4)
+    assert ei[1] > ei[0]  # same mean, more uncertainty -> more EI
+    assert ei[2] > ei[0]  # lower mean -> more EI
+
+
+class TestBayesOpt:
+    def _cost(self, cfg):
+        # smooth bowl with minimum at micro=4, block=256
+        m = {1: 2.0, 2: 1.0, 4: 0.0, 8: 1.0}[cfg["micro"]]
+        b = {128: 1.0, 256: 0.0, 512: 1.5}[cfg["block"]]
+        return 1.0 + m + b
+
+    def test_finds_optimum_under_budget(self):
+        space = {"micro": [1, 2, 4, 8], "block": [128, 256, 512]}
+        bo = BayesOpt(space, seed=0, n_init=4)
+        for _ in range(8):  # 8 of 12 configs
+            cfg = bo.suggest()
+            bo.observe(cfg, self._cost(cfg))
+        best, cost = bo.best()
+        assert cost <= 1.0 + 1.0  # within the two best basins
+        # and strictly better than the worst half of the space
+        all_costs = sorted(
+            self._cost({"micro": m, "block": b})
+            for m in space["micro"]
+            for b in space["block"]
+        )
+        assert cost <= all_costs[2]
+
+    def test_exhausts_space_returns_none(self):
+        bo = BayesOpt({"a": [1, 2]}, seed=1)
+        for _ in range(2):
+            bo.observe(bo.suggest(), 1.0)
+        assert bo.suggest() is None
+
+    def test_failed_builds_are_penalized_not_fatal(self):
+        bo = BayesOpt({"a": [1, 2, 3, 4]}, seed=0, n_init=2)
+        c1 = bo.suggest()
+        bo.observe(c1, None)  # failed compile
+        c2 = bo.suggest()
+        bo.observe(c2, 0.5)
+        best, cost = bo.best()
+        assert best == c2 and cost == 0.5
+        assert bo.suggest() is not None  # GP fit survives the penalty
+
+
+def test_tune_strategy_integration():
+    base = Strategy(data=4, fsdp=2)
+    space = {
+        "num_micro_steps": [1, 2, 4],
+        "remat": ["none", "dots", "full"],
+    }
+
+    def fake_timer(build_fn, s):
+        if s.remat == "none":
+            return None  # OOM
+        return (
+            0.1 * s.num_micro_steps
+            + (0.05 if s.remat == "full" else 0.0)
+        )
+
+    best, history = tune_strategy(
+        lambda s: None, base, space, budget=9, time_fn=fake_timer
+    )
+    assert best.num_micro_steps == 1 and best.remat == "dots"
+    assert best.data == 4 and best.fsdp == 2  # base dims preserved
+    assert len(history) == 9

@@ -1,0 +1,83 @@
+"""Agent diagnosis collectors: incremental log tailing, error-line
+filtering, chip-metrics forwarding (reference datacollector parity)."""
+
+import json
+
+from dlrover_tpu.agent.diagnosis_collector import (
+    ChipMetricsCollector,
+    TrainingLogCollector,
+)
+from dlrover_tpu.master.diagnosis import DiagnosisDataType
+
+
+class FakeClient:
+    def __init__(self):
+        self.reports = []
+
+    def report_diagnosis_data(self, data_cls, data_content, node_rank=-1):
+        self.reports.append((data_cls, data_content, node_rank))
+        return True
+
+
+class TestTrainingLogCollector:
+    def test_ships_only_new_error_lines(self, tmp_path):
+        log = tmp_path / "train.log"
+        log.write_text(
+            "step 1 loss 2.3\n"
+            "jaxlib.xla_extension.XlaRuntimeError: RESOURCE_EXHAUSTED: "
+            "Out of memory allocating 12345 bytes\n"
+            "step 2 loss 2.2\n"
+        )
+        client = FakeClient()
+        col = TrainingLogCollector(str(log), client=client, node_rank=3)
+        col._tick()
+        assert len(client.reports) == 1
+        cls, content, rank = client.reports[0]
+        assert cls == DiagnosisDataType.TRAINING_LOG
+        assert "RESOURCE_EXHAUSTED" in content
+        assert "loss 2.3" not in content
+        assert rank == 3
+
+        # second tick: nothing new -> no report
+        col._tick()
+        assert len(client.reports) == 1
+
+        # appended error is picked up incrementally
+        with open(log, "a") as f:
+            f.write("Traceback (most recent call last):\n")
+        col._tick()
+        assert len(client.reports) == 2
+        assert "Traceback" in client.reports[1][1]
+
+    def test_truncated_file_restarts(self, tmp_path):
+        log = tmp_path / "train.log"
+        log.write_text("x" * 100 + "\n")
+        client = FakeClient()
+        col = TrainingLogCollector(str(log), client=client)
+        col._tick()
+        log.write_text("short OOM line\n")  # rotation/truncation
+        col._tick()
+        assert any("OOM" in c for _, c, _ in client.reports)
+
+    def test_missing_file_is_quiet(self, tmp_path):
+        col = TrainingLogCollector(
+            str(tmp_path / "nope.log"), client=FakeClient()
+        )
+        col._tick()  # no exception
+
+
+class TestChipMetricsCollector:
+    def test_forwards_fresh_stats_once(self, tmp_path):
+        stats = tmp_path / "chip.json"
+        stats.write_text(
+            json.dumps([{"hbm_used": 1 << 30, "duty_cycle": 0.92}])
+        )
+        client = FakeClient()
+        col = ChipMetricsCollector(str(stats), client=client)
+        col._tick()
+        assert len(client.reports) == 1
+        assert client.reports[0][0] == DiagnosisDataType.CHIP_METRICS
+        assert json.loads(client.reports[0][1])[0]["duty_cycle"] == 0.92
+        # unchanged mtime -> no duplicate report
+        col._tick()
+        assert len(client.reports) == 1
