@@ -8,3 +8,10 @@ from dlrover_tpu.accelerate.engine_service import (  # noqa: F401
     start_strategy_service,
 )
 from dlrover_tpu.accelerate.search import successive_halving  # noqa: F401
+from dlrover_tpu.accelerate.bayes_search import (  # noqa: F401
+    BayesOpt,
+    tune_strategy,
+)
+from dlrover_tpu.accelerate.dim_planner import (  # noqa: F401
+    CalibratedPlanner,
+)

@@ -1,1 +1,6 @@
 from dlrover_tpu.ops.flash_attention import flash_attention  # noqa: F401
+from dlrover_tpu.ops.fused import (  # noqa: F401
+    fused_linear_cross_entropy,
+    layer_norm,
+    rms_norm,
+)

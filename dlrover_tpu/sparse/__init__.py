@@ -1,2 +1,5 @@
 from dlrover_tpu.sparse.kv_table import KvTable  # noqa: F401
 from dlrover_tpu.sparse.embedding import SparseEmbedding  # noqa: F401
+from dlrover_tpu.sparse.checkpoint import (  # noqa: F401
+    SparseCheckpointManager,
+)
