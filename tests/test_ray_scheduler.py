@@ -1,0 +1,151 @@
+"""Ray platform variant: actor watcher state mapping + diffing, actor
+scaler ScalePlan execution (reference ray_watcher / ray_scaler parity;
+driven entirely through FakeRayClient — ray itself is absent here,
+like the reference's mocked-client tests)."""
+
+import threading
+
+from dlrover_tpu.common.constants import NodeEventType, NodeStatus
+from dlrover_tpu.common.messages import ScalePlan
+from dlrover_tpu.scheduler.ray import (
+    ActorScaler,
+    ActorWatcher,
+    FakeRayClient,
+    actor_state_to_status,
+)
+
+
+def test_actor_state_mapping():
+    assert actor_state_to_status("ALIVE") == NodeStatus.RUNNING
+    assert actor_state_to_status("PENDING_CREATION") == NodeStatus.PENDING
+    assert actor_state_to_status("DEAD") == NodeStatus.FAILED
+    assert (
+        actor_state_to_status("DEAD", exit_ok=True)
+        == NodeStatus.SUCCEEDED
+    )
+    assert actor_state_to_status("???") == NodeStatus.UNKNOWN
+
+
+class TestActorScaler:
+    def test_scale_up_down_and_explicit_nodes(self):
+        client = FakeRayClient()
+        scaler = ActorScaler("job", client)
+
+        scaler.scale(ScalePlan(node_group_resources={
+            "worker": {"count": 3, "resource": "cpu=2,tpu_chips=4"},
+        }))
+        assert sorted(client.created) == [
+            "job-worker-0", "job-worker-1", "job-worker-2",
+        ]
+
+        # scale down to 1: highest ids drop first
+        scaler.scale(ScalePlan(node_group_resources={
+            "worker": {"count": 1},
+        }))
+        # killed actors linger in the table as DEAD (real Ray
+        # semantics) but hold no slot
+        live = {
+            n for n, i in client.actors.items() if i["state"] != "DEAD"
+        }
+        assert live == {"job-worker-0"}
+        assert "job-worker-2" in client.removed
+
+        # launch_nodes: node-spec dicts on free ids; remove by name
+        scaler.scale(ScalePlan(launch_nodes=[
+            {"type": "worker", "resource": "cpu=1"},
+        ]))
+        assert client.actors["job-worker-1"]["state"] == "PENDING_CREATION"
+        scaler.scale(ScalePlan(remove_nodes=["job-worker-1"]))
+        assert client.actors["job-worker-1"]["state"] == "DEAD"
+
+    def test_migrate_node(self):
+        client = FakeRayClient()
+        scaler = ActorScaler("job", client)
+        scaler.scale(ScalePlan(node_group_resources={
+            "worker": {"count": 2},
+        }))
+        scaler.scale(ScalePlan(migrate_nodes={
+            "job-worker-0": {"type": "worker", "resource": "cpu=8"},
+        }))
+        # replacement created on a free id, old actor killed
+        assert client.actors["job-worker-2"]["state"] == "PENDING_CREATION"
+        assert client.actors["job-worker-0"]["state"] == "DEAD"
+
+    def test_dead_actor_is_replaced(self):
+        """A crashed (DEAD) worker must not occupy a slot: the next
+        scale() recreates it under the same name."""
+        client = FakeRayClient()
+        scaler = ActorScaler("job", client)
+        plan = ScalePlan(node_group_resources={"worker": {"count": 2}})
+        scaler.scale(plan)
+        client.set_state("job-worker-1", "DEAD")  # crash
+        scaler.scale(plan)
+        assert client.actors["job-worker-1"]["state"] == "PENDING_CREATION"
+        assert client.created.count("job-worker-1") == 2
+
+    def test_scale_up_fills_gaps(self):
+        client = FakeRayClient()
+        scaler = ActorScaler("job", client)
+        client.create_actor("job-worker-1")  # id 0 is free
+        scaler.scale(ScalePlan(node_group_resources={
+            "worker": {"count": 3},
+        }))
+        assert set(client.actors) == {
+            "job-worker-0", "job-worker-1", "job-worker-2",
+        }
+
+
+class TestActorWatcher:
+    def test_list_filters_foreign_actors(self):
+        client = FakeRayClient()
+        client.create_actor("job-worker-0")
+        client.create_actor("otherjob-worker-0")
+        client.set_state("job-worker-0", "ALIVE")
+        w = ActorWatcher("job", client)
+        nodes = w.list()
+        assert len(nodes) == 1
+        assert nodes[0].name == "job-worker-0"
+        assert nodes[0].status == NodeStatus.RUNNING
+
+    def test_watch_emits_transitions_and_deletions(self):
+        client = FakeRayClient()
+        client.create_actor("job-worker-0")
+        w = ActorWatcher("job", client, poll_interval=0.01)
+        events = []
+        got_enough = threading.Event()
+
+        def handler(ev):
+            events.append((ev.event_type, ev.node.name, ev.node.status))
+            if len(events) >= 4:
+                got_enough.set()
+
+        t = threading.Thread(target=w.watch, args=(handler,), daemon=True)
+        t.start()
+        import time
+
+        # PENDING -> ALIVE -> intentionally killed (DEAD) -> gc'd
+        client.set_state("job-worker-0", "ALIVE")
+        time.sleep(0.05)
+        client.remove_actor("job-worker-0")
+        time.sleep(0.05)
+        client.gc_actor("job-worker-0")
+        assert got_enough.wait(timeout=5.0)
+        w.stop()
+        t.join(timeout=2.0)
+        kinds = [(e[0], e[2]) for e in events[:4]]
+        assert (NodeEventType.MODIFIED, NodeStatus.PENDING) == kinds[0]
+        assert (NodeEventType.MODIFIED, NodeStatus.RUNNING) in kinds
+        # an INTENDED kill is a clean exit, NOT a failure -> no relaunch
+        assert (NodeEventType.MODIFIED, NodeStatus.SUCCEEDED) in kinds
+        assert (NodeEventType.DELETED, NodeStatus.DELETED) in kinds
+
+    def test_crash_maps_to_failed_clean_exit_to_succeeded(self):
+        client = FakeRayClient()
+        client.create_actor("job-worker-0")
+        client.create_actor("job-worker-1")
+        client.set_state("job-worker-0", "DEAD")  # crash
+        client.set_state("job-worker-1", "DEAD", exit_ok=True)
+        w = ActorWatcher("job", client)
+        by_name = {n.name: n.status for n in w.list()}
+        assert by_name["job-worker-0"] == NodeStatus.FAILED
+        assert by_name["job-worker-1"] == NodeStatus.SUCCEEDED
