@@ -89,7 +89,12 @@ def _candidates(on_tpu: bool):
     # optimizer "int8" = the framework's quantized-moment AdamW
     # (1 byte/param/moment) — what lets ~1B-param configs fit a 16 GB
     # chip with fp32 master weights.
-    common = dict(vocab_size=32000, max_seq_len=2048, remat="dots")
+    # ce_chunk_rows=4096: measured best fused-CE chunk on v5e (fewer
+    # scan trips over the lm head; 0.5154 vs 0.5129 MFU at 512)
+    common = dict(
+        vocab_size=32000, max_seq_len=2048, remat="dots",
+        ce_chunk_rows=4096,
+    )
     return [
         # headline candidates: best throughput config first
         ("llama-0.6b",

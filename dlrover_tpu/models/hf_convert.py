@@ -1,0 +1,189 @@
+"""HuggingFace Llama checkpoint interop.
+
+Reference parity: DLRover accelerates user-supplied HF models and
+ships an HF-Trainer flash-checkpoint adapter
+(``dlrover/trainer/torch/flash_checkpoint/hf_trainer.py``); a user
+switching to this framework brings HF Llama weights with them.  This
+module converts ``transformers`` LlamaForCausalLM state dicts to and
+from the framework's stacked-layer param pytree
+(``models/llama.py:init_params``), so pretraining continues from (or
+exports to) the HF ecosystem.
+
+Layout notes (verified by the logits-parity test):
+- torch Linear stores ``[out, in]``; the JAX params store ``[in,
+  out]`` — every projection transposes.
+- our ``layers`` subtree stacks all layers on a leading dim (scan
+  executor), so per-layer HF tensors are stacked with ``np.stack``.
+- RoPE: HF applies split-half rotate_half, the same convention as
+  ``apply_rope`` — weights convert with no permutation.
+- ``tie_word_embeddings=True`` models reuse the embedding as lm_head;
+  the converter materializes the transpose (the framework keeps them
+  separate — VOCAB-sharded lm_head).
+"""
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.models.llama import LlamaConfig
+
+
+def _t(x) -> np.ndarray:
+    """torch tensor / array -> fp32 numpy (no torch import needed at
+    module level; anything with ``.detach`` is treated as a tensor)."""
+    if hasattr(x, "detach"):
+        x = x.detach().cpu().float().numpy()
+    return np.asarray(x, dtype=np.float32)
+
+
+def config_from_hf(hf_config) -> LlamaConfig:
+    """transformers LlamaConfig -> framework LlamaConfig.
+
+    Raises ``ValueError`` for features the framework's RoPE does not
+    implement (Llama-3.x ``rope_scaling``, decoupled ``head_dim``):
+    converting those silently would produce a model whose position
+    embeddings differ from the source — corrupted, not finetuned."""
+    scaling = getattr(hf_config, "rope_scaling", None)
+    if scaling and scaling.get("rope_type", scaling.get("type")) not in (
+        None,
+        "default",
+    ):
+        raise ValueError(
+            f"unsupported rope_scaling {scaling!r}: the framework "
+            "implements plain-theta RoPE only"
+        )
+    head_dim = getattr(hf_config, "head_dim", None)
+    derived = hf_config.hidden_size // hf_config.num_attention_heads
+    if head_dim not in (None, derived):
+        raise ValueError(
+            f"unsupported head_dim={head_dim} (hidden/heads={derived}):"
+            " the framework derives head_dim from dim//n_heads"
+        )
+    return LlamaConfig(
+        vocab_size=hf_config.vocab_size,
+        dim=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(
+            hf_config,
+            "num_key_value_heads",
+            hf_config.num_attention_heads,
+        )
+        or hf_config.num_attention_heads,
+        mlp_dim=hf_config.intermediate_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        norm_eps=float(getattr(hf_config, "rms_norm_eps", 1e-5)),
+    )
+
+
+def params_from_hf(
+    state_dict: Dict,
+    cfg: Optional[LlamaConfig] = None,
+    hf_config=None,
+) -> Tuple[Dict, LlamaConfig]:
+    """HF ``LlamaForCausalLM.state_dict()`` (or a model instance) ->
+    (framework params pytree, LlamaConfig).
+
+    Pass either the target ``cfg`` or the source ``hf_config``; with a
+    model instance both are derived."""
+    if hasattr(state_dict, "state_dict"):  # a model instance
+        if hf_config is None:
+            hf_config = state_dict.config
+        state_dict = state_dict.state_dict()
+    if cfg is None:
+        if hf_config is None:
+            raise ValueError("need cfg or hf_config")
+        cfg = config_from_hf(hf_config)
+
+    sd = {k: _t(v) for k, v in state_dict.items()}
+    L = cfg.n_layers
+
+    def stack(fmt: str, transpose: bool) -> np.ndarray:
+        tensors = []
+        for i in range(L):
+            w = sd[fmt.format(i)]
+            tensors.append(w.T if transpose else w)
+        return np.stack(tensors)
+
+    embed = sd["model.embed_tokens.weight"]  # [V, D]
+    if "lm_head.weight" in sd:
+        lm_head = sd["lm_head.weight"].T  # [V, D] -> [D, V]
+    else:  # tied embeddings
+        lm_head = embed.T.copy()
+
+    params = {
+        "embed": embed,
+        "layers": {
+            "attn_norm": stack(
+                "model.layers.{}.input_layernorm.weight", False
+            ),
+            "wq": stack(
+                "model.layers.{}.self_attn.q_proj.weight", True
+            ),
+            "wk": stack(
+                "model.layers.{}.self_attn.k_proj.weight", True
+            ),
+            "wv": stack(
+                "model.layers.{}.self_attn.v_proj.weight", True
+            ),
+            "wo": stack(
+                "model.layers.{}.self_attn.o_proj.weight", True
+            ),
+            "mlp_norm": stack(
+                "model.layers.{}.post_attention_layernorm.weight",
+                False,
+            ),
+            "w_gate": stack(
+                "model.layers.{}.mlp.gate_proj.weight", True
+            ),
+            "w_up": stack("model.layers.{}.mlp.up_proj.weight", True),
+            "w_down": stack(
+                "model.layers.{}.mlp.down_proj.weight", True
+            ),
+        },
+        "final_norm": sd["model.norm.weight"],
+        "lm_head": lm_head,
+    }
+    import jax.numpy as jnp
+
+    params = {
+        k: (
+            {kk: jnp.asarray(vv) for kk, vv in v.items()}
+            if isinstance(v, dict)
+            else jnp.asarray(v)
+        )
+        for k, v in params.items()
+    }
+    return params, cfg
+
+
+def params_to_hf(params: Dict, cfg: LlamaConfig) -> Dict:
+    """Framework params -> HF-layout numpy state dict (torch-free; feed
+    to ``model.load_state_dict`` after ``torch.from_numpy``)."""
+    lp = params["layers"]
+    out: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": _t(params["embed"]),
+        "model.norm.weight": _t(params["final_norm"]),
+        "lm_head.weight": _t(params["lm_head"]).T,
+    }
+    names = {
+        "attn_norm": ("model.layers.{}.input_layernorm.weight", False),
+        "wq": ("model.layers.{}.self_attn.q_proj.weight", True),
+        "wk": ("model.layers.{}.self_attn.k_proj.weight", True),
+        "wv": ("model.layers.{}.self_attn.v_proj.weight", True),
+        "wo": ("model.layers.{}.self_attn.o_proj.weight", True),
+        "mlp_norm": (
+            "model.layers.{}.post_attention_layernorm.weight",
+            False,
+        ),
+        "w_gate": ("model.layers.{}.mlp.gate_proj.weight", True),
+        "w_up": ("model.layers.{}.mlp.up_proj.weight", True),
+        "w_down": ("model.layers.{}.mlp.down_proj.weight", True),
+    }
+    for key, (fmt, transpose) in names.items():
+        stacked = _t(lp[key])
+        for i in range(cfg.n_layers):
+            w = stacked[i]
+            out[fmt.format(i)] = w.T.copy() if transpose else w.copy()
+    return out

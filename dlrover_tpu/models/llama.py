@@ -43,6 +43,9 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     # remat policy for the scanned block: "none" | "full" | "dots"
     remat: str = "full"
+    # fused-CE row-chunk size (peak logits memory = chunk x vocab fp32;
+    # larger chunks = fewer scan trips, bigger lm-head matmuls)
+    ce_chunk_rows: int = 512
 
     @property
     def head_dim(self) -> int:
@@ -458,7 +461,11 @@ def loss_fn(
 
         hidden = forward_hidden(params, inputs, cfg, attention_fn)
         return fused_linear_cross_entropy(
-            hidden, params["lm_head"], targets, mask
+            hidden,
+            params["lm_head"],
+            targets,
+            mask,
+            chunk_rows=cfg.ce_chunk_rows,
         )
     logits = forward(params, inputs, cfg, attention_fn)
     logp = jax.nn.log_softmax(logits, axis=-1)

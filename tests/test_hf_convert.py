@@ -1,0 +1,124 @@
+"""HF Llama interop: logits parity between transformers' torch forward
+and the framework's JAX forward on converted weights, plus a
+params<->state-dict roundtrip."""
+
+import os
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("HF_HUB_OFFLINE", "1")
+os.environ.setdefault("TRANSFORMERS_OFFLINE", "1")
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from dlrover_tpu.models.hf_convert import (  # noqa: E402
+    config_from_hf,
+    params_from_hf,
+    params_to_hf,
+)
+from dlrover_tpu.models.llama import (  # noqa: E402
+    dot_product_attention,
+    forward,
+)
+
+
+def _tiny_hf_model(tie=False):
+    cfg = transformers.LlamaConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=64,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        tie_word_embeddings=tie,
+        attention_bias=False,
+    )
+    torch.manual_seed(0)
+    return transformers.LlamaForCausalLM(cfg).eval(), cfg
+
+
+class TestHfConvert:
+    def test_config_mapping(self):
+        _, hf_cfg = _tiny_hf_model()
+        cfg = config_from_hf(hf_cfg)
+        assert cfg.dim == 64 and cfg.n_layers == 2
+        assert cfg.n_heads == 4 and cfg.n_kv_heads == 2
+        assert cfg.vocab_size == 128 and cfg.mlp_dim == 128
+
+    def test_logits_match_transformers(self):
+        model, hf_cfg = _tiny_hf_model()
+        params, cfg = params_from_hf(model)
+        cfg = type(cfg)(**{**cfg.__dict__, "dtype": jnp.float32})
+
+        tokens = np.array(
+            [[1, 5, 9, 2, 77, 31, 8, 3], [4, 4, 120, 9, 6, 13, 2, 1]],
+            dtype=np.int32,
+        )
+        with torch.no_grad():
+            want = (
+                model(torch.tensor(tokens, dtype=torch.long))
+                .logits.float()
+                .numpy()
+            )
+        got = np.asarray(
+            forward(
+                params,
+                jnp.asarray(tokens),
+                cfg,
+                attention_fn=dot_product_attention,
+            )
+        )
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    def test_rejects_unsupported_rope_scaling(self):
+        _, hf_cfg = _tiny_hf_model()
+        hf_cfg.rope_scaling = {
+            "rope_type": "llama3",
+            "factor": 8.0,
+            "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 8192,
+        }
+        with pytest.raises(ValueError, match="rope_scaling"):
+            config_from_hf(hf_cfg)
+
+    def test_rejects_decoupled_head_dim(self):
+        _, hf_cfg = _tiny_hf_model()
+        hf_cfg.head_dim = 32  # != hidden/heads = 16
+        with pytest.raises(ValueError, match="head_dim"):
+            config_from_hf(hf_cfg)
+
+    def test_tied_embeddings(self):
+        model, hf_cfg = _tiny_hf_model(tie=True)
+        params, cfg = params_from_hf(model)
+        np.testing.assert_allclose(
+            np.asarray(params["lm_head"]),
+            np.asarray(params["embed"]).T,
+        )
+
+    def test_roundtrip(self):
+        model, _hf_cfg = _tiny_hf_model()
+        params, cfg = params_from_hf(model)
+        sd = params_to_hf(params, cfg)
+        want = {k: v.detach().float().numpy() for k, v in
+                model.state_dict().items()}
+        assert set(sd) == set(want)
+        for k in want:
+            np.testing.assert_allclose(
+                sd[k], want[k], rtol=1e-6, atol=1e-6, err_msg=k
+            )
+        # and back again
+        params2, _ = params_from_hf(sd, cfg=cfg)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(params),
+            jax.tree_util.tree_leaves(params2),
+        ):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b))
