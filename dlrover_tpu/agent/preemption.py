@@ -1,0 +1,122 @@
+"""TPU-VM preemption / maintenance-event watcher.
+
+Reference parity: on GPU clusters the primary failure signal is the
+k8s pod kill (SIGTERM → ``ckpt_saver`` flush, ``training.py``
+restart); on TPU-VMs the PRIMARY signal is the GCE metadata server's
+maintenance-event — it fires ~60s before the host is migrated or the
+preemptible VM is terminated, long before any SIGTERM arrives
+(SURVEY.md §7 "hard parts": the agent must subscribe to both).
+
+``PreemptionWatcher`` plain-polls the instance metadata endpoint
+every ``poll_interval`` seconds (well inside the ~60s preemption
+lead; the metadata ``wait_for_change`` long-poll would shave the
+interval but complicates the injectable-fetcher seam) and invokes
+the registered callbacks once per event:
+the agent wires these to (1) flush the latest shm checkpoint slot to
+storage and (2) report the imminent failure to the master so the
+rendezvous can fence the node before the hardware goes away.
+
+The metadata fetcher is injectable (tests and non-GCE environments
+never touch the network).
+"""
+
+import threading
+from typing import Callable, List, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+_METADATA_URL = (
+    "http://metadata.google.internal/computeMetadata/v1/instance/"
+    "maintenance-event"
+)
+_NONE_EVENT = "NONE"
+
+
+def _default_fetcher(timeout: float = 5.0) -> Optional[str]:
+    """Read the maintenance-event metadata value; None when the
+    metadata server is unreachable (not on GCE)."""
+    import urllib.request
+
+    req = urllib.request.Request(
+        _METADATA_URL, headers={"Metadata-Flavor": "Google"}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.read().decode().strip()
+    except OSError:
+        return None
+
+
+class PreemptionWatcher:
+    """Fire callbacks exactly once per maintenance event.
+
+    Events (GCE contract): ``NONE`` (idle), ``MIGRATE_ON_HOST_MAINTENANCE``,
+    ``TERMINATE_ON_HOST_MAINTENANCE``; preemptible VMs surface
+    ``TRUE``/``FALSE`` on the preempted endpoint — any non-idle value
+    is treated as "hardware goes away soon"."""
+
+    def __init__(
+        self,
+        fetcher: Optional[Callable[[], Optional[str]]] = None,
+        poll_interval: float = 5.0,
+    ):
+        self._fetch = fetcher or _default_fetcher
+        self._interval = poll_interval
+        self._callbacks: List[Callable[[str], None]] = []
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_event = _NONE_EVENT
+        self.unavailable = False  # metadata server unreachable
+
+    def on_preemption(self, callback: Callable[[str], None]):
+        """Register ``callback(event_str)``; called from the watcher
+        thread once per distinct non-idle event."""
+        self._callbacks.append(callback)
+
+    def _is_idle(self, value: Optional[str]) -> bool:
+        return value is None or value.upper() in (_NONE_EVENT, "FALSE", "")
+
+    def check_once(self) -> Optional[str]:
+        """One poll; fires callbacks on a NEW non-idle event and
+        returns it (None otherwise)."""
+        value = self._fetch()
+        if value is None:
+            if not self.unavailable:
+                self.unavailable = True
+                logger.info(
+                    "metadata server unreachable; preemption watcher "
+                    "idle (not on GCE)"
+                )
+            return None
+        self.unavailable = False
+        if self._is_idle(value):
+            self._last_event = _NONE_EVENT
+            return None
+        if value == self._last_event:
+            return None  # already reported this event
+        self._last_event = value
+        logger.warning("maintenance event: %s — flushing state", value)
+        for cb in self._callbacks:
+            try:
+                cb(value)
+            except Exception as e:  # noqa: BLE001
+                logger.error("preemption callback failed: %s", e)
+        return value
+
+    def _loop(self):
+        backoff = self._interval
+        while not self._stopped.wait(backoff):
+            self.check_once()
+            # when not on GCE, poll rarely — the endpoint won't appear
+            backoff = 300.0 if self.unavailable else self._interval
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="preemption-watcher", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stopped.set()

@@ -65,6 +65,9 @@ class ElasticLaunchConfig:
     envs: Dict[str, str] = field(default_factory=dict)
     # persistent XLA compilation cache keeps post-restart warmup cheap
     compile_cache_dir: str = ""
+    # watch the GCE metadata maintenance-event endpoint: on TPU-VMs
+    # preemption fires there ~60s before any SIGTERM (agent/preemption.py)
+    watch_preemption: bool = True
 
     def auto_configure_params(self):
         """Fill nproc from local device count when unset (reference
@@ -458,16 +461,35 @@ class ElasticTrainingAgent:
     def run(self) -> int:
         """Agent main loop. Returns a process exit code."""
         factory_queue = None
+        preemption_watcher = None
         if self._start_ckpt_saver:
             factory_queue = AsyncCheckpointSaver.start_async_saving_ckpt()
+        if self._config.watch_preemption:
+            from dlrover_tpu.agent.preemption import PreemptionWatcher
+
+            preemption_watcher = PreemptionWatcher()
+            preemption_watcher.on_preemption(self._on_preemption)
+            preemption_watcher.start()
         try:
             return self._invoke_run()
         finally:
             self._stopped = True
+            if preemption_watcher is not None:
+                preemption_watcher.stop()
             self._stop_workers()
             if factory_queue is not None:
                 factory_queue.close()
                 AsyncCheckpointSaver.reset()
+
+    def _on_preemption(self, event: str):
+        """Maintenance event: flush the newest shm snapshot to storage
+        and fence this node at the master BEFORE the hardware goes
+        away (the SIGTERM path may never run)."""
+        self._save_ckpt_to_storage(f"preemption:{event}")
+        self._try_report_failure(
+            f"maintenance event {event}",
+            TrainingExceptionLevel.NODE_ERROR,
+        )
 
     def _invoke_run(self) -> int:
         if not self._initialize_workers():

@@ -23,6 +23,8 @@ requested step, then every delta after it in step order, applied with
 
 import io
 import os
+import queue
+import threading
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -79,6 +81,52 @@ class SparseCheckpointManager:
         self._last_cut: Dict[str, int] = {}
         self._saves_since_full = 0
         self._last_step: Optional[int] = None
+        # a lost async write breaks the delta chain; force the next
+        # save to be full when one fails
+        self._force_full = False
+        self._io_queue: Optional[queue.Queue] = None
+        self._io_thread: Optional[threading.Thread] = None
+
+    # ---------------------------------------------------- async writer
+
+    def _ensure_io_thread(self):
+        if self._io_thread is not None:
+            return
+        self._io_queue = queue.Queue()
+        self._pending = 0
+        self._pending_cv = threading.Condition()
+
+        def _loop():
+            while True:
+                item = self._io_queue.get()
+                step, manifest, payload = item
+                try:
+                    self._write_commit(step, manifest, payload)
+                except Exception as e:  # noqa: BLE001
+                    logger.error(
+                        "sparse ckpt async write for step %s failed: "
+                        "%s — forcing next save full", step, e,
+                    )
+                    self._force_full = True
+                finally:
+                    with self._pending_cv:
+                        self._pending -= 1
+                        self._pending_cv.notify_all()
+
+        self._io_thread = threading.Thread(
+            target=_loop, name="sparse-ckpt-writer", daemon=True
+        )
+        self._io_thread.start()
+
+    def wait_for_writes(self, timeout: float = 600.0):
+        """Join all queued async writes (call before process exit)."""
+        if self._io_thread is None:
+            return
+        with self._pending_cv:
+            if not self._pending_cv.wait_for(
+                lambda: self._pending == 0, timeout=timeout
+            ):
+                logger.warning("sparse ckpt writes still pending")
 
     # ------------------------------------------------------------ save
 
@@ -87,16 +135,32 @@ class SparseCheckpointManager:
         step: int,
         tables: Dict,
         full: Optional[bool] = None,
+        blocking: bool = True,
     ) -> str:
         """Persist ``tables`` at ``step``; returns the committed dir.
 
         ``full=None`` -> automatic cadence (first save and every
-        ``full_every``-th are full)."""
+        ``full_every``-th are full).  ``blocking=False`` exports the
+        rows inline (the version cut must happen NOW) but hands
+        serialization + storage writes + commit to a background writer
+        thread — the train step is blocked only for the row memcpy,
+        mirroring the dense engine's async persist.  Call
+        :meth:`wait_for_writes` before process exit."""
+        final = os.path.join(self.dir, _step_dir(step))
+        if self.storage.exists(final):
+            # a committed dir for this step exists: only legal as an
+            # idempotent re-save of the SAME timeline (the final save
+            # in a train loop repeating the last interval step);
+            # restore() truncates ahead-of-restore steps, so an
+            # abandoned-timeline dir cannot survive to reach here
+            return final
         if full is None:
             full = (
                 not self._last_cut
+                or self._force_full
                 or self._saves_since_full >= self.full_every - 1
             )
+        self._force_full = False
         kind = "full" if full else "delta"
         manifest = {
             "step": step,
@@ -104,10 +168,8 @@ class SparseCheckpointManager:
             "base_step": self._last_step if not full else None,
             "tables": {},
         }
-        tmp = os.path.join(self.dir, _TMP_PREFIX + _step_dir(step))
-        final = os.path.join(self.dir, _step_dir(step))
-        self.storage.safe_makedirs(tmp)
         cuts: Dict[str, int] = {}
+        payload: Dict[str, tuple] = {}
         for name, table in tables.items():
             if full:
                 cut = table.version
@@ -116,6 +178,40 @@ class SparseCheckpointManager:
                 since = self._last_cut.get(name, 0)
                 keys, values, cut = table.export_delta(since)
             cuts[name] = cut
+            payload[name] = (keys, values)
+            manifest["tables"][name] = {
+                "count": int(keys.size),
+                "dim": int(values.shape[1]) if values.ndim == 2 else 0,
+                "cut_version": int(cut),
+            }
+        # bookkeeping advances at the cut, not the commit: the next
+        # delta must not re-export these rows (a lost async write is
+        # recovered by _force_full, and across processes by restore()
+        # re-reading the last COMMITTED manifest)
+        self._last_cut = cuts
+        self._last_step = step
+        self._saves_since_full = 0 if full else self._saves_since_full + 1
+        logger.info(
+            "sparse ckpt %s save at step %s (%s rows%s)",
+            kind,
+            step,
+            sum(m["count"] for m in manifest["tables"].values()),
+            ", async" if not blocking else "",
+        )
+        if blocking:
+            self._write_commit(step, manifest, payload)
+        else:
+            self._ensure_io_thread()
+            with self._pending_cv:
+                self._pending += 1
+            self._io_queue.put((step, manifest, payload))
+        return final
+
+    def _write_commit(self, step: int, manifest: dict, payload: Dict):
+        tmp = os.path.join(self.dir, _TMP_PREFIX + _step_dir(step))
+        final = os.path.join(self.dir, _step_dir(step))
+        self.storage.safe_makedirs(tmp)
+        for name, (keys, values) in payload.items():
             self.storage.write(
                 _npy_bytes(keys), os.path.join(tmp, f"{name}.keys.npy")
             )
@@ -123,26 +219,11 @@ class SparseCheckpointManager:
                 _npy_bytes(values),
                 os.path.join(tmp, f"{name}.values.npy"),
             )
-            manifest["tables"][name] = {
-                "count": int(keys.size),
-                "dim": int(values.shape[1]) if values.ndim == 2 else 0,
-                "cut_version": int(cut),
-            }
         self.storage.write_json(
             manifest, os.path.join(tmp, "manifest.json")
         )
         self.storage.safe_move(tmp, final)  # commit
-        self._last_cut = cuts
-        self._last_step = step
-        self._saves_since_full = 0 if full else self._saves_since_full + 1
-        logger.info(
-            "sparse ckpt %s save at step %s (%s rows)",
-            kind,
-            step,
-            sum(m["count"] for m in manifest["tables"].values()),
-        )
         self._cleanup()
-        return final
 
     # --------------------------------------------------------- restore
 
@@ -204,6 +285,20 @@ class SparseCheckpointManager:
                 )
                 if keys.size:
                     table.import_(keys, values)
+        # the timeline is rewound to target: committed saves NEWER
+        # than it belong to an abandoned run — a later re-save of
+        # those steps would otherwise be silently skipped by the
+        # idempotence check and corrupt the delta chain with
+        # old-timeline rows
+        for m in self._manifests():
+            if m["step"] > target["step"]:
+                logger.info(
+                    "sparse ckpt: dropping abandoned-timeline step %s",
+                    m["step"],
+                )
+                self.storage.safe_rmtree(
+                    os.path.join(self.dir, _step_dir(m["step"]))
+                )
         # future deltas continue from the restored chain's head
         self._last_cut = {
             name: meta["cut_version"]

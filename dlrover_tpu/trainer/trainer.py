@@ -50,6 +50,10 @@ class TrainingArgs:
     # "staged" (leaf-wise device->host, extra HBM = one leaf, but the
     # step blocks for the transfer) near HBM capacity
     snapshot_mode: str = "auto"
+    # host-side sparse embedding tables ({name: KvTable-like}) saved
+    # alongside the dense state at every storage-tier step via
+    # SparseCheckpointManager full+delta chains, restored on resume
+    sparse_tables: Optional[dict] = None
     extra: dict = field(default_factory=dict)
 
 
@@ -93,6 +97,19 @@ class Trainer:
                 local_shard_num=int(
                     os.getenv("DLROVER_TPU_LOCAL_PROCESS_COUNT", "1")
                 ),
+            )
+        self._sparse_mgr = None
+        if args.sparse_tables and args.checkpoint_dir:
+            from dlrover_tpu.sparse.checkpoint import (
+                SparseCheckpointManager,
+            )
+
+            # one chain per process: sparse tables are host-local
+            self._sparse_mgr = SparseCheckpointManager(
+                os.path.join(
+                    args.checkpoint_dir,
+                    f"sparse-rank{self._ctx.rank:05d}",
+                )
             )
         self._hang = HangDetector(
             timeout=args.hang_timeout, on_hang=default_hang_action
@@ -140,6 +157,25 @@ class Trainer:
                 self.state = restored
                 start_step = step
                 logger.info("resumed training from step %d", step)
+                if self._sparse_mgr is not None:
+                    # dense step wins: load the sparse chain at-or-
+                    # before it so embeddings never run AHEAD of the
+                    # dense weights
+                    s = self._sparse_mgr.restore(
+                        self._args.sparse_tables, step=step
+                    )
+                    if s is not None:
+                        logger.info(
+                            "restored sparse tables at step %d", s
+                        )
+                    else:
+                        logger.warning(
+                            "dense state resumed at step %d but NO "
+                            "sparse save exists at-or-before it — "
+                            "embedding tables keep their current "
+                            "(likely freshly-initialized) contents",
+                            step,
+                        )
         self.progress.global_step = start_step
         return start_step
 
@@ -215,6 +251,12 @@ class Trainer:
             snap = self._snap_fn(self.state)
         if to_storage:
             self._engine.save_to_storage(step, snap, blocking=False)
+            if self._sparse_mgr is not None:
+                # export inline (version cut), write in background —
+                # the step blocks only for the touched-row memcpy
+                self._sparse_mgr.save(
+                    step, self._args.sparse_tables, blocking=False
+                )
         else:
             self._engine.save_to_memory(step, snap, blocking=False)
 
@@ -292,6 +334,9 @@ class Trainer:
                 self._engine.wait_for_snapshot(timeout=600)
                 if self._engine.save_to_storage(step, self.state):
                     self._engine.wait_for_persist(step, timeout=600)
+                if self._sparse_mgr is not None:
+                    self._sparse_mgr.save(step, self._args.sparse_tables)
+                    self._sparse_mgr.wait_for_writes()
                 self._engine.close()
         return {
             "final_step": step,

@@ -137,7 +137,7 @@ class TestPrefetch:
 
 class TestTrainer:
     def _build(self, tmp_path, max_steps, socket_dir,
-               snapshot_mode="auto"):
+               snapshot_mode="auto", sparse_tables=None):
         os.environ["DLROVER_TPU_SOCKET_DIR"] = socket_dir
         cfg = LlamaConfig.tiny(remat="none")
         result = auto_accelerate(
@@ -161,6 +161,7 @@ class TestTrainer:
             log_interval=100,
             micro_batch_size=8,
             snapshot_mode=snapshot_mode,
+            sparse_tables=sparse_tables,
         )
         return Trainer(result, args, data_iter)
 
@@ -191,3 +192,32 @@ class TestTrainer:
         t2 = self._build(tmp_path, max_steps=6, socket_dir=sock)
         start = t2._init_or_restore_state()
         assert start >= 4
+
+    def test_sparse_tables_save_and_restore_with_dense(self, tmp_path):
+        """Host-side KvTable embeddings checkpoint at the storage tier
+        alongside the dense state and restore on resume (reference
+        role: tfplus saver integration)."""
+        from dlrover_tpu.sparse.kv_table import KvTable
+
+        sock = str(tmp_path / "socks3")
+        table = KvTable(dim=4)
+        keys = np.arange(10, dtype=np.int64)
+        table.scatter(keys, np.full((10, 4), 7.0, np.float32))
+        t1 = self._build(
+            tmp_path, max_steps=4, socket_dir=sock,
+            sparse_tables={"emb": table},
+        )
+        summary = t1.train()
+        assert summary["final_step"] == 4
+
+        fresh = KvTable(dim=4)
+        t2 = self._build(
+            tmp_path, max_steps=6, socket_dir=sock,
+            sparse_tables={"emb": fresh},
+        )
+        start = t2._init_or_restore_state()
+        assert start >= 4
+        got = fresh.gather(keys, insert_missing=False)
+        np.testing.assert_allclose(got, 7.0)
+        table.close()
+        fresh.close()

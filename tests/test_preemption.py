@@ -1,0 +1,71 @@
+"""TPU-VM preemption watcher: event edge detection, idle resets,
+metadata-unavailable quiescence, agent callback wiring."""
+
+from dlrover_tpu.agent.preemption import PreemptionWatcher
+
+
+class TestPreemptionWatcher:
+    def test_fires_once_per_event(self):
+        values = iter(
+            ["NONE", "TERMINATE_ON_HOST_MAINTENANCE",
+             "TERMINATE_ON_HOST_MAINTENANCE", "NONE",
+             "MIGRATE_ON_HOST_MAINTENANCE"]
+        )
+        events = []
+        w = PreemptionWatcher(fetcher=lambda: next(values))
+        w.on_preemption(events.append)
+        results = [w.check_once() for _ in range(5)]
+        assert events == [
+            "TERMINATE_ON_HOST_MAINTENANCE",
+            "MIGRATE_ON_HOST_MAINTENANCE",
+        ]
+        assert results[1] == "TERMINATE_ON_HOST_MAINTENANCE"
+        assert results[2] is None  # same event, not re-fired
+
+    def test_event_refires_after_idle_reset(self):
+        values = iter(["TRUE", "NONE", "TRUE"])
+        events = []
+        w = PreemptionWatcher(fetcher=lambda: next(values))
+        w.on_preemption(events.append)
+        for _ in range(3):
+            w.check_once()
+        assert events == ["TRUE", "TRUE"]
+
+    def test_unreachable_metadata_is_quiet(self):
+        w = PreemptionWatcher(fetcher=lambda: None)
+        w.on_preemption(lambda e: (_ for _ in ()).throw(AssertionError))
+        assert w.check_once() is None
+        assert w.unavailable
+
+    def test_callback_error_does_not_break_watcher(self):
+        values = iter(["TRUE", "NONE", "TRUE"])
+        hits = []
+        w = PreemptionWatcher(fetcher=lambda: next(values))
+
+        def bad(_e):
+            raise RuntimeError("boom")
+
+        w.on_preemption(bad)
+        w.on_preemption(hits.append)
+        for _ in range(3):
+            w.check_once()
+        assert hits == ["TRUE", "TRUE"]
+
+
+def test_agent_preemption_flushes_and_reports(monkeypatch, tmp_path):
+    """The agent's _on_preemption callback flushes the shm checkpoint
+    and reports a NODE_ERROR to the master."""
+    from dlrover_tpu.agent import training as tr
+
+    calls = {"flush": [], "report": []}
+
+    agent = tr.ElasticTrainingAgent.__new__(tr.ElasticTrainingAgent)
+    agent._save_ckpt_to_storage = lambda reason: calls["flush"].append(
+        reason
+    )
+    agent._try_report_failure = (
+        lambda msg, level: calls["report"].append((msg, level))
+    )
+    agent._on_preemption("TERMINATE_ON_HOST_MAINTENANCE")
+    assert calls["flush"] == ["preemption:TERMINATE_ON_HOST_MAINTENANCE"]
+    assert calls["report"][0][1] == "node_error"

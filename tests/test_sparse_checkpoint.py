@@ -112,6 +112,50 @@ class TestSparseCheckpoint:
         np.testing.assert_array_equal(k1, k2)
         fresh.close()
 
+    def test_async_save_commits_in_background(self, table, tmp_path):
+        mgr = SparseCheckpointManager(str(tmp_path), full_every=10)
+        _set_rows(table, 0, 20)
+        mgr.save(1, {"emb": table}, blocking=False)
+        _set_rows(table, 20, 30)
+        mgr.save(2, {"emb": table}, blocking=False)
+        mgr.wait_for_writes()
+        fresh = KvTable(dim=DIM)
+        assert SparseCheckpointManager(str(tmp_path)).restore(
+            {"emb": fresh}
+        ) == 2
+        k1, v1 = _dump(table)
+        k2, v2 = _dump(fresh)
+        np.testing.assert_array_equal(k1, k2)
+        np.testing.assert_allclose(v1, v2)
+        fresh.close()
+
+    def test_restore_truncates_abandoned_timeline(self, table, tmp_path):
+        """Rewinding to an earlier step drops newer committed saves so
+        a re-save of those steps cannot silently keep old-timeline
+        rows (review finding: idempotence vs rollback)."""
+        mgr = SparseCheckpointManager(str(tmp_path), full_every=1)
+        _set_rows(table, 0, 10)
+        mgr.save(1, {"emb": table})
+        _set_rows(table, 0, 10, scale=3.0)  # old-timeline values
+        mgr.save(2, {"emb": table})
+
+        # rollback: restore at step 1, retrain differently, re-save 2
+        fresh = KvTable(dim=DIM)
+        mgr2 = SparseCheckpointManager(str(tmp_path), full_every=1)
+        assert mgr2.restore({"emb": fresh}, step=1) == 1
+        assert mgr2.latest_step() == 1  # step-2 dir dropped
+        _set_rows(fresh, 0, 10, scale=9.0)  # new timeline
+        mgr2.save(2, {"emb": fresh})
+
+        final = KvTable(dim=DIM)
+        assert SparseCheckpointManager(str(tmp_path)).restore(
+            {"emb": final}
+        ) == 2
+        _, v = _dump(final)
+        np.testing.assert_allclose(v[:, 0], np.arange(10) * 9.0)
+        fresh.close()
+        final.close()
+
     def test_crash_tmp_dir_is_invisible(self, table, tmp_path):
         mgr = SparseCheckpointManager(str(tmp_path))
         _set_rows(table, 0, 5)
