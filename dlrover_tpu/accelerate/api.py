@@ -30,6 +30,11 @@ class AccelerateResult:
     rules: object
     profile: object
     timings: dict
+    # measurement-calibrated planner (None without a dry run): already
+    # fitted on this run's timings — ``planner.plan(n_devices=256)``
+    # ranks candidates at a larger target scale (profile small, plan
+    # big; accelerate/dim_planner.py)
+    planner: object = None
 
 
 def _build_for_strategy(
@@ -73,6 +78,8 @@ def auto_accelerate(
     moe: bool = False,
     batch_per_replica: int = 1,
     seq_len: int = 2048,
+    tune_space: Optional[dict] = None,
+    tune_budget: int = 6,
 ) -> AccelerateResult:
     """Args mirror ``build_train_step`` plus search knobs.
 
@@ -84,12 +91,19 @@ def auto_accelerate(
     ``sample_batch_fn(batch_sharding) -> batch`` enables the timed dry
     run; without it (or with dry_run=False) the top-ranked memory-fit
     candidate wins directly.
+
+    ``tune_space`` (dry-run mode only): Strategy-field value lists,
+    e.g. ``{"num_micro_steps": [1, 2, 4], "remat": ["dots", "full"]}``
+    — after the mesh race picks a winner, Bayesian optimization
+    (``bayes_search.tune_strategy``) spends ``tune_budget`` extra
+    timed builds searching the tunables inside it.
     """
     if devices is None:
         devices = jax.devices()
     profile = analyse_model(init_params_fn, optimizer)
     timings = {}
 
+    planner = None
     if load_strategy is not None:
         strategy = load_strategy
     else:
@@ -121,6 +135,36 @@ def auto_accelerate(
             strategy, timings = successive_halving(build, candidates)
             if strategy is None:
                 strategy = candidates[0]
+            elif tune_space:
+                # BO over the winner's tunables (micro steps, remat,
+                # pipe microbatches, ...) — the knobs no analytic
+                # model predicts
+                from dlrover_tpu.accelerate.bayes_search import (
+                    tune_strategy,
+                )
+
+                strategy, tune_hist = tune_strategy(
+                    build, strategy, tune_space, budget=tune_budget
+                )
+                timings["bayes_tune"] = tune_hist
+            # calibrate the per-term cost model on what was measured:
+            # result.planner.plan(n) ranks candidates at target scale
+            from dlrover_tpu.accelerate.dim_planner import (
+                CalibratedPlanner,
+            )
+
+            by_desc = {c.describe(): c for c in candidates}
+            measured = [
+                (by_desc[d], t[-1])
+                for d, t in timings.items()
+                if d in by_desc and t and t[-1] is not None
+            ]
+            planner = CalibratedPlanner(
+                profile,
+                batch_per_replica=batch_per_replica,
+                seq_len=seq_len,
+            )
+            planner.calibrate(measured)
         else:
             strategy = candidates[0]
 
@@ -139,4 +183,5 @@ def auto_accelerate(
         rules=rules,
         profile=profile,
         timings=timings,
+        planner=planner,
     )

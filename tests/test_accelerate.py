@@ -225,6 +225,11 @@ class TestAutoAccelerate:
         assert any(
             t == t for ts in result.timings.values() for t in ts
         )
+        # the dry-run timings calibrated a planner that extrapolates
+        # to a larger target mesh (profile small, plan big)
+        assert result.planner is not None
+        plans = result.planner.plan(n_devices=16, top_k=3)
+        assert plans and all(s.n_devices == 16 for s, _ in plans)
         state = result.fns.init_state(jax.random.PRNGKey(0))
         batch = jax.device_put(
             {"tokens": jnp.ones((8, 17), dtype=jnp.int32)},
@@ -232,6 +237,47 @@ class TestAutoAccelerate:
         )
         _, metrics = result.fns.train_step(state, batch)
         assert np.isfinite(float(metrics["loss"]))
+
+    def test_dry_run_bo_tune_wiring(self, tiny_cfg, monkeypatch):
+        """With tune_space set, the BO tunable search runs on the race
+        winner and its choice becomes the built strategy (patched
+        timer: the wiring, not the GP, is under test here)."""
+        import dlrover_tpu.accelerate.bayes_search as bs
+        import dlrover_tpu.accelerate.search as srch
+
+        calls = {}
+
+        def fake_tune(build_fn, base, space, budget=6, **kw):
+            calls["base"] = base
+            calls["space"] = space
+            import dataclasses
+
+            return dataclasses.replace(base, remat="dots"), {"n": budget}
+
+        def fake_race(build_fn, candidates, **kw):
+            # skip the compile-heavy race; the race itself is covered
+            # by test_dry_run_search_picks_and_runs
+            return candidates[0], {candidates[0].describe(): [0.1]}
+
+        monkeypatch.setattr(bs, "tune_strategy", fake_tune)
+        monkeypatch.setattr(srch, "successive_halving", fake_race)
+        result = auto_accelerate(
+            loss_fn=lambda p, b: loss_fn(p, b, tiny_cfg),
+            optimizer=optax.adamw(1e-3),
+            init_params_fn=lambda rng: init_params(rng, tiny_cfg),
+            param_axes=param_logical_axes(tiny_cfg),
+            sample_batch_fn=lambda sharding: jax.device_put(
+                {"tokens": jnp.ones((8, 17), dtype=jnp.int32)}, sharding
+            ),
+            dry_run=True,
+            batch_per_replica=1,
+            seq_len=16,
+            tune_space={"remat": ["none", "dots", "full"]},
+            tune_budget=3,
+        )
+        assert calls["space"] == {"remat": ["none", "dots", "full"]}
+        assert result.strategy.remat == "dots"
+        assert result.timings["bayes_tune"] == {"n": 3}
 
     def test_full_auto_picks_and_runs(self, tiny_cfg):
         result = auto_accelerate(
