@@ -3,3 +3,4 @@ from dlrover_tpu.sparse.embedding import SparseEmbedding  # noqa: F401
 from dlrover_tpu.sparse.checkpoint import (  # noqa: F401
     SparseCheckpointManager,
 )
+from dlrover_tpu.sparse.kv_table import gather_batch  # noqa: F401

@@ -118,6 +118,15 @@ def _load_library():
             ctypes.POINTER(ctypes.c_float),
             ctypes.c_int64,
         ]
+        lib.kv_gather_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+            ctypes.c_int,
+            ctypes.c_int,
+        ]
         _lib_handle = lib
         return lib
 
@@ -348,3 +357,55 @@ class KvTable:
             "kv_export_delta kept losing the sizing race; table is "
             "being mutated faster than it can be scanned"
         )
+
+
+def gather_batch(
+    tables,
+    keys_list,
+    insert_missing: bool = True,
+    count_frequency: bool = True,
+):
+    """Gather from many tables in ONE library crossing (reference
+    ``BatchKvVariableGatherOrZerosV2``, tfplus ``kv_variable_ops.cc``
+    batch ops): a recommender step looks up dozens of feature tables
+    back to back — batching keeps the whole loop in C.
+
+    ``tables``: list of :class:`KvTable` (dims may differ);
+    ``keys_list``: one int64 array per table.  Returns one
+    ``[*keys.shape, dim]`` fp32 array per table.
+    """
+    if len(tables) != len(keys_list):
+        raise ValueError("one key array per table")
+    if not tables:
+        return []
+    lib = tables[0]._lib
+    n = len(tables)
+    keys_np = [
+        np.ascontiguousarray(k, dtype=np.int64) for k in keys_list
+    ]
+    flat = np.concatenate([k.reshape(-1) for k in keys_np])
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum([k.size for k in keys_np], out=offsets[1:])
+    outs = [
+        np.empty((k.size, t.dim), dtype=np.float32)
+        for t, k in zip(tables, keys_np)
+    ]
+    handle_arr = (ctypes.c_void_p * n)(
+        *[t._handle for t in tables]
+    )
+    out_arr = (ctypes.POINTER(ctypes.c_float) * n)(
+        *[_f32_ptr(o) for o in outs]
+    )
+    lib.kv_gather_batch(
+        handle_arr,
+        n,
+        _i64_ptr(flat),
+        _i64_ptr(offsets),
+        out_arr,
+        1 if insert_missing else 0,
+        1 if count_frequency else 0,
+    )
+    return [
+        o.reshape(k.shape + (t.dim,))
+        for o, k, t in zip(outs, keys_np, tables)
+    ]

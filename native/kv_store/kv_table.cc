@@ -209,6 +209,23 @@ void kv_gather(void* handle, const int64_t* keys, int64_t n, float* out,
   }
 }
 
+// Batched gather across T tables in ONE library crossing (reference
+// BatchKvVariableGatherOrZerosV2, tfplus kv_variable_ops.cc): a
+// recommender step looks up dozens of feature tables back to back —
+// batching amortizes the FFI overhead and keeps the per-table loop in
+// C.  handles[t] gathers keys[key_offsets[t] .. key_offsets[t+1])
+// into out[t][...]; per-table dims may differ (out is per-table).
+void kv_gather_batch(void** handles, int64_t n_tables,
+                     const int64_t* keys, const int64_t* key_offsets,
+                     float** outs, int insert_missing, int count_freq) {
+  for (int64_t t = 0; t < n_tables; ++t) {
+    const int64_t lo = key_offsets[t];
+    const int64_t hi = key_offsets[t + 1];
+    kv_gather(handles[t], keys + lo, hi - lo, outs[t], insert_missing,
+              count_freq);
+  }
+}
+
 // updates[n * dim]; op: 0 = assign, 1 = add (grad accumulate),
 // 2 = sub (apply positive lr*grad).  Missing keys are inserted first
 // (zeros) so scatter after a failover replays cleanly.

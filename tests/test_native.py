@@ -41,6 +41,30 @@ class TestKvTable:
         np.testing.assert_array_equal(out, 0)
         assert len(t) == 0
 
+    def test_gather_batch_matches_per_table(self):
+        """One library crossing over many tables (reference
+        BatchKvVariableGatherOrZerosV2) equals per-table gathers —
+        including mixed dims and 2-D key shapes."""
+        from dlrover_tpu.sparse.kv_table import gather_batch
+
+        t1 = KvTable(4, init_stddev=0.1, seed=1)
+        t2 = KvTable(8, init_stddev=0.1, seed=2)
+        k1 = np.array([[1, 2], [3, 1]], dtype=np.int64)
+        k2 = np.array([7, 8, 9], dtype=np.int64)
+        want1, want2 = t1.gather(k1), t2.gather(k2)
+
+        f1 = KvTable(4, init_stddev=0.1, seed=1)
+        f2 = KvTable(8, init_stddev=0.1, seed=2)
+        got1, got2 = gather_batch([f1, f2], [k1, k2])
+        assert got1.shape == (2, 2, 4) and got2.shape == (3, 8)
+        np.testing.assert_array_equal(got1, want1)
+        np.testing.assert_array_equal(got2, want2)
+        # frequency counted through the batch path too
+        assert f1.frequency(1) == 2
+        assert gather_batch([], []) == []
+        for t in (t1, t2, f1, f2):
+            t.close()
+
     def test_scatter_ops(self):
         t = KvTable(2)
         k = np.array([1], dtype=np.int64)
