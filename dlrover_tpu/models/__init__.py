@@ -6,3 +6,9 @@ from dlrover_tpu.models.llama import (  # noqa: F401
     param_logical_axes,
     count_params,
 )
+from dlrover_tpu.models import vit  # noqa: F401
+from dlrover_tpu.models.hf_convert import (  # noqa: F401
+    config_from_hf,
+    params_from_hf,
+    params_to_hf,
+)

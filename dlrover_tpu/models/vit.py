@@ -1,0 +1,197 @@
+"""Vision Transformer — second model family on the same substrate.
+
+Role parity: the reference accelerates arbitrary user models (HF/
+Megatron/vision) through ``auto_accelerate``; this framework ships
+model families natively.  ViT demonstrates that the logical-axes
+scheme, the strategy engine and the kernels are model-agnostic:
+the same ``EMBED``/``HEADS``/``MLP`` rules shard it, the same Pallas
+flash attention serves it (non-causal), and ``auto_accelerate``
+consumes it unchanged.
+
+TPU notes: patchify is one big reshape+matmul (MXU-friendly — no
+im2col gather); layers are stacked on a leading dim and executed with
+``lax.scan`` exactly like llama, so pipeline sharding works for free.
+"""
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dlrover_tpu.models.llama import rms_norm
+from dlrover_tpu.parallel import sharding as sh
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    channels: int = 3
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    mlp_dim: int = 3072
+    num_classes: int = 1000
+    norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * self.channels
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @staticmethod
+    def tiny(**overrides) -> "ViTConfig":
+        base = dict(
+            image_size=32, patch_size=8, dim=64, n_layers=2,
+            n_heads=4, mlp_dim=128, num_classes=10,
+        )
+        base.update(overrides)
+        return ViTConfig(**base)
+
+
+def init_params(key, cfg: ViTConfig) -> Dict:
+    """Stacked-layer pytree, fp32 masters (same conventions as llama:
+    ``layers`` leading dim = the scan/pipeline axis)."""
+    ks = jax.random.split(key, 6)
+    d, mlp, L = cfg.dim, cfg.mlp_dim, cfg.n_layers
+
+    def dense(key, *shape, in_axis=0):
+        return jax.random.normal(key, shape, jnp.float32) * (
+            shape[in_axis] ** -0.5
+        )
+
+    lk = jax.random.split(ks[2], 6)
+    layer = {
+        "attn_norm": jnp.ones((L, d), jnp.float32),
+        "wqkv": dense(lk[0], L, d, 3 * d, in_axis=1),
+        "wo": dense(lk[1], L, d, d, in_axis=1),
+        "mlp_norm": jnp.ones((L, d), jnp.float32),
+        "w_up": dense(lk[2], L, d, mlp, in_axis=1),
+        "w_down": dense(lk[3], L, mlp, d, in_axis=1),
+    }
+    return {
+        "patch_embed": dense(ks[0], cfg.patch_dim, d),
+        "pos_embed": (
+            jax.random.normal(
+                ks[1], (cfg.n_patches + 1, d), jnp.float32
+            )
+            * 0.02
+        ),
+        "cls_token": jnp.zeros((d,), jnp.float32),
+        "layers": layer,
+        "final_norm": jnp.ones((d,), jnp.float32),
+        "head": dense(ks[3], d, cfg.num_classes),
+    }
+
+
+def param_logical_axes(cfg: ViTConfig) -> Dict:
+    return {
+        "patch_embed": (None, sh.EMBED),
+        "pos_embed": (None, sh.EMBED),
+        "cls_token": (None,),
+        "layers": {
+            "attn_norm": (sh.LAYERS, None),
+            "wqkv": (sh.LAYERS, sh.EMBED, sh.HEADS),
+            "wo": (sh.LAYERS, sh.HEADS, sh.EMBED),
+            "mlp_norm": (sh.LAYERS, None),
+            "w_up": (sh.LAYERS, sh.EMBED, sh.MLP),
+            "w_down": (sh.LAYERS, sh.MLP, sh.EMBED),
+        },
+        "final_norm": (None,),
+        "head": (sh.EMBED, None),
+    }
+
+
+def patchify(images: jnp.ndarray, cfg: ViTConfig) -> jnp.ndarray:
+    """[B, H, W, C] -> [B, n_patches, patch_dim] by reshape only."""
+    b, h, w, c = images.shape
+    p = cfg.patch_size
+    x = images.reshape(b, h // p, p, w // p, p, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, (h // p) * (w // p), p * p * c)
+
+
+def _layer_forward(cfg: ViTConfig, lp: Dict, x: jnp.ndarray):
+    b, s, d = x.shape
+    nh, hd = cfg.n_heads, cfg.head_dim
+    dt = cfg.dtype
+
+    def proj(a, w):
+        return jnp.matmul(
+            a, w.astype(dt), preferred_element_type=jnp.float32
+        ).astype(dt)
+
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    qkv = proj(h, lp["wqkv"]).reshape(b, s, 3, nh, hd)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    from dlrover_tpu.models.llama import _default_attention
+
+    attn = _default_attention()(q, k, v, causal=False)
+    x = x + proj(attn.reshape(b, s, nh * hd), lp["wo"])
+
+    h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    x = x + proj(jax.nn.gelu(proj(h, lp["w_up"])), lp["w_down"])
+    return x
+
+
+def forward(
+    params: Dict, images: jnp.ndarray, cfg: ViTConfig
+) -> jnp.ndarray:
+    """images [B, H, W, C] -> logits [B, num_classes] (fp32)."""
+    dt = cfg.dtype
+    patches = patchify(images.astype(dt), cfg)
+    x = jnp.matmul(
+        patches,
+        params["patch_embed"].astype(dt),
+        preferred_element_type=jnp.float32,
+    ).astype(dt)
+    b = x.shape[0]
+    cls = jnp.broadcast_to(
+        params["cls_token"].astype(dt), (b, 1, cfg.dim)
+    )
+    x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos_embed"].astype(dt)[None]
+    x = sh.apply_sharding_constraint(
+        x, (sh.BATCH, sh.SEQ, sh.EMBED), _rules()
+    )
+
+    block = partial(_layer_forward, cfg)
+
+    def body(carry, lp):
+        return block(lp, carry), None
+
+    x, _ = lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return jnp.matmul(
+        x[:, 0],  # CLS token
+        params["head"].astype(dt),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _rules():
+    from dlrover_tpu.models.llama import _current_rules
+
+    return _current_rules()
+
+
+def loss_fn(params: Dict, batch: Dict, cfg: ViTConfig) -> jnp.ndarray:
+    """Softmax cross entropy; batch = {"images": [B,H,W,C],
+    "labels": [B]}."""
+    logits = forward(params, batch["images"], cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(
+        logp, batch["labels"][:, None], axis=-1
+    ).squeeze(-1)
+    return jnp.mean(nll)
