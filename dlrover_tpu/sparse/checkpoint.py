@@ -82,8 +82,10 @@ class SparseCheckpointManager:
         self._saves_since_full = 0
         self._last_step: Optional[int] = None
         # a lost async write breaks the delta chain; force the next
-        # save to be full when one fails
+        # save to be full when one fails (guarded: the writer thread
+        # sets it, save() reads+clears it)
         self._force_full = False
+        self._flag_lock = threading.Lock()
         self._io_queue: Optional[queue.Queue] = None
         self._io_thread: Optional[threading.Thread] = None
 
@@ -107,7 +109,8 @@ class SparseCheckpointManager:
                         "sparse ckpt async write for step %s failed: "
                         "%s — forcing next save full", step, e,
                     )
-                    self._force_full = True
+                    with self._flag_lock:
+                        self._force_full = True
                 finally:
                     with self._pending_cv:
                         self._pending -= 1
@@ -154,13 +157,17 @@ class SparseCheckpointManager:
             # restore() truncates ahead-of-restore steps, so an
             # abandoned-timeline dir cannot survive to reach here
             return final
-        if full is None:
-            full = (
-                not self._last_cut
-                or self._force_full
-                or self._saves_since_full >= self.full_every - 1
-            )
-        self._force_full = False
+        with self._flag_lock:
+            if full is None:
+                full = (
+                    not self._last_cut
+                    or self._force_full
+                    or self._saves_since_full >= self.full_every - 1
+                )
+            if full:
+                # only a FULL save repairs a broken chain; an explicit
+                # full=False must not consume the recovery flag
+                self._force_full = False
         kind = "full" if full else "delta"
         manifest = {
             "step": step,
@@ -244,29 +251,57 @@ class SparseCheckpointManager:
         return manifests[-1]["step"] if manifests else None
 
     def restore(self, tables: Dict, step: Optional[int] = None):
-        """Load the newest save at-or-before ``step`` (default: the
-        newest committed save) into ``tables``; returns the restored
-        step or None when nothing is committed."""
+        """Load the newest CONSISTENT save at-or-before ``step``
+        (default: the newest committed save) into ``tables``; returns
+        the restored step or None when nothing is committed.
+
+        Consistency: a delta is only restorable when its ``base_step``
+        is the immediately preceding committed save and that save is
+        itself consistent — a failed async write leaves a hole, and
+        deltas committed past the hole reference rows the chain no
+        longer carries; those are skipped (with a warning), falling
+        back to the newest consistent prefix."""
         manifests = self._manifests()
         if step is not None:
             manifests = [m for m in manifests if m["step"] <= step]
         if not manifests:
             return None
-        target = manifests[-1]
+        # forward pass: a full restarts consistency; a delta is
+        # consistent iff it chains to the previous consistent save
+        consistent: List[dict] = []
+        prev_ok: Optional[dict] = None
+        for m in manifests:
+            if m["kind"] == "full":
+                prev_ok = m
+                consistent.append(m)
+            elif (
+                prev_ok is not None
+                and m.get("base_step") == prev_ok["step"]
+            ):
+                prev_ok = m
+                consistent.append(m)
+            else:
+                logger.warning(
+                    "sparse ckpt: delta at step %s has no consistent "
+                    "base (hole in the chain) — ignoring it and "
+                    "everything after it until the next full save",
+                    m["step"],
+                )
+                prev_ok = None
+        if not consistent:
+            raise RuntimeError(
+                "sparse ckpt chain has no restorable save — every "
+                "committed delta is missing its base"
+            )
+        target = consistent[-1]
         # chain: newest full at-or-before target, then deltas upward
         chain: List[dict] = []
-        for m in reversed(manifests):
+        for m in reversed(consistent):
             if m["step"] > target["step"]:
                 continue
             chain.append(m)
             if m["kind"] == "full":
                 break
-        else:
-            if not chain or chain[-1]["kind"] != "full":
-                raise RuntimeError(
-                    "sparse ckpt chain has no full base — cleanup "
-                    "removed it or the first save was a delta"
-                )
         chain.reverse()
         for m in chain:
             d = os.path.join(self.dir, _step_dir(m["step"]))

@@ -335,8 +335,11 @@ class Trainer:
                 if self._engine.save_to_storage(step, self.state):
                     self._engine.wait_for_persist(step, timeout=600)
                 if self._sparse_mgr is not None:
-                    self._sparse_mgr.save(step, self._args.sparse_tables)
+                    # join in-flight async writes FIRST: the final step
+                    # may equal the last interval step, and two writers
+                    # on one step dir would race the commit rename
                     self._sparse_mgr.wait_for_writes()
+                    self._sparse_mgr.save(step, self._args.sparse_tables)
                 self._engine.close()
         return {
             "final_step": step,

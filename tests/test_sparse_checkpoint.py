@@ -156,6 +156,46 @@ class TestSparseCheckpoint:
         fresh.close()
         final.close()
 
+    def test_restore_skips_deltas_past_a_chain_hole(self, table, tmp_path):
+        """A lost (uncommitted) delta leaves a hole; deltas committed
+        past it must be ignored — restoring them would silently revert
+        rows touched only inside the hole (review finding)."""
+        import shutil
+
+        mgr = SparseCheckpointManager(str(tmp_path), full_every=10)
+        _set_rows(table, 0, 10)
+        mgr.save(1, {"emb": table})  # full
+        _set_rows(table, 10, 20)
+        mgr.save(2, {"emb": table})  # delta base=1
+        _set_rows(table, 20, 30)
+        mgr.save(3, {"emb": table})  # delta base=2
+        # simulate the async write of step 2 having been lost
+        shutil.rmtree(tmp_path / "step-00000002")
+
+        fresh = KvTable(dim=DIM)
+        restored = SparseCheckpointManager(str(tmp_path)).restore(
+            {"emb": fresh}
+        )
+        assert restored == 1  # newest CONSISTENT save
+        k, _ = _dump(fresh)
+        assert k.max() == 9  # nothing from the broken suffix applied
+        fresh.close()
+
+    def test_explicit_delta_does_not_consume_force_full(
+        self, table, tmp_path
+    ):
+        mgr = SparseCheckpointManager(str(tmp_path), full_every=10)
+        _set_rows(table, 0, 5)
+        mgr.save(1, {"emb": table})
+        mgr._force_full = True  # as the writer thread would on failure
+        _set_rows(table, 5, 8)
+        mgr.save(2, {"emb": table}, full=False)  # explicit delta
+        assert mgr._force_full  # flag survives
+        _set_rows(table, 8, 9)
+        mgr.save(3, {"emb": table})  # cadence save honors the flag
+        assert not mgr._force_full
+        assert mgr._manifests()[-1]["kind"] == "full"
+
     def test_crash_tmp_dir_is_invisible(self, table, tmp_path):
         mgr = SparseCheckpointManager(str(tmp_path))
         _set_rows(table, 0, 5)
