@@ -40,3 +40,11 @@ def _isolated_socket_dir(tmp_path, monkeypatch):
 def tmp_ckpt_dir():
     with tempfile.TemporaryDirectory(prefix="dlrover_tpu_ckpt_") as d:
         yield d
+
+
+def pytest_configure(config):
+    # the timeout marks are advisory (no pytest-timeout in the image);
+    # register them so the suite runs warning-clean
+    config.addinivalue_line(
+        "markers", "timeout(seconds): advisory per-test time budget"
+    )
