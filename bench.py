@@ -125,9 +125,32 @@ def main() -> int:
     import jax.numpy as jnp
 
     on_tpu = jax.default_backend() == "tpu"
-    # ~1.5B bf16 params on the real chip (3 GB); small on CPU CI
-    n_params = 1_500_000_000 if on_tpu else 50_000_000
+    # ~1.5B bf16 params on the real chip (3 GB); small on CPU CI.
+    # The tunnel this env reaches the chip through has WILDLY variable
+    # d2h bandwidth (0.065 GB/s in round 2, 0.002 GB/s observed in
+    # round 3): probe it first and cap the state so one full drain
+    # stays ~<=90s — the headline (dispatch blocking) is
+    # size-insensitive and d2h_gbps in extras normalizes the drains.
+    d2h_probe_gbps = None
+    n_params = 50_000_000
+    if on_tpu:
+        probe = jax.device_put(
+            jnp.ones((16, 1024, 1024), jnp.float32)  # 64 MB
+        )
+        jax.block_until_ready(probe)
+        import numpy as _np
+
+        t0 = time.perf_counter()
+        host = _np.asarray(probe)
+        d2h_probe_gbps = host.nbytes / 1e9 / max(
+            time.perf_counter() - t0, 1e-9
+        )
+        budget_bytes = d2h_probe_gbps * 1e9 * 90.0
+        n_params = int(
+            min(max(budget_bytes / 2, 50_000_000), 1_500_000_000)
+        )
     chunk = 25_000_000
+    n_params = max(n_params // chunk, 1) * chunk
     n_chunks = n_params // chunk
 
     key = jax.random.PRNGKey(0)
@@ -224,6 +247,11 @@ def main() -> int:
                     "first_save_block_s": round(first_block_s, 4),
                     "first_save_total_s": round(first_total_s, 2),
                     "backend": jax.default_backend(),
+                    "d2h_probe_gbps": (
+                        round(d2h_probe_gbps, 4)
+                        if d2h_probe_gbps is not None
+                        else None
+                    ),
                     "baseline_blocking_s": BASELINE_BLOCKING_S,
                     "host_memcpy_gbps": round(memcpy_gbps, 3),
                     "train": train_bench,

@@ -52,6 +52,29 @@ class StrategyResponse(Message):
     """Ranked candidates as Strategy kwargs dicts (wire-stable)."""
 
     candidates: List = field(default_factory=list)
+    # true when the ranking used fleet-measured timings (the service's
+    # calibrated planner had data for this workload)
+    calibrated: bool = False
+
+
+@dataclass
+class StrategyMeasurement(Message):
+    """Client -> service: one measured dry-run/production step time.
+
+    The Brain role (reference ``persist_metrics`` RPC +
+    ``optimize_job_worker_resource.go``'s learned throughput model):
+    clients report what a strategy actually cost; the service
+    calibrates its per-term cost model per workload and ranks BETTER
+    for the next requester of the same workload."""
+
+    # workload key (same fields the request carries)
+    num_params: int = 0
+    num_layers: int = 0
+    batch_per_replica: int = 1
+    seq_len: int = 2048
+    # what was measured
+    strategy: Dict = field(default_factory=dict)
+    step_time_s: float = 0.0
 
 
 def _strategy_to_dict(s: Strategy) -> Dict:
@@ -62,9 +85,40 @@ def _strategy_to_dict(s: Strategy) -> Dict:
     return dataclasses.asdict(s)
 
 
+def _workload_key(num_params, num_layers, batch, seq) -> Tuple:
+    return (num_params, num_layers, batch, seq)
+
+
 class StrategyService:
     """The in-process brain behind the RPC surface (usable directly —
-    the service wrapper only adds the wire)."""
+    the service wrapper only adds the wire).
+
+    Reported measurements accumulate per workload; once any exist, the
+    ranking for that workload runs through a
+    :class:`~dlrover_tpu.accelerate.dim_planner.CalibratedPlanner`
+    fitted on them — the fleet teaches the service its real
+    compute/comm balance (the reference Brain's datastore + learned
+    throughput model)."""
+
+    # newest measurements win; older fleet history ages out (bounds
+    # service memory AND keeps the fit tracking current hardware)
+    MAX_MEASUREMENTS_PER_WORKLOAD = 64
+
+    def __init__(self):
+        self._measurements: Dict[Tuple, List] = {}
+        # fitted planner per workload, invalidated by record()
+        self._planners: Dict[Tuple, object] = {}
+
+    def record(self, m: StrategyMeasurement) -> None:
+        if m.step_time_s <= 0:
+            return
+        key = _workload_key(
+            m.num_params, m.num_layers, m.batch_per_replica, m.seq_len
+        )
+        hist = self._measurements.setdefault(key, [])
+        hist.append((Strategy(**m.strategy), m.step_time_s))
+        del hist[: -self.MAX_MEASUREMENTS_PER_WORKLOAD]
+        self._planners.pop(key, None)  # refit lazily on next request
 
     def generate(self, req: StrategyRequest) -> StrategyResponse:
         profile = ModelProfile(
@@ -85,9 +139,35 @@ class StrategyService:
             moe=req.moe,
             batch_per_replica=req.batch_per_replica,
             seq_len=req.seq_len,
-        )[: req.max_candidates]
+        )
+        key = _workload_key(
+            req.num_params,
+            req.num_layers,
+            req.batch_per_replica,
+            req.seq_len,
+        )
+        measured = self._measurements.get(key)
+        calibrated = False
+        if measured:
+            planner = self._planners.get(key)
+            if planner is None:
+                from dlrover_tpu.accelerate.dim_planner import (
+                    CalibratedPlanner,
+                )
+
+                planner = CalibratedPlanner(
+                    profile,
+                    batch_per_replica=req.batch_per_replica,
+                    seq_len=req.seq_len,
+                )
+                planner.calibrate(measured)
+                self._planners[key] = planner
+            cands = [s for s, _ in planner.rank(cands)]
+            calibrated = True
+        cands = cands[: req.max_candidates]
         return StrategyResponse(
-            candidates=[_strategy_to_dict(s) for s in cands]
+            candidates=[_strategy_to_dict(s) for s in cands],
+            calibrated=calibrated,
         )
 
 
@@ -99,6 +179,9 @@ def start_strategy_service(
     brain = StrategyService()
 
     def report_fn(envelope):
+        msg = deserialize_message(envelope.data)
+        if isinstance(msg, StrategyMeasurement):
+            brain.record(msg)
         return BoolResponse(success=True)
 
     def get_fn(envelope):
@@ -147,6 +230,26 @@ class StrategyClient:
         if resp is None:
             return []
         return [Strategy(**kw) for kw in resp.candidates]
+
+    def report_measurement(
+        self,
+        profile: ModelProfile,
+        strategy: Strategy,
+        step_time_s: float,
+        batch_per_replica: int = 1,
+        seq_len: int = 2048,
+    ) -> bool:
+        """Teach the service what this strategy actually cost."""
+        return self._channel.report(
+            StrategyMeasurement(
+                num_params=profile.num_params,
+                num_layers=profile.num_layers,
+                batch_per_replica=batch_per_replica,
+                seq_len=seq_len,
+                strategy=_strategy_to_dict(strategy),
+                step_time_s=step_time_s,
+            )
+        )
 
     def close(self):
         self._channel.close()

@@ -4,3 +4,9 @@ from dlrover_tpu.ops.fused import (  # noqa: F401
     layer_norm,
     rms_norm,
 )
+from dlrover_tpu.ops.flash_attention import flash_attention_lse  # noqa: F401
+from dlrover_tpu.ops.grouped_gemm import grouped_gemm  # noqa: F401
+from dlrover_tpu.ops.quantization import (  # noqa: F401
+    dequantize_blockwise,
+    quantize_blockwise,
+)

@@ -173,6 +173,16 @@ class TestCandidates:
             # the 7B rule: every fitting plan shards the train state
             for s in cands:
                 assert s.fsdp * s.tensor * s.pipe >= 8
+
+            # fleet calibration: report a measurement that makes the
+            # current top candidate look terrible; the next request's
+            # ranking must change (the Brain learns)
+            assert client.report_measurement(
+                big, cands[0], step_time_s=1000.0
+            )
+            cands2 = client.request_candidates(big, 8)
+            assert cands2
+            assert cands2[0] != cands[0]
             client.close()
         finally:
             server.stop(0)
