@@ -67,8 +67,13 @@ class StrategyMeasurement(Message):
     calibrates its per-term cost model per workload and ranks BETTER
     for the next requester of the same workload."""
 
-    # workload key (same fields the request carries)
+    # workload key (same fields the request carries — byte fields
+    # included: two jobs with equal param counts but different
+    # activation/optimizer footprints are DIFFERENT workloads)
     num_params: int = 0
+    param_bytes: int = 0
+    optimizer_bytes: int = 0
+    activation_bytes_per_sample: int = 0
     num_layers: int = 0
     batch_per_replica: int = 1
     seq_len: int = 2048
@@ -85,8 +90,18 @@ def _strategy_to_dict(s: Strategy) -> Dict:
     return dataclasses.asdict(s)
 
 
-def _workload_key(num_params, num_layers, batch, seq) -> Tuple:
-    return (num_params, num_layers, batch, seq)
+def _workload_key(msg) -> Tuple:
+    """Workload identity from a request OR measurement (both carry the
+    same profile fields)."""
+    return (
+        msg.num_params,
+        msg.param_bytes,
+        msg.optimizer_bytes,
+        msg.activation_bytes_per_sample,
+        msg.num_layers,
+        msg.batch_per_replica,
+        msg.seq_len,
+    )
 
 
 class StrategyService:
@@ -105,6 +120,12 @@ class StrategyService:
     MAX_MEASUREMENTS_PER_WORKLOAD = 64
 
     def __init__(self):
+        import threading
+
+        # one lock over both maps: the gRPC pool serves record() and
+        # generate() concurrently, and a stale planner stored after a
+        # concurrent record() would silently drop that measurement
+        self._lock = threading.Lock()
         self._measurements: Dict[Tuple, List] = {}
         # fitted planner per workload, invalidated by record()
         self._planners: Dict[Tuple, object] = {}
@@ -112,13 +133,25 @@ class StrategyService:
     def record(self, m: StrategyMeasurement) -> None:
         if m.step_time_s <= 0:
             return
-        key = _workload_key(
-            m.num_params, m.num_layers, m.batch_per_replica, m.seq_len
-        )
-        hist = self._measurements.setdefault(key, [])
-        hist.append((Strategy(**m.strategy), m.step_time_s))
-        del hist[: -self.MAX_MEASUREMENTS_PER_WORKLOAD]
-        self._planners.pop(key, None)  # refit lazily on next request
+        try:
+            # tolerate version skew: a client with extra/renamed
+            # Strategy fields must not crash the RPC handler —
+            # telemetry is best-effort
+            import dataclasses
+
+            known = {f.name for f in dataclasses.fields(Strategy)}
+            strategy = Strategy(
+                **{k: v for k, v in m.strategy.items() if k in known}
+            )
+        except (TypeError, ValueError) as e:
+            logger.warning("unusable strategy measurement: %s", e)
+            return
+        key = _workload_key(m)
+        with self._lock:
+            hist = self._measurements.setdefault(key, [])
+            hist.append((strategy, m.step_time_s))
+            del hist[: -self.MAX_MEASUREMENTS_PER_WORKLOAD]
+            self._planners.pop(key, None)  # refit on next request
 
     def generate(self, req: StrategyRequest) -> StrategyResponse:
         profile = ModelProfile(
@@ -140,30 +173,27 @@ class StrategyService:
             batch_per_replica=req.batch_per_replica,
             seq_len=req.seq_len,
         )
-        key = _workload_key(
-            req.num_params,
-            req.num_layers,
-            req.batch_per_replica,
-            req.seq_len,
-        )
-        measured = self._measurements.get(key)
+        key = _workload_key(req)
         calibrated = False
-        if measured:
-            planner = self._planners.get(key)
-            if planner is None:
-                from dlrover_tpu.accelerate.dim_planner import (
-                    CalibratedPlanner,
-                )
+        with self._lock:
+            measured = self._measurements.get(key)
+            if measured:
+                planner = self._planners.get(key)
+                if planner is None:
+                    from dlrover_tpu.accelerate.dim_planner import (
+                        CalibratedPlanner,
+                    )
 
-                planner = CalibratedPlanner(
-                    profile,
-                    batch_per_replica=req.batch_per_replica,
-                    seq_len=req.seq_len,
-                )
-                planner.calibrate(measured)
-                self._planners[key] = planner
+                    planner = CalibratedPlanner(
+                        profile,
+                        batch_per_replica=req.batch_per_replica,
+                        seq_len=req.seq_len,
+                    )
+                    planner.calibrate(list(measured))
+                    self._planners[key] = planner
+                calibrated = True
+        if calibrated:
             cands = [s for s, _ in planner.rank(cands)]
-            calibrated = True
         cands = cands[: req.max_candidates]
         return StrategyResponse(
             candidates=[_strategy_to_dict(s) for s in cands],
@@ -243,6 +273,11 @@ class StrategyClient:
         return self._channel.report(
             StrategyMeasurement(
                 num_params=profile.num_params,
+                param_bytes=profile.param_bytes,
+                optimizer_bytes=profile.optimizer_bytes,
+                activation_bytes_per_sample=(
+                    profile.activation_bytes_per_sample
+                ),
                 num_layers=profile.num_layers,
                 batch_per_replica=batch_per_replica,
                 seq_len=seq_len,
