@@ -207,7 +207,11 @@ def run_goodput(
             f"{len(kills)} kills but only {len(recoveries)} measured "
             "recoveries"
         )
-    mean_rec = sum(recoveries) / len(recoveries)
+    # zero-kill baseline run: no faults -> no recovery loss (1.0 is
+    # then exact, not an artifact of an empty mean)
+    mean_rec = (
+        sum(recoveries) / len(recoveries) if recoveries else 0.0
+    )
     goodput_hourly = 3600.0 / (3600.0 + mean_rec)
     return {
         "goodput": round(goodput, 4),

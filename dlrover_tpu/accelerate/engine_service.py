@@ -90,6 +90,28 @@ def _strategy_to_dict(s: Strategy) -> Dict:
     return dataclasses.asdict(s)
 
 
+def _strategy_from_dict(kw: Dict) -> Optional[Strategy]:
+    """Version-skew-tolerant Strategy reconstruction (both directions
+    of a rolling upgrade put unknown fields on the wire).  Unknown
+    keys are dropped WITH a warning — a silently defaulted renamed
+    field would corrupt whatever consumes the result — and an
+    unconstructible dict returns None."""
+    import dataclasses
+
+    known = {f.name for f in dataclasses.fields(Strategy)}
+    unknown = sorted(set(kw) - known)
+    if unknown:
+        logger.warning(
+            "strategy wire dict has unknown fields %s (version "
+            "skew?); dropping them", unknown,
+        )
+    try:
+        return Strategy(**{k: v for k, v in kw.items() if k in known})
+    except (TypeError, ValueError) as e:
+        logger.warning("unusable strategy dict: %s", e)
+        return None
+
+
 def _workload_key(msg) -> Tuple:
     """Workload identity from a request OR measurement (both carry the
     same profile fields)."""
@@ -133,18 +155,8 @@ class StrategyService:
     def record(self, m: StrategyMeasurement) -> None:
         if m.step_time_s <= 0:
             return
-        try:
-            # tolerate version skew: a client with extra/renamed
-            # Strategy fields must not crash the RPC handler —
-            # telemetry is best-effort
-            import dataclasses
-
-            known = {f.name for f in dataclasses.fields(Strategy)}
-            strategy = Strategy(
-                **{k: v for k, v in m.strategy.items() if k in known}
-            )
-        except (TypeError, ValueError) as e:
-            logger.warning("unusable strategy measurement: %s", e)
+        strategy = _strategy_from_dict(m.strategy)
+        if strategy is None:
             return
         key = _workload_key(m)
         with self._lock:
@@ -259,7 +271,12 @@ class StrategyClient:
         )
         if resp is None:
             return []
-        return [Strategy(**kw) for kw in resp.candidates]
+        out = []
+        for kw in resp.candidates:
+            s = _strategy_from_dict(kw)
+            if s is not None:
+                out.append(s)
+        return out
 
     def report_measurement(
         self,

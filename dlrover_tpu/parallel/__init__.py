@@ -1,6 +1,7 @@
 from dlrover_tpu.parallel.mesh import (  # noqa: F401
     AxisName,
     MeshContext,
+    create_hybrid_parallel_mesh,
     create_parallel_mesh,
     destroy_parallel_mesh,
     get_mesh,

@@ -103,6 +103,27 @@ def _resolve_dims(
     return dims
 
 
+def _build_mesh_context(
+    device_array: np.ndarray,
+    dims: List[Tuple[str, int]],
+    set_global: bool,
+) -> MeshContext:
+    """Shared tail of the mesh builders: dup-name check, Mesh +
+    MeshContext construction, global-context install."""
+    from jax.sharding import Mesh
+
+    names = tuple(n for n, _ in dims)
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate axis names in {names}")
+    mesh = Mesh(device_array.reshape([s for _, s in dims]), names)
+    ctx = MeshContext(mesh=mesh, dims=list(dims))
+    if set_global:
+        global _context
+        with _lock:
+            _context = ctx
+    return ctx
+
+
 def create_parallel_mesh(
     parallel_config: Optional[Sequence[Tuple[str, int]]] = None,
     devices=None,
@@ -117,29 +138,78 @@ def create_parallel_mesh(
     reference's rank-order semantics for strided groups.
     """
     import jax
-    from jax.sharding import Mesh
 
     if devices is None:
         devices = jax.devices()
     if parallel_config is None:
         parallel_config = [(AxisName.DATA, -1)]
     dims = _resolve_dims(parallel_config, len(devices))
-    names = tuple(n for n, _ in dims)
-    if len(set(names)) != len(names):
-        raise ValueError(f"duplicate axis names in {names}")
-    shape = tuple(s for _, s in dims)
-    device_array = np.asarray(devices).reshape(shape)
-    mesh = Mesh(device_array, names)
-    ctx = MeshContext(mesh=mesh, dims=list(dims))
+    ctx = _build_mesh_context(
+        np.asarray(devices), dims, set_global
+    )
     logger.info(
         "parallel mesh: %s over %d devices",
         dict(dims),
         len(devices),
     )
-    if set_global:
-        global _context
-        with _lock:
-            _context = ctx
+    return ctx
+
+
+def create_hybrid_parallel_mesh(
+    dcn_config: Sequence[Tuple[str, int]],
+    ici_config: Sequence[Tuple[str, int]],
+    devices=None,
+    set_global: bool = True,
+    granule_fn=None,
+) -> MeshContext:
+    """Multi-slice mesh: DCN axes stride ACROSS slices, ICI axes stay
+    INSIDE a slice.
+
+    The reference expresses the same hierarchy with nested NCCL groups
+    (intra-node rings under inter-node trees); on TPU pods the
+    physical boundary is the slice: collectives on the ``ici_config``
+    axes ride the torus, collectives on the ``dcn_config`` axes cross
+    the data-center network — so put data/pipeline in ``dcn_config``
+    and tensor/seq/expert/fsdp in ``ici_config``.
+
+    ``granule_fn(device) -> key`` groups devices into slices (default:
+    ``slice_index`` where the runtime exposes it, else
+    ``process_index`` — the CPU-mesh test seam).  Mesh axis order is
+    dcn axes (outermost) then ici axes, consistent with
+    ``create_parallel_mesh``'s locality convention.
+    """
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    if granule_fn is None:
+        def granule_fn(d):
+            s = getattr(d, "slice_index", None)
+            return s if s is not None else d.process_index
+
+    granules: Dict[object, list] = {}
+    for d in devices:
+        granules.setdefault(granule_fn(d), []).append(d)
+    granule_keys = sorted(granules, key=str)
+    per = {len(g) for g in granules.values()}
+    if len(per) != 1:
+        raise ValueError(
+            f"uneven slices: {sorted(per)} devices per granule"
+        )
+    per_granule = per.pop()
+
+    dcn_dims = _resolve_dims(dcn_config, len(granule_keys))
+    ici_dims = _resolve_dims(ici_config, per_granule)
+    device_array = np.asarray([granules[k] for k in granule_keys])
+    ctx = _build_mesh_context(
+        device_array, list(dcn_dims) + list(ici_dims), set_global
+    )
+    logger.info(
+        "hybrid mesh: dcn %s x ici %s over %d slices",
+        dict(dcn_dims),
+        dict(ici_dims),
+        len(granule_keys),
+    )
     return ctx
 
 

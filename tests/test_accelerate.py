@@ -187,6 +187,30 @@ class TestCandidates:
         finally:
             server.stop(0)
 
+    def test_strategy_service_tolerates_version_skew(self):
+        """A measurement whose strategy dict carries unknown fields
+        (client on a different build) is absorbed, not a crash."""
+        from dlrover_tpu.accelerate.engine_service import (
+            StrategyMeasurement,
+            StrategyService,
+        )
+
+        svc = StrategyService()
+        svc.record(
+            StrategyMeasurement(
+                num_params=1000,
+                num_layers=2,
+                strategy={
+                    "data": 2,
+                    "future_field_not_in_this_build": 7,
+                },
+                step_time_s=0.5,
+            )
+        )
+        key = next(iter(svc._measurements))
+        (s, t), = svc._measurements[key]
+        assert s.data == 2 and t == 0.5
+
     def test_long_context_adds_seq_axis(self, tiny_cfg):
         profile = analyse_model(
             lambda rng: init_params(rng, tiny_cfg), optax.adamw(1e-3)

@@ -67,6 +67,55 @@ class TestMesh:
         assert dict(dims)[AxisName.DATA] == 2
         assert np.prod([s for _, s in dims]) == 8
 
+    def test_hybrid_mesh_slices_stay_inside_ici_axes(self):
+        """Multi-slice layout: devices of one (faked) slice must land
+        in one DCN-axis row, so ICI-axis collectives never cross DCN."""
+        from dlrover_tpu.parallel.mesh import (
+            create_hybrid_parallel_mesh,
+        )
+
+        devices = jax.devices()
+        # fake 2 slices of 4 chips on the 8-device CPU mesh
+        fake_slice = {d: i // 4 for i, d in enumerate(devices)}
+        ctx = create_hybrid_parallel_mesh(
+            dcn_config=[(AxisName.DATA, 2)],
+            ici_config=[(AxisName.FSDP, 2), (AxisName.TENSOR, 2)],
+            granule_fn=lambda d: fake_slice[d],
+        )
+        assert ctx.mesh.axis_names == (
+            AxisName.DATA, AxisName.FSDP, AxisName.TENSOR,
+        )
+        arr = ctx.mesh.devices
+        assert arr.shape == (2, 2, 2)
+        for row in range(2):
+            slices = {fake_slice[d] for d in arr[row].flatten()}
+            assert len(slices) == 1  # one slice per DCN row
+
+        # and a sharded computation runs over it
+        x = jax.device_put(
+            jnp.arange(16.0).reshape(4, 4),
+            jax.sharding.NamedSharding(
+                ctx.mesh, P((AxisName.DATA, AxisName.FSDP), None)
+            ),
+        )
+        total = jax.jit(lambda a: a.sum())(x)
+        assert float(total) == 120.0
+
+    def test_hybrid_mesh_uneven_slices_rejected(self):
+        from dlrover_tpu.parallel.mesh import (
+            create_hybrid_parallel_mesh,
+        )
+
+        devices = jax.devices()
+        sizes = [0, 0, 0, 1, 1, 1, 1, 1]  # 3 + 5 split
+        fake = {d: sizes[i] for i, d in enumerate(devices)}
+        with pytest.raises(ValueError, match="uneven"):
+            create_hybrid_parallel_mesh(
+                [(AxisName.DATA, 2)],
+                [(AxisName.TENSOR, -1)],
+                granule_fn=lambda d: fake[d],
+            )
+
 
 class TestShardingRules:
     def test_tp_rules_spec(self):
