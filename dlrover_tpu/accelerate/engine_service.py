@@ -90,20 +90,26 @@ def _strategy_to_dict(s: Strategy) -> Dict:
     return dataclasses.asdict(s)
 
 
+# warn once per distinct unknown-field set: steady-state version skew
+# during a rolling upgrade would otherwise log per RPC at fleet scale
+_warned_unknown_fields: set = set()
+
+
 def _strategy_from_dict(kw: Dict) -> Optional[Strategy]:
     """Version-skew-tolerant Strategy reconstruction (both directions
     of a rolling upgrade put unknown fields on the wire).  Unknown
-    keys are dropped WITH a warning — a silently defaulted renamed
-    field would corrupt whatever consumes the result — and an
-    unconstructible dict returns None."""
+    keys are dropped WITH a (once-per-set) warning — a silently
+    defaulted renamed field would corrupt whatever consumes the
+    result — and an unconstructible dict returns None."""
     import dataclasses
 
     known = {f.name for f in dataclasses.fields(Strategy)}
-    unknown = sorted(set(kw) - known)
-    if unknown:
+    unknown = tuple(sorted(set(kw) - known))
+    if unknown and unknown not in _warned_unknown_fields:
+        _warned_unknown_fields.add(unknown)
         logger.warning(
             "strategy wire dict has unknown fields %s (version "
-            "skew?); dropping them", unknown,
+            "skew?); dropping them", list(unknown),
         )
     try:
         return Strategy(**{k: v for k, v in kw.items() if k in known})

@@ -190,7 +190,14 @@ def create_hybrid_parallel_mesh(
     granules: Dict[object, list] = {}
     for d in devices:
         granules.setdefault(granule_fn(d), []).append(d)
-    granule_keys = sorted(granules, key=str)
+    # numeric-aware ordering: str-sorting integer slice ids would put
+    # slice 10 before slice 2, permuting DCN coordinates vs slice
+    # numbering on 10+-slice pods
+    granule_keys = sorted(
+        granules,
+        key=lambda k: (0, k, "") if isinstance(k, int)
+        else (1, 0, str(k)),
+    )
     per = {len(g) for g in granules.values()}
     if len(per) != 1:
         raise ValueError(
