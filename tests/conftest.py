@@ -48,3 +48,14 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "timeout(seconds): advisory per-test time budget"
     )
+
+
+@pytest.fixture(autouse=True)
+def _suite_clean_mesh():
+    """Suite-wide: drop the global mesh context after every test —
+    un-jitted model code reads it at trace time, so a mesh leaked by
+    one module silently reroutes another module's kernels."""
+    yield
+    from dlrover_tpu.parallel.mesh import destroy_parallel_mesh
+
+    destroy_parallel_mesh()

@@ -27,12 +27,6 @@ from dlrover_tpu.models.llama import (
 from dlrover_tpu.parallel.mesh import destroy_parallel_mesh
 
 
-@pytest.fixture(autouse=True)
-def _clean_mesh():
-    yield
-    destroy_parallel_mesh()
-
-
 @pytest.fixture(scope="module")
 def tiny_cfg():
     return LlamaConfig.tiny(remat="none")

@@ -29,12 +29,6 @@ from dlrover_tpu.parallel.mesh import destroy_parallel_mesh
 from dlrover_tpu.trainer.trainer import Trainer, TrainingArgs
 
 
-@pytest.fixture(autouse=True)
-def _clean_mesh():
-    yield
-    destroy_parallel_mesh()
-
-
 # the producer must not import jax (a spawned child would re-init the
 # TPU plugin); it touches only the shm module
 _PRODUCER_SCRIPT = """

@@ -24,12 +24,6 @@ from dlrover_tpu.parallel.mesh import (
 )
 
 
-@pytest.fixture(autouse=True)
-def _clean_mesh():
-    yield
-    destroy_parallel_mesh()
-
-
 class TestFlashAttention:
     @pytest.mark.parametrize("causal", [False, True])
     def test_matches_dense(self, causal):

@@ -39,12 +39,6 @@ from dlrover_tpu.parallel.pipeline import (
 from dlrover_tpu.parallel.train_step import build_train_step
 
 
-@pytest.fixture(autouse=True)
-def _clean_mesh():
-    yield
-    destroy_parallel_mesh()
-
-
 class TestMesh:
     def test_infer_dim(self):
         ctx = create_parallel_mesh([(AxisName.DATA, -1)])

@@ -6,20 +6,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-import pytest
 
 from dlrover_tpu.accelerate import auto_accelerate, load_strategy
-from dlrover_tpu.parallel.mesh import destroy_parallel_mesh
-
-
-@pytest.fixture(autouse=True)
-def _clean_mesh():
-    # un-jitted forward reads the GLOBAL mesh context for kernel
-    # selection; a seq/pipe mesh left by an earlier test module would
-    # route tiny unsharded arrays into collective kernels
-    destroy_parallel_mesh()
-    yield
-    destroy_parallel_mesh()
 from dlrover_tpu.models.vit import (
     ViTConfig,
     forward,
