@@ -54,6 +54,12 @@ class TrainingArgs:
     # alongside the dense state at every storage-tier step via
     # SparseCheckpointManager full+delta chains, restored on resume
     sparse_tables: Optional[dict] = None
+    # deterministic-replay flight recorder (trainer/replay.py):
+    # batches ring-logged every step, state digests every
+    # replay_digest_interval steps (a digest forces a device sync —
+    # keep the interval coarse in production)
+    replay_dir: str = ""
+    replay_digest_interval: int = 50
     extra: dict = field(default_factory=dict)
 
 
@@ -109,6 +115,15 @@ class Trainer:
                 os.path.join(
                     args.checkpoint_dir,
                     f"sparse-rank{self._ctx.rank:05d}",
+                )
+            )
+        self._replay = None
+        if args.replay_dir:
+            from dlrover_tpu.trainer.replay import ReplayRecorder
+
+            self._replay = ReplayRecorder(
+                os.path.join(
+                    args.replay_dir, f"rank{self._ctx.rank:05d}"
                 )
             )
         self._hang = HangDetector(
@@ -302,6 +317,8 @@ class Trainer:
                 for batch in self._data_iter_fn():
                     if step >= self._args.max_steps:
                         break
+                    if self._replay is not None:
+                        self._replay.record(step + 1, batch)
                     device_batch = jax.device_put(
                         batch, batch_sharding
                     )
@@ -309,6 +326,12 @@ class Trainer:
                         self.state, device_batch
                     )
                     step += 1
+                    if (
+                        self._replay is not None
+                        and step % self._args.replay_digest_interval
+                        == 0
+                    ):
+                        self._replay.commit(step, self.state)
                     self.progress.step_done()
                     self._hang.report_step(step)
                     if pending is not None:

@@ -131,7 +131,7 @@ class TestPrefetch:
 
 class TestTrainer:
     def _build(self, tmp_path, max_steps, socket_dir,
-               snapshot_mode="auto", sparse_tables=None):
+               snapshot_mode="auto", sparse_tables=None, **extra_args):
         os.environ["DLROVER_TPU_SOCKET_DIR"] = socket_dir
         cfg = LlamaConfig.tiny(remat="none")
         result = auto_accelerate(
@@ -156,6 +156,7 @@ class TestTrainer:
             micro_batch_size=8,
             snapshot_mode=snapshot_mode,
             sparse_tables=sparse_tables,
+            **extra_args,
         )
         return Trainer(result, args, data_iter)
 
@@ -186,6 +187,31 @@ class TestTrainer:
         t2 = self._build(tmp_path, max_steps=6, socket_dir=sock)
         start = t2._init_or_restore_state()
         assert start >= 4
+
+    def test_replay_recorder_wired(self, tmp_path):
+        """With replay_dir set, the Trainer ring-logs every batch and
+        digests the state on the configured cadence."""
+        import json
+
+        sock = str(tmp_path / "socks4")
+        t = self._build(
+            tmp_path, max_steps=4, socket_dir=sock,
+            replay_dir=str(tmp_path / "replay"),
+            replay_digest_interval=2,
+        )
+        t.train()
+        rank_dir = tmp_path / "replay" / "rank00000"
+        batches = [
+            f.name for f in rank_dir.iterdir()
+            if f.name.startswith("batch-")
+        ]
+        assert len(batches) == 4
+        entries = [
+            json.loads(x)
+            for x in (rank_dir / "journal.jsonl").read_text().splitlines()
+        ]
+        digests = [e for e in entries if "state_digest" in e]
+        assert {e["step"] for e in digests} == {2, 4}
 
     def test_sparse_tables_save_and_restore_with_dense(self, tmp_path):
         """Host-side KvTable embeddings checkpoint at the storage tier
