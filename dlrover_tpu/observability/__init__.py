@@ -3,3 +3,7 @@ from dlrover_tpu.observability.metrics import (  # noqa: F401
     MetricsRegistry,
 )
 from dlrover_tpu.observability.profiler import AProfiler  # noqa: F401
+from dlrover_tpu.observability.hlo_census import (  # noqa: F401
+    census_report,
+    gemm_census,
+)

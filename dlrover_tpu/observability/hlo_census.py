@@ -1,0 +1,194 @@
+"""GEMM census over compiled HLO — the xpu_timer shape-clustering,
+compile-time edition.
+
+Reference parity: xpu_timer's core trick is clustering CUDA GEMM
+launches by (b, m, n, k) and exporting per-cluster counts/latency
+(``atorch/dev/xpu_timer/xpu_timer/common/manager.h``,
+``nvidia/hook.cc``).  There is no symbol-interposition seam on TPU —
+but the SAME census is available *before the program ever runs*: every
+matmul is a ``dot`` in the compiled HLO with explicit operand shapes.
+This module parses them out of ``compiled.as_text()`` and aggregates
+by contraction shape, so the "where do my FLOPs go" table the
+reference computes from hooked kernel launches comes from one compile
+here — plus MXU-alignment warnings (a dimension not a multiple of the
+128-lane width wastes systolic-array cycles) that a runtime hook
+cannot give.
+"""
+
+import re
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+# every HLO value definition, e.g.
+#   %a.1 = f32[64,128]{1,0} parameter(0)
+_DEF_RE = re.compile(
+    r"%(?P<name>[\w.\-]+)\s*=\s*(?P<dtype>[a-z0-9]+)"
+    r"\[(?P<shape>[0-9,]*)\]"
+)
+# a dot instruction; operand shapes are NOT inline in compiled HLO —
+# they resolve through the definition table, e.g.
+#   ROOT %dot_general.1 = f32[64,256]{1,0} dot(%a.1, %b.1),
+#       lhs_contracting_dims={1}, rhs_contracting_dims={0}, ...
+_DOT_RE = re.compile(
+    r"%(?P<out>[\w.\-]+)\s*=\s*(?P<odtype>[a-z0-9]+)"
+    r"\[(?P<oshape>[0-9,]*)\][^\n]*?\bdot\("
+    r"\s*%(?P<lhs>[\w.\-]+)\s*,\s*%(?P<rhs>[\w.\-]+)\s*\)"
+    r"[^\n]*?lhs_contracting_dims=\{(?P<lc>[0-9,]*)\}",
+)
+# the StableHLO form (``jax.jit(f).lower(...)``): types inline, one
+# regex, identical on every backend (TPU's COMPILED hlo rewrites dots
+# into layout-annotated convolutions — the lowered module is the
+# stable census surface), e.g.
+#   %2 = stablehlo.dot_general %0, %1, batching_dims = [0] x [0],
+#     contracting_dims = [2] x [1] :
+#     (tensor<4x32x64xbf16>, tensor<4x64x16xbf16>) -> tensor<4x32x16xbf16>
+_STABLEHLO_DOT_RE = re.compile(
+    r"stablehlo\.dot_general\b[^:\n]*?"
+    r"contracting_dims\s*=\s*\[(?P<lc>[0-9, ]*)\]\s*x\s*\[[0-9, ]*\]"
+    r"[^:\n]*:\s*\(tensor<(?P<l>[0-9a-zA-Z_x]+)>\s*,\s*"
+    r"tensor<(?P<r>[0-9a-zA-Z_x]+)>\)\s*->\s*"
+    r"tensor<(?P<o>[0-9a-zA-Z_x]+)>",
+)
+_MXU_LANES = 128
+
+
+def _mlir_shape(s: str) -> Tuple[Tuple[int, ...], str]:
+    """'4x32x64xbf16' -> ((4, 32, 64), 'bf16')."""
+    parts = s.split("x")
+    dims = []
+    for p in parts:
+        if p.isdigit():
+            dims.append(int(p))
+        else:
+            return tuple(dims), p
+    return tuple(dims), parts[-1]
+
+
+def _dims(s: str) -> Tuple[int, ...]:
+    return tuple(int(x) for x in s.split(",")) if s else ()
+
+
+@dataclass
+class GemmCluster:
+    """All dots sharing one (batch, m, n, k) contraction shape."""
+
+    batch: int
+    m: int
+    n: int
+    k: int
+    dtype: str
+    count: int = 0
+    # dims not divisible by the 128-wide MXU lanes
+    misaligned_dims: Tuple[str, ...] = ()
+
+    @property
+    def flops(self) -> float:
+        """Total MACs x2 across the cluster."""
+        return 2.0 * self.batch * self.m * self.n * self.k * self.count
+
+    def describe(self) -> str:
+        tag = (
+            f" [MISALIGNED {','.join(self.misaligned_dims)}]"
+            if self.misaligned_dims
+            else ""
+        )
+        return (
+            f"{self.dtype} b={self.batch} m={self.m} n={self.n} "
+            f"k={self.k} x{self.count} -> {self.flops / 1e9:.2f} "
+            f"GFLOP{tag}"
+        )
+
+
+def _add(clusters: Dict, batch, mm, nn, k, dtype):
+    key = (batch, mm, nn, k, dtype)
+    if key not in clusters:
+        misaligned = tuple(
+            name
+            for name, v in (("m", mm), ("n", nn), ("k", k))
+            if v % _MXU_LANES and v > _MXU_LANES
+        )
+        clusters[key] = GemmCluster(
+            batch=batch, m=mm, n=nn, k=k, dtype=dtype,
+            misaligned_dims=misaligned,
+        )
+    clusters[key].count += 1
+
+
+def _add_dot(
+    clusters: Dict,
+    lshape: Tuple[int, ...],
+    oshape: Tuple[int, ...],
+    lc: Tuple[int, ...],
+    dtype: str,
+):
+    """Shared (m, n, k, batch) derivation for both HLO dialects."""
+    if not lshape or not lc:
+        return
+    k = 1
+    for d in lc:
+        if d < len(lshape):
+            k *= lshape[d]
+    batch = 1
+    # batch dims = everything in the output beyond (m, n)
+    if len(oshape) > 2:
+        for d in oshape[:-2]:
+            batch *= d
+    mm = oshape[-2] if len(oshape) >= 2 else 1
+    nn = oshape[-1] if len(oshape) >= 1 else 1
+    _add(clusters, batch, mm, nn, k, dtype)
+
+
+def gemm_census(module) -> List[GemmCluster]:
+    """Parse every dot/dot_general out of an HLO or StableHLO module
+    and cluster by contraction shape, largest total FLOPs first.
+
+    Accepts text or anything with ``as_text()``.  Prefer
+    ``jax.jit(f).lower(args)`` (StableHLO — identical on every
+    backend; TPU's post-layout HLO rewrites dots beyond recognition);
+    CPU/GPU ``.compile()`` output parses too."""
+    text = module if isinstance(module, str) else module.as_text()
+    clusters: Dict[Tuple, GemmCluster] = {}
+
+    # StableHLO form (types inline)
+    for m in _STABLEHLO_DOT_RE.finditer(text):
+        lshape, _ = _mlir_shape(m.group("l"))
+        oshape, dtype = _mlir_shape(m.group("o"))
+        lc = tuple(
+            int(x) for x in m.group("lc").replace(" ", "").split(",")
+            if x
+        )
+        _add_dot(clusters, lshape, oshape, lc, dtype)
+
+    if not clusters:
+        # compiled-HLO form: operand shapes resolve through the
+        # definition table
+        shapes: Dict[str, Tuple[int, ...]] = {}
+        for m in _DEF_RE.finditer(text):
+            shapes[m.group("name")] = _dims(m.group("shape"))
+        for m in _DOT_RE.finditer(text):
+            _add_dot(
+                clusters,
+                shapes.get(m.group("lhs"), ()),
+                _dims(m.group("oshape")),
+                _dims(m.group("lc")),
+                m.group("odtype"),
+            )
+    return sorted(
+        clusters.values(), key=lambda c: c.flops, reverse=True
+    )
+
+
+def census_report(hlo_text_or_compiled, top: int = 10) -> str:
+    """Human-readable top-N GEMM table + totals."""
+    clusters = gemm_census(hlo_text_or_compiled)
+    total = sum(c.flops for c in clusters)
+    lines = [
+        f"GEMM census: {sum(c.count for c in clusters)} dots, "
+        f"{len(clusters)} shape clusters, "
+        f"{total / 1e12:.3f} TFLOP total"
+    ]
+    for c in clusters[:top]:
+        share = 100.0 * c.flops / total if total else 0.0
+        lines.append(f"  {share:5.1f}%  {c.describe()}")
+    return "\n".join(lines)

@@ -60,6 +60,7 @@ class ReplayRecorder:
             for f in os.listdir(root)
             if f.startswith("batch-") and f.endswith(".npz")
         )
+        self._appends = 0
 
     def record(self, step: int, batch: Dict) -> Dict:
         """Persist the batch for ``step``; returns it unchanged."""
@@ -68,6 +69,11 @@ class ReplayRecorder:
             for k, v in batch.items()
         }
         np.savez(_batch_path(self.root, step), **arrays)
+        # re-recording a step (restart replays the incident window) is
+        # an overwrite, not a second ring slot — a duplicate entry
+        # would make length-based eviction delete live files
+        if step in self._recorded:
+            self._recorded.remove(step)
         self._recorded.append(step)
         self._append(
             {"step": step, "batch_digest": pytree_digest(arrays)}
@@ -79,6 +85,7 @@ class ReplayRecorder:
                 os.remove(_batch_path(self.root, old))
             except OSError:
                 pass
+        self._maybe_compact_journal()
         return batch
 
     def commit(self, step: int, state) -> str:
@@ -90,6 +97,26 @@ class ReplayRecorder:
     def _append(self, entry: Dict):
         with open(self._journal_path, "a") as f:
             f.write(json.dumps(entry) + "\n")
+        self._appends += 1
+
+    def _maybe_compact_journal(self):
+        """The journal would otherwise grow one line per step forever;
+        every ``keep`` appends, rewrite it keeping only entries for
+        steps still in (or newer than) the ring."""
+        if self._appends < 2 * self.keep:
+            return
+        floor = self._recorded[0] if self._recorded else 0
+        kept = [
+            e
+            for step, e in sorted(_load_journal(self.root).items())
+            if step >= floor
+        ]
+        tmp = self._journal_path + ".tmp"
+        with open(tmp, "w") as f:
+            for e in kept:
+                f.write(json.dumps(e) + "\n")
+        os.replace(tmp, self._journal_path)
+        self._appends = 0
 
 
 def _load_journal(root: str) -> Dict[int, Dict]:

@@ -328,6 +328,9 @@ class Trainer:
                     step += 1
                     if (
                         self._replay is not None
+                        # interval <= 0 = batches only, no digests
+                        # (a digest forces a device sync)
+                        and self._args.replay_digest_interval > 0
                         and step % self._args.replay_digest_interval
                         == 0
                     ):

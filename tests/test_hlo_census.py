@@ -1,0 +1,93 @@
+"""GEMM census over compiled HLO (xpu_timer shape-clustering analog):
+dot extraction, shape clustering, flops share, MXU-alignment flags."""
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.observability.hlo_census import (
+    census_report,
+    gemm_census,
+)
+
+
+def _compiled(fn, *args):
+    return jax.jit(fn).lower(*args).compile()
+
+
+def _lowered(fn, *args):
+    return jax.jit(fn).lower(*args)
+
+
+class TestGemmCensus:
+    def test_finds_matmul_with_right_shape(self):
+        a = jnp.ones((64, 128), jnp.float32)
+        b = jnp.ones((128, 256), jnp.float32)
+        clusters = gemm_census(_compiled(lambda a, b: a @ b, a, b))
+        assert clusters, "no dot found in HLO"
+        c = clusters[0]
+        assert (c.m, c.n, c.k) == (64, 256, 128)
+        assert c.flops == 2.0 * 64 * 256 * 128
+
+    def test_clusters_repeated_shapes(self):
+        a = jnp.ones((32, 128), jnp.float32)
+        w1 = jnp.ones((128, 128), jnp.float32)
+        w2 = jnp.ones((128, 128), jnp.float32)
+
+        def fn(a, w1, w2):
+            # two same-shape matmuls with a nonlinearity between them
+            # (so XLA cannot collapse them into one dot)
+            return jnp.tanh(a @ w1) @ w2
+
+        clusters = gemm_census(_compiled(fn, a, w1, w2))
+        same = [
+            c for c in clusters if (c.m, c.n, c.k) == (32, 128, 128)
+        ]
+        assert same and same[0].count == 2
+
+    def test_batched_dot_counts_batch_dim(self):
+        a = jnp.ones((4, 32, 64), jnp.float32)
+        b = jnp.ones((4, 64, 16), jnp.float32)
+        clusters = gemm_census(
+            _compiled(lambda a, b: jnp.einsum("bij,bjk->bik", a, b), a, b)
+        )
+        assert clusters
+        c = clusters[0]
+        assert c.batch == 4 and c.k == 64
+
+    def test_misalignment_flagged(self):
+        a = jnp.ones((256, 200), jnp.float32)  # k=200 not 128-aligned
+        b = jnp.ones((200, 256), jnp.float32)
+        clusters = gemm_census(_compiled(lambda a, b: a @ b, a, b))
+        assert any("k" in c.misaligned_dims for c in clusters)
+
+    def test_stablehlo_lowered_path(self):
+        """The backend-independent census surface: jit(f).lower(...)
+        (StableHLO) — what the TPU path must use, since post-layout
+        TPU HLO rewrites dots into convolutions."""
+        a = jnp.ones((4, 32, 64), jnp.float32)
+        b = jnp.ones((4, 64, 16), jnp.float32)
+        clusters = gemm_census(
+            _lowered(lambda a, b: jnp.einsum("bij,bjk->bik", a, b), a, b)
+        )
+        assert clusters
+        c = clusters[0]
+        assert (c.batch, c.m, c.n, c.k) == (4, 32, 16, 64)
+
+    def test_report_on_real_model(self):
+        from dlrover_tpu.models.llama import (
+            LlamaConfig,
+            init_params,
+            loss_fn,
+        )
+
+        cfg = LlamaConfig.tiny(dtype=jnp.float32, remat="none")
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jnp.ones((2, 17), jnp.int32)
+        lowered = jax.jit(
+            lambda p, t: loss_fn(p, {"tokens": t}, cfg)
+        ).lower(params, tokens)
+        report = census_report(lowered)
+        assert "GEMM census" in report
+        assert "TFLOP total" in report
+        # the tiny llama has several distinct projection shapes
+        assert len(gemm_census(lowered)) >= 3
