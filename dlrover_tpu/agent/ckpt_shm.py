@@ -324,11 +324,14 @@ class SharedMemoryHandler:
     ) -> Tuple[int, Dict[str, np.ndarray]]:
         """Rebuild {keypath: ndarray} from shm.
 
-        ``copy=True`` returns standalone arrays (one memcpy per leaf;
-        shm may be overwritten afterwards).  ``copy=False`` returns
-        zero-copy views directly onto the shm buffer — the fast restore
-        path (feed them straight to ``jax.device_put`` and drop them
-        before the slot is reused, two snapshots later).
+        ``copy=True`` returns standalone arrays (ONE bulk memcpy; shm
+        may be overwritten afterwards).  Cost note: the copy's wall
+        time is dominated by FIRST-TOUCH page faults of the fresh
+        private buffer, not memcpy (measured 0.17 GB/s faulting vs
+        7.7 GB/s resident in the build container) — which is why
+        ``copy=False`` zero-copy views are the restore hot path (feed
+        them straight to ``jax.device_put`` and drop them before the
+        slot is reused, two snapshots later).
 
         ``step`` selects a specific restorable step (either slot);
         None = the newest complete snapshot."""
