@@ -78,6 +78,7 @@ def auto_accelerate(
     moe: bool = False,
     batch_per_replica: int = 1,
     seq_len: int = 2048,
+    global_batch: Optional[int] = None,
     tune_space: Optional[dict] = None,
     tune_budget: int = 6,
 ) -> AccelerateResult:
@@ -91,6 +92,12 @@ def auto_accelerate(
     ``sample_batch_fn(batch_sharding) -> batch`` enables the timed dry
     run; without it (or with dry_run=False) the top-ranked memory-fit
     candidate wins directly.
+
+    ``global_batch``: the user's actual (global) batch size.  The
+    batch dim shards over data x fsdp, so candidates whose
+    data x fsdp does not divide it are unusable — they are filtered
+    out rather than discovered as a device_put error at the first
+    step.
 
     ``tune_space`` (dry-run mode only): Strategy-field value lists,
     e.g. ``{"num_micro_steps": [1, 2, 4], "remat": ["dots", "full"]}``
@@ -114,6 +121,7 @@ def auto_accelerate(
             moe=moe,
             batch_per_replica=batch_per_replica,
             seq_len=seq_len,
+            global_batch=global_batch,
         )
         if not candidates:
             raise RuntimeError(

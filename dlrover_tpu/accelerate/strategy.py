@@ -195,6 +195,7 @@ def generate_candidates(
     moe: bool = False,
     batch_per_replica: int = 1,
     seq_len: int = 2048,
+    global_batch: Optional[int] = None,
 ) -> List[Strategy]:
     """Mesh factorizations that fit memory, ranked by the workload
     cost model (:func:`estimate_step_cost` — compute shard + grad
@@ -204,7 +205,15 @@ def generate_candidates(
     A factorization whose activations overflow at micro_steps=1 is
     retried with gradient accumulation (2/4/8 micro steps) — the
     reference searches micro-batching as part of the strategy space,
-    not as a user afterthought."""
+    not as a user afterthought.
+
+    With ``global_batch`` set, every candidate is evaluated at ITS OWN
+    per-device batch (``global_batch / (data*fsdp)``): factorizations
+    whose batch sharding doesn't divide the batch are dropped (they'd
+    fail at the first ``device_put``), the gradient-accumulation
+    reshape divisibility (``global_batch % (micro * data*fsdp)``) is
+    enforced, and memory-fit + ranking see what each plan would
+    actually run, not a fixed ``batch_per_replica``."""
     candidates = []
     for tensor, fsdp_d, pipe in itertools.product(
         _divisors(n_devices), _divisors(n_devices), (1, 2, 4)
@@ -229,15 +238,30 @@ def generate_candidates(
         if moe and rest % 2 == 0 and rest > 1:
             expert = 2
             rest //= 2
+        batch_shard = rest * fsdp_d  # batch dim shards over data x fsdp
+        if global_batch:
+            if global_batch % batch_shard != 0:
+                continue  # would fail at the first device_put
+            bpd = global_batch // batch_shard
+        else:
+            bpd = batch_per_replica
         for micro in (1, 2, 4, 8):
-            if batch_per_replica % micro != 0 and micro > 1:
-                continue
+            if micro > 1:
+                if bpd % micro != 0:
+                    continue
+                # the accumulation reshape splits the GLOBAL batch dim
+                # into (micro, B/micro) and the inner dim re-shards
+                if (
+                    global_batch
+                    and global_batch % (micro * batch_shard) != 0
+                ):
+                    continue
             fits, util = fits_in_memory(
                 profile,
                 n_devices,
                 fsdp=fsdp_d,
                 tensor=tensor,
-                batch_per_device=batch_per_replica,
+                batch_per_device=bpd,
                 pipe=pipe,
                 micro_steps=micro,
             )
@@ -251,20 +275,21 @@ def generate_candidates(
                     pipe=pipe,
                     num_micro_steps=micro,
                 )
-                candidates.append((s, util))
+                candidates.append((s, util, bpd))
                 break  # smallest micro count that fits wins
 
-    # rank by modeled step time; memory utilization breaks ties
-    # (sort keys are computed once per element)
+    # rank by modeled step time at each candidate's OWN effective
+    # batch; memory utilization breaks ties (sort keys are computed
+    # once per element)
     candidates.sort(
         key=lambda su: (
-            estimate_step_cost(su[0], profile, batch_per_replica, seq_len),
+            estimate_step_cost(su[0], profile, su[2], seq_len),
             su[1],
         )
     )
     seen = set()
     unique = []
-    for s, _ in candidates:
+    for s, _, _ in candidates:
         key = (s.data, s.fsdp, s.tensor, s.seq, s.expert, s.pipe)
         if key not in seen:
             seen.add(key)

@@ -88,6 +88,8 @@ def main():
         # finetune: "init" = place the converted weights
         init_params_fn=lambda rng: params,
         param_axes=param_logical_axes(cfg),
+        # only strategies whose batch sharding divides the real batch
+        global_batch=args.batch,
     )
     print(f"strategy: {result.strategy.describe()}", flush=True)
 

@@ -205,6 +205,26 @@ class TestCandidates:
         (s, t), = svc._measurements[key]
         assert s.data == 2 and t == 0.5
 
+    def test_global_batch_filters_indivisible_candidates(self, tiny_cfg):
+        """A global batch of 4 on 8 devices cannot shard over dp=8;
+        auto_accelerate must pick a dividing factorization instead of
+        letting the first device_put explode."""
+        result = auto_accelerate(
+            loss_fn=lambda p, b: loss_fn(p, b, tiny_cfg),
+            optimizer=optax.adamw(1e-3),
+            init_params_fn=lambda rng: init_params(rng, tiny_cfg),
+            param_axes=param_logical_axes(tiny_cfg),
+            global_batch=4,
+        )
+        assert 4 % (result.strategy.data * result.strategy.fsdp) == 0
+        batch = jax.device_put(
+            {"tokens": jnp.ones((4, 17), dtype=jnp.int32)},
+            result.fns.batch_sharding,
+        )
+        state = result.fns.init_state(jax.random.PRNGKey(0))
+        _, metrics = result.fns.train_step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))
+
     def test_long_context_adds_seq_axis(self, tiny_cfg):
         profile = analyse_model(
             lambda rng: init_params(rng, tiny_cfg), optax.adamw(1e-3)

@@ -1,0 +1,82 @@
+"""Example-script smoke tests through the REAL launcher (the
+reference's examples are exercised in CI the same way; an example that
+rots is a broken front door).  Small step counts; each runs in its own
+socket dir and subprocess."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_example(tmp_path, script, *args, timeout=420, launcher=True):
+    env = dict(
+        os.environ,
+        DLROVER_TPU_SOCKET_DIR=str(tmp_path / "socks"),
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=REPO,
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        HF_HUB_OFFLINE="1",
+        TRANSFORMERS_OFFLINE="1",
+    )
+    os.makedirs(env["DLROVER_TPU_SOCKET_DIR"], exist_ok=True)
+    if launcher:
+        cmd = [
+            sys.executable, "-m", "dlrover_tpu.run",
+            "--nnodes=1", "--nproc_per_node=1",
+            os.path.join(REPO, "examples", script), *args,
+        ]
+    else:
+        cmd = [
+            sys.executable,
+            os.path.join(REPO, "examples", script), *args,
+        ]
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=timeout,
+        cwd=str(tmp_path), env=env,
+    )
+    assert proc.returncode == 0, (
+        f"{script} failed:\n{proc.stdout[-1200:]}\n{proc.stderr[-800:]}"
+    )
+    return proc.stdout
+
+
+class TestExamples:
+    def test_generate(self, tmp_path):
+        out = _run_example(
+            tmp_path, "generate.py", "--max_new", "4", launcher=False
+        )
+        assert len(out.strip().splitlines()) >= 2  # batch of samples
+
+    def test_moe_pretrain(self, tmp_path):
+        out = _run_example(tmp_path, "moe_pretrain.py", "--steps", "3")
+        assert "done" in out
+
+    def test_rlhf_ppo(self, tmp_path):
+        out = _run_example(tmp_path, "rlhf_ppo.py", "--rounds", "1")
+        assert "reward" in out
+
+    def test_vit_train(self, tmp_path):
+        out = _run_example(
+            tmp_path, "vit_train.py", "--steps", "4",
+            "--ckpt_dir", str(tmp_path / "ckpt"),
+        )
+        assert "done" in out
+
+    def test_hf_finetune(self, tmp_path):
+        out = _run_example(
+            tmp_path, "hf_finetune.py", "--steps", "2",
+            "--ckpt_dir", str(tmp_path / "ckpt"),
+        )
+        assert "done" in out
+
+    @pytest.mark.timeout(600)
+    def test_llama_pretrain(self, tmp_path):
+        out = _run_example(
+            tmp_path, "llama_pretrain.py", "--steps", "4",
+            "--ckpt_dir", str(tmp_path / "ckpt"),
+        )
+        assert "done" in out
