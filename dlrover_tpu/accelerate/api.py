@@ -167,9 +167,16 @@ def auto_accelerate(
                 for d, t in timings.items()
                 if d in by_desc and t and t[-1] is not None
             ]
+            # same constant rank basis as candidate generation: with
+            # a known global batch, per-device tokens = global/n
+            rank_bpr = (
+                global_batch / len(devices)
+                if global_batch is not None
+                else batch_per_replica
+            )
             planner = CalibratedPlanner(
                 profile,
-                batch_per_replica=batch_per_replica,
+                batch_per_replica=rank_bpr,
                 seq_len=seq_len,
             )
             planner.calibrate(measured)

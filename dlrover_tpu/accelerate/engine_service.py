@@ -42,6 +42,9 @@ class StrategyRequest(Message):
     n_devices: int = 1
     batch_per_replica: int = 1
     seq_len: int = 2048
+    # the client's REAL global batch (None = unknown): candidates
+    # whose batch sharding can't divide it are useless to serve
+    global_batch: Optional[int] = None
     long_context: bool = False
     moe: bool = False
     max_candidates: int = 8
@@ -190,6 +193,7 @@ class StrategyService:
             moe=req.moe,
             batch_per_replica=req.batch_per_replica,
             seq_len=req.seq_len,
+            global_batch=req.global_batch,
         )
         key = _workload_key(req)
         calibrated = False
@@ -256,6 +260,7 @@ class StrategyClient:
         n_devices: int,
         batch_per_replica: int = 1,
         seq_len: int = 2048,
+        global_batch: Optional[int] = None,
         long_context: bool = False,
         moe: bool = False,
     ) -> List[Strategy]:
@@ -271,6 +276,7 @@ class StrategyClient:
                 n_devices=n_devices,
                 batch_per_replica=batch_per_replica,
                 seq_len=seq_len,
+                global_batch=global_batch,
                 long_context=long_context,
                 moe=moe,
             )

@@ -207,13 +207,24 @@ def generate_candidates(
     reference searches micro-batching as part of the strategy space,
     not as a user afterthought.
 
-    With ``global_batch`` set, every candidate is evaluated at ITS OWN
-    per-device batch (``global_batch / (data*fsdp)``): factorizations
-    whose batch sharding doesn't divide the batch are dropped (they'd
-    fail at the first ``device_put``), the gradient-accumulation
-    reshape divisibility (``global_batch % (micro * data*fsdp)``) is
-    enforced, and memory-fit + ranking see what each plan would
-    actually run, not a fixed ``batch_per_replica``."""
+    With ``global_batch`` set, factorizations whose batch sharding
+    (data x fsdp) doesn't divide it are dropped (they'd fail at the
+    first ``device_put``) and each candidate's MEMORY fit is evaluated
+    at ITS OWN per-device batch (``global_batch / (data*fsdp)``).
+    The cost RANKING keeps a
+    constant per-device basis (``global_batch / n_devices``): the
+    model's compute term assumes a fixed global batch, and feeding
+    each candidate its own bpd would charge model-parallel plans
+    tensor*pipe-times the compute of data-parallel ones."""
+    if global_batch is not None and global_batch < 1:
+        raise ValueError(
+            f"global_batch must be >= 1, got {global_batch}"
+        )
+    rank_bpr = (
+        global_batch / n_devices
+        if global_batch is not None
+        else batch_per_replica
+    )
     candidates = []
     for tensor, fsdp_d, pipe in itertools.product(
         _divisors(n_devices), _divisors(n_devices), (1, 2, 4)
@@ -239,23 +250,17 @@ def generate_candidates(
             expert = 2
             rest //= 2
         batch_shard = rest * fsdp_d  # batch dim shards over data x fsdp
-        if global_batch:
+        if global_batch is not None:
             if global_batch % batch_shard != 0:
                 continue  # would fail at the first device_put
             bpd = global_batch // batch_shard
         else:
             bpd = batch_per_replica
         for micro in (1, 2, 4, 8):
-            if micro > 1:
-                if bpd % micro != 0:
-                    continue
-                # the accumulation reshape splits the GLOBAL batch dim
-                # into (micro, B/micro) and the inner dim re-shards
-                if (
-                    global_batch
-                    and global_batch % (micro * batch_shard) != 0
-                ):
-                    continue
+            # micro | bpd also guarantees the accumulation reshape's
+            # global divisibility: global = bpd * batch_shard
+            if micro > 1 and bpd % micro != 0:
+                continue
             fits, util = fits_in_memory(
                 profile,
                 n_devices,
@@ -275,7 +280,7 @@ def generate_candidates(
                     pipe=pipe,
                     num_micro_steps=micro,
                 )
-                candidates.append((s, util, bpd))
+                candidates.append((s, util))
                 break  # smallest micro count that fits wins
 
     # rank by modeled step time at each candidate's OWN effective
@@ -283,13 +288,13 @@ def generate_candidates(
     # once per element)
     candidates.sort(
         key=lambda su: (
-            estimate_step_cost(su[0], profile, su[2], seq_len),
+            estimate_step_cost(su[0], profile, rank_bpr, seq_len),
             su[1],
         )
     )
     seen = set()
     unique = []
-    for s, _, _ in candidates:
+    for s, _ in candidates:
         key = (s.data, s.fsdp, s.tensor, s.seq, s.expert, s.pipe)
         if key not in seen:
             seen.add(key)

@@ -225,6 +225,47 @@ class TestCandidates:
         _, metrics = result.fns.train_step(state, batch)
         assert np.isfinite(float(metrics["loss"]))
 
+    def test_global_batch_keeps_model_parallel_competitive(self):
+        """The ranking basis must stay CONSTANT across factorizations:
+        charging each candidate its own per-shard batch would bill a
+        tp=8 plan 8x the compute of fsdp=8 (review finding)."""
+        big = ModelProfile(
+            num_params=7_000_000_000,
+            param_bytes=28_000_000_000,
+            largest_leaf=1,
+            leaf_count=100,
+            optimizer_bytes=56_000_000_000,
+            num_layers=32,
+            activation_bytes_per_sample=32 * 7 * 2048 * 4096 * 2,
+        )
+        cands = generate_candidates(big, 8, global_batch=8)
+        assert cands
+        # all candidates shard the model (7B), and the ranking keeps
+        # model-parallel dims present rather than degenerating to
+        # maximize-data*fsdp
+        assert all(8 % (s.data * s.fsdp) == 0 for s in cands)
+        with pytest.raises(ValueError, match="global_batch"):
+            generate_candidates(big, 8, global_batch=0)
+
+    def test_strategy_service_respects_global_batch(self):
+        from dlrover_tpu.accelerate.engine_service import (
+            StrategyRequest,
+            StrategyService,
+        )
+
+        svc = StrategyService()
+        req = StrategyRequest(
+            num_params=1_000_000,
+            param_bytes=4_000_000,
+            optimizer_bytes=8_000_000,
+            n_devices=8,
+            global_batch=4,
+        )
+        resp = svc.generate(req)
+        assert resp.candidates
+        for kw in resp.candidates:
+            assert 4 % (kw["data"] * kw["fsdp"]) == 0
+
     def test_long_context_adds_seq_axis(self, tiny_cfg):
         profile = analyse_model(
             lambda rng: init_params(rng, tiny_cfg), optax.adamw(1e-3)
