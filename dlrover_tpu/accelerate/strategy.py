@@ -283,9 +283,13 @@ def generate_candidates(
                 candidates.append((s, util))
                 break  # smallest micro count that fits wins
 
-    # rank by modeled step time at each candidate's OWN effective
-    # batch; memory utilization breaks ties (sort keys are computed
-    # once per element)
+    # rank by modeled step time at the CONSTANT per-device basis
+    # rank_bpr = global_batch / n_devices (memory fit above used each
+    # candidate's own per-device batch); ranking at per-candidate
+    # batches would charge model-parallel plans tensor*pipe-times the
+    # compute of data-parallel ones — see
+    # test_global_batch_keeps_model_parallel_competitive.  Memory
+    # utilization breaks ties.
     candidates.sort(
         key=lambda su: (
             estimate_step_cost(su[0], profile, rank_bpr, seq_len),

@@ -64,7 +64,9 @@ class AsyncCheckpointSaver:
 
     def __init__(self, config: SaverConfig, storage=None):
         self.config = config
-        self._storage = storage or get_checkpoint_storage()
+        self._storage = storage or get_checkpoint_storage(
+            path=config.checkpoint_dir
+        )
         self._shm_handlers: List[SharedMemoryHandler] = []
         self._locks = []
         for local_rank in range(config.local_shard_num):
@@ -368,7 +370,7 @@ class AsyncCheckpointSaver:
 
 def find_latest_checkpoint(root: str, storage=None) -> Optional[str]:
     """Resolve the newest committed checkpoint dir via the tracker."""
-    storage = storage or get_checkpoint_storage()
+    storage = storage or get_checkpoint_storage(path=root)
     tracker = os.path.join(root, CheckpointConstant.TRACKER_FILE)
     content = storage.read(tracker)
     if content:

@@ -25,26 +25,45 @@ from typing import Callable, List, Optional
 
 from dlrover_tpu.common.log import default_logger as logger
 
-_METADATA_URL = (
+_METADATA_BASE = (
     "http://metadata.google.internal/computeMetadata/v1/instance/"
-    "maintenance-event"
 )
+# Hosted-VM migration/termination and spot/preemptible termination
+# are surfaced on DIFFERENT endpoints (maintenance-event says
+# NONE/MIGRATE.../TERMINATE...; preempted says TRUE/FALSE) — a
+# spot preemption never appears on maintenance-event, so both must
+# be polled.
+_METADATA_PATHS = ("maintenance-event", "preempted")
 _NONE_EVENT = "NONE"
 
 
-def _default_fetcher(timeout: float = 5.0) -> Optional[str]:
-    """Read the maintenance-event metadata value; None when the
-    metadata server is unreachable (not on GCE)."""
+def _fetch_metadata(path: str, timeout: float) -> Optional[str]:
     import urllib.request
 
     req = urllib.request.Request(
-        _METADATA_URL, headers={"Metadata-Flavor": "Google"}
+        _METADATA_BASE + path, headers={"Metadata-Flavor": "Google"}
     )
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             return resp.read().decode().strip()
     except OSError:
         return None
+
+
+def _default_fetcher(timeout: float = 5.0) -> Optional[str]:
+    """Poll maintenance-event then preempted; return the first
+    non-idle value, an idle value when both endpoints answered idle,
+    or None when the metadata server is unreachable (not on GCE)."""
+    idle_seen: Optional[str] = None
+    for path in _METADATA_PATHS:
+        value = _fetch_metadata(path, timeout)
+        if value is None:
+            continue
+        if value.upper() in (_NONE_EVENT, "FALSE", ""):
+            idle_seen = value
+            continue
+        return "PREEMPTED" if path == "preempted" else value
+    return idle_seen
 
 
 class PreemptionWatcher:

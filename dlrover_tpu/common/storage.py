@@ -1,10 +1,13 @@
 """Checkpoint storage abstraction.
 
 Reference parity: ``dlrover/python/common/storage.py:24,128,203,231,258``
-(CheckpointStorage ABC, PosixDiskStorage, deletion strategies).  A GCS
-backend slot exists for TPU deployments (gated: the bare image has no
-``google-cloud-storage``; POSIX paths cover GCS-Fuse mounts, the common
-TPU-VM setup).
+(CheckpointStorage ABC, PosixDiskStorage, deletion strategies), extended
+with an fsspec-backed object-store tier (``FsspecStorage``): on a TPU
+pod the VM-local disk dies with the VM, so the persistence story IS the
+object store (SURVEY §5.4 "agent-side async persist to GCS").  Any
+fsspec URL works — ``gs://`` (gcsfs), ``s3://``, ``memory://`` (tests)
+— selected automatically by :func:`get_checkpoint_storage` from the
+checkpoint path's protocol.
 """
 
 import json
@@ -158,12 +161,116 @@ class PosixDiskStorage(CheckpointStorage):
         return sorted(os.listdir(path))
 
 
-class PosixStorageWithDeletion(PosixDiskStorage):
-    """POSIX storage that applies a deletion strategy after each commit
-    of a persisted step (reference: ``common/storage.py:258``)."""
+class FsspecStorage(CheckpointStorage):
+    """Object-store checkpoint IO over any fsspec filesystem.
 
-    def __init__(self, tracker_file: str, deletion_strategy):
-        super().__init__()
+    Commit semantics differ from POSIX: object stores have no atomic
+    directory rename, so ``safe_move`` is server-side copy+delete per
+    object (non-atomic).  The saver's protocol stays crash-consistent
+    anyway because the single-object tracker-file write — which IS
+    atomic on GCS/S3 — is the commit point: a reader follows the
+    tracker to a fully-populated final dir or ignores the orphaned
+    stage prefix.
+
+    ``write_chunks`` streams each chunk straight into the backend's
+    buffered upload (multipart on GCS/S3) — a shard-sized shm shard is
+    never materialized host-side a second time.
+    """
+
+    def __init__(self, protocol_or_url: str, fs=None, **fs_kwargs):
+        import fsspec
+
+        if fs is not None:
+            self._fs = fs
+        else:
+            protocol = protocol_or_url.split("://", 1)[0]
+            self._fs = fsspec.filesystem(protocol, **fs_kwargs)
+
+    def _p(self, path: str) -> str:
+        return self._fs._strip_protocol(path)
+
+    def write(self, content, path: str):
+        if isinstance(content, str):
+            content = content.encode()
+        p = self._p(path)
+        with self._fs.open(p, "wb") as f:
+            f.write(bytes(content))
+
+    def write_chunks(self, chunks, path: str):
+        with self._fs.open(self._p(path), "wb") as f:
+            for chunk in chunks:
+                f.write(bytes(chunk))
+
+    def read(self, path: str, mode: str = "r"):
+        p = self._p(path)
+        try:
+            data = self._fs.cat_file(p)
+        except (FileNotFoundError, IsADirectoryError):
+            # ONLY genuine absence maps to empty — a transient network
+            # error (TimeoutError etc. are OSError subclasses) must
+            # raise, or a flaky tracker read would silently restart
+            # training from step 0 with checkpoints in the bucket
+            return b"" if "b" in mode else ""
+        return data if "b" in mode else data.decode()
+
+    def safe_rmtree(self, dir_path: str):
+        p = self._p(dir_path)
+        try:
+            self._fs.rm(p, recursive=True)
+        except (FileNotFoundError, OSError):
+            pass
+
+    def safe_remove(self, path: str):
+        p = self._p(path)
+        try:
+            self._fs.rm_file(p)
+        except (FileNotFoundError, OSError):
+            pass
+
+    def safe_makedirs(self, dir_path: str):
+        # prefixes need no creation on object stores; makedirs keeps
+        # directory-full filesystems (memory://, local) working
+        try:
+            self._fs.makedirs(self._p(dir_path), exist_ok=True)
+        except (OSError, ValueError):
+            pass
+
+    def safe_move(self, src: str, dst: str):
+        s, d = self._p(src), self._p(dst)
+        if not self._fs.exists(s) or self._fs.exists(d):
+            return
+        self._fs.mv(s, d, recursive=True)
+
+    def exists(self, path: str) -> bool:
+        return bool(self._fs.exists(self._p(path)))
+
+    def listdir(self, path: str) -> List[str]:
+        p = self._p(path)
+        try:
+            # bust the dircache: node-0's commit loop polls for done
+            # files OTHER nodes write; a cached listing would never
+            # show them and every multi-node commit would time out
+            self._fs.invalidate_cache(p)
+            entries = self._fs.ls(p, detail=False)
+        except (FileNotFoundError, OSError):
+            return []
+        # ls returns full paths (files AND sub-prefixes); callers want
+        # names, like os.listdir
+        return sorted(
+            e.rstrip("/").rsplit("/", 1)[-1]
+            for e in entries
+            if e.rstrip("/") != p.rstrip("/")
+        )
+
+
+class StorageWithDeletion(CheckpointStorage):
+    """Wrap any storage with a deletion strategy applied after each
+    tracker-file commit (reference: ``common/storage.py:258``).
+    Composition, so the POSIX and fsspec tiers share it."""
+
+    def __init__(self, base: CheckpointStorage, tracker_file: str,
+                 deletion_strategy):
+        self._base = base
         self._tracker_file = tracker_file
         self._deletion_strategy = deletion_strategy
 
@@ -171,19 +278,75 @@ class PosixStorageWithDeletion(PosixDiskStorage):
         # committing the tracker file marks a persisted step
         if os.path.basename(path) == os.path.basename(self._tracker_file):
             try:
-                prev = self.read(path)
+                prev = self._base.read(path)
                 if prev:
                     self._deletion_strategy.clean_up(
-                        int(prev), self.safe_rmtree
+                        int(prev), self._base.safe_rmtree
                     )
             except (ValueError, OSError) as e:
                 logger.warning("deletion strategy failed: %s", e)
-        super().write(content, path)
+        self._base.write(content, path)
+
+    def write_chunks(self, chunks, path: str):
+        self._base.write_chunks(chunks, path)
+
+    def read(self, path: str, mode: str = "r"):
+        return self._base.read(path, mode)
+
+    def safe_rmtree(self, dir_path: str):
+        self._base.safe_rmtree(dir_path)
+
+    def safe_remove(self, path: str):
+        self._base.safe_remove(path)
+
+    def safe_makedirs(self, dir_path: str):
+        self._base.safe_makedirs(dir_path)
+
+    def safe_move(self, src: str, dst: str):
+        self._base.safe_move(src, dst)
+
+    def exists(self, path: str) -> bool:
+        return self._base.exists(path)
+
+    def listdir(self, path: str) -> List[str]:
+        return self._base.listdir(path)
+
+
+class PosixStorageWithDeletion(StorageWithDeletion):
+    """Back-compat alias: POSIX storage + deletion strategy."""
+
+    def __init__(self, tracker_file: str, deletion_strategy):
+        super().__init__(
+            PosixDiskStorage(), tracker_file, deletion_strategy
+        )
+
+
+def is_remote_url(path: Optional[str]) -> bool:
+    """True when ``path`` carries an fsspec protocol.  file:// counts:
+    PosixDiskStorage would treat the URL as a cwd-relative literal
+    path; fsspec's LocalFileSystem strips the scheme and resolves it
+    correctly.  The single source of truth for every call site that
+    branches on URL-ness (storage selection, makedirs skip, shm
+    namespace hashing)."""
+    return bool(path and "://" in path)
+
+
+_is_remote_url = is_remote_url  # back-compat private alias
 
 
 def get_checkpoint_storage(
-    deletion_strategy=None, tracker_file: str = ""
+    deletion_strategy=None, tracker_file: str = "",
+    path: Optional[str] = None,
 ) -> CheckpointStorage:
+    """Storage for ``path``: fsspec when it carries an object-store
+    protocol (``gs://…``, ``s3://…``, ``memory://…``), POSIX disk
+    otherwise; optionally wrapped with a deletion strategy."""
+    if is_remote_url(path):
+        base: CheckpointStorage = FsspecStorage(path)
+    else:
+        base = PosixDiskStorage()
     if deletion_strategy:
-        return PosixStorageWithDeletion(tracker_file, deletion_strategy)
-    return PosixDiskStorage()
+        return StorageWithDeletion(
+            base, tracker_file, deletion_strategy
+        )
+    return base

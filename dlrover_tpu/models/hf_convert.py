@@ -74,6 +74,9 @@ def config_from_hf(hf_config) -> LlamaConfig:
         max_seq_len=hf_config.max_position_embeddings,
         rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
         norm_eps=float(getattr(hf_config, "rms_norm_eps", 1e-5)),
+        tie_word_embeddings=bool(
+            getattr(hf_config, "tie_word_embeddings", False)
+        ),
     )
 
 
@@ -158,15 +161,31 @@ def params_from_hf(
     return params, cfg
 
 
-def params_to_hf(params: Dict, cfg: LlamaConfig) -> Dict:
+def params_to_hf(
+    params: Dict, cfg: LlamaConfig, tied: Optional[bool] = None
+) -> Dict:
     """Framework params -> HF-layout numpy state dict (torch-free; feed
-    to ``model.load_state_dict`` after ``torch.from_numpy``)."""
+    to ``model.load_state_dict`` after ``torch.from_numpy``).
+
+    ``tied=True`` omits ``lm_head.weight``, matching the
+    ``save_pretrained`` artifact of a ``tie_word_embeddings=True``
+    model (safetensors strips the shared tensor; ``from_pretrained``
+    re-ties on load).  Default follows ``cfg.tie_word_embeddings``
+    (set by ``config_from_hf``) — the config carries the truth;
+    comparing tensors would misclassify an untied model whose weights
+    have not yet diverged.  Pass ``tied=False`` when feeding a raw
+    ``load_state_dict`` (a tied model's in-memory state dict KEEPS
+    the duplicate key and a strict load requires it)."""
     lp = params["layers"]
+    embed = _t(params["embed"])
+    if tied is None:
+        tied = cfg.tie_word_embeddings
     out: Dict[str, np.ndarray] = {
-        "model.embed_tokens.weight": _t(params["embed"]),
+        "model.embed_tokens.weight": embed,
         "model.norm.weight": _t(params["final_norm"]),
-        "lm_head.weight": _t(params["lm_head"]).T,
     }
+    if not tied:
+        out["lm_head.weight"] = _t(params["lm_head"]).T  # [D,V]->[V,D]
     names = {
         "attn_norm": ("model.layers.{}.input_layernorm.weight", False),
         "wq": ("model.layers.{}.self_attn.q_proj.weight", True),

@@ -46,6 +46,10 @@ class LlamaConfig:
     # fused-CE row-chunk size (peak logits memory = chunk x vocab fp32;
     # larger chunks = fewer scan trips, bigger lm-head matmuls)
     ce_chunk_rows: int = 512
+    # source checkpoint tied lm_head to the embedding (HF
+    # tie_word_embeddings); the framework keeps them separate
+    # (vocab-sharded lm_head), but HF export must honor the tie
+    tie_word_embeddings: bool = False
 
     @property
     def head_dim(self) -> int:

@@ -303,23 +303,52 @@ class SparseCheckpointManager:
             if m["kind"] == "full":
                 break
         chain.reverse()
+        # read EVERY chain payload before mutating the live tables: a
+        # missing/torn file (crash mid-commit on a non-atomic object
+        # store) must fail the restore with the live rows intact
+        loaded = []  # per chain link: {name: (keys, values)}
         for m in chain:
             d = os.path.join(self.dir, _step_dir(m["step"]))
-            for name, table in tables.items():
+            payload = {}
+            for name in tables:
                 if name not in m["tables"]:
                     continue
-                keys = _npy_load(
-                    self.storage.read(
-                        os.path.join(d, f"{name}.keys.npy"), "rb"
-                    )
+                payload[name] = (
+                    _npy_load(
+                        self.storage.read(
+                            os.path.join(d, f"{name}.keys.npy"), "rb"
+                        )
+                    ),
+                    _npy_load(
+                        self.storage.read(
+                            os.path.join(d, f"{name}.values.npy"),
+                            "rb",
+                        )
+                    ),
                 )
-                values = _npy_load(
-                    self.storage.read(
-                        os.path.join(d, f"{name}.values.npy"), "rb"
+            loaded.append(payload)
+        # restore-in-place must rewind EXACTLY: rows inserted after
+        # the restore point are not expressible as delta removals, so
+        # every table the CHAIN touches (a delta target may omit a
+        # table an earlier full carries) is cleared before re-import —
+        # otherwise phantom rows survive and diverge from the dense
+        # state restored alongside.
+        chain_names = set()
+        for payload in loaded:
+            chain_names.update(payload)
+        for name in chain_names:
+            table = tables[name]
+            if hasattr(table, "clear"):
+                dropped = table.clear()
+                if dropped:
+                    logger.info(
+                        "sparse ckpt: cleared %s live rows from %s "
+                        "before restore", dropped, name,
                     )
-                )
+        for payload in loaded:
+            for name, (keys, values) in payload.items():
                 if keys.size:
-                    table.import_(keys, values)
+                    tables[name].import_(keys, values)
         # the timeline is rewound to target: committed saves NEWER
         # than it belong to an abandoned run — a later re-save of
         # those steps would otherwise be silently skipped by the

@@ -100,6 +100,8 @@ def _load_library():
         ]
         lib.kv_evict_below.restype = ctypes.c_int64
         lib.kv_evict_below.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.kv_clear.restype = ctypes.c_int64
+        lib.kv_clear.argtypes = [ctypes.c_void_p]
         lib.kv_version.restype = ctypes.c_uint64
         lib.kv_version.argtypes = [ctypes.c_void_p]
         lib.kv_enable_spill.restype = ctypes.c_int
@@ -255,6 +257,12 @@ class KvTable:
         self._lib.kv_import(
             self._handle, _i64_ptr(keys), keys.size, _f32_ptr(values)
         )
+
+    def clear(self) -> int:
+        """Drop every row (RAM + spill tiers); returns removed count.
+        Checkpoint restore-in-place clears before re-importing so rows
+        inserted after the restore point cannot survive the rewind."""
+        return int(self._lib.kv_clear(self._handle))
 
     def evict_below(self, min_frequency: int) -> int:
         return int(

@@ -18,6 +18,7 @@ from dlrover_tpu.common.env import (
     get_process_count,
     get_process_rank,
 )
+from dlrover_tpu.common.storage import is_remote_url
 from dlrover_tpu.trainer.checkpoint.engine import CheckpointEngine
 
 
@@ -49,7 +50,8 @@ class Checkpointer:
         storage=None,
     ):
         self.checkpoint_dir = checkpoint_dir
-        os.makedirs(checkpoint_dir, exist_ok=True)
+        if not is_remote_url(checkpoint_dir):  # URLs need no local dir
+            os.makedirs(checkpoint_dir, exist_ok=True)
         rank = get_process_rank() if process_rank is None else process_rank
         world = (
             get_process_count() if process_count is None else process_count

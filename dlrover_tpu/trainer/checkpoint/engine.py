@@ -20,7 +20,10 @@ from typing import Optional
 from dlrover_tpu.common.constants import CheckpointConstant
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.common.multi_process import SharedQueue
-from dlrover_tpu.common.storage import get_checkpoint_storage
+from dlrover_tpu.common.storage import (
+    get_checkpoint_storage,
+    is_remote_url,
+)
 from dlrover_tpu.agent.ckpt_saver import (
     AsyncCheckpointSaver,
     CheckpointEvent,
@@ -106,12 +109,19 @@ class CheckpointEngine:
             # across restarts of the SAME job (resume depends on it).
             import hashlib
 
-            digest = hashlib.sha1(
-                os.path.abspath(checkpoint_dir).encode()
-            ).hexdigest()[:8]
+            # URLs (gs://…, memory://…) are already absolute; abspath
+            # would prepend the cwd and de-sync the name across ranks
+            dir_key = (
+                checkpoint_dir
+                if is_remote_url(checkpoint_dir)
+                else os.path.abspath(checkpoint_dir)
+            )
+            digest = hashlib.sha1(dir_key.encode()).hexdigest()[:8]
             name = f"d{digest}"
         self._name = name
-        self._storage = storage or get_checkpoint_storage()
+        self._storage = storage or get_checkpoint_storage(
+            path=checkpoint_dir
+        )
         self._local_saver: Optional[AsyncCheckpointSaver] = None
         # cross-rank restore-step consensus hook:
         # (avail_row: List[int]) -> agreed step, where avail_row is

@@ -121,7 +121,10 @@ def main():
     print(f"done: {summary}", flush=True)
 
     if args.export:
-        sd = params_to_hf(trainer.state["params"], cfg)
+        # tied=False: this npz feeds a raw load_state_dict, whose
+        # in-memory tied state dict KEEPS the duplicate lm_head key
+        # (only the save_pretrained safetensors artifact omits it)
+        sd = params_to_hf(trainer.state["params"], cfg, tied=False)
         os.makedirs(args.export, exist_ok=True)
         out = os.path.join(args.export, "hf_state_dict.npz")
         np.savez(out, **sd)

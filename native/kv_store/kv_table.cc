@@ -462,6 +462,27 @@ uint64_t kv_spilled_count(void* handle) {
   return t->spill.index.size();
 }
 
+// Drop EVERY row — RAM and spilled tiers — returning the removed
+// count.  Used by checkpoint restore-in-place: a rewind must not
+// leave rows inserted after the restore point (deltas cannot express
+// removals, so import-over-live diverges from the dense state).
+int64_t kv_clear(void* handle) {
+  auto* t = static_cast<KvTable*>(handle);
+  int64_t removed = 0;
+  AllShardsLock all(t);
+  for (auto& s : t->shards) {
+    removed += static_cast<int64_t>(s.map.size());
+    s.map.clear();
+  }
+  std::lock_guard<std::mutex> lk(t->spill.mu);
+  removed += static_cast<int64_t>(t->spill.index.size());
+  for (auto& kv : t->spill.index)
+    t->spill.free_offsets.push_back(kv.second);
+  t->spill.index.clear();
+  t->version.fetch_add(1, std::memory_order_relaxed);
+  return removed;
+}
+
 // Remove keys below a frequency threshold (under-frequency eviction,
 // reference under-/frequency-filtering).  Returns evicted count.
 int64_t kv_evict_below(void* handle, uint64_t min_frequency) {

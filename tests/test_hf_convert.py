@@ -103,6 +103,35 @@ class TestHfConvert:
             np.asarray(params["lm_head"]),
             np.asarray(params["embed"]).T,
         )
+        assert cfg.tie_word_embeddings
+
+    def test_tied_export_matches_pretrained_artifact(self):
+        """A tied model's export must match the key set of its
+        save_pretrained artifact (safetensors strips the shared
+        lm_head tensor; from_pretrained re-ties on load) — the
+        in-memory state_dict() keeps the duplicate, but the FILE is
+        the interop surface."""
+        import os
+        import tempfile
+
+        from safetensors import safe_open
+
+        model, _hf_cfg = _tiny_hf_model(tie=True)
+        with tempfile.TemporaryDirectory() as d:
+            model.save_pretrained(d)
+            with safe_open(
+                os.path.join(d, "model.safetensors"), framework="np"
+            ) as sf:
+                file_keys = set(sf.keys())
+        params, cfg = params_from_hf(model)
+        sd = params_to_hf(params, cfg)
+        assert "lm_head.weight" not in sd
+        assert set(sd) == file_keys
+        # explicit override (for raw load_state_dict consumers, whose
+        # tied state_dict DOES carry the duplicate key)
+        assert "lm_head.weight" in params_to_hf(
+            params, cfg, tied=False
+        )
 
     def test_roundtrip(self):
         model, _hf_cfg = _tiny_hf_model()

@@ -204,3 +204,30 @@ class TestSparseCheckpoint:
         os.makedirs(tmp_path / "._tmp-step-00000002")
         mgr2 = SparseCheckpointManager(str(tmp_path))
         assert mgr2.latest_step() == 1
+
+    def test_restore_in_place_clears_phantom_rows(self, table, tmp_path):
+        """Rewinding a LIVE table must drop rows inserted after the
+        restore point — deltas cannot express removals, so without the
+        pre-restore clear() those phantoms survive and diverge from
+        the dense state restored alongside."""
+        _set_rows(table, 0, 20)
+        mgr = SparseCheckpointManager(str(tmp_path))
+        mgr.save(1, {"emb": table}, full=True)
+        # rows inserted AFTER the save: gone after restore-in-place
+        _set_rows(table, 100, 120)
+        assert len(table) == 40
+        assert mgr.restore({"emb": table}) == 1
+        k, _ = _dump(table)
+        np.testing.assert_array_equal(
+            k, np.arange(0, 20, dtype=np.int64)
+        )
+
+    def test_kv_clear_drops_ram_and_spill(self, table, tmp_path):
+        _set_rows(table, 0, 30)
+        table.enable_spill(str(tmp_path / "spill.bin"))
+        assert table.spill_below(2) > 0  # all rows have freq < 2
+        _set_rows(table, 50, 60)
+        dropped = table.clear()
+        assert dropped == 40
+        assert len(table) == 0
+        assert table.spilled_count == 0
