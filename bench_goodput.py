@@ -51,12 +51,17 @@ def _read_progress(path):
 
 
 def run_goodput(
-    target_steps: int = 80,
-    kill_at_steps=(20, 50),
+    target_steps: int = 2000,
+    kill_at_steps=(500, 1100),
     step_sleep: float = 0.1,
-    timeout: float = 600.0,
+    timeout: float = 900.0,
 ) -> dict:
     """Run the kill-and-recover experiment; returns the metrics dict.
+
+    Defaults space the kills ~60 s of useful work apart (600 steps x
+    ~0.11 s), so the MEASURED goodput is comparable to the reference's
+    ">=95% under preemptions" claim instead of a 15 s-spacing toy that
+    only clears the bar after projection.
 
     Raises RuntimeError on harness failure (launcher died, steps not
     reached, step continuity broken).
@@ -89,10 +94,17 @@ def run_goodput(
                 "--monitor_interval=0.3",
                 "--stop_timeout=2",
                 f"--max_restarts={len(kill_at_steps) + 2}",
-                # restarted workers hit the persistent XLA cache —
-                # recompile is the avoidable half of recovery latency
+                # the three restart-latency levers, all on by default
+                # in the harness because they ARE the product defaults
+                # for preemption-heavy TPU fleets:
+                # - persistent XLA cache (recompile is avoidable)
+                # - prefork zygote (reimport is avoidable)
+                # - short failure grace (survivors of a peer kill are
+                #   wedged in collectives; SIGTERM buys nothing)
                 "--compile_cache_dir="
                 + os.path.join(workdir, "xla_cache"),
+                "--prefork",
+                "--failure_stop_timeout=0.5",
                 os.path.join(REPO, "scripts", "goodput_train.py"),
             ],
             stdout=log,
@@ -144,13 +156,18 @@ def run_goodput(
     if not lines or max(e["step"] for e in lines) < target_steps:
         raise RuntimeError("target steps never reached")
 
-    # continuity: an incarnation's first step is one past a snapshot
+    # continuity: an incarnation's first step is one past a snapshot.
+    # Rollback (re-executed steps) is measured here too: with per-step
+    # snapshots it is 0, but at a realistic checkpoint cadence the
+    # work re-done after restore is goodput loss the projection must
+    # charge (ADVICE-r3: recovery latency alone overstates goodput).
     by_inc = {}
     for e in lines:
         if e["rank"] != 0:
             continue
         by_inc.setdefault(e["inc"], []).append(e)
     prev_last = None
+    rollback_steps = []
     for inc in sorted(by_inc):
         entries = sorted(by_inc[inc], key=lambda e: e["step"])
         first = entries[0]["step"]
@@ -158,6 +175,8 @@ def run_goodput(
             raise RuntimeError(
                 f"step gap across restart: {prev_last} -> {first}"
             )
+        if prev_last is not None:
+            rollback_steps.append(max(0, prev_last + 1 - first))
         steps = [e["step"] for e in entries]
         if steps != list(range(steps[0], steps[-1] + 1)):
             raise RuntimeError(f"non-contiguous steps in inc {inc}")
@@ -194,15 +213,9 @@ def run_goodput(
         if after:
             recoveries.append(min(e["t"] for e in after) - kill_t)
 
-    # The raw CI goodput kills every ~15 SECONDS of useful work — a
-    # fault rate ~240x the reference experiment's.  The
-    # apples-to-apples number vs the reference's ">=95% with [roughly
-    # hourly] preemptions" projects the MEASURED recovery latency onto
-    # an hourly-preemption schedule: each fault costs `recovery` out
-    # of every 3600s of work.
     if len(recoveries) != len(kills):
         # an unmeasured kill must fail the harness, not inflate the
-        # projection (mean of fewer recoveries -> silently optimistic)
+        # numbers (mean of fewer recoveries -> silently optimistic)
         raise RuntimeError(
             f"{len(kills)} kills but only {len(recoveries)} measured "
             "recoveries"
@@ -212,7 +225,19 @@ def run_goodput(
     mean_rec = (
         sum(recoveries) / len(recoveries) if recoveries else 0.0
     )
-    goodput_hourly = 3600.0 / (3600.0 + mean_rec)
+    # Secondary PROJECTION onto the reference experiment's (roughly
+    # hourly) fault rate: each fault costs measured recovery latency
+    # PLUS measured rollback (steps re-executed after restore x step
+    # time) out of every 3600s of work.  The measured goodput above is
+    # the headline; this contextualizes it against the reference's
+    # ">=95% with hourly preemptions".
+    mean_rollback_s = (
+        sum(rollback_steps) / len(rollback_steps) * step_time
+        if rollback_steps
+        else 0.0
+    )
+    fault_cost = mean_rec + mean_rollback_s
+    goodput_hourly = 3600.0 / (3600.0 + fault_cost)
     return {
         "goodput": round(goodput, 4),
         "goodput_hourly_preemptions": round(goodput_hourly, 4),
@@ -223,6 +248,8 @@ def run_goodput(
         "wall_s": round(wall, 2),
         "recovery_latency_s": [round(r, 2) for r in recoveries],
         "mean_recovery_s": round(mean_rec, 2),
+        "rollback_steps": rollback_steps,
+        "mean_rollback_s": round(mean_rollback_s, 3),
     }
 
 
@@ -232,14 +259,12 @@ def main() -> int:
         json.dumps(
             {
                 "metric": "goodput_under_kills",
-                # headline: measured recovery projected to the
-                # reference experiment's (roughly hourly) fault rate;
-                # the raw CI-kill-rate goodput stays in extras
-                "value": result["goodput_hourly_preemptions"],
+                # headline: the MEASURED goodput at ~60s kill spacing
+                # (the hourly-rate projection, now charged with
+                # measured rollback too, stays in extras)
+                "value": result["goodput"],
                 "unit": "fraction",
-                "vs_baseline": round(
-                    result["goodput_hourly_preemptions"] / 0.95, 3
-                ),
+                "vs_baseline": round(result["goodput"] / 0.95, 3),
                 "extras": result,
             }
         ),

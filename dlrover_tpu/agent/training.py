@@ -58,6 +58,16 @@ class ElasticLaunchConfig:
     # signal handlers, so it always eats the full grace period —
     # recovery latency is dominated by this knob.
     stop_timeout: float = 15.0
+    # grace used instead of stop_timeout when restarting after a
+    # WORKER FAILURE: the group is already broken (survivors are wedged
+    # in a collective against a dead peer, and the agent has already
+    # flushed the shm checkpoint itself), so a long SIGTERM grace buys
+    # nothing but recovery latency
+    failure_stop_timeout: float = 1.0
+    # fork restarted workers from a pre-imported zygote process
+    # (agent/zygote.py): removes the ~3-4s Python/jax import chain
+    # from every restart's critical path
+    prefork: bool = False
     node_rank: int = field(
         default_factory=lambda: int(os.getenv(NodeEnv.NODE_RANK, "0"))
     )
@@ -185,6 +195,7 @@ class ElasticTrainingAgent:
         self._start_ckpt_saver = start_ckpt_saver
         self._coordinator_port = get_free_port()
         self._stopped = False
+        self._zygote = None  # ZygotePool when config.prefork
 
     # ------------------------------------------------------------- workers
     def _rendezvous(self):
@@ -312,9 +323,12 @@ class ElasticTrainingAgent:
             env = self._worker_env(
                 rdzv_round, coordinator, world_size, process_rank, local_rank
             )
-            proc = subprocess.Popen(  # noqa: S603
-                self._entrypoint, env=env
-            )
+            if self._zygote is not None:
+                proc = self._zygote.spawn(self._entrypoint, env)
+            else:
+                proc = subprocess.Popen(  # noqa: S603
+                    self._entrypoint, env=env
+                )
             self._procs.append(proc)
         return True
 
@@ -391,7 +405,14 @@ class ElasticTrainingAgent:
             self._remaining_restarts,
         )
         self._save_ckpt_to_storage(reason)
-        self._stop_workers()
+        # failure restarts: the group is broken and the shm snapshot
+        # is already flushed — survivors wedged in collectives would
+        # eat the full stop grace for nothing
+        self._stop_workers(
+            timeout=self._config.failure_stop_timeout
+            if consume_budget
+            else None
+        )
         return self._initialize_workers()
 
     def _report_failure(self, result: RunResult):
@@ -470,6 +491,21 @@ class ElasticTrainingAgent:
             preemption_watcher = PreemptionWatcher()
             preemption_watcher.on_preemption(self._on_preemption)
             preemption_watcher.start()
+        if self._config.prefork:
+            from dlrover_tpu.agent.zygote import ZygotePool
+
+            pool = ZygotePool(
+                name=f"zygote_{self._node_rank}_{os.getpid()}"
+            )
+            env = dict(os.environ)
+            env.update(self._config.envs)
+            if self._config.compile_cache_dir:
+                env.setdefault(
+                    "JAX_COMPILATION_CACHE_DIR",
+                    self._config.compile_cache_dir,
+                )
+            if pool.start(env=env):
+                self._zygote = pool
         try:
             return self._invoke_run()
         finally:
@@ -477,6 +513,9 @@ class ElasticTrainingAgent:
             if preemption_watcher is not None:
                 preemption_watcher.stop()
             self._stop_workers()
+            if self._zygote is not None:
+                self._zygote.close()
+                self._zygote = None
             if factory_queue is not None:
                 factory_queue.close()
                 AsyncCheckpointSaver.reset()

@@ -3,3 +3,7 @@ from dlrover_tpu.optimizers.low_bit import quantized_moments  # noqa: F401
 from dlrover_tpu.optimizers.wsam import (  # noqa: F401
     wsam_gradients,
 )
+from dlrover_tpu.optimizers.schedules import (  # noqa: F401
+    available_schedulers,
+    get_scheduler,
+)

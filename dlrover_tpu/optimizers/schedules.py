@@ -1,0 +1,169 @@
+"""Learning-rate schedules: a name-keyed registry over optax.
+
+Reference parity: ``atorch/atorch/trainer/atorch_trainer.py:654``
+(``get_scheduler`` wiring HF ``SchedulerType`` names into the trainer)
+and the HF ``transformers.optimization`` family it delegates to.  The
+TPU-first design is simpler: an optax schedule is a pure
+``step -> lr`` function that lives INSIDE the optimizer
+(``optax.adamw(learning_rate=get_scheduler(...))``), so its position
+is carried by the optimizer state's step count — flash-checkpoint
+resume restores it with the opt_state, no separate scheduler state
+object to save (the reference serializes ``lr_scheduler.state_dict()``
+separately; here consistency is structural).
+
+Supported names (HF-compatible plus TPU-pretraining staples):
+``constant``, ``constant_with_warmup``, ``linear``, ``cosine``,
+``cosine_with_min_lr``, ``polynomial``, ``inverse_sqrt``, ``wsd``
+(warmup-stable-decay).
+"""
+
+from typing import Callable, Optional
+
+import optax
+
+SchedulerFn = Callable[..., optax.Schedule]
+
+_REGISTRY = {}
+
+
+def register_scheduler(name: str):
+    def deco(fn: SchedulerFn) -> SchedulerFn:
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def available_schedulers():
+    return sorted(_REGISTRY)
+
+
+def get_scheduler(
+    name: str,
+    learning_rate: float,
+    total_steps: Optional[int] = None,
+    warmup_steps: int = 0,
+    **kwargs,
+) -> optax.Schedule:
+    """Build a ``step -> lr`` schedule by name.
+
+    ``total_steps`` is required by decaying schedules (linear/cosine/
+    polynomial/wsd); warmup always ramps linearly from 0 over
+    ``warmup_steps``.
+    """
+    if name not in _REGISTRY:
+        raise ValueError(
+            f"unknown scheduler {name!r}; available: "
+            f"{available_schedulers()}"
+        )
+    decaying = name in (
+        "linear", "cosine", "cosine_with_min_lr", "polynomial", "wsd"
+    )
+    if decaying and not total_steps:
+        raise ValueError(f"scheduler {name!r} requires total_steps")
+    return _REGISTRY[name](
+        learning_rate=learning_rate,
+        total_steps=total_steps,
+        warmup_steps=warmup_steps,
+        **kwargs,
+    )
+
+
+def _with_warmup(
+    base: optax.Schedule, learning_rate: float, warmup_steps: int
+) -> optax.Schedule:
+    if warmup_steps <= 0:
+        return base
+    warmup = optax.linear_schedule(0.0, learning_rate, warmup_steps)
+    return optax.join_schedules([warmup, base], [warmup_steps])
+
+
+@register_scheduler("constant")
+def _constant(learning_rate, total_steps, warmup_steps, **_):
+    return _with_warmup(
+        optax.constant_schedule(learning_rate),
+        learning_rate,
+        warmup_steps,
+    )
+
+
+@register_scheduler("constant_with_warmup")
+def _constant_with_warmup(learning_rate, total_steps, warmup_steps, **_):
+    return _with_warmup(
+        optax.constant_schedule(learning_rate),
+        learning_rate,
+        max(warmup_steps, 1),
+    )
+
+
+@register_scheduler("linear")
+def _linear(learning_rate, total_steps, warmup_steps, end_value=0.0, **_):
+    decay = optax.linear_schedule(
+        learning_rate, end_value, max(total_steps - warmup_steps, 1)
+    )
+    return _with_warmup(decay, learning_rate, warmup_steps)
+
+
+@register_scheduler("cosine")
+def _cosine(learning_rate, total_steps, warmup_steps, **_):
+    decay = optax.cosine_decay_schedule(
+        learning_rate, max(total_steps - warmup_steps, 1)
+    )
+    return _with_warmup(decay, learning_rate, warmup_steps)
+
+
+@register_scheduler("cosine_with_min_lr")
+def _cosine_min(
+    learning_rate, total_steps, warmup_steps, min_lr_ratio=0.1, **_
+):
+    decay = optax.cosine_decay_schedule(
+        learning_rate,
+        max(total_steps - warmup_steps, 1),
+        alpha=min_lr_ratio,
+    )
+    return _with_warmup(decay, learning_rate, warmup_steps)
+
+
+@register_scheduler("polynomial")
+def _polynomial(
+    learning_rate, total_steps, warmup_steps, power=1.0,
+    end_value=1e-7, **_,
+):
+    decay = optax.polynomial_schedule(
+        learning_rate,
+        end_value,
+        power,
+        max(total_steps - warmup_steps, 1),
+    )
+    return _with_warmup(decay, learning_rate, warmup_steps)
+
+
+@register_scheduler("inverse_sqrt")
+def _inverse_sqrt(learning_rate, total_steps, warmup_steps, **_):
+    shift = max(warmup_steps, 1)
+
+    def decay(step):
+        return learning_rate * (shift / (step + shift)) ** 0.5
+
+    # join at warmup boundary: optax.join_schedules rebases the second
+    # schedule's step to 0 at the boundary, which is what shift expects
+    return _with_warmup(decay, learning_rate, warmup_steps)
+
+
+@register_scheduler("wsd")
+def _wsd(
+    learning_rate, total_steps, warmup_steps, decay_ratio=0.1,
+    min_lr_ratio=0.0, **_,
+):
+    """Warmup-Stable-Decay: hold peak LR for most of training, decay
+    linearly over the final ``decay_ratio`` fraction — the continual-
+    pretraining-friendly schedule (checkpoints mid-plateau resume into
+    longer runs without LR mismatch)."""
+    decay_steps = max(int(total_steps * decay_ratio), 1)
+    stable_steps = max(total_steps - warmup_steps - decay_steps, 0)
+    stable = optax.constant_schedule(learning_rate)
+    decay = optax.linear_schedule(
+        learning_rate, learning_rate * min_lr_ratio, decay_steps
+    )
+    tail = optax.join_schedules([stable, decay], [stable_steps])
+    return _with_warmup(tail, learning_rate, warmup_steps)

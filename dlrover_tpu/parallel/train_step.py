@@ -11,7 +11,7 @@ donated so optimizer update is in-place in HBM.
 """
 
 from dataclasses import dataclass
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -36,6 +36,10 @@ class TrainStepFns:
     init_state: Callable  # (rng) -> sharded TrainState pytree
     state_shardings: Any
     batch_sharding: Any
+    # forward-only loss under the SAME shardings (no donation: eval
+    # must not consume the train state's buffers); None on artifacts
+    # built before eval existed
+    eval_step: Optional[Callable] = None  # (state, batch) -> metrics
 
 
 def make_train_state(params, optimizer):
@@ -181,9 +185,23 @@ def build_train_step(
         out_shardings=(state_shardings, replicated),
         donate_argnums=(0,),
     )
+
+    def _eval_step(state, batch):
+        with rules_scope(rules):
+            loss = loss_fn(state["params"], batch)
+        return {"loss": loss}
+
+    # no donation: evaluation reads the live train state and must not
+    # invalidate its buffers mid-run
+    eval_step = jax.jit(
+        _eval_step,
+        in_shardings=(state_shardings, batch_sharding),
+        out_shardings=replicated,
+    )
     return TrainStepFns(
         train_step=train_step,
         init_state=init_state,
         state_shardings=state_shardings,
         batch_sharding=batch_sharding,
+        eval_step=eval_step,
     )

@@ -32,7 +32,7 @@ import numpy as np
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.common.storage import (
     CheckpointStorage,
-    PosixDiskStorage,
+    get_checkpoint_storage,
 )
 
 _STEP_PREFIX = "step-"
@@ -72,7 +72,11 @@ class SparseCheckpointManager:
         max_chains_to_keep: int = 2,
     ):
         self.dir = ckpt_dir
-        self.storage = storage or PosixDiskStorage()
+        # path-aware default: a gs://… chain dir must select the
+        # object-store tier like the dense engine does — defaulting to
+        # POSIX would silently strand sparse state on the VM-local
+        # disk the object tier exists to outlive
+        self.storage = storage or get_checkpoint_storage(path=ckpt_dir)
         self.full_every = max(1, full_every)
         self.max_chains = max(1, max_chains_to_keep)
         self.storage.safe_makedirs(ckpt_dir)

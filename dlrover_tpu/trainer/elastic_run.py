@@ -67,6 +67,18 @@ def parse_args(argv=None):
         "bounds restart latency",
     )
     parser.add_argument(
+        "--failure_stop_timeout", type=float, default=1.0,
+        help="shorter grace used when restarting after a worker "
+        "FAILURE (the group is already broken; survivors are wedged "
+        "in collectives and the shm ckpt is flushed agent-side)",
+    )
+    parser.add_argument(
+        "--prefork",
+        action="store_true",
+        help="fork restarted workers from a pre-imported zygote "
+        "(removes the Python/jax import chain from restart latency)",
+    )
+    parser.add_argument(
         "--network-check",
         "--network_check",
         dest="network_check",
@@ -216,6 +228,8 @@ def run(args) -> int:
         max_restarts=args.max_restarts,
         monitor_interval=args.monitor_interval,
         stop_timeout=args.stop_timeout,
+        failure_stop_timeout=args.failure_stop_timeout,
+        prefork=args.prefork,
         node_rank=node_rank,
         compile_cache_dir=args.compile_cache_dir,
     )

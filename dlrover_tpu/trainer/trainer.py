@@ -42,6 +42,11 @@ class TrainingArgs:
     global_batch_size: int = 0
     micro_batch_size: int = 0
     hang_timeout: float = 1800.0
+    # periodic in-train evaluation cadence (steps; 0 = disabled).
+    # Requires eval_iter_fn at Trainer construction.
+    eval_interval: int = 0
+    # max batches per evaluation pass (0 = drain the eval iterator)
+    eval_max_batches: int = 0
     capture_loss_spikes: bool = False
     spike_dir: str = ""
     metrics_port: int = 0  # 0 = no exporter daemon
@@ -70,15 +75,31 @@ class Trainer:
         args: TrainingArgs,
         data_iter_fn: Callable[[], Iterable],
         rng_seed: int = 0,
+        eval_iter_fn: Optional[Callable[[], Iterable]] = None,
+        callbacks=None,
+        lr_schedule: Optional[Callable[[int], float]] = None,
     ):
         """``accelerate_result``: an ``AccelerateResult`` (from
         ``auto_accelerate``); ``data_iter_fn()`` returns a fresh batch
-        iterator yielding host pytrees matching the batch sharding."""
+        iterator yielding host pytrees matching the batch sharding.
+
+        ``eval_iter_fn`` enables ``evaluate()`` and the periodic
+        in-train cadence (``args.eval_interval``).  ``callbacks`` is a
+        list of :class:`~dlrover_tpu.trainer.callbacks.TrainerCallback`.
+        ``lr_schedule`` (the optax schedule the optimizer was built
+        with — see ``optimizers/schedules.get_scheduler``) lets the
+        trainer log/export the current LR; the schedule POSITION lives
+        in the optimizer state, so resume needs no extra wiring."""
+        from dlrover_tpu.trainer.callbacks import CallbackList
+
         self._ctx = init_distributed()
         self._result = accelerate_result
         self._fns = accelerate_result.fns
         self._args = args
         self._data_iter_fn = data_iter_fn
+        self._eval_iter_fn = eval_iter_fn
+        self._callbacks = CallbackList(callbacks)
+        self._lr_schedule = lr_schedule
         self._rng_seed = rng_seed
 
         self.state = None
@@ -149,6 +170,7 @@ class Trainer:
                 MetricsRegistry,
                 set_default_registry,
             )
+            from dlrover_tpu.trainer.callbacks import MetricsCallback
 
             self._registry = MetricsRegistry()
             set_default_registry(self._registry)
@@ -156,6 +178,9 @@ class Trainer:
                 self._registry,
                 rank=self._ctx.rank,
                 port=args.metrics_port + self._ctx.rank,
+            )
+            self._callbacks.callbacks.append(
+                MetricsCallback(self._registry)
             )
 
     # ------------------------------------------------------------ resume
@@ -274,6 +299,7 @@ class Trainer:
                 )
         else:
             self._engine.save_to_memory(step, snap, blocking=False)
+        self._callbacks.on_save(step, storage=to_storage)
 
     def _consume_metrics(self, step: int, metrics, batch) -> float:
         loss = float(metrics["loss"])  # syncs on step completion
@@ -282,15 +308,71 @@ class Trainer:
         self._last_done = now
         if self._spikes is not None:
             self._spikes.observe(step, loss, batch)
-        if self._registry is not None:
-            self._registry.set_gauge("train_step", step)
-            self._registry.set_gauge("train_loss", loss)
-            self._registry.observe_duration("step_time", dt)
+        record = {"loss": loss, "step_time_s": dt}
+        if "grad_norm" in metrics:
+            record["grad_norm"] = float(metrics["grad_norm"])
+        if self._lr_schedule is not None:
+            record["lr"] = float(self._lr_schedule(step))
+        self._callbacks.on_step_end(step, record)
         if step % self._args.log_interval == 0:
             logger.info(
                 "step %d loss %.4f (%.3fs/step)", step, loss, dt
             )
         return dt
+
+    # ------------------------------------------------------------- eval
+    def evaluate(self, eval_iter_fn=None, max_batches: int = 0):
+        """One evaluation pass: mean forward loss over the eval
+        iterator under the training shardings (reference
+        ``AtorchTrainer.evaluate``/``evaluation_loop``
+        ``atorch_trainer.py:1742,1857`` — redesigned as a jitted
+        forward-only step; no gather-to-rank-0, the loss is already a
+        replicated scalar).  Returns the metrics dict and fires
+        ``on_eval``."""
+        it_fn = eval_iter_fn or self._eval_iter_fn
+        if it_fn is None:
+            raise ValueError(
+                "evaluate() needs eval_iter_fn (ctor or argument)"
+            )
+        if self._fns.eval_step is None:
+            raise ValueError(
+                "the accelerate artifacts carry no eval_step "
+                "(rebuilt with an older build_train_step?)"
+            )
+        if self.state is None:
+            self._init_or_restore_state()
+        max_batches = max_batches or self._args.eval_max_batches
+        batch_sharding = self._fns.batch_sharding
+        t0 = time.perf_counter()
+        total, count = 0.0, 0
+        # one-deep pipeline, same as train: batch N+1 dispatches while
+        # N's loss materializes
+        pending = None
+        for batch in it_fn():
+            if max_batches and count >= max_batches:
+                break
+            device_batch = jax.device_put(batch, batch_sharding)
+            metrics = self._fns.eval_step(self.state, device_batch)
+            if pending is not None:
+                total += float(pending["loss"])
+            pending = metrics
+            count += 1
+        if pending is not None:
+            total += float(pending["loss"])
+        if count == 0:
+            raise ValueError("eval iterator yielded no batches")
+        result = {
+            "eval_loss": total / count,
+            "eval_batches": count,
+            "eval_time_s": round(time.perf_counter() - t0, 3),
+        }
+        step = int(self.progress.global_step)
+        logger.info(
+            "eval @ step %d: loss %.4f (%d batches, %.2fs)",
+            step, result["eval_loss"], count, result["eval_time_s"],
+        )
+        self._callbacks.on_eval(step, result)
+        return result
 
     # ------------------------------------------------------------- train
     def train(self):
@@ -298,9 +380,15 @@ class Trainer:
         if self._exporter is not None:
             self._exporter.start()
         self._hang.start()
+        self._callbacks.on_train_begin(start_step)
         batch_sharding = self._fns.batch_sharding
         step = start_step
         step_times = []
+        eval_every = (
+            self._args.eval_interval
+            if self._eval_iter_fn is not None
+            else 0
+        )
         try:
             # metrics are read to host with a ONE-STEP delay: forcing
             # float(loss) right after dispatch would block on the device
@@ -343,6 +431,15 @@ class Trainer:
                         )
                     pending = (step, metrics, batch)
                     self._maybe_checkpoint(step)
+                    if eval_every and step % eval_every == 0:
+                        # settle the pipelined metrics first so the
+                        # eval pause is not booked as a step time
+                        step_times.append(
+                            self._consume_metrics(*pending)
+                        )
+                        pending = None
+                        self.evaluate()
+                        self._last_done = time.perf_counter()
                 else:
                     continue
                 break
@@ -367,9 +464,11 @@ class Trainer:
                     self._sparse_mgr.wait_for_writes()
                     self._sparse_mgr.save(step, self._args.sparse_tables)
                 self._engine.close()
-        return {
+        summary = {
             "final_step": step,
             "mean_step_time": (
                 sum(step_times) / len(step_times) if step_times else 0.0
             ),
         }
+        self._callbacks.on_train_end(summary)
+        return summary

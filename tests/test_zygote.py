@@ -1,0 +1,199 @@
+"""Pre-fork zygote: warm-import worker spawn (agent/zygote.py).
+
+Reference context: restart latency is the goodput loss the reference's
+fault-tolerance story minimizes (``docs/tech_report/
+fault_tolerance_exps.md``); the zygote removes the Python/jax import
+chain from every restart.  These tests exercise the REAL fork server
+over its unix socket: spawn, exit-code plumbing (normal / nonzero /
+signal), env application in the child, fallback to plain Popen, and
+module-mode entrypoints.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from dlrover_tpu.agent.zygote import (
+    DEFAULT_PRELOAD,
+    ZygoteHandle,
+    ZygotePool,
+)
+
+WORKER = """
+import os, sys, time
+mode = os.environ.get("MODE", "exit0")
+sys.stdout.write("rank=" + os.environ.get("RANK", "?") + "\\n")
+sys.stdout.flush()
+if mode == "exit7":
+    sys.exit(7)
+if mode == "sleep":
+    time.sleep(60)
+if mode == "check_import":
+    # jax must already be importable without paying import time
+    t0 = time.time()
+    import jax  # noqa: F401
+    sys.exit(0 if time.time() - t0 < 0.5 else 8)
+"""
+
+
+@pytest.fixture(scope="module")
+def pool(tmp_path_factory):
+    sockdir = tmp_path_factory.mktemp("zyg_socks")
+    old = os.environ.get("DLROVER_TPU_SOCKET_DIR")
+    os.environ["DLROVER_TPU_SOCKET_DIR"] = str(sockdir)
+    script_dir = tmp_path_factory.mktemp("zyg_scripts")
+    script = script_dir / "worker.py"
+    script.write_text(WORKER)
+    p = ZygotePool(name="test_zyg", preload=("jax",))
+    assert p.start(wait=True)
+    p._script = str(script)  # stashed for tests
+    yield p
+    p.close()
+    if old is None:
+        os.environ.pop("DLROVER_TPU_SOCKET_DIR", None)
+    else:
+        os.environ["DLROVER_TPU_SOCKET_DIR"] = old
+
+
+def _env(**kw):
+    env = dict(os.environ)
+    env.update(kw)
+    return env
+
+
+class TestZygoteSpawn:
+    def test_fork_spawn_and_exit_zero(self, pool):
+        h = pool.spawn([sys.executable, pool._script], _env(RANK="0"))
+        assert isinstance(h, ZygoteHandle)  # not the Popen fallback
+        assert h.wait(timeout=30) == 0
+        assert h.poll() == 0  # cached after exit
+
+    def test_nonzero_exit_code(self, pool):
+        h = pool.spawn(
+            [sys.executable, pool._script], _env(MODE="exit7")
+        )
+        assert h.wait(timeout=30) == 7
+
+    def test_sigkill_reports_negative_signal(self, pool):
+        h = pool.spawn(
+            [sys.executable, pool._script], _env(MODE="sleep")
+        )
+        assert h.poll() is None  # running
+        time.sleep(0.3)
+        h.kill()
+        assert h.wait(timeout=15) == -signal.SIGKILL
+
+    def test_sigterm_terminate(self, pool):
+        h = pool.spawn(
+            [sys.executable, pool._script], _env(MODE="sleep")
+        )
+        time.sleep(0.3)
+        h.terminate()
+        assert h.wait(timeout=15) == -signal.SIGTERM
+
+    def test_preloaded_import_is_warm(self, pool):
+        """The forked child sees jax already in sys.modules — the
+        whole point of the zygote."""
+        h = pool.spawn(
+            [sys.executable, pool._script], _env(MODE="check_import")
+        )
+        assert h.wait(timeout=30) == 0
+
+    def test_wait_timeout_raises(self, pool):
+        h = pool.spawn(
+            [sys.executable, pool._script], _env(MODE="sleep")
+        )
+        with pytest.raises(subprocess.TimeoutExpired):
+            h.wait(timeout=0.3)
+        h.kill()
+        h.wait(timeout=15)
+
+    def test_spawn_latency_beats_cold_start(self, pool):
+        """Fork from the warm zygote must be far under a cold python+
+        jax boot (~2.5s+ on this 1-core box); generous 2.0s bound
+        keeps CI noise out."""
+        t0 = time.time()
+        h = pool.spawn([sys.executable, pool._script], _env())
+        rc = h.wait(timeout=30)
+        assert rc == 0
+        assert time.time() - t0 < 2.0
+
+
+class TestZygoteFallback:
+    def test_popen_fallback_when_no_zygote(self, tmp_path):
+        script = tmp_path / "w.py"
+        script.write_text("import sys; sys.exit(3)")
+        p = ZygotePool(name="never_started")
+        h = p.spawn([sys.executable, str(script)], dict(os.environ))
+        assert isinstance(h, subprocess.Popen)
+        assert h.wait(timeout=30) == 3
+
+    def test_default_preload_list_is_backendless(self):
+        # guards the fork-safety invariant: nothing in the default
+        # preload may initialize a jax backend (the server refuses to
+        # serve if one did — this just pins the list's intent)
+        assert "jax" in DEFAULT_PRELOAD
+        for mod in DEFAULT_PRELOAD:
+            assert "xla_bridge" not in mod
+
+
+class TestZygoteDeath:
+    def test_exit_record_survives_zygote_death(self, tmp_path):
+        """A worker that completes cleanly AFTER its zygote died must
+        not be reported as failed: the child's own exit record is the
+        fallback truth source."""
+        sockdir = tmp_path / "socks"
+        old = os.environ.get("DLROVER_TPU_SOCKET_DIR")
+        os.environ["DLROVER_TPU_SOCKET_DIR"] = str(sockdir)
+        try:
+            script = tmp_path / "slow_ok.py"
+            script.write_text(
+                "import time, sys\ntime.sleep(1.5)\nsys.exit(0)\n"
+            )
+            p = ZygotePool(name="death_zyg", preload=())
+            assert p.start(wait=True)
+            h = p.spawn(
+                [sys.executable, str(script)], dict(os.environ)
+            )
+            assert isinstance(h, ZygoteHandle)
+            # kill the zygote while the worker is still running
+            p._proc.kill()
+            p._proc.wait()
+            assert h.poll() is None  # worker alive (os.kill probe)
+            rc = h.wait(timeout=30)
+            assert rc == 0, f"clean orphan completion reported {rc}"
+        finally:
+            p.close()
+            if old is None:
+                os.environ.pop("DLROVER_TPU_SOCKET_DIR", None)
+            else:
+                os.environ["DLROVER_TPU_SOCKET_DIR"] = old
+
+    def test_orphan_signal_death_is_failure(self, tmp_path):
+        sockdir = tmp_path / "socks2"
+        old = os.environ.get("DLROVER_TPU_SOCKET_DIR")
+        os.environ["DLROVER_TPU_SOCKET_DIR"] = str(sockdir)
+        try:
+            script = tmp_path / "sleep.py"
+            script.write_text("import time\ntime.sleep(60)\n")
+            p = ZygotePool(name="death_zyg2", preload=())
+            assert p.start(wait=True)
+            h = p.spawn(
+                [sys.executable, str(script)], dict(os.environ)
+            )
+            assert isinstance(h, ZygoteHandle)
+            p._proc.kill()
+            p._proc.wait()
+            os.kill(h.pid, signal.SIGKILL)  # abnormal death, no record
+            rc = h.wait(timeout=30)
+            assert rc == ZygotePool.ORPHAN_EXIT
+        finally:
+            p.close()
+            if old is None:
+                os.environ.pop("DLROVER_TPU_SOCKET_DIR", None)
+            else:
+                os.environ["DLROVER_TPU_SOCKET_DIR"] = old
