@@ -32,6 +32,7 @@ numbers represent (the reference publishes goodput, not MFU, so parity
 is "reference-class utilization").
 """
 
+import os
 import argparse
 import json
 import sys
@@ -222,6 +223,27 @@ def _run_candidate(
     peak, chip = _chip_peak_flops(jax.devices()[0])
     peak_total = peak * len(jax.devices())
 
+    # runtime per-op timing (xpu_timer analog): trace 2 steps, report
+    # time shares by HLO category + GEMM clusters by shape.  Gated off
+    # on CPU (no device op tracks) and by BENCH_OP_TRACE=0.
+    op_time = None
+    if (
+        jax.default_backend() == "tpu"
+        and os.environ.get("BENCH_OP_TRACE", "1") != "0"
+    ):
+        try:
+            from dlrover_tpu.observability.trace import (
+                capture_op_profile,
+            )
+
+            report = capture_op_profile(
+                fns.train_step, state, batch_dict, steps=2, warmup=0
+            )
+            if report.total_device_us:
+                op_time = report.summary(top_k=5)
+        except Exception as e:  # noqa: BLE001 - observability only
+            print(f"op trace capture failed: {e}", file=sys.stderr)
+
     destroy_parallel_mesh()
     return {
         "config": name,
@@ -246,6 +268,7 @@ def _run_candidate(
         "peak_tflops": round(peak / 1e12, 1),
         "optimizer": optimizer,
         "backend": jax.default_backend(),
+        "op_time": op_time,
     }
 
 

@@ -158,6 +158,17 @@ class MasterClient:
             msg.NodeCheckpointState(step=step)
         )
 
+    def brain_query(self, kind: str = "speed", job: str = "default",
+                    limit: int = 100):
+        """Query the master's durable Brain datastore; returns the
+        payload dict, or None when no datastore is configured."""
+        res = self._channel.get(
+            msg.BrainQueryRequest(kind=kind, job=job, limit=limit)
+        )
+        if res is None or not getattr(res, "available", False):
+            return None
+        return res.payload
+
     # ------------------------------------------------------------ KV store
     def kv_store_set(self, key: str, value: bytes) -> bool:
         return self._channel.report(msg.KeyValuePair(key=key, value=value))

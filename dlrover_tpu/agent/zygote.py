@@ -236,6 +236,15 @@ class ZygoteServer:
 
     def _spawn(self, argv: Sequence[str], env: Dict[str, str]) -> int:
         pid = os.fork()
+        if pid != 0:
+            # the kernel recycles pids: stale exit state recorded for a
+            # PREVIOUS child under this pid would make poll report the
+            # old exit code for the live worker
+            self._exit_codes.pop(pid, None)
+            try:
+                os.unlink(os.path.join(self._exit_dir, str(pid)))
+            except OSError:
+                pass
         if pid == 0:
             code = 1
             try:

@@ -429,6 +429,25 @@ class SucceededRequest(Message):
     pass
 
 
+@dataclass
+class BrainQueryRequest(Message):
+    """Query the master's durable Brain datastore (speed history /
+    node events / measured workloads) — the TPU analog of the Go
+    Brain's query RPCs over its MySQL recorders."""
+
+    kind: str = "speed"  # speed | node_events | workloads
+    job: str = "default"
+    limit: int = 100
+
+
+@dataclass
+class BrainQueryResponse(Message):
+    # speed: {worker_count: records_per_sec}; node_events: list of
+    # dicts; workloads: list of workload-signature strings
+    payload: Dict = field(default_factory=dict)
+    available: bool = False  # False = no datastore configured
+
+
 # --------------------------------------------------------------------------
 # scale plans (master -> scaler; also CRD-shaped for the k8s path)
 # --------------------------------------------------------------------------

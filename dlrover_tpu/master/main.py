@@ -20,15 +20,27 @@ def parse_master_args(argv=None):
                         choices=["local", "k8s", "ray"])
     parser.add_argument("--job_name", default="local-job")
     parser.add_argument("--pending_timeout", type=int, default=900)
+    parser.add_argument(
+        "--brain_db", default="",
+        help="sqlite path for the durable Brain datastore (speed "
+        "history, strategy calibration, node events survive master "
+        "restarts); also via $DLROVER_TPU_BRAIN_DB",
+    )
     return parser.parse_args(argv)
 
 
 def run(args) -> int:
+    import os
+
     from dlrover_tpu.common.env import get_free_port
     from dlrover_tpu.master.master import (
         DistributedJobMaster,
         LocalJobMaster,
     )
+
+    if args.brain_db:
+        os.environ["DLROVER_TPU_BRAIN_DB"] = args.brain_db
+    os.environ.setdefault("DLROVER_TPU_JOB_NAME", args.job_name)
 
     port = args.port or get_free_port()
     if args.platform == "local":

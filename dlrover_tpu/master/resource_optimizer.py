@@ -252,10 +252,35 @@ class LocalAllreduceOptimizer(ResourceOptimizer):
         max_workers: int = 1,
         min_marginal_gain: float = 0.6,
         oom_memory_factor: float = 1.5,
+        datastore=None,
+        job_name: str = "default",
     ):
+        """``datastore``/``job_name``: persist speed samples per job
+        (reference: the Go Brain's MySQL job-metrics recorders) so a
+        restarted master's WorkerResource decisions start from the
+        job's full observed speed curve, not an empty map.  Defaults
+        to the process datastore when ``DLROVER_TPU_BRAIN_DB`` is
+        set."""
+        if datastore is None:
+            from dlrover_tpu.master.datastore import (
+                get_default_datastore,
+            )
+
+            datastore = get_default_datastore()
+        self._datastore = datastore
+        self._job_name = job_name
         self._min = min_workers
         self._max = max_workers
         self._samples: Dict[int, float] = {}
+        if datastore is not None:
+            try:
+                self._samples.update(
+                    datastore.speed_history(job_name)
+                )
+            except Exception as e:  # noqa: BLE001
+                logger.warning(
+                    "speed-history restore failed: %s", e
+                )
         self._current_workers = 0
         self._stragglers: List[str] = []
         self._oom_nodes: Dict[str, int] = {}
@@ -274,6 +299,13 @@ class LocalAllreduceOptimizer(ResourceOptimizer):
         prev = self._samples.get(worker_num, 0.0)
         self._samples[worker_num] = max(prev, records_per_sec)
         self._current_workers = worker_num
+        if self._datastore is not None and records_per_sec > prev:
+            try:
+                self._datastore.record_speed(
+                    self._job_name, worker_num, records_per_sec
+                )
+            except Exception as e:  # noqa: BLE001
+                logger.warning("speed persist failed: %s", e)
 
     def set_current_workers(self, worker_num: int):
         if worker_num > 0:

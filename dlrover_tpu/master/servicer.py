@@ -94,8 +94,36 @@ class MasterServicer:
             return msg.ClusterVersion()
         if isinstance(request, msg.ElasticRunConfigRequest):
             return msg.ElasticRunConfig()
+        if isinstance(request, msg.BrainQueryRequest):
+            return self._brain_query(request)
         logger.warning("unhandled get request: %r", request)
         return None
+
+    def _brain_query(
+        self, request: msg.BrainQueryRequest
+    ) -> msg.BrainQueryResponse:
+        from dlrover_tpu.master.datastore import get_default_datastore
+
+        store = get_default_datastore()
+        if store is None:
+            return msg.BrainQueryResponse(available=False)
+        if request.kind == "speed":
+            payload = {
+                "speed": store.speed_history(request.job)
+            }
+        elif request.kind == "node_events":
+            payload = {
+                "events": store.node_events(
+                    request.job, limit=request.limit
+                )
+            }
+        elif request.kind == "workloads":
+            payload = {"workloads": store.measured_workloads()}
+        else:
+            return msg.BrainQueryResponse(available=False)
+        return msg.BrainQueryResponse(
+            payload=payload, available=True
+        )
 
     def _get_task(self, node_id: int, request: msg.TaskRequest) -> msg.Task:
         if not self._start_training_time:

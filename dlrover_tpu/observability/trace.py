@@ -1,0 +1,295 @@
+"""Runtime per-op timing: capture + parse jax.profiler chrome traces.
+
+Reference parity: the xpu_timer's core is MEASURED per-kernel and
+per-collective time on the running job
+(``atorch/dev/xpu_timer/xpu_timer/nvidia/hook.cc:111`` intercepts
+kernel launches; ``common/manager.h:201`` clusters GEMMs), plus the
+offline trace analyser ``atorch/atorch/utils/parse_trace_json.py``
+(chrome trace -> per-op aggregation).  The TPU design needs no
+LD_PRELOAD hook: XLA already stamps every HLO op's device time into
+the ``jax.profiler`` trace (``*.trace.json.gz``, chrome format) with
+its HLO category, FLOPs, bytes accessed, and shape — this module turns
+that into the same actionable report: time share by category, GEMM
+clusters by shape with achieved TFLOP/s, collective time, step time.
+
+Use ``capture_op_profile(step_fn, args)`` on a live job/bench, or
+``parse_trace(path)`` on a recorded trace directory.
+"""
+
+import glob
+import gzip
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.common.log import default_logger as logger
+
+# hlo_category values seen on TPU: "loop fusion", "fusion",
+# "convolution", "data formatting", "copy", "all-reduce", ...
+_COLLECTIVE_RE = re.compile(
+    r"all-reduce|all-gather|reduce-scatter|all-to-all|"
+    r"collective|permute|send|recv",
+    re.IGNORECASE,
+)
+# the MXU ops: TPU lowers dots to convolutions, so both count
+_GEMM_RE = re.compile(r"convolution|dot|matmul", re.IGNORECASE)
+
+# control-flow CONTAINERS whose duration spans their body ops (a scan
+# layer-loop "while" holds ~50% of wall time and every op inside it is
+# also emitted individually) — counting them would double-book
+_CONTAINER_CATEGORIES = frozenset(
+    {"while", "conditional", "call", "control-flow"}
+)
+
+
+@dataclass
+class OpAggregate:
+    key: str
+    category: str
+    time_us: float = 0.0
+    count: int = 0
+    flops: float = 0.0
+    bytes_accessed: float = 0.0
+    example: str = ""  # one representative op name
+    source: str = ""
+
+    @property
+    def tflops_per_sec(self) -> float:
+        return (
+            self.flops / (self.time_us * 1e6)
+            if self.time_us > 0
+            else 0.0
+        )
+
+
+@dataclass
+class TraceReport:
+    """Parsed per-op device-time report for one trace."""
+
+    total_device_us: float = 0.0
+    step_count: int = 0
+    mean_step_us: float = 0.0
+    by_category: Dict[str, float] = field(default_factory=dict)
+    gemm_clusters: List[OpAggregate] = field(default_factory=list)
+    collectives: List[OpAggregate] = field(default_factory=list)
+    top_ops: List[OpAggregate] = field(default_factory=list)
+    device: str = ""
+
+    def summary(self, top_k: int = 10) -> dict:
+        """JSON-ready digest (bench extras / exporter payload)."""
+        total = self.total_device_us or 1.0
+
+        def row(a: OpAggregate) -> dict:
+            return {
+                "key": a.key,
+                "time_us": round(a.time_us, 1),
+                "share": round(a.time_us / total, 4),
+                "count": a.count,
+                "tflops_per_sec": round(a.tflops_per_sec, 2),
+                "example": a.example,
+                "source": a.source,
+            }
+
+        return {
+            "total_device_us": round(self.total_device_us, 1),
+            "steps": self.step_count,
+            "mean_step_us": round(self.mean_step_us, 1),
+            "category_share": {
+                k: round(v / total, 4)
+                for k, v in sorted(
+                    self.by_category.items(),
+                    key=lambda kv: -kv[1],
+                )
+            },
+            "gemm_clusters": [
+                row(a) for a in self.gemm_clusters[:top_k]
+            ],
+            "collectives": [
+                row(a) for a in self.collectives[:top_k]
+            ],
+            "top_ops": [row(a) for a in self.top_ops[:top_k]],
+        }
+
+    def export_to_registry(self, registry, top_k: int = 5):
+        """Mirror the report onto a MetricsRegistry: category shares
+        and the top GEMM clusters' achieved TFLOP/s as gauges the C++
+        exporter serves (xpu_timer's Prometheus surface)."""
+        total = self.total_device_us or 1.0
+        for cat, us in self.by_category.items():
+            name = re.sub(r"[^a-zA-Z0-9]+", "_", cat).strip("_")
+            registry.set_gauge(f"optime_share_{name}", us / total)
+        for i, a in enumerate(self.gemm_clusters[:top_k]):
+            registry.set_gauge(
+                f"gemm_cluster_{i}_tflops", a.tflops_per_sec
+            )
+            registry.set_gauge(
+                f"gemm_cluster_{i}_share", a.time_us / total
+            )
+        if self.mean_step_us:
+            registry.set_gauge(
+                "traced_step_time_us", self.mean_step_us
+            )
+
+
+def _find_trace_file(path: str) -> str:
+    """Accept a trace file, a profile dir, or a jax.profiler log dir
+    (searches for the newest ``*.trace.json.gz``)."""
+    if os.path.isfile(path):
+        return path
+    candidates = sorted(
+        glob.glob(
+            os.path.join(path, "**", "*.trace.json*"), recursive=True
+        )
+    )
+    if not candidates:
+        raise FileNotFoundError(f"no chrome trace under {path}")
+    return candidates[-1]
+
+
+def _load_events(trace_file: str) -> List[dict]:
+    opener = gzip.open if trace_file.endswith(".gz") else open
+    with opener(trace_file, "rb") as f:
+        raw = json.loads(f.read())
+    if isinstance(raw, list):  # bare-array chrome format
+        return raw
+    return raw.get("traceEvents", [])
+
+
+def _shape_key(args: dict, name: str) -> str:
+    shape = args.get("shape_with_layout", "")
+    # strip tiling/memory annotations: cluster by logical shape
+    shape = re.sub(r"\{[^}]*\}", "", shape)
+    if shape:
+        return shape
+    return re.sub(r"\.\d+$", "", name)  # dot.42 -> dot
+
+
+def parse_trace(path: str, device_prefix: str = "/device:") -> TraceReport:
+    """Chrome trace -> :class:`TraceReport`.
+
+    Aggregates X (complete) events on device-process "XLA Ops" tracks;
+    steps come from the "XLA Modules" track.  Works on any backend
+    that emits device tracks (TPU does; CPU traces carry only host
+    events and yield an empty report rather than an error).
+    """
+    trace_file = _find_trace_file(path)
+    events = _load_events(trace_file)
+    pids: Dict[int, str] = {}
+    tids: Dict[Tuple[int, int], str] = {}
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name":
+            pids[e["pid"]] = e.get("args", {}).get("name", "")
+        elif e.get("name") == "thread_name":
+            tids[(e["pid"], e.get("tid"))] = e.get("args", {}).get(
+                "name", ""
+            )
+
+    report = TraceReport()
+    ops: Dict[str, OpAggregate] = {}
+    step_durs: List[float] = []
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        pname = pids.get(e.get("pid"), "")
+        if not pname.startswith(device_prefix):
+            continue
+        report.device = report.device or pname
+        tname = tids.get((e.get("pid"), e.get("tid")), "")
+        dur = float(e.get("dur", 0.0))
+        if tname.startswith("XLA Modules"):
+            step_durs.append(dur)
+            continue
+        if not tname.startswith("XLA Ops"):
+            continue
+        args = e.get("args", {}) or {}
+        name = e.get("name", "?")
+        category = args.get("hlo_category", "") or "uncategorized"
+        if category in _CONTAINER_CATEGORIES:
+            continue  # body ops are emitted individually
+        report.total_device_us += dur
+        report.by_category[category] = (
+            report.by_category.get(category, 0.0) + dur
+        )
+        key = f"{category}|{_shape_key(args, name)}"
+        agg = ops.get(key)
+        if agg is None:
+            agg = ops[key] = OpAggregate(
+                key=_shape_key(args, name),
+                category=category,
+                example=name,
+                source=args.get("source", ""),
+            )
+        agg.time_us += dur
+        agg.count += 1
+        try:
+            agg.flops += float(args.get("model_flops", 0) or 0)
+        except (TypeError, ValueError):
+            pass
+        try:
+            agg.bytes_accessed += float(
+                args.get("raw_bytes_accessed", 0) or 0
+            )
+        except (TypeError, ValueError):
+            pass
+
+    by_time = sorted(ops.values(), key=lambda a: -a.time_us)
+    report.top_ops = by_time
+    report.gemm_clusters = [
+        a
+        for a in by_time
+        if _GEMM_RE.search(a.category)
+        or _GEMM_RE.search(a.example)
+    ]
+    report.collectives = [
+        a
+        for a in by_time
+        if _COLLECTIVE_RE.search(a.category)
+        or _COLLECTIVE_RE.search(a.example)
+    ]
+    report.step_count = len(step_durs)
+    if step_durs:
+        report.mean_step_us = sum(step_durs) / len(step_durs)
+    if not report.total_device_us:
+        logger.warning(
+            "trace %s has no device op events (CPU backend?)",
+            trace_file,
+        )
+    return report
+
+
+def capture_op_profile(
+    step_fn,
+    *args,
+    steps: int = 3,
+    trace_dir: Optional[str] = None,
+    warmup: int = 1,
+) -> TraceReport:
+    """Run ``step_fn(*args)`` ``steps`` times under the profiler and
+    parse the result.  The carry convention matches train steps:
+    when ``step_fn`` returns a tuple whose first element has the same
+    structure as ``args[0]``, it is threaded through."""
+    import tempfile
+
+    import jax
+
+    d = trace_dir or tempfile.mkdtemp(prefix="dlrover_optrace_")
+    carry = args
+
+    def one(carry):
+        out = step_fn(*carry)
+        if isinstance(out, tuple) and len(carry) > 1:
+            return (out[0],) + tuple(carry[1:])
+        return carry
+
+    for _ in range(warmup):
+        carry = one(carry)
+    jax.block_until_ready(carry)
+    with jax.profiler.trace(d):
+        for _ in range(steps):
+            carry = one(carry)
+        jax.block_until_ready(carry)
+    return parse_trace(d)

@@ -312,7 +312,9 @@ class Trainer:
         if "grad_norm" in metrics:
             record["grad_norm"] = float(metrics["grad_norm"])
         if self._lr_schedule is not None:
-            record["lr"] = float(self._lr_schedule(step))
+            # optax evaluates step_size_fn(count) BEFORE incrementing:
+            # the Nth update applied schedule(N-1)
+            record["lr"] = float(self._lr_schedule(step - 1))
         self._callbacks.on_step_end(step, record)
         if step % self._args.log_interval == 0:
             logger.info(

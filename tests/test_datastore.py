@@ -1,0 +1,202 @@
+"""Durable Brain datastore (master/datastore.py).
+
+Reference parity: ``dlrover/go/brain/pkg/datastore/`` +
+``dbbase/recorder.go:280`` — job metrics persisted so optimization
+learns across (master) restarts.  The restart scenario is the point of
+every test here: state written by one instance must be served by a
+FRESH instance over the same sqlite file.
+"""
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.accelerate.analyser import ModelProfile
+from dlrover_tpu.accelerate.engine_service import (
+    StrategyMeasurement,
+    StrategyRequest,
+    StrategyService,
+)
+from dlrover_tpu.master.datastore import (
+    BrainDatastore,
+    workload_signature,
+)
+from dlrover_tpu.master.resource_optimizer import (
+    LocalAllreduceOptimizer,
+)
+
+
+@pytest.fixture()
+def db_path(tmp_path):
+    return str(tmp_path / "brain.db")
+
+
+class TestBrainDatastore:
+    def test_speed_history_roundtrip(self, db_path):
+        ds = BrainDatastore(db_path)
+        ds.record_speed("job-a", 4, 100.0)
+        ds.record_speed("job-a", 4, 120.0)
+        ds.record_speed("job-a", 8, 180.0)
+        ds.record_speed("job-b", 2, 50.0)
+        ds.close()
+        ds2 = BrainDatastore(db_path)  # "restarted master"
+        assert ds2.speed_history("job-a") == {4: 120.0, 8: 180.0}
+        assert ds2.speed_history("job-b") == {2: 50.0}
+        ds2.close()
+
+    def test_measurements_newest_limit(self, db_path):
+        ds = BrainDatastore(db_path)
+        key = workload_signature((1, 2, 3))
+        for i in range(10):
+            ds.record_measurement(key, {"data": i}, 1.0 + i)
+        got = ds.load_measurements(key, limit=4)
+        assert [s["data"] for s, _ in got] == [6, 7, 8, 9]
+        assert key in ds.measured_workloads()
+        ds.close()
+
+    def test_node_events_ordered(self, db_path):
+        ds = BrainDatastore(db_path)
+        ds.record_node_event("job", "worker-0", "process_error", "oom")
+        ds.record_node_event("job", "worker-1", "node_error", "hang")
+        events = ds.node_events("job")
+        assert len(events) == 2
+        assert events[0]["node"] == "worker-1"  # newest first
+        ds.close()
+
+    def test_prune(self, db_path):
+        ds = BrainDatastore(db_path)
+        ds.record_speed("job", 2, 10.0)
+        ds.prune(max_age_s=0.0)  # everything is older than "now - 0"
+        assert ds.speed_history("job") == {}
+        ds.close()
+
+
+def _profile_request(**kw):
+    base = dict(
+        num_params=10_000_000,
+        param_bytes=40_000_000,
+        optimizer_bytes=80_000_000,
+        activation_bytes_per_sample=1_000_000,
+        num_layers=8,
+        n_devices=8,
+        batch_per_replica=4,
+        seq_len=512,
+    )
+    base.update(kw)
+    return StrategyRequest(**base)
+
+
+class TestStrategyServiceDurability:
+    def test_calibration_survives_restart(self, db_path):
+        """Kill/restart the strategy brain: a FRESH service over the
+        same datastore file must still rank calibrated=True from the
+        old fleet's measurements (VERDICT-r3 missing #2)."""
+        ds = BrainDatastore(db_path)
+        svc = StrategyService(datastore=ds)
+        req = _profile_request()
+        first = svc.generate(req)
+        assert not first.calibrated  # nothing measured yet
+        # the fleet reports timings for two candidates
+        for kw, t in [
+            (first.candidates[0], 0.5),
+            (first.candidates[-1], 2.0),
+        ]:
+            svc.record(
+                StrategyMeasurement(
+                    num_params=req.num_params,
+                    param_bytes=req.param_bytes,
+                    optimizer_bytes=req.optimizer_bytes,
+                    activation_bytes_per_sample=(
+                        req.activation_bytes_per_sample
+                    ),
+                    num_layers=req.num_layers,
+                    batch_per_replica=req.batch_per_replica,
+                    seq_len=req.seq_len,
+                    strategy=dict(kw),
+                    step_time_s=t,
+                )
+            )
+        assert svc.generate(req).calibrated
+        ds.close()
+
+        # master restart: new datastore handle, new service instance
+        ds2 = BrainDatastore(db_path)
+        svc2 = StrategyService(datastore=ds2)
+        resp = svc2.generate(req)
+        assert resp.calibrated, (
+            "restarted service lost the fleet calibration"
+        )
+        ds2.close()
+
+    def test_no_datastore_still_works(self):
+        svc = StrategyService(datastore=None)
+        resp = svc.generate(_profile_request())
+        assert resp.candidates
+        assert not resp.calibrated
+
+
+class TestOptimizerDurability:
+    def test_speed_curve_survives_restart(self, db_path):
+        ds = BrainDatastore(db_path)
+        opt = LocalAllreduceOptimizer(
+            min_workers=1, max_workers=8, datastore=ds,
+            job_name="job-x",
+        )
+        opt.record_speed(2, 100.0)
+        opt.record_speed(4, 190.0)
+        ds.close()
+
+        ds2 = BrainDatastore(db_path)
+        opt2 = LocalAllreduceOptimizer(
+            min_workers=1, max_workers=8, datastore=ds2,
+            job_name="job-x",
+        )
+        # the restarted optimizer starts from the full speed curve
+        assert opt2._samples == {2: 100.0, 4: 190.0}
+        ds2.close()
+
+    def test_other_jobs_history_isolated(self, db_path):
+        ds = BrainDatastore(db_path)
+        opt = LocalAllreduceOptimizer(
+            datastore=ds, job_name="job-1"
+        )
+        opt.record_speed(2, 10.0)
+        opt_b = LocalAllreduceOptimizer(
+            datastore=ds, job_name="job-2"
+        )
+        assert opt_b._samples == {}
+        ds.close()
+
+
+class TestServicerBrainQuery:
+    def test_query_over_rpc(self, db_path, monkeypatch):
+        """The full wire path: datastore -> servicer dispatch ->
+        MasterClient.brain_query."""
+        import dlrover_tpu.master.datastore as ds_mod
+        from dlrover_tpu.agent.master_client import MasterClient
+        from dlrover_tpu.common.env import get_free_port
+        from dlrover_tpu.master.servicer import (
+            MasterServicer,
+            create_master_service,
+        )
+
+        monkeypatch.setenv("DLROVER_TPU_BRAIN_DB", db_path)
+        monkeypatch.setattr(ds_mod, "_default_store", None)
+        store = ds_mod.get_default_datastore()
+        store.record_speed("default", 4, 99.0)
+        store.record_node_event("default", "worker-3", "oom", "16GB")
+
+        servicer = MasterServicer()
+        port = get_free_port()
+        server = create_master_service(port, servicer)
+        server.start()
+        try:
+            client = MasterClient(f"127.0.0.1:{port}", node_id=0)
+            speed = client.brain_query(kind="speed")
+            assert speed == {"speed": {4: 99.0}}
+            events = client.brain_query(kind="node_events")
+            assert events["events"][0]["node"] == "worker-3"
+            assert client.brain_query(kind="nonsense") is None
+        finally:
+            server.stop(0)
+            store.close()
+            monkeypatch.setattr(ds_mod, "_default_store", None)

@@ -210,7 +210,9 @@ class TestEvaluate:
         assert [s for s, _ in rec.steps] == list(range(1, 7))
         for step, m in rec.steps:
             assert np.isfinite(m["loss"])
-            assert m["lr"] == pytest.approx(float(schedule(step)))
+            # optax applies schedule(count) pre-increment: the Nth
+            # step's applied LR is schedule(N-1)
+            assert m["lr"] == pytest.approx(float(schedule(step - 1)))
         # eval fired at the cadence (final eval at 6 + the final-save
         # path doesn't re-run eval)
         assert [s for s, _ in rec.evals] == [3, 6]
@@ -276,5 +278,5 @@ class TestSchedulerResume:
         t2._callbacks.callbacks.append(rec)
         t2.train()
         for step, m in rec.steps:
-            assert m["lr"] == pytest.approx(float(schedule(step)))
+            assert m["lr"] == pytest.approx(float(schedule(step - 1)))
         assert [s for s, _ in rec.steps] == [5, 6, 7, 8]
