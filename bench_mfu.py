@@ -121,6 +121,19 @@ def _candidates(on_tpu: bool):
               n_layers=16, mlp_dim=5504, remat="full",
               ce_chunk_rows=512),
          8, 2048, 10, "int8"),
+        # host-offload proof: ~1.75B params on one 16 GB chip — bf16
+        # compute params in HBM, fp32 master+moments in the TPU host's
+        # RAM as pinned_host chunks (optimizers/host_offload.py; ref
+        # adam_offload.py).  fp32 resident state alone (28 GB) would
+        # be ~2x HBM.  Measured r4: 5.0 s/step, MFU 0.19 — the
+        # op_time report attributes ~59% of device time to the 24
+        # B/param/step chunk DMA at ~14 GB/s (PCIe-bound, as the
+        # reference's offload is); the proof is FITTING, not speed.
+        ("llama-1.8b-offload",
+         dict(common, dim=2048, n_heads=16, n_kv_heads=16,
+              n_layers=32, mlp_dim=5504, remat="full",
+              ce_chunk_rows=512),
+         8, 2048, 6, "offload"),
     ]
 
 
@@ -148,28 +161,52 @@ def _run_candidate(
 
     cfg = LlamaConfig(**cfg_kwargs)
     destroy_parallel_mesh()
-    ctx = create_parallel_mesh(
-        [(AxisName.DATA, len(jax.devices()))],
-        devices=jax.devices(),
-    )
-    rules = default_rules(fsdp=False)
-    if optimizer == "int8":
-        from dlrover_tpu.optimizers import quantized_moments
+    if optimizer == "offload":
+        # host-offload path: single-chip by design (no mesh — on pods
+        # the state shards over fsdp instead); bf16 params in HBM,
+        # fp32 master/moments in host DRAM, streamed chunk updates
+        from dlrover_tpu.optimizers.host_offload import (
+            HostOffloadAdamW,
+            build_offloaded_train_step,
+        )
 
-        opt = quantized_moments(3e-4)
+        init_state_fn, offload_step = build_offloaded_train_step(
+            lambda p, b: loss_fn(p, b, cfg),
+            lambda rng: init_params(rng, cfg),
+            HostOffloadAdamW(learning_rate=3e-4),
+        )
+        state = init_state_fn(jax.random.PRNGKey(0))
+        jax.block_until_ready(state.params)
+        n_params = count_params(state.params)
+
+        class _OffloadFns:
+            train_step = staticmethod(offload_step)
+            batch_sharding = None
+
+        fns = _OffloadFns()
     else:
-        opt = optax.adamw(3e-4)
-    fns = build_train_step(
-        loss_fn=lambda p, b: loss_fn(p, b, cfg),
-        optimizer=opt,
-        init_params_fn=lambda rng: init_params(rng, cfg),
-        param_axes=param_logical_axes(cfg),
-        mesh_ctx=ctx,
-        rules=rules,
-    )
-    state = fns.init_state(jax.random.PRNGKey(0))
-    jax.block_until_ready(state)
-    n_params = count_params(state["params"])
+        ctx = create_parallel_mesh(
+            [(AxisName.DATA, len(jax.devices()))],
+            devices=jax.devices(),
+        )
+        rules = default_rules(fsdp=False)
+        if optimizer == "int8":
+            from dlrover_tpu.optimizers import quantized_moments
+
+            opt = quantized_moments(3e-4)
+        else:
+            opt = optax.adamw(3e-4)
+        fns = build_train_step(
+            loss_fn=lambda p, b: loss_fn(p, b, cfg),
+            optimizer=opt,
+            init_params_fn=lambda rng: init_params(rng, cfg),
+            param_axes=param_logical_axes(cfg),
+            mesh_ctx=ctx,
+            rules=rules,
+        )
+        state = fns.init_state(jax.random.PRNGKey(0))
+        jax.block_until_ready(state)
+        n_params = count_params(state["params"])
 
     tokens = jax.device_put(
         jax.random.randint(
@@ -327,14 +364,24 @@ def run_mfu() -> dict:
     if headline is None:
         raise RuntimeError(f"all candidates failed: {last_err}")
     if on_tpu:
-        # attach the largest-model proof (int8-moment optimizer)
+        # attach the scale proofs: the largest int8-moment config that
+        # fits, PLUS the host-offload config (different mechanism —
+        # both are part of the single-chip scale story)
+        proofs = []
+        seen_opts = set()
         for idx, cand in enumerate(cands):
             if len(cand) <= 5:
                 continue
+            opt_kind = cand[5]
+            if opt_kind in seen_opts:
+                continue  # first (largest) success per mechanism
             result, _err = run_one(idx)
             if result is not None:
-                headline["scale_proof"] = result
-                break
+                proofs.append(result)
+                seen_opts.add(opt_kind)
+        if proofs:
+            headline["scale_proof"] = proofs[0]
+            headline["scale_proofs"] = proofs
     return headline
 
 

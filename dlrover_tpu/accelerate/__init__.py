@@ -15,3 +15,7 @@ from dlrover_tpu.accelerate.bayes_search import (  # noqa: F401
 from dlrover_tpu.accelerate.dim_planner import (  # noqa: F401
     CalibratedPlanner,
 )
+from dlrover_tpu.accelerate.solver import (  # noqa: F401
+    JointPlan,
+    solve as solve_joint_plan,
+)

@@ -57,7 +57,10 @@ class AllreduceAutoScaler:
     def _collect_speed(self):
         if self._speed_monitor is None:
             return
-        speed = self._speed_monitor.running_speed
+        # running_speed is a METHOD — the bare attribute compared >0
+        # raised TypeError every cycle, silently eaten by the loop's
+        # catch-all (caught by the autoscale e2e test)
+        speed = self._speed_monitor.running_speed()
         worker_num = 0
         if self._job_manager is not None:
             worker_num = len(self._job_manager.get_running_nodes())

@@ -30,6 +30,43 @@ SCALEPLAN_PLURAL = "scaleplans"
 MASTER_SUFFIX = "-dlrover-master"
 
 
+def update_condition(
+    status: Dict,
+    cond_type: str,
+    cond_status: bool,
+    reason: str = "",
+    message: str = "",
+) -> Dict:
+    """Maintain a k8s-style conditions list on a CRD status
+    (reference ``dlrover/go/operator/pkg/common/condition.go`` —
+    ``setCondition``/``updateJobConditions``): one entry per type,
+    ``lastTransitionTime`` touched only when the boolean status
+    actually flips."""
+    now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    want = "True" if cond_status else "False"
+    conditions = list(status.get("conditions") or [])
+    for cond in conditions:
+        if cond.get("type") == cond_type:
+            if cond.get("status") != want:
+                cond["lastTransitionTime"] = now
+            cond.update(
+                status=want, reason=reason, message=message
+            )
+            break
+    else:
+        conditions.append(
+            {
+                "type": cond_type,
+                "status": want,
+                "reason": reason,
+                "message": message,
+                "lastTransitionTime": now,
+            }
+        )
+    status["conditions"] = conditions
+    return status
+
+
 def _pod_resource(node_spec: Dict) -> Optional[Dict]:
     """Resource hints out of an optimizer node spec ({"type", "memory"
     (MB), "cpu", ...}) — non-resource keys dropped."""
@@ -157,10 +194,12 @@ class ElasticJobController:
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # plans already applied (or attempted) by THIS controller,
-        # keyed by (name, uid) -> outcome phase: a failed status patch
-        # must retry only the patch, and a mid-apply failure must not
-        # re-execute creates with fresh worker ids every resync
-        self._applied_plans: Dict[tuple, str] = {}
+        # keyed by (name, uid) -> [outcome phase, patched?]: a failed
+        # status patch must retry only the patch (then stop — endless
+        # re-patching would churn the CR with watch events every
+        # resync), and a mid-apply failure must not re-execute creates
+        # with fresh worker ids
+        self._applied_plans: Dict[tuple, list] = {}
 
     # -- ElasticJob ------------------------------------------------------
     def reconcile_elasticjob(self, job: Dict):
@@ -176,9 +215,18 @@ class ElasticJobController:
         if master_name not in pods:
             logger.info("reconcile ElasticJob %s: creating master", name)
             self._client.create_pod(master_pod_manifest(job))
-            self._set_status(
-                ELASTICJOB_PLURAL, name, {"phase": "Running"}
+            status = dict(job.get("status") or {})
+            status["phase"] = "Running"
+            update_condition(
+                status, "MasterCreated", True,
+                reason="MasterPodCreated",
+                message=f"master pod {master_name} created",
             )
+            update_condition(
+                status, "Running", True, reason="JobRunning",
+                message="job master is supervising the job",
+            )
+            self._set_status(ELASTICJOB_PLURAL, name, status)
 
     # -- ScalePlan -------------------------------------------------------
     def reconcile_scaleplan(self, plan: Dict):
@@ -199,11 +247,12 @@ class ElasticJobController:
         if status.get("phase") in ("Succeeded", "Failed"):
             return
         if plan_key in self._applied_plans:
-            # applied but the status patch failed: retry only the patch
-            self._set_status(
-                SCALEPLAN_PLURAL, name,
-                {"phase": self._applied_plans[plan_key]},
-            )
+            entry = self._applied_plans[plan_key]
+            if not entry[1]:  # applied but the status patch failed
+                entry[1] = self._set_status(
+                    SCALEPLAN_PLURAL, name,
+                    self._plan_status(entry[0], status),
+                )
             return
         spec = plan.get("spec", {})
         owner = spec.get("ownerJob", "")
@@ -215,7 +264,7 @@ class ElasticJobController:
         # worker ids every resync (unbounded pod growth); a partially-
         # applied plan is surfaced as Failed instead of silently
         # retried
-        self._applied_plans[plan_key] = "Failed"
+        self._applied_plans[plan_key] = ["Failed", False]
 
         # replica targets: diff current worker pods against the target
         replica_specs = spec.get("replicaResourceSpecs", {}) or {}
@@ -252,8 +301,35 @@ class ElasticJobController:
                 )
             )
             self._delete_quietly(old_name)
-        self._applied_plans[plan_key] = "Succeeded"
-        self._set_status(SCALEPLAN_PLURAL, name, {"phase": "Succeeded"})
+        patched = self._set_status(
+            SCALEPLAN_PLURAL, name,
+            self._plan_status("Succeeded", status),
+        )
+        self._applied_plans[plan_key] = ["Succeeded", patched]
+
+    @staticmethod
+    def _plan_status(phase: str, existing: Optional[Dict] = None) -> Dict:
+        """ScalePlan status with a condition trail (ref
+        ``scaleplan_types.go:29-126`` phase + conditions).  Starts
+        from the CR's EXISTING status so ``lastTransitionTime`` only
+        moves when the condition actually flips."""
+        status: Dict = dict(existing or {})
+        status["phase"] = phase
+        update_condition(
+            status, "Applied", phase == "Succeeded",
+            reason=(
+                "PlanApplied"
+                if phase == "Succeeded"
+                else "PlanApplyFailed"
+            ),
+            message=(
+                "all creates/removes/migrations executed"
+                if phase == "Succeeded"
+                else "plan application did not complete; pods may be "
+                "partially scaled"
+            ),
+        )
+        return status
 
     def _worker_template(self, job_name: str) -> Optional[Dict]:
         """The owner ElasticJob's worker pod template (workers must run
@@ -353,13 +429,17 @@ class ElasticJobController:
             return []
         return list(out.get("items", []))
 
-    def _set_status(self, plural: str, name: str, status: Dict):
+    def _set_status(
+        self, plural: str, name: str, status: Dict
+    ) -> bool:
         try:
             self._client.update_custom_resource_status(
                 GROUP, VERSION, plural, name, {"status": status}
             )
+            return True
         except Exception as e:  # noqa: BLE001
             logger.warning("status update failed for %s: %s", name, e)
+            return False
 
     def _pods_by_name(self, selector: str) -> Dict[str, Dict]:
         pods = self._client.list_pods(selector)

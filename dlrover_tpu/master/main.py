@@ -46,8 +46,21 @@ def run(args) -> int:
     if args.platform == "local":
         master = LocalJobMaster(port, args.node_num)
     else:
+        # platform-appropriate scaler: without one, BOTH the relaunch
+        # path and the autoscale cycle are observers only
+        if args.platform == "k8s":
+            from dlrover_tpu.master.scaler import ElasticJobScaler
+
+            scaler = ElasticJobScaler(args.job_name)
+        else:
+            # ray masters get their ActorScaler from the ray
+            # scheduler layer (needs a live client); no default here
+            scaler = None
         master = DistributedJobMaster(
-            port, args.node_num, pending_timeout=args.pending_timeout
+            port,
+            args.node_num,
+            scaler=scaler,
+            pending_timeout=args.pending_timeout,
         )
     master.prepare()
     logger.info("job %s master listening on %s", args.job_name,

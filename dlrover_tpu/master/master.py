@@ -149,7 +149,8 @@ class DistributedJobMaster(JobMaster):
     SUPERVISE_INTERVAL = 30
 
     def __init__(self, port: int, node_num: int, scaler=None,
-                 diagnosis_manager=None, pending_timeout=None):
+                 diagnosis_manager=None, pending_timeout=None,
+                 autoscale: bool = True, max_workers: int = 0):
         super().__init__(
             port,
             node_num,
@@ -158,9 +159,41 @@ class DistributedJobMaster(JobMaster):
             ),
             diagnosis_manager=diagnosis_manager,
         )
+        # periodic optimize -> ScalePlan cycle (reference
+        # job_auto_scaler.py:271); the plan executes through the SAME
+        # scaler the job manager relaunches with, so a no-op scaler
+        # (local runs) makes this a cheap observer
+        self.auto_scaler = None
+        if autoscale and scaler is not None:
+            import os
+
+            from dlrover_tpu.master.auto_scaler import (
+                AllreduceAutoScaler,
+            )
+            from dlrover_tpu.master.resource_optimizer import (
+                LocalAllreduceOptimizer,
+            )
+
+            self.auto_scaler = AllreduceAutoScaler(
+                LocalAllreduceOptimizer(
+                    min_workers=node_num,
+                    max_workers=max_workers or node_num,
+                    job_name=os.getenv(
+                        "DLROVER_TPU_JOB_NAME", "default"
+                    ),
+                ),
+                scaler,
+                speed_monitor=self.speed_monitor,
+                job_manager=self.job_manager,
+                rendezvous_manager=self.rdzv_managers.get(
+                    RendezvousName.NETWORK_CHECK
+                ),
+            )
 
     def run(self) -> int:
         exit_code = 0
+        if self.auto_scaler is not None:
+            self.auto_scaler.start()
         while not self._stopped.is_set():
             if self.job_manager.all_workers_exited():
                 if self.job_manager.all_workers_failed():
@@ -187,6 +220,8 @@ class DistributedJobMaster(JobMaster):
                 break
             self.process_diagnosis()
             self._stopped.wait(self.SUPERVISE_INTERVAL)
+        if self.auto_scaler is not None:
+            self.auto_scaler.stop()
         return exit_code
 
 

@@ -1,0 +1,407 @@
+"""Host-offloaded AdamW: optimizer state lives in HOST memory.
+
+Reference parity: ``atorch/atorch/optimizers/adam_offload.py`` (309
+LoC: fp32 master params + Adam moments on the host, bucket-wise
+grad D2H / param H2D around a CPU AVX update).  A v5e chip has 16 GB
+HBM; fp32 AdamW costs 16 bytes/param of resident state (master + two
+moments) + 2 bytes of bf16 compute params — host-resident state is
+the standard lever past ~1B params/chip when int8 moments are not
+enough.
+
+TPU redesign (single-chip scale lever; on pods the same state is
+SHARDED over the fsdp axis instead — ``parallel/train_step.py``):
+
+- device holds only **bf16 compute params**; fp32 master params and
+  fp32 moments live in HOST memory (host DRAM, no HBM).
+- backward runs as one jit (bf16 params -> bf16 grads).
+- the update streams CHUNKS of (master, mu, nu, grad) through the
+  chip: H2D in, fused Adam math on device, bf16 param chunk + updated
+  fp32 chunks out.  Chunking bounds the HBM transient to
+  ``6 * chunk_bytes`` regardless of leaf size (the reference's bucket
+  loop, same reason).
+
+Two storage backends for the host state:
+
+- ``pinned_host`` (default on TPU): chunks are jax arrays with
+  ``memory_kind="pinned_host"`` — resident in the **TPU host's** RAM
+  and DMA'd over its PCIe by XLA-compiled transfer programs, with
+  donation recycling the host buffers.  This is the XLA-memories
+  redesign of the reference's cudaMemcpy bucket loop, and the only
+  correct choice when the Python client is NOT the TPU host (a
+  remote/tunnel attachment would otherwise haul every chunk over the
+  network).
+- ``numpy`` (default on CPU/tests): plain in-process numpy buffers,
+  updated in place, with a sliding in-flight window overlapping
+  transfers and compute.
+
+Either way the state checkpoints through the flash-ckpt engine:
+leaves are ``device_get``-able (numpy ones already are).
+"""
+
+import functools
+from typing import Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+
+# 64M elements = 256 MB per fp32 chunk buffer; the update transient is
+# ~6 buffers (3 in, 3 out) plus the resident bf16 params and grads
+DEFAULT_CHUNK_ELEMS = 64 * 1024 * 1024
+
+
+class OffloadState(NamedTuple):
+    """Train state for the offloaded path.  ``params`` is the bf16
+    device tree the forward consumes.  With the numpy backend,
+    master/mu/nu mirror the params tree with numpy leaves; with the
+    pinned_host backend they are per-leaf LISTS of host-memory chunk
+    arrays (wrapped in the same treedef)."""
+
+    step: int
+    params: Dict  # bf16, device
+    master: Dict  # fp32, host
+    mu: Dict      # fp32, host
+    nu: Dict      # fp32, host
+
+
+def _adamw_chunk_math(master, mu, nu, grad, bc1, bc2,
+                      *, lr, b1, b2, eps, wd):
+    """THE AdamW update over one fp32 chunk — the single source of
+    the math for both storage backends (a fix applied to one must not
+    silently miss the other)."""
+    g = grad.astype(jnp.float32)
+    mu = b1 * mu + (1.0 - b1) * g
+    nu = b2 * nu + (1.0 - b2) * g * g
+    update = (mu / bc1) / (jnp.sqrt(nu / bc2) + eps)
+    if wd:
+        update = update + wd * master
+    master = master - lr * update
+    return master, mu, nu, master.astype(jnp.bfloat16)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("lr", "b1", "b2", "eps", "wd"),
+    donate_argnums=(0, 1, 2),
+)
+def _chunk_update(master, mu, nu, grad, bc1, bc2,
+                  *, lr, b1, b2, eps, wd):
+    """numpy-backend entry: plain device in/out chunks."""
+    return _adamw_chunk_math(
+        master, mu, nu, grad, bc1, bc2,
+        lr=lr, b1=b1, b2=b2, eps=eps, wd=wd,
+    )
+
+
+class HostOffloadAdamW:
+    """AdamW whose fp32 state never resides in HBM.
+
+    Not an optax transformation on purpose: optax updates live inside
+    one jit over device state, which is exactly what offload must
+    avoid.  Use with :func:`build_offloaded_train_step` or drive
+    ``init``/``apply_gradients`` directly.
+    """
+
+    def __init__(
+        self,
+        learning_rate: float = 1e-3,
+        b1: float = 0.9,
+        b2: float = 0.999,
+        eps: float = 1e-8,
+        weight_decay: float = 0.0,
+        chunk_elems: int = DEFAULT_CHUNK_ELEMS,
+        max_in_flight: int = 2,
+        backend: str = "auto",
+    ):
+        self.lr = learning_rate
+        self.b1 = b1
+        self.b2 = b2
+        self.eps = eps
+        self.wd = weight_decay
+        self.chunk = int(chunk_elems)
+        self.window = max(1, int(max_in_flight))
+        if backend == "auto":
+            backend = (
+                "pinned_host"
+                if jax.default_backend() == "tpu"
+                else "numpy"
+            )
+        if backend not in ("numpy", "pinned_host"):
+            raise ValueError(f"unknown offload backend {backend!r}")
+        self.backend = backend
+
+    # ------------------------------------------- pinned_host helpers
+    def _shardings(self):
+        from jax.sharding import SingleDeviceSharding
+
+        dev = SingleDeviceSharding(jax.devices()[0])
+        host = dev.with_memory_kind("pinned_host")
+        return dev, host
+
+    def _pinned_update_fn(self):
+        """Chunk update compiled with host-memory in/out shardings;
+        donation recycles the TPU-host buffers so steady state
+        allocates nothing."""
+        if getattr(self, "_pinned_fn", None) is not None:
+            return self._pinned_fn
+        dev, host = self._shardings()
+
+        def body(master, mu, nu, grad, bc1, bc2):
+            # host->HBM in, shared AdamW math, HBM->host out
+            m_d, mu_d, nu_d, p_bf16 = _adamw_chunk_math(
+                jax.device_put(master, dev),
+                jax.device_put(mu, dev),
+                jax.device_put(nu, dev),
+                grad, bc1, bc2,
+                lr=self.lr, b1=self.b1, b2=self.b2,
+                eps=self.eps, wd=self.wd,
+            )
+            return (
+                jax.device_put(m_d, host),
+                jax.device_put(mu_d, host),
+                jax.device_put(nu_d, host),
+                p_bf16,
+            )
+
+        self._pinned_fn = jax.jit(
+            body,
+            in_shardings=(host, host, host, dev, None, None),
+            out_shardings=(host, host, host, dev),
+            donate_argnums=(0, 1, 2),
+        )
+        return self._pinned_fn
+
+    def _chunk_slices(self, n: int):
+        return [
+            slice(lo, min(lo + self.chunk, n))
+            for lo in range(0, n, self.chunk)
+        ]
+
+    # ----------------------------------------------------------- init
+    def init(self, params) -> OffloadState:
+        """``params``: any pytree of arrays (host or device).  Master
+        copies and moments materialize on the host; the returned
+        ``params`` tree is bf16 on device."""
+        if self.backend == "pinned_host":
+            return self._init_pinned(params)
+        return self._init_numpy(params)
+
+    def _init_pinned(self, params) -> OffloadState:
+        _, host = self._shardings()
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        master, mu, nu, bf16 = [], [], [], []
+        for leaf in leaves:
+            arr = jnp.asarray(leaf)
+            flat = arr.reshape(-1).astype(jnp.float32)
+            m_chunks, mu_chunks, nu_chunks = [], [], []
+            for sl in self._chunk_slices(flat.shape[0]):
+                chunk = flat[sl]
+                m_chunks.append(jax.device_put(chunk, host))
+                zero = jnp.zeros(chunk.shape, jnp.float32)
+                mu_chunks.append(jax.device_put(zero, host))
+                nu_chunks.append(jax.device_put(zero, host))
+            master.append(m_chunks)
+            mu.append(mu_chunks)
+            nu.append(nu_chunks)
+            bf16.append(arr.astype(jnp.bfloat16))
+            del arr, flat  # the fp32 device copy must not linger
+        unf = jax.tree_util.tree_unflatten
+        return OffloadState(
+            step=0,
+            params=unf(treedef, bf16),
+            master=unf(treedef, master),
+            mu=unf(treedef, mu),
+            nu=unf(treedef, nu),
+        )
+
+    def _init_numpy(self, params) -> OffloadState:
+        # np.array (not asarray/ascontiguousarray): a jax Array's
+        # zero-copy numpy view is READ-ONLY, and the writeback path
+        # updates reshape(-1) views of these buffers in place — they
+        # must be owned, contiguous, writable host memory
+        master = jax.tree_util.tree_map(
+            lambda p: np.array(p, dtype=np.float32, order="C"),
+            params,
+        )
+        zeros = jax.tree_util.tree_map(
+            lambda p: np.zeros(p.shape, np.float32), master
+        )
+        zeros2 = jax.tree_util.tree_map(
+            lambda p: np.zeros(p.shape, np.float32), master
+        )
+        bf16 = jax.tree_util.tree_map(
+            lambda p: jnp.asarray(p, dtype=jnp.bfloat16), master
+        )
+        return OffloadState(
+            step=0, params=bf16, master=master, mu=zeros, nu=zeros2
+        )
+
+    # --------------------------------------------------------- update
+    def apply_gradients(
+        self, state: OffloadState, grads
+    ) -> OffloadState:
+        """One AdamW step.  ``grads``: device pytree matching
+        ``state.params``.  Streams chunks through the chip; host
+        buffers are recycled (donation on pinned_host, in-place numpy
+        otherwise)."""
+        if self.backend == "pinned_host":
+            return self._apply_pinned(state, grads)
+        return self._apply_numpy(state, grads)
+
+    def _apply_pinned(
+        self, state: OffloadState, grads
+    ) -> OffloadState:
+        step = state.step + 1
+        bc1 = jnp.float32(1.0 - self.b1**step)
+        bc2 = jnp.float32(1.0 - self.b2**step)
+        fn = self._pinned_update_fn()
+        leaves_m, treedef = jax.tree_util.tree_flatten(
+            state.master, is_leaf=lambda x: isinstance(x, list)
+        )
+        leaves_mu = treedef.flatten_up_to(state.mu)
+        leaves_nu = treedef.flatten_up_to(state.nu)
+        leaves_p = treedef.flatten_up_to(state.params)
+        leaves_g = treedef.flatten_up_to(grads)
+        new_m, new_mu, new_nu, new_p = [], [], [], []
+        for li, m_chunks in enumerate(leaves_m):
+            shape = leaves_p[li].shape
+            flat_g = leaves_g[li].reshape(-1)
+            slices = self._chunk_slices(flat_g.shape[0])
+            ms, mus, nus, ps = [], [], [], []
+            for j, sl in enumerate(slices):
+                m_h, mu_h, nu_h, p_d = fn(
+                    m_chunks[j],
+                    leaves_mu[li][j],
+                    leaves_nu[li][j],
+                    flat_g[sl],
+                    bc1,
+                    bc2,
+                )
+                ms.append(m_h)
+                mus.append(mu_h)
+                nus.append(nu_h)
+                ps.append(p_d)
+            new_m.append(ms)
+            new_mu.append(mus)
+            new_nu.append(nus)
+            flat_p = ps[0] if len(ps) == 1 else jnp.concatenate(ps)
+            new_p.append(flat_p.reshape(shape))
+        unf = jax.tree_util.tree_unflatten
+        return OffloadState(
+            step=step,
+            params=unf(treedef, new_p),
+            master=unf(treedef, new_m),
+            mu=unf(treedef, new_mu),
+            nu=unf(treedef, new_nu),
+        )
+
+    def _apply_numpy(
+        self, state: OffloadState, grads
+    ) -> OffloadState:
+        step = state.step + 1
+        bc1 = jnp.float32(1.0 - self.b1**step)
+        bc2 = jnp.float32(1.0 - self.b2**step)
+
+        leaves_m, treedef = jax.tree_util.tree_flatten(state.master)
+        leaves_mu = treedef.flatten_up_to(state.mu)
+        leaves_nu = treedef.flatten_up_to(state.nu)
+        leaves_g = treedef.flatten_up_to(grads)
+
+        new_param_chunks: Dict[int, list] = {}
+        in_flight = []  # (leaf_idx, chunk_slice, device results)
+
+        def drain_one():
+            li, sl, res = in_flight.pop(0)
+            m_d, mu_d, nu_d, p_d = res
+            # d2h writebacks into the SAME host buffers
+            np.copyto(
+                leaves_m[li].reshape(-1)[sl], np.asarray(m_d)
+            )
+            np.copyto(
+                leaves_mu[li].reshape(-1)[sl], np.asarray(mu_d)
+            )
+            np.copyto(
+                leaves_nu[li].reshape(-1)[sl], np.asarray(nu_d)
+            )
+            new_param_chunks.setdefault(li, []).append(p_d)
+
+        for li in range(len(leaves_m)):
+            flat_m = leaves_m[li].reshape(-1)
+            flat_mu = leaves_mu[li].reshape(-1)
+            flat_nu = leaves_nu[li].reshape(-1)
+            flat_g = leaves_g[li].reshape(-1)
+            n = flat_m.shape[0]
+            for lo in range(0, n, self.chunk):
+                sl = slice(lo, min(lo + self.chunk, n))
+                res = _chunk_update(
+                    jnp.asarray(flat_m[sl]),
+                    jnp.asarray(flat_mu[sl]),
+                    jnp.asarray(flat_nu[sl]),
+                    flat_g[sl],
+                    bc1,
+                    bc2,
+                    lr=self.lr, b1=self.b1, b2=self.b2,
+                    eps=self.eps, wd=self.wd,
+                )
+                in_flight.append((li, sl, res))
+                # bounded window: older chunks' HBM buffers are freed
+                # by the writeback before new ones are dispatched
+                while len(in_flight) > self.window:
+                    drain_one()
+        while in_flight:
+            drain_one()
+
+        new_params = []
+        for li, m in enumerate(leaves_m):
+            chunks = new_param_chunks[li]
+            flat = (
+                chunks[0]
+                if len(chunks) == 1
+                else jnp.concatenate(chunks)
+            )
+            new_params.append(flat.reshape(m.shape))
+        return OffloadState(
+            step=step,
+            params=jax.tree_util.tree_unflatten(
+                treedef, new_params
+            ),
+            master=state.master,
+            mu=state.mu,
+            nu=state.nu,
+        )
+
+
+def build_offloaded_train_step(
+    loss_fn,
+    init_params_fn,
+    optimizer: Optional[HostOffloadAdamW] = None,
+):
+    """Single-chip train step with host-resident optimizer state.
+
+    Returns ``(init_state, train_step)`` where ``train_step(state,
+    batch) -> (state, metrics)``:  backward is one jit over the bf16
+    device params; the update streams through
+    :meth:`HostOffloadAdamW.apply_gradients`.
+    """
+    opt = optimizer or HostOffloadAdamW()
+
+    grad_fn = jax.jit(
+        lambda params, batch: jax.value_and_grad(loss_fn)(
+            params, batch
+        )
+    )
+
+    def init_state(rng) -> OffloadState:
+        params = init_params_fn(rng)
+        state = opt.init(params)
+        del params
+        return state
+
+    def train_step(state: OffloadState, batch):
+        loss, grads = grad_fn(state.params, batch)
+        new_state = opt.apply_gradients(state, grads)
+        return new_state, {"loss": loss}
+
+    return init_state, train_step

@@ -1,0 +1,249 @@
+"""Host-offloaded AdamW (optimizers/host_offload.py).
+
+Reference parity: ``atorch/atorch/optimizers/adam_offload.py`` —
+fp32 master/moments on the host, bucket-streamed updates.  Tests
+check math parity against optax.adamw (fp32 trajectories), the
+multi-chunk streaming path, in-place host-buffer reuse, and the
+end-to-end offloaded train step.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.optimizers.host_offload import (
+    HostOffloadAdamW,
+    OffloadState,
+    build_offloaded_train_step,
+)
+
+
+def _tree_params(rng):
+    k1, k2, k3 = jax.random.split(rng, 3)
+    return {
+        "w": jax.random.normal(k1, (300,), jnp.float32),
+        "b": jax.random.normal(k2, (7,), jnp.float32),
+        "m": jax.random.normal(k3, (13, 11), jnp.float32),
+    }
+
+
+class TestMathParity:
+    @pytest.mark.parametrize("chunk", [1 << 20, 128])
+    def test_matches_optax_adamw(self, chunk):
+        """Multi-step trajectory of the offloaded optimizer matches
+        optax.adamw run in fp32 (same lr/betas/eps/wd).  chunk=128
+        forces the multi-chunk path on every leaf."""
+        lr, wd = 1e-2, 0.01
+        params = _tree_params(jax.random.PRNGKey(0))
+        opt = HostOffloadAdamW(
+            learning_rate=lr, weight_decay=wd, chunk_elems=chunk
+        )
+        state = opt.init(params)
+        ref_opt = optax.adamw(lr, weight_decay=wd)
+        ref_params = jax.tree_util.tree_map(jnp.asarray, params)
+        ref_state = ref_opt.init(ref_params)
+
+        for i in range(5):
+            # deterministic synthetic grads, fp32 on both sides
+            grads = jax.tree_util.tree_map(
+                lambda p: 0.1 * p + 0.01 * (i + 1), state.master
+            )
+            grads_dev = jax.tree_util.tree_map(jnp.asarray, grads)
+            state = opt.apply_gradients(state, grads_dev)
+            updates, ref_state = ref_opt.update(
+                jax.tree_util.tree_map(jnp.asarray, grads),
+                ref_state,
+                ref_params,
+            )
+            ref_params = optax.apply_updates(ref_params, updates)
+            # masters track the fp32 reference to float tolerance
+            for a, b in zip(
+                jax.tree_util.tree_leaves(state.master),
+                jax.tree_util.tree_leaves(ref_params),
+            ):
+                np.testing.assert_allclose(
+                    a, np.asarray(b), rtol=2e-5, atol=2e-7
+                )
+
+    def test_device_params_are_bf16_of_master(self):
+        opt = HostOffloadAdamW(learning_rate=1e-2)
+        state = opt.init(_tree_params(jax.random.PRNGKey(1)))
+        grads = jax.tree_util.tree_map(
+            lambda p: jnp.asarray(0.5 * p), state.master
+        )
+        state = opt.apply_gradients(state, grads)
+        for p, m in zip(
+            jax.tree_util.tree_leaves(state.params),
+            jax.tree_util.tree_leaves(state.master),
+        ):
+            assert p.dtype == jnp.bfloat16
+            np.testing.assert_allclose(
+                np.asarray(p, np.float32),
+                m.astype(np.float32),
+                rtol=1e-2,  # bf16 mantissa
+            )
+
+
+class TestHostResidency:
+    def test_state_is_host_numpy_and_reused(self):
+        """The fp32 state must be numpy (host DRAM, zero HBM) and the
+        update must write the SAME buffers in place — reallocation
+        would double host memory at 2B-param scale."""
+        opt = HostOffloadAdamW(learning_rate=1e-2, chunk_elems=64)
+        state = opt.init({"w": np.ones((500,), np.float32)})
+        assert isinstance(state.master["w"], np.ndarray)
+        assert isinstance(state.mu["w"], np.ndarray)
+        buf_m = state.master["w"]
+        buf_mu = state.mu["w"]
+        state2 = opt.apply_gradients(
+            state, {"w": jnp.ones((500,), jnp.float32)}
+        )
+        assert state2.master["w"] is buf_m  # in-place
+        assert state2.mu["w"] is buf_mu
+        assert not np.array_equal(buf_m, np.ones((500,)))  # updated
+        assert state2.step == 1
+
+    def test_checkpoint_roundtrip(self):
+        """The state snapshots through device_get/asarray like any
+        train state (flash-ckpt compatibility)."""
+        opt = HostOffloadAdamW(learning_rate=1e-2)
+        state = opt.init({"w": np.full((64,), 2.0, np.float32)})
+        state = opt.apply_gradients(
+            state, {"w": jnp.ones((64,), jnp.float32)}
+        )
+        snap = jax.tree_util.tree_map(
+            np.asarray, state._asdict()
+        )
+        restored = OffloadState(
+            step=int(snap["step"]) if not isinstance(
+                snap["step"], int
+            ) else snap["step"],
+            params=jax.tree_util.tree_map(
+                jnp.asarray, snap["params"]
+            ),
+            master=snap["master"],
+            mu=snap["mu"],
+            nu=snap["nu"],
+        )
+        s1 = opt.apply_gradients(
+            state, {"w": jnp.ones((64,), jnp.float32)}
+        )
+        s2 = opt.apply_gradients(
+            restored, {"w": jnp.ones((64,), jnp.float32)}
+        )
+        np.testing.assert_allclose(
+            s1.master["w"], s2.master["w"], rtol=1e-7
+        )
+
+
+class TestOffloadedTrainStep:
+    def test_end_to_end_converges(self):
+        target = jnp.full((256,), 3.0)
+
+        def loss_fn(params, batch):
+            pred = params["w"].astype(jnp.float32) * batch["x"]
+            return jnp.mean((pred - target) ** 2)
+
+        init_state, train_step = build_offloaded_train_step(
+            loss_fn,
+            lambda rng: {
+                "w": jax.random.normal(rng, (256,), jnp.float32)
+            },
+            HostOffloadAdamW(learning_rate=0.1, chunk_elems=100),
+        )
+        state = init_state(jax.random.PRNGKey(0))
+        batch = {"x": jnp.ones((256,))}
+        first = None
+        for _ in range(60):
+            state, metrics = train_step(state, batch)
+            if first is None:
+                first = float(metrics["loss"])
+        assert float(metrics["loss"]) < 0.05 * first
+        assert state.step == 60
+
+
+def _pinned_host_supported():
+    import jax as _jax
+    from jax.sharding import SingleDeviceSharding
+
+    try:
+        dev = SingleDeviceSharding(_jax.devices()[0])
+        host = dev.with_memory_kind("pinned_host")
+        x = _jax.device_put(jnp.ones((8,)), host)
+        fn = _jax.jit(
+            lambda a: _jax.device_put(
+                _jax.device_put(a, dev) * 2.0, host
+            ),
+            in_shardings=(host,),
+            out_shardings=host,
+        )
+        return float(np.asarray(fn(x))[0]) == 2.0
+    except Exception:  # noqa: BLE001
+        return False
+
+
+@pytest.mark.skipif(
+    not _pinned_host_supported(),
+    reason="backend has no pinned_host memory space",
+)
+class TestPinnedHostBackend:
+    """The XLA-memories backend: state chunks live in the TPU host's
+    RAM as pinned_host jax arrays; transfers are compiled DMA, never
+    the Python client's bandwidth (critical under remote
+    attachments)."""
+
+    def test_matches_numpy_backend(self):
+        params = _tree_params(jax.random.PRNGKey(3))
+        kw = dict(learning_rate=1e-2, weight_decay=0.01,
+                  chunk_elems=128)
+        opt_np = HostOffloadAdamW(backend="numpy", **kw)
+        opt_ph = HostOffloadAdamW(backend="pinned_host", **kw)
+        s_np = opt_np.init(params)
+        s_ph = opt_ph.init(params)
+        for i in range(3):
+            grads = jax.tree_util.tree_map(
+                lambda p: jnp.asarray(0.1 * p + 0.01 * (i + 1)),
+                params,
+            )
+            s_np = opt_np.apply_gradients(s_np, grads)
+            s_ph = opt_ph.apply_gradients(s_ph, grads)
+        # identical math, different residency: compare the bf16
+        # device params AND the reassembled fp32 masters
+        for a, b in zip(
+            jax.tree_util.tree_leaves(s_np.params),
+            jax.tree_util.tree_leaves(s_ph.params),
+        ):
+            np.testing.assert_array_equal(
+                np.asarray(a, np.float32), np.asarray(b, np.float32)
+            )
+        flat_np = np.concatenate(
+            [
+                np.asarray(x).reshape(-1)
+                for x in jax.tree_util.tree_leaves(s_np.master)
+            ]
+        )
+        flat_ph = np.concatenate(
+            [
+                np.asarray(c).reshape(-1)
+                for leaf in jax.tree_util.tree_leaves(
+                    s_ph.master,
+                    is_leaf=lambda x: isinstance(x, list),
+                )
+                for c in leaf
+            ]
+        )
+        np.testing.assert_allclose(flat_np, flat_ph, rtol=1e-6)
+
+    def test_state_resides_in_host_memory(self):
+        opt = HostOffloadAdamW(backend="pinned_host", chunk_elems=64)
+        state = opt.init({"w": jnp.ones((200,), jnp.float32)})
+        for chunk in state.master["w"]:
+            assert chunk.sharding.memory_kind == "pinned_host"
+        state = opt.apply_gradients(
+            state, {"w": jnp.ones((200,), jnp.float32)}
+        )
+        for chunk in state.mu["w"]:
+            assert chunk.sharding.memory_kind == "pinned_host"
+        assert state.params["w"].dtype == jnp.bfloat16

@@ -1,0 +1,161 @@
+"""Joint (mesh × remat × microbatch × tiles) solver
+(accelerate/solver.py).
+
+Reference parity: ``atorch/atorch/auto/opt_lib/shard_planners/
+mip_tp_planner.py:496``.  The validation anchor is the v5e bench
+workload: the solver must reproduce the measured hand tuning (flash
+tiles 1024×512 at seq 2048; dots preferred over full when both fit;
+accumulation rescuing cheaper remat when memory binds) from its model
+alone.
+"""
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.accelerate.analyser import ModelProfile
+from dlrover_tpu.accelerate.solver import (
+    REMAT_POLICIES,
+    attention_traffic_s,
+    candidate_tiles,
+    solve,
+)
+
+
+def bench_profile(n_layers=8, params=536_000_000):
+    """llama-0.6b-shaped profile (adamw fp32 moments)."""
+    return ModelProfile(
+        num_params=params,
+        param_bytes=4 * params,
+        largest_leaf=0,
+        leaf_count=12,
+        optimizer_bytes=8 * params,
+        activation_bytes_per_sample=940_000_000,  # remat=none, s2048
+        num_layers=n_layers,
+    )
+
+
+class TestTiles:
+    def test_bench_tiles_reproduced(self):
+        """seq 2048, head_dim 128 -> the measured-best 1024x512 must
+        be the feasible maximum (traffic-minimal) tile."""
+        tiles = candidate_tiles(2048)
+        assert (1024, 512) in tiles
+        # nothing larger is feasible: 2048-wide q violates the >=2
+        # pipeline-blocks rule; kv > q/2 violates the bwd conflict rule
+        assert all(bq <= 1024 and bk <= bq // 2 or bq <= 128
+                   for bq, bk in tiles)
+        best = min(
+            tiles,
+            key=lambda t: attention_traffic_s(
+                t[0], t[1], 8, 2048, 16, 8
+            ),
+        )
+        assert best == (1024, 512)
+
+    def test_small_seq_has_tiles(self):
+        assert (128, 128) in candidate_tiles(128)
+
+    def test_vmem_budget_prunes(self):
+        tiny = candidate_tiles(2048, vmem_budget=1 << 20)
+        assert tiny  # something survives
+        assert (1024, 512) not in tiny  # 4MB+ scores pruned
+
+    def test_traffic_monotone_in_block_size(self):
+        small = attention_traffic_s(256, 128, 8, 2048, 16, 8)
+        big = attention_traffic_s(1024, 512, 8, 2048, 16, 8)
+        assert big < small
+
+
+class TestSolve:
+    def test_reproduces_bench_hand_tuning(self):
+        """Single chip, bench workload: top plans carry the measured
+        1024x512 tiles; among the directly measured single-micro
+        policies, dots ranks ahead of full (r3: 0.52 vs ~0.48 MFU)."""
+        plans = solve(
+            bench_profile(), n_devices=1, batch_per_replica=8,
+            seq_len=2048, n_heads=16, top_k=500,
+        )
+        assert plans[0].block_q == 1024
+        assert plans[0].block_kv == 512
+        micro1 = [
+            p for p in plans if p.strategy.num_micro_steps == 1
+        ]
+        dots = next(p for p in micro1 if p.remat == "dots")
+        full = next(p for p in micro1 if p.remat == "full")
+        assert dots.predicted_step_s < full.predicted_step_s
+
+    def test_accumulation_rescues_cheaper_remat(self):
+        """remat=none does not fit at micro=1 (0.96 util is over a
+        0.9 headroom) but fits with accumulation — the joint solve
+        must surface that point; a per-axis search (fixed micro, then
+        remat) cannot."""
+        plans = solve(
+            bench_profile(), n_devices=1, batch_per_replica=8,
+            seq_len=2048, n_heads=16, headroom=0.80, top_k=500,
+        )
+        none_plans = [p for p in plans if p.remat == "none"]
+        assert none_plans
+        assert all(
+            p.strategy.num_micro_steps > 1 for p in none_plans
+        )
+
+    def test_memory_binds_out_none_for_bigger_model(self):
+        """A 0.9b-adamw profile: fp32 state alone is ~11 GB; full
+        activations cannot fit at any micro count -> no remat=none
+        plan survives."""
+        plans = solve(
+            bench_profile(n_layers=16, params=940_000_000),
+            n_devices=1, batch_per_replica=8, seq_len=2048,
+            n_heads=16, top_k=500,
+        )
+        assert plans
+        assert all(p.remat != "none" for p in plans)
+        assert plans[0].remat in ("dots", "full")
+
+    def test_solver_scales_to_mesh(self):
+        """8 devices: the solve covers sharded candidates and every
+        returned plan fits its own memory model."""
+        plans = solve(
+            bench_profile(), n_devices=8, batch_per_replica=8,
+            seq_len=2048, n_heads=16, global_batch=64, top_k=10,
+        )
+        assert plans
+        for p in plans:
+            total = (
+                p.strategy.data * p.strategy.fsdp
+                * p.strategy.tensor * p.strategy.seq
+                * p.strategy.expert * p.strategy.pipe
+            )
+            assert total == 8
+            assert p.memory_utilization <= 1.0
+
+    def test_calibrated_weights_change_ranking(self):
+        """The solver consumes CalibratedPlanner weights: inflating
+        the compute coefficient (slow MXU) makes recompute-heavy
+        'full' lose more ground vs 'dots'."""
+        base = solve(
+            bench_profile(), n_devices=1, batch_per_replica=8,
+            seq_len=2048, n_heads=16, top_k=500,
+        )
+        heavy = solve(
+            bench_profile(), n_devices=1, batch_per_replica=8,
+            seq_len=2048, n_heads=16, top_k=500,
+            weights=[5.0, 1, 1, 1, 1, 1, 1],
+        )
+
+        def gap(plans):
+            micro1 = [
+                p for p in plans
+                if p.strategy.num_micro_steps == 1
+            ]
+            d = next(p for p in micro1 if p.remat == "dots")
+            f = next(p for p in micro1 if p.remat == "full")
+            return f.predicted_step_s - d.predicted_step_s
+
+        assert gap(heavy) > gap(base)
+
+    def test_remat_policy_table_sane(self):
+        fracs = [f for f, _ in REMAT_POLICIES.values()]
+        mults = [m for _, m in REMAT_POLICIES.values()]
+        assert min(fracs) > 0 and max(fracs) == 1.0
+        assert min(mults) == 1.0 and max(mults) <= 1.5
