@@ -276,6 +276,11 @@ def solve(
             terms[0] *= flop_mult  # recompute lands on the compute term
             base_s = float(terms @ w) + accum_s
             local_seq = seq_len // max(s.seq, 1)
+            # per-DEVICE attention traffic: heads shard over tensor,
+            # layers over pipe, sequence over seq (charging unsharded
+            # totals would overbill model-parallel plans by
+            # tensor*pipe against the already-sharded compute terms)
+            model_shard = max(s.tensor * s.pipe, 1)
             for bq, bk in tiles_for(local_seq):
                 t = base_s + attention_traffic_s(
                     bq,
@@ -285,7 +290,7 @@ def solve(
                     n_heads,
                     profile.num_layers or 1,
                     head_dim,
-                )
+                ) / model_shard
                 plans.append(
                     JointPlan(
                         strategy=s,

@@ -142,7 +142,9 @@ class JobMetricCollector:
         speed = 0.0
         if self._speed_monitor is not None:
             step = self._speed_monitor.completed_global_step
-            speed = self._speed_monitor.running_speed
+            # running_speed is a METHOD (same defect auto_scaler had:
+            # the bare attribute serialized a bound method as "speed")
+            speed = self._speed_monitor.running_speed()
         running = 0
         resources: Dict = {}
         if self._job_manager is not None:

@@ -7,6 +7,7 @@ the C++ daemon (``native/metrics_exporter/exporter.cc``) serves them
 as Prometheus text on 28888+rank.
 """
 
+import re
 import os
 import subprocess
 import tempfile
@@ -32,7 +33,11 @@ _BIN = os.path.join(_BIN_DIR, "metrics_exporter")
 class MetricsRegistry:
     """Process-local metric store flushed to the exporter file."""
 
-    def __init__(self, path: str = "", flush_interval: float = 5.0):
+    def __init__(self, path: str = "", flush_interval: float = 5.0,
+                 rank: Optional[int] = None):
+        """``rank``: when set, every metric carries a ``rank`` label —
+        the per-rank series the reference's per-rank bvar exporters
+        provide (aggregation then happens in PromQL, not here)."""
         self._path = path or os.path.join(
             tempfile.gettempdir(),
             f"dlrover_tpu_metrics_{os.getpid()}.prom",
@@ -41,16 +46,37 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._flush_interval = flush_interval
         self._last_flush = 0.0
+        self._rank = rank
 
     @property
     def path(self) -> str:
         return self._path
 
+    @staticmethod
+    def _escape_label(value) -> str:
+        """Prometheus text-format label escaping (backslash, quote,
+        newline) — an unescaped quote in a value would corrupt the
+        whole exposition line."""
+        return (
+            str(value)
+            .replace("\\", "\\\\")
+            .replace('"', '\\"')
+            .replace("\n", "\\n")
+        )
+
+    _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
     def _key(self, name: str, labels: Optional[Dict] = None) -> str:
-        if not labels:
+        name = self._NAME_RE.sub("_", name)
+        merged = dict(labels or {})
+        if self._rank is not None:
+            merged.setdefault("rank", self._rank)
+        if not merged:
             return name
         inner = ",".join(
-            f'{k}="{v}"' for k, v in sorted(labels.items())
+            f'{self._NAME_RE.sub("_", str(k))}='
+            f'"{self._escape_label(v)}"'
+            for k, v in sorted(merged.items())
         )
         return f"{name}{{{inner}}}"
 
@@ -77,10 +103,16 @@ class MetricsRegistry:
 
     def flush(self):
         with self._lock:
+            now = time.time()
+            # trailing unix timestamp (Prometheus text format allows
+            # it) is what lets the exporter evict STALE series — a
+            # crashed writer's last file would otherwise be served as
+            # live forever
             lines = [
-                f"{k} {v:.9g}" for k, v in sorted(self._metrics.items())
+                f"{k} {v:.9g} {now:.3f}"
+                for k, v in sorted(self._metrics.items())
             ]
-            self._last_flush = time.time()
+            self._last_flush = now
         tmp = self._path + ".tmp"
         try:
             with open(tmp, "w") as f:
@@ -112,12 +144,21 @@ def set_default_registry(registry: MetricsRegistry):
 
 
 class MetricsExporter:
-    """Builds (once) and supervises the native exporter daemon."""
+    """Builds (once) and supervises the native exporter daemon.
+
+    ``extra_files``: additional per-rank metric files to merge into
+    this exporter's exposition (node-level aggregation: rank 0 serves
+    every local rank).  ``stale_secs``: series whose trailing flush
+    timestamp is older than this are evicted (0 = never)."""
 
     def __init__(self, registry: MetricsRegistry, rank: int = 0,
-                 port: Optional[int] = None):
+                 port: Optional[int] = None,
+                 extra_files: Optional[list] = None,
+                 stale_secs: float = 600.0):
         self._registry = registry
         self._port = port if port is not None else BASE_PORT + rank
+        self._extra_files = list(extra_files or [])
+        self._stale_secs = stale_secs
         self._proc: Optional[subprocess.Popen] = None
 
     @property
@@ -138,10 +179,19 @@ class MetricsExporter:
         binary = self.build()
         self._registry.flush()
         self._proc = subprocess.Popen(  # noqa: S603
-            [binary, self._registry.path, str(self._port)],
+            [
+                binary,
+                str(self._port),
+                str(self._stale_secs),
+                self._registry.path,
+                *self._extra_files,
+            ],
             stderr=subprocess.DEVNULL,
         )
-        logger.info("metrics exporter on :%d", self._port)
+        logger.info(
+            "metrics exporter on :%d (%d files)",
+            self._port, 1 + len(self._extra_files),
+        )
 
     def stop(self):
         if self._proc is not None:
