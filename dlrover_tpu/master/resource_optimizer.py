@@ -104,20 +104,66 @@ class WorkerCreateResource(OptimizeAlgorithm):
 class WorkerResource(OptimizeAlgorithm):
     """Throughput-driven worker-count tuning.
 
-    The stop/settle decision uses the LOCAL throughput slope between
-    the two largest observed world sizes (the reference's
-    worker-speed-ratio compares speed before/after the last grow
-    step): while the marginal throughput of one more worker stays
-    above ``min_marginal_gain x (current per-worker speed)``, grow by
-    up to 25% of the current size per cycle; once returns diminish,
-    settle at the best-known size and stop growing."""
+    With >=3 observed world sizes the decision uses a FITTED scaling
+    model extrapolated to unseen sizes (the reference Brain fits a
+    linear throughput model over persisted history,
+    ``optimize_job_worker_resource.go:400``): least-squares of
+    ``n/speed = a + b*n`` — the Amdahl/serial-fraction form, linear in
+    exactly the quantity a synchronous data-parallel job degrades in —
+    then jump toward the LARGEST size whose predicted marginal gain
+    still clears ``min_marginal_gain``.  The durable speed history
+    (``master/datastore.py``) makes the fit meaningful across master
+    restarts.
+
+    With only 2 sizes, fall back to the local slope between them
+    (the reference's worker-speed-ratio); while marginal throughput
+    stays above the threshold, grow by up to 25% per cycle."""
 
     name = "optimize_worker_resource"
+
+    # never jump more than this factor past the current size in one
+    # plan: the fit extrapolates, reality gets a vote at each stop
+    MAX_JUMP_FACTOR = 2.0
 
     def __init__(self, min_marginal_gain: float = 0.6,
                  growth_ratio: float = 0.25):
         self._gain = min_marginal_gain
         self._growth = growth_ratio
+
+    def _fit_knee(self, samples: Dict[int, float],
+                  max_workers: int) -> Optional[int]:
+        """Fit n/speed = a + b*n; return the largest n whose predicted
+        marginal gain clears the threshold (None = fit unusable)."""
+        sizes = sorted(samples)
+        xs = [float(n) for n in sizes]
+        ys = [n / max(samples[n], 1e-9) for n in sizes]
+        k = len(xs)
+        sx, sy = sum(xs), sum(ys)
+        sxx = sum(x * x for x in xs)
+        sxy = sum(x * y for x, y in zip(xs, ys))
+        denom = k * sxx - sx * sx
+        if abs(denom) < 1e-12:
+            return None
+        b = (k * sxy - sx * sy) / denom
+        a = (sy - b * sx) / k
+
+        def speed(n: float) -> float:
+            d = a + b * n
+            return n / d if d > 1e-12 else 0.0
+
+        if b <= 0:
+            # no measurable serial fraction yet: predicted scaling is
+            # (super)linear — the knee is past max_workers
+            return max_workers
+        best = None
+        for n in range(1, max_workers + 1):
+            per_worker = speed(n) / n
+            marginal = (speed(n + 1) - speed(n)) / max(
+                per_worker, 1e-12
+            )
+            if marginal >= self._gain:
+                best = n + 1
+        return best
 
     @staticmethod
     def _best_known(meta: JobMeta, tolerance: float = 0.05) -> int:
@@ -145,6 +191,40 @@ class WorkerResource(OptimizeAlgorithm):
         # settle the stale larger sample would otherwise re-emit the
         # same scale-back plan every cycle forever
         current = meta.current_workers or sizes[-1]
+        if len(sizes) >= 3:
+            target = self._fit_knee(samples, meta.max_workers)
+            if target is not None:
+                target = max(target, meta.min_workers)
+                if target > current:
+                    cap = int(current * self.MAX_JUMP_FACTOR)
+                    count = min(target, cap, meta.max_workers)
+                    if count == current:
+                        return None  # capped at where we already are
+                    plan = ScalePlan()
+                    plan.node_group_resources[NodeType.WORKER] = {
+                        "count": count
+                    }
+                    logger.info(
+                        "fitted scaling model: knee at %d workers "
+                        "(current %d)", target, current,
+                    )
+                    return plan
+                if target < current:
+                    settle = max(
+                        min(target, self._best_known(meta)),
+                        meta.min_workers,
+                    )
+                    if settle != current:
+                        plan = ScalePlan()
+                        plan.node_group_resources[
+                            NodeType.WORKER
+                        ] = {"count": settle}
+                        logger.info(
+                            "fitted scaling model: settling at %d "
+                            "workers (current %d)", settle, current,
+                        )
+                        return plan
+                return None  # already at the predicted knee
         if len(sizes) >= 2:
             # stop/settle decision uses the LOCAL slope between the two
             # largest observed sizes (the reference's worker-speed-ratio

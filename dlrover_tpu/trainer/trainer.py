@@ -172,7 +172,9 @@ class Trainer:
             )
             from dlrover_tpu.trainer.callbacks import MetricsCallback
 
-            self._registry = MetricsRegistry()
+            # rank label keeps this rank's series distinct when a
+            # node-level exporter merges every rank's metric file
+            self._registry = MetricsRegistry(rank=self._ctx.rank)
             set_default_registry(self._registry)
             self._exporter = MetricsExporter(
                 self._registry,

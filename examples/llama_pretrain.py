@@ -31,6 +31,11 @@ def parse_args():
     p.add_argument("--fsdp", type=int, default=0)
     p.add_argument("--tensor", type=int, default=0)
     p.add_argument(
+        "--eval_interval", type=int, default=0,
+        help="evaluate on a held-out set every N steps (0 = off); "
+        "curves land in <ckpt_dir>/curves/train_log.jsonl",
+    )
+    p.add_argument(
         "--ckpt_dir", default="/tmp/dlrover_tpu_llama_ckpt"
     )
     return p.parse_args()
@@ -41,7 +46,7 @@ def main():
 
     from dlrover_tpu.trainer.elastic import init_distributed
 
-    init_distributed()
+    ctx = init_distributed()
 
     import jax
     import optax
@@ -102,6 +107,29 @@ def main():
                 )
             }
 
+    def eval_iter():
+        # fixed held-out set (seeded separately from training data)
+        eval_rng = np.random.default_rng(12345)
+        for _ in range(4):
+            yield {
+                "tokens": eval_rng.integers(
+                    0, cfg.vocab_size,
+                    size=(args.batch, args.seq + 1),
+                    dtype=np.int32,
+                )
+            }
+
+    callbacks = []
+    if args.eval_interval and args.ckpt_dir and ctx.rank == 0:
+        # rank-0 only: every rank appending to one shared jsonl would
+        # interleave duplicate records (see callbacks.py docstring)
+        from dlrover_tpu.trainer.callbacks import JsonlLoggerCallback
+
+        callbacks.append(
+            JsonlLoggerCallback(
+                os.path.join(args.ckpt_dir, "curves")
+            )
+        )
     trainer = Trainer(
         result,
         TrainingArgs(
@@ -111,10 +139,20 @@ def main():
             save_storage_interval=25,
             log_interval=10,
             micro_batch_size=args.batch,
+            eval_interval=args.eval_interval,
         ),
         data_iter,
+        eval_iter_fn=eval_iter,
+        callbacks=callbacks,
     )
     summary = trainer.train()
+    if args.eval_interval:
+        if summary["final_step"] % args.eval_interval == 0:
+            # the in-train cadence already evaluated at the final step
+            print("final eval: covered by in-train cadence", flush=True)
+        else:
+            final_eval = trainer.evaluate()
+            print(f"final eval: {final_eval}", flush=True)
     print(f"done: {summary}", flush=True)
 
 

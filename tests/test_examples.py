@@ -78,5 +78,16 @@ class TestExamples:
         out = _run_example(
             tmp_path, "llama_pretrain.py", "--steps", "4",
             "--ckpt_dir", str(tmp_path / "ckpt"),
+            "--eval_interval", "2",
         )
         assert "done" in out
+        assert "final eval" in out
+        # recorded eval curves on disk (VERDICT-r3 weak #8: examples
+        # never validated)
+        import json as _json
+
+        log = tmp_path / "ckpt" / "curves" / "train_log.jsonl"
+        entries = [
+            _json.loads(x) for x in log.read_text().splitlines()
+        ]
+        assert any(e["kind"] == "eval" for e in entries)

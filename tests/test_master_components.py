@@ -392,3 +392,54 @@ class TestStats:
         )
         assert reporter.model.num_params == 123
         assert reporter.model.hidden_size == 64
+
+
+class TestFittedScalingModel:
+    """WorkerResource with >=3 samples fits n/speed = a + b*n (the
+    reference Brain's linear throughput model over persisted history,
+    optimize_job_worker_resource.go:400) and jumps toward the
+    predicted knee instead of 25% increments."""
+
+    @staticmethod
+    def _amdahl(n, serial=0.08, unit=100.0):
+        return unit * n / (1.0 + serial * (n - 1))
+
+    def test_jumps_toward_predicted_knee(self):
+        opt = LocalAllreduceOptimizer(min_workers=1, max_workers=64)
+        for n in (1, 2, 4):
+            opt.record_speed(n, self._amdahl(n))
+        opt.set_current_workers(4)
+        plan = opt.generate_plan(JobStage.RUNNING)
+        assert plan is not None
+        count = plan.node_group_resources["worker"]["count"]
+        # knee for serial=0.08 at gain 0.6 is ~7; the 2x jump cap
+        # bounds a single plan at 8 — either way, a real multi-step
+        # jump instead of a 25% (=1 worker) increment
+        assert 4 < count <= 8, count
+
+    def test_settles_when_past_the_knee(self):
+        opt = LocalAllreduceOptimizer(min_workers=1, max_workers=64)
+        # strong serial fraction: knee is low
+        for n in (2, 8, 32):
+            opt.record_speed(n, self._amdahl(n, serial=0.9))
+        opt.set_current_workers(32)
+        plan = opt.generate_plan(JobStage.RUNNING)
+        assert plan is not None
+        count = plan.node_group_resources["worker"]["count"]
+        assert count < 32
+
+    def test_superlinear_history_grows(self):
+        opt = LocalAllreduceOptimizer(min_workers=1, max_workers=16)
+        for n, v in ((1, 100.0), (2, 210.0), (4, 450.0)):
+            opt.record_speed(n, v)
+        opt.set_current_workers(4)
+        plan = opt.generate_plan(JobStage.RUNNING)
+        assert plan is not None
+        assert plan.node_group_resources["worker"]["count"] == 8
+
+    def test_at_knee_no_plan(self):
+        opt = LocalAllreduceOptimizer(min_workers=1, max_workers=8)
+        for n in (2, 4, 8):
+            opt.record_speed(n, self._amdahl(n, serial=0.05))
+        opt.set_current_workers(8)  # max already
+        assert opt.generate_plan(JobStage.RUNNING) is None
