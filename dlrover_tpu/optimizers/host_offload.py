@@ -36,6 +36,14 @@ Two storage backends for the host state:
 
 Either way the state checkpoints through the flash-ckpt engine:
 leaves are ``device_get``-able (numpy ones already are).
+
+``moments="int8"`` additionally stores the offloaded moments
+blockwise-quantized (the host-offload dual of
+``optimizers.quantized_moments``): the per-step stream drops from
+~24 to ~12 bytes/param — the offload proof is PCIe-bound (~59% of
+device time in chunk DMA), so halving the traffic is the single
+biggest lever.  ``nu`` stores sqrt(nu) exactly like the resident int8
+optimizer (dynamic-range rationale in ``optimizers/low_bit.py``).
 """
 
 import functools
@@ -54,10 +62,16 @@ DEFAULT_CHUNK_ELEMS = 64 * 1024 * 1024
 
 class OffloadState(NamedTuple):
     """Train state for the offloaded path.  ``params`` is the bf16
-    device tree the forward consumes.  With the numpy backend,
-    master/mu/nu mirror the params tree with numpy leaves; with the
-    pinned_host backend they are per-leaf LISTS of host-memory chunk
-    arrays (wrapped in the same treedef)."""
+    device tree the forward consumes.  Host-state layout by
+    configuration:
+
+    - numpy + fp32 moments: master/mu/nu mirror the params tree with
+      whole-leaf numpy arrays (updated in place);
+    - pinned_host + fp32: per-leaf LISTS of host-memory chunk arrays;
+    - int8 moments (either backend): master as above, mu/nu as
+      per-leaf LISTS of ``(int8_payload, block_scales)`` tuples, one
+      per chunk (payload padded to the quant block).
+    """
 
     step: int
     params: Dict  # bf16, device
@@ -81,6 +95,54 @@ def _adamw_chunk_math(master, mu, nu, grad, bc1, bc2,
     return master, mu, nu, master.astype(jnp.bfloat16)
 
 
+# int8 moment quantization block — the SAME block the resident int8
+# optimizer quantizes over (a retune there must not silently diverge)
+from dlrover_tpu.ops.quantization import BLOCK as _QBLOCK  # noqa: E402
+
+
+def _deq_chunk(q, scales, n):
+    """int8 [padded] + per-1024-block scales -> fp32 [n]."""
+    x = q.astype(jnp.float32).reshape(-1, _QBLOCK) * scales[:, None]
+    return x.reshape(-1)[:n]
+
+
+def _quant_chunk(x):
+    """fp32 [n] -> (int8 [padded], per-block scales).  Plain jnp: the
+    op is memory-bound and lives inside the chunk jit, so XLA fuses it
+    into the same pass as the update math."""
+    n = x.shape[0]
+    pad = (-n) % _QBLOCK
+    if pad:
+        x = jnp.pad(x, (0, pad))
+    blocks = x.reshape(-1, _QBLOCK)
+    scales = jnp.maximum(
+        jnp.max(jnp.abs(blocks), axis=1) / 127.0, 1e-12
+    )
+    q = jnp.clip(
+        jnp.round(blocks / scales[:, None]), -127, 127
+    ).astype(jnp.int8)
+    return q.reshape(-1), scales
+
+
+def _adamw_chunk_math_q(master, mu_q, mu_s, nu_q, nu_s, grad,
+                        bc1, bc2, *, lr, b1, b2, eps, wd):
+    """AdamW over one chunk with int8-quantized moments: dequant ->
+    THE shared math -> requant, all inside one jit pass.  nu is
+    stored as sqrt(nu) (see optimizers/low_bit.py for the
+    dynamic-range rationale); squaring it reconstructs the value the
+    shared update consumes."""
+    n = master.shape[0]
+    mu = _deq_chunk(mu_q, mu_s, n)
+    nu_root = _deq_chunk(nu_q, nu_s, n)
+    master, mu, nu, p_bf16 = _adamw_chunk_math(
+        master, mu, nu_root * nu_root, grad, bc1, bc2,
+        lr=lr, b1=b1, b2=b2, eps=eps, wd=wd,
+    )
+    mu_q2, mu_s2 = _quant_chunk(mu)
+    nu_q2, nu_s2 = _quant_chunk(jnp.sqrt(nu))
+    return master, mu_q2, mu_s2, nu_q2, nu_s2, p_bf16
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("lr", "b1", "b2", "eps", "wd"),
@@ -91,6 +153,20 @@ def _chunk_update(master, mu, nu, grad, bc1, bc2,
     """numpy-backend entry: plain device in/out chunks."""
     return _adamw_chunk_math(
         master, mu, nu, grad, bc1, bc2,
+        lr=lr, b1=b1, b2=b2, eps=eps, wd=wd,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("lr", "b1", "b2", "eps", "wd"),
+    donate_argnums=(0, 1, 2, 3, 4),
+)
+def _chunk_update_q(master, mu_q, mu_s, nu_q, nu_s, grad, bc1, bc2,
+                    *, lr, b1, b2, eps, wd):
+    """numpy-backend entry, int8 moments."""
+    return _adamw_chunk_math_q(
+        master, mu_q, mu_s, nu_q, nu_s, grad, bc1, bc2,
         lr=lr, b1=b1, b2=b2, eps=eps, wd=wd,
     )
 
@@ -114,6 +190,7 @@ class HostOffloadAdamW:
         chunk_elems: int = DEFAULT_CHUNK_ELEMS,
         max_in_flight: int = 2,
         backend: str = "auto",
+        moments: str = "fp32",
     ):
         self.lr = learning_rate
         self.b1 = b1
@@ -122,6 +199,9 @@ class HostOffloadAdamW:
         self.wd = weight_decay
         self.chunk = int(chunk_elems)
         self.window = max(1, int(max_in_flight))
+        if moments not in ("fp32", "int8"):
+            raise ValueError(f"unknown moments dtype {moments!r}")
+        self.moments = moments
         if backend == "auto":
             backend = (
                 "pinned_host"
@@ -147,31 +227,60 @@ class HostOffloadAdamW:
         if getattr(self, "_pinned_fn", None) is not None:
             return self._pinned_fn
         dev, host = self._shardings()
-
-        def body(master, mu, nu, grad, bc1, bc2):
-            # host->HBM in, shared AdamW math, HBM->host out
-            m_d, mu_d, nu_d, p_bf16 = _adamw_chunk_math(
-                jax.device_put(master, dev),
-                jax.device_put(mu, dev),
-                jax.device_put(nu, dev),
-                grad, bc1, bc2,
-                lr=self.lr, b1=self.b1, b2=self.b2,
-                eps=self.eps, wd=self.wd,
-            )
-            return (
-                jax.device_put(m_d, host),
-                jax.device_put(mu_d, host),
-                jax.device_put(nu_d, host),
-                p_bf16,
-            )
-
-        self._pinned_fn = jax.jit(
-            body,
-            in_shardings=(host, host, host, dev, None, None),
-            out_shardings=(host, host, host, dev),
-            donate_argnums=(0, 1, 2),
+        hyper = dict(
+            lr=self.lr, b1=self.b1, b2=self.b2, eps=self.eps,
+            wd=self.wd,
         )
+
+        if self.moments == "int8":
+
+            def body(master, mu_q, mu_s, nu_q, nu_s, grad, bc1, bc2):
+                outs = _adamw_chunk_math_q(
+                    jax.device_put(master, dev),
+                    jax.device_put(mu_q, dev),
+                    jax.device_put(mu_s, dev),
+                    jax.device_put(nu_q, dev),
+                    jax.device_put(nu_s, dev),
+                    grad, bc1, bc2, **hyper,
+                )
+                return tuple(
+                    jax.device_put(o, host) for o in outs[:5]
+                ) + (outs[5],)
+
+            self._pinned_fn = jax.jit(
+                body,
+                in_shardings=(host,) * 5 + (dev, None, None),
+                out_shardings=(host,) * 5 + (dev,),
+                donate_argnums=(0, 1, 2, 3, 4),
+            )
+        else:
+
+            def body(master, mu, nu, grad, bc1, bc2):
+                # host->HBM in, shared AdamW math, HBM->host out
+                m_d, mu_d, nu_d, p_bf16 = _adamw_chunk_math(
+                    jax.device_put(master, dev),
+                    jax.device_put(mu, dev),
+                    jax.device_put(nu, dev),
+                    grad, bc1, bc2, **hyper,
+                )
+                return (
+                    jax.device_put(m_d, host),
+                    jax.device_put(mu_d, host),
+                    jax.device_put(nu_d, host),
+                    p_bf16,
+                )
+
+            self._pinned_fn = jax.jit(
+                body,
+                in_shardings=(host, host, host, dev, None, None),
+                out_shardings=(host, host, host, dev),
+                donate_argnums=(0, 1, 2),
+            )
         return self._pinned_fn
+
+    @staticmethod
+    def _q_padded(n: int) -> int:
+        return ((n + _QBLOCK - 1) // _QBLOCK) * _QBLOCK
 
     def _chunk_slices(self, n: int):
         return [
@@ -199,9 +308,28 @@ class HostOffloadAdamW:
             for sl in self._chunk_slices(flat.shape[0]):
                 chunk = flat[sl]
                 m_chunks.append(jax.device_put(chunk, host))
-                zero = jnp.zeros(chunk.shape, jnp.float32)
-                mu_chunks.append(jax.device_put(zero, host))
-                nu_chunks.append(jax.device_put(zero, host))
+                if self.moments == "int8":
+                    padded = self._q_padded(chunk.shape[0])
+                    zq = jnp.zeros((padded,), jnp.int8)
+                    zs = jnp.zeros(
+                        (padded // _QBLOCK,), jnp.float32
+                    )
+                    mu_chunks.append(
+                        (
+                            jax.device_put(zq, host),
+                            jax.device_put(zs, host),
+                        )
+                    )
+                    nu_chunks.append(
+                        (
+                            jax.device_put(zq, host),
+                            jax.device_put(zs, host),
+                        )
+                    )
+                else:
+                    zero = jnp.zeros(chunk.shape, jnp.float32)
+                    mu_chunks.append(jax.device_put(zero, host))
+                    nu_chunks.append(jax.device_put(zero, host))
             master.append(m_chunks)
             mu.append(mu_chunks)
             nu.append(nu_chunks)
@@ -225,17 +353,35 @@ class HostOffloadAdamW:
             lambda p: np.array(p, dtype=np.float32, order="C"),
             params,
         )
-        zeros = jax.tree_util.tree_map(
-            lambda p: np.zeros(p.shape, np.float32), master
-        )
-        zeros2 = jax.tree_util.tree_map(
-            lambda p: np.zeros(p.shape, np.float32), master
-        )
+        if self.moments == "int8":
+            def zq_chunks(p):
+                out = []
+                for sl in self._chunk_slices(p.size):
+                    padded = self._q_padded(sl.stop - sl.start)
+                    out.append(
+                        (
+                            np.zeros((padded,), np.int8),
+                            np.zeros(
+                                (padded // _QBLOCK,), np.float32
+                            ),
+                        )
+                    )
+                return out
+
+            mu = jax.tree_util.tree_map(zq_chunks, master)
+            nu = jax.tree_util.tree_map(zq_chunks, master)
+        else:
+            mu = jax.tree_util.tree_map(
+                lambda p: np.zeros(p.shape, np.float32), master
+            )
+            nu = jax.tree_util.tree_map(
+                lambda p: np.zeros(p.shape, np.float32), master
+            )
         bf16 = jax.tree_util.tree_map(
             lambda p: jnp.asarray(p, dtype=jnp.bfloat16), master
         )
         return OffloadState(
-            step=0, params=bf16, master=master, mu=zeros, nu=zeros2
+            step=0, params=bf16, master=master, mu=mu, nu=nu
         )
 
     # --------------------------------------------------------- update
@@ -271,17 +417,27 @@ class HostOffloadAdamW:
             slices = self._chunk_slices(flat_g.shape[0])
             ms, mus, nus, ps = [], [], [], []
             for j, sl in enumerate(slices):
-                m_h, mu_h, nu_h, p_d = fn(
-                    m_chunks[j],
-                    leaves_mu[li][j],
-                    leaves_nu[li][j],
-                    flat_g[sl],
-                    bc1,
-                    bc2,
-                )
+                if self.moments == "int8":
+                    mu_q, mu_s = leaves_mu[li][j]
+                    nu_q, nu_s = leaves_nu[li][j]
+                    (m_h, mu_q2, mu_s2, nu_q2, nu_s2, p_d) = fn(
+                        m_chunks[j], mu_q, mu_s, nu_q, nu_s,
+                        flat_g[sl], bc1, bc2,
+                    )
+                    mus.append((mu_q2, mu_s2))
+                    nus.append((nu_q2, nu_s2))
+                else:
+                    m_h, mu_h, nu_h, p_d = fn(
+                        m_chunks[j],
+                        leaves_mu[li][j],
+                        leaves_nu[li][j],
+                        flat_g[sl],
+                        bc1,
+                        bc2,
+                    )
+                    mus.append(mu_h)
+                    nus.append(nu_h)
                 ms.append(m_h)
-                mus.append(mu_h)
-                nus.append(nu_h)
                 ps.append(p_d)
             new_m.append(ms)
             new_mu.append(mus)
@@ -310,42 +466,73 @@ class HostOffloadAdamW:
         leaves_g = treedef.flatten_up_to(grads)
 
         new_param_chunks: Dict[int, list] = {}
-        in_flight = []  # (leaf_idx, chunk_slice, device results)
+        in_flight = []  # (leaf_idx, chunk_slice, chunk_idx, results)
+
+        int8 = self.moments == "int8"
+        hyper = dict(
+            lr=self.lr, b1=self.b1, b2=self.b2, eps=self.eps,
+            wd=self.wd,
+        )
 
         def drain_one():
-            li, sl, res = in_flight.pop(0)
-            m_d, mu_d, nu_d, p_d = res
-            # d2h writebacks into the SAME host buffers
-            np.copyto(
-                leaves_m[li].reshape(-1)[sl], np.asarray(m_d)
-            )
-            np.copyto(
-                leaves_mu[li].reshape(-1)[sl], np.asarray(mu_d)
-            )
-            np.copyto(
-                leaves_nu[li].reshape(-1)[sl], np.asarray(nu_d)
-            )
+            li, sl, j, res = in_flight.pop(0)
+            if int8:
+                m_d, mu_q, mu_s, nu_q, nu_s, p_d = res
+                np.copyto(
+                    leaves_m[li].reshape(-1)[sl], np.asarray(m_d)
+                )
+                qb, sb = leaves_mu[li][j]
+                np.copyto(qb, np.asarray(mu_q))
+                np.copyto(sb, np.asarray(mu_s))
+                qb, sb = leaves_nu[li][j]
+                np.copyto(qb, np.asarray(nu_q))
+                np.copyto(sb, np.asarray(nu_s))
+            else:
+                m_d, mu_d, nu_d, p_d = res
+                # d2h writebacks into the SAME host buffers
+                np.copyto(
+                    leaves_m[li].reshape(-1)[sl], np.asarray(m_d)
+                )
+                np.copyto(
+                    leaves_mu[li].reshape(-1)[sl], np.asarray(mu_d)
+                )
+                np.copyto(
+                    leaves_nu[li].reshape(-1)[sl], np.asarray(nu_d)
+                )
             new_param_chunks.setdefault(li, []).append(p_d)
 
         for li in range(len(leaves_m)):
             flat_m = leaves_m[li].reshape(-1)
-            flat_mu = leaves_mu[li].reshape(-1)
-            flat_nu = leaves_nu[li].reshape(-1)
             flat_g = leaves_g[li].reshape(-1)
             n = flat_m.shape[0]
-            for lo in range(0, n, self.chunk):
-                sl = slice(lo, min(lo + self.chunk, n))
-                res = _chunk_update(
-                    jnp.asarray(flat_m[sl]),
-                    jnp.asarray(flat_mu[sl]),
-                    jnp.asarray(flat_nu[sl]),
-                    flat_g[sl],
-                    bc1,
-                    bc2,
-                    lr=self.lr, b1=self.b1, b2=self.b2,
-                    eps=self.eps, wd=self.wd,
-                )
-                in_flight.append((li, sl, res))
+            for j, sl in enumerate(self._chunk_slices(n)):
+                if int8:
+                    mu_q, mu_s = leaves_mu[li][j]
+                    nu_q, nu_s = leaves_nu[li][j]
+                    res = _chunk_update_q(
+                        jnp.asarray(flat_m[sl]),
+                        jnp.asarray(mu_q),
+                        jnp.asarray(mu_s),
+                        jnp.asarray(nu_q),
+                        jnp.asarray(nu_s),
+                        flat_g[sl],
+                        bc1,
+                        bc2,
+                        **hyper,
+                    )
+                else:
+                    flat_mu = leaves_mu[li].reshape(-1)
+                    flat_nu = leaves_nu[li].reshape(-1)
+                    res = _chunk_update(
+                        jnp.asarray(flat_m[sl]),
+                        jnp.asarray(flat_mu[sl]),
+                        jnp.asarray(flat_nu[sl]),
+                        flat_g[sl],
+                        bc1,
+                        bc2,
+                        **hyper,
+                    )
+                in_flight.append((li, sl, j, res))
                 # bounded window: older chunks' HBM buffers are freed
                 # by the writeback before new ones are dispatched
                 while len(in_flight) > self.window:

@@ -247,3 +247,63 @@ class TestPinnedHostBackend:
         for chunk in state.mu["w"]:
             assert chunk.sharding.memory_kind == "pinned_host"
         assert state.params["w"].dtype == jnp.bfloat16
+
+
+class TestInt8Moments:
+    """moments="int8": offloaded moments stored blockwise-quantized —
+    halves the per-step PCIe stream of the offload path (which the
+    op-time report showed is ~59% chunk DMA)."""
+
+    def test_converges_like_fp32(self):
+        target = jnp.full((2100,), 2.0)  # not a QBLOCK multiple
+
+        def loss_fn(params, batch):
+            pred = params["w"].astype(jnp.float32) * batch["x"]
+            return jnp.mean((pred - target) ** 2)
+
+        def run(moments):
+            init_state, train_step = build_offloaded_train_step(
+                loss_fn,
+                lambda rng: {
+                    "w": jax.random.normal(rng, (2100,), jnp.float32)
+                },
+                HostOffloadAdamW(
+                    learning_rate=0.1, chunk_elems=1000,
+                    backend="numpy", moments=moments,
+                ),
+            )
+            state = init_state(jax.random.PRNGKey(0))
+            batch = {"x": jnp.ones((2100,))}
+            for _ in range(50):
+                state, metrics = train_step(state, batch)
+            return float(metrics["loss"]), state
+
+        loss_fp32, _ = run("fp32")
+        loss_int8, state = run("int8")
+        # int8 moments track the fp32 trajectory to quantization noise
+        assert loss_int8 < 0.1
+        assert abs(loss_int8 - loss_fp32) < 0.05
+        assert state.step == 50
+
+    def test_state_layout_and_memory(self):
+        opt = HostOffloadAdamW(
+            backend="numpy", moments="int8", chunk_elems=2048
+        )
+        state = opt.init({"w": np.ones((5000,), np.float32)})
+        chunks = state.mu["w"]
+        assert len(chunks) == 3  # 2048 + 2048 + 904(padded 1024)
+        q, s = chunks[0]
+        assert q.dtype == np.int8 and q.shape == (2048,)
+        assert s.shape == (2,)
+        q_tail, s_tail = chunks[2]
+        assert q_tail.shape == (1024,)  # padded to QBLOCK
+        # in-place buffer reuse after a step
+        state2 = opt.apply_gradients(
+            state, {"w": jnp.ones((5000,), jnp.float32)}
+        )
+        assert state2.mu["w"][0][0] is q
+        assert not np.all(q == 0)  # updated in place
+
+    def test_bad_moments_value_raises(self):
+        with pytest.raises(ValueError, match="moments"):
+            HostOffloadAdamW(moments="fp8")
