@@ -41,6 +41,9 @@ from dlrover_tpu.parallel.sharding import (
 FLASH_ENV = "DLROVER_TPU_FLASH_ATTENTION"
 # test/override hook: "auto" | "ring" | "ulysses"
 SP_KERNEL_ENV = "DLROVER_TPU_SP_KERNEL"
+# solver-chosen flash tiles, "block_q,block_kv" (empty = measured
+# defaults); accelerate.solve_joint_plan emits the pair
+FLASH_BLOCKS_ENV = "DLROVER_TPU_FLASH_BLOCKS"
 
 
 def _flash_enabled(flash: Optional[bool]) -> bool:
@@ -93,6 +96,23 @@ def select_attention(
         _fa.flash_attention if use_flash
         else _llama.dot_product_attention
     )
+    if use_flash:
+        # tile override: apply a solver-chosen flash tile without
+        # touching model code
+        blocks = os.getenv(FLASH_BLOCKS_ENV, "")
+        if blocks:
+            try:
+                bq, bk = (int(x) for x in blocks.split(","))
+                if bq <= 0 or bk <= 0:
+                    raise ValueError("blocks must be positive")
+                inner = partial(
+                    inner, block_q=bq, block_k=bk
+                )
+            except ValueError:
+                logger.warning(
+                    "ignoring malformed %s=%r",
+                    FLASH_BLOCKS_ENV, blocks,
+                )
 
     seq_size = (
         mesh_ctx.axis_size(AxisName.SEQUENCE) if mesh_ctx else 1
@@ -263,11 +283,18 @@ def _sp_under_shard_map(mesh_ctx: MeshContext,
                 causal=causal,
             )
         else:
+            # a tile override carried by the inner partial must reach
+            # the ring's per-block kernel too — seq-sharded strategies
+            # are exactly where the solver sizes tiles for the LOCAL
+            # sequence
+            tile_kwargs = getattr(inner_attention, "keywords", {})
             fn = partial(
                 ring_attention,
                 axis_name=AxisName.SEQUENCE,
                 causal=causal,
                 use_flash=use_flash,
+                block_q=tile_kwargs.get("block_q"),
+                block_k=tile_kwargs.get("block_k"),
             )
         sp = shard_map(
             fn,

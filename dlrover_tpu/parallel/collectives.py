@@ -173,6 +173,8 @@ def ring_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     use_flash: Optional[bool] = None,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
 ) -> jnp.ndarray:
     """Blockwise ring attention over a sequence-sharded mesh axis.
 
@@ -213,7 +215,8 @@ def ring_attention(
     def inner(qq, kc, vc, causal_):
         if use_flash:
             return flash_attention_lse(
-                qq, kc, vc, causal=causal_, sm_scale=scale
+                qq, kc, vc, causal=causal_, sm_scale=scale,
+                block_q=block_q, block_k=block_k,
             )
         return _dense_block_lse(qq, kc, vc, causal_, scale)
 

@@ -531,3 +531,56 @@ class TestModuleReplace:
         result_dp = self._accelerate(cfg, {"data": 8, "remat": "none"})
         loss_dp = self._step(result_dp)
         np.testing.assert_allclose(loss_sp, loss_dp, rtol=2e-3)
+
+
+class TestFlashBlockOverride:
+    def test_env_tile_override_applied(self, monkeypatch):
+        """The solver's (block_q, block_kv) choice is appliable via
+        DLROVER_TPU_FLASH_BLOCKS without touching model code."""
+        import functools
+
+        from dlrover_tpu.accelerate.module_replace import (
+            select_attention,
+        )
+
+        monkeypatch.setenv("DLROVER_TPU_FLASH_BLOCKS", "256,128")
+        monkeypatch.setenv("DLROVER_TPU_FLASH_ATTENTION", "1")
+        fn = select_attention(None, None)
+        assert isinstance(fn, functools.partial)
+        assert fn.keywords == {"block_q": 256, "block_k": 128}
+        # the wrapped kernel still runs (interpret mode on CPU)
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        q = jax.random.normal(
+            jax.random.PRNGKey(0), (1, 256, 2, 128), jnp.float32
+        )
+        out = fn(q, q, q, causal=True)
+        assert out.shape == q.shape
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_malformed_override_ignored(self, monkeypatch):
+        from dlrover_tpu.accelerate.module_replace import (
+            select_attention,
+        )
+
+        monkeypatch.setenv("DLROVER_TPU_FLASH_BLOCKS", "nope")
+        monkeypatch.setenv("DLROVER_TPU_FLASH_ATTENTION", "1")
+        fn = select_attention(None, None)
+        import functools
+
+        assert not isinstance(fn, functools.partial)
+
+    def test_zero_block_override_ignored(self, monkeypatch):
+        import functools
+
+        from dlrover_tpu.accelerate.module_replace import (
+            select_attention,
+        )
+
+        monkeypatch.setenv("DLROVER_TPU_FLASH_BLOCKS", "0,128")
+        monkeypatch.setenv("DLROVER_TPU_FLASH_ATTENTION", "1")
+        assert not isinstance(
+            select_attention(None, None), functools.partial
+        )
