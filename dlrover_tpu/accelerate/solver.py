@@ -100,7 +100,8 @@ def candidate_tiles(
     - blocks are multiples of 128 covering the sequence evenly;
     - ≥2 q-blocks per (batch, head) grid row: with one q block the
       kernel's KV stream cannot overlap the next row's prologue
-      (block_q ≤ seq/2);
+      (block_q ≤ seq/2) — validated on chip: forcing 2048×512 at
+      seq 2048 measures 0.521 MFU vs 0.530 at 1024×512 (r4);
     - bwd VMEM working set fits the budget: two bq×bk f32 score/
       dscore tiles + ~7 tile×head_dim f32 operands (q, k, v, o, do,
       dq, partial dk/dv);
