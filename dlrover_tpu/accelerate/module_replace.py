@@ -105,9 +105,27 @@ def select_attention(
                 bq, bk = (int(x) for x in blocks.split(","))
                 if bq <= 0 or bk <= 0:
                     raise ValueError("blocks must be positive")
-                inner = partial(
-                    inner, block_q=bq, block_k=bk
-                )
+                # clamp to the LOCAL sequence at call time: under a
+                # seq-sharded mesh the kernel sees seq/s.seq, and a
+                # well-formed override sized for the global seq would
+                # otherwise fail at kernel build (ADVICE-r4).  The
+                # clamp point is the first place local shapes exist.
+                base = inner
+
+                def inner(q, k, v, *a, _base=base, _bq=bq, _bk=bk,
+                          **kw):
+                    lbq = min(_bq, q.shape[1])
+                    lbk = min(_bk, k.shape[1])
+                    if (lbq, lbk) != (_bq, _bk):
+                        logger.warning(
+                            "%s=%r exceeds local seq (q=%d k=%d); "
+                            "clamped to %d,%d",
+                            FLASH_BLOCKS_ENV, blocks,
+                            q.shape[1], k.shape[1], lbq, lbk,
+                        )
+                    return _base(
+                        q, k, v, *a, block_q=lbq, block_k=lbk, **kw
+                    )
             except ValueError:
                 logger.warning(
                     "ignoring malformed %s=%r",
@@ -296,9 +314,23 @@ def _sp_under_shard_map(mesh_ctx: MeshContext,
                 block_q=tile_kwargs.get("block_q"),
                 block_k=tile_kwargs.get("block_k"),
             )
+        # inside another manual region (the pipe executor's
+        # partial-manual shard_map), the inner map must be built on
+        # the AMBIENT abstract mesh — passing the concrete mesh trips
+        # "context mesh should match" because pipe is already Manual
+        import jax as _jax
+
+        use_mesh = mesh
+        cur = _jax.sharding.get_abstract_mesh()
+        if cur is not None and getattr(cur, "axis_names", ()):
+            if any(
+                "Manual" in str(t)
+                for t in getattr(cur, "axis_types", ())
+            ):
+                use_mesh = cur
         sp = shard_map(
             fn,
-            mesh=mesh,
+            mesh=use_mesh,
             in_specs=(q_spec, kv_spec, kv_spec),
             out_specs=q_spec,
             check_vma=False,

@@ -208,14 +208,29 @@ class ZygoteServer:
                     flush=True,
                 )
         jax = sys.modules.get("jax")
-        if jax is not None and getattr(
-            jax._src.xla_bridge, "_backends", None
-        ):
-            # a live backend would not survive fork — refuse to serve
-            raise RuntimeError(
-                "zygote preload initialized a jax backend; "
-                "remove the offending preload module"
+        if jax is not None:
+            # a live backend would not survive fork — refuse to serve.
+            # The check reads a private attribute; if a jax upgrade
+            # moves it the guard must DEGRADE LOUDLY, not silently
+            # vanish (ADVICE-r4)
+            bridge = getattr(
+                getattr(jax, "_src", None), "xla_bridge", None
             )
+            backends = getattr(bridge, "_backends", None)
+            if bridge is None or backends is None:
+                print(
+                    "zygote: WARNING jax._src.xla_bridge._backends "
+                    "not found — cannot verify no backend was "
+                    "initialized by preload modules; forked workers "
+                    "may inherit a broken backend",
+                    file=sys.stderr,
+                    flush=True,
+                )
+            elif backends:
+                raise RuntimeError(
+                    "zygote preload initialized a jax backend; "
+                    "remove the offending preload module"
+                )
         print(
             f"zygote: ready ({len(modules)} modules in "
             f"{time.time() - t0:.1f}s)",
@@ -333,20 +348,22 @@ class ZygoteHandle:
             )
         except (ConnectionError, OSError):
             # zygote gone: its children were reparented to init and
-            # keep running.  Once the pid disappears, the child's own
-            # exit record distinguishes a clean completion from a
-            # crash (a signal death writes no record -> ORPHAN_EXIT)
-            try:
-                os.kill(self.pid, 0)
-            except ProcessLookupError:
-                recorded = read_exit_record(
-                    self._pool.exit_dir, self.pid
-                )
-                self.returncode = (
-                    recorded
-                    if recorded is not None
-                    else ZygotePool.ORPHAN_EXIT
-                )
+            # keep running.  The child's own exit record is consulted
+            # FIRST: after a clean exit the kernel may recycle the pid
+            # for an unrelated process, and a liveness probe alone
+            # would then report the dead rank as running forever
+            # (ADVICE-r4).  A signal death writes no record; only then
+            # does the probe decide alive vs ORPHAN_EXIT.
+            recorded = read_exit_record(
+                self._pool.exit_dir, self.pid
+            )
+            if recorded is not None:
+                self.returncode = recorded
+            else:
+                try:
+                    os.kill(self.pid, 0)
+                except ProcessLookupError:
+                    self.returncode = ZygotePool.ORPHAN_EXIT
         return self.returncode
 
     def wait(self, timeout: Optional[float] = None) -> int:

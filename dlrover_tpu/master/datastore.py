@@ -37,7 +37,8 @@ CREATE TABLE IF NOT EXISTS strategy_measurements (
     workload TEXT NOT NULL,
     strategy TEXT NOT NULL,
     step_time_s REAL NOT NULL,
-    created_at REAL NOT NULL
+    created_at REAL NOT NULL,
+    job TEXT NOT NULL DEFAULT ''
 );
 CREATE INDEX IF NOT EXISTS idx_meas_workload
     ON strategy_measurements (workload, created_at);
@@ -75,26 +76,78 @@ class BrainDatastore:
         parent = os.path.dirname(os.path.abspath(db_path))
         os.makedirs(parent, exist_ok=True)
         self._lock = threading.Lock()
+        # timeout + WAL: the store is no longer single-master — a
+        # fleet can point several job masters at one db file (the
+        # reference's cluster-wide Brain over MySQL,
+        # ref: dlrover/go/brain/pkg/datastore/dbbase/recorder.go:280)
+        # and WAL lets one master read while another commits
         self._conn = sqlite3.connect(
-            db_path, check_same_thread=False
+            db_path, check_same_thread=False, timeout=10.0
         )
         with self._lock:
+            try:
+                self._conn.execute("PRAGMA journal_mode=WAL")
+                self._conn.execute("PRAGMA busy_timeout=10000")
+            except sqlite3.OperationalError:
+                pass  # read-only FS etc.: plain journaling still works
             self._conn.executescript(_SCHEMA)
+            # migration: pre-r5 files lack the job column on
+            # strategy_measurements (calibration provenance +
+            # per-job pruning)
+            try:
+                self._conn.execute(
+                    "ALTER TABLE strategy_measurements "
+                    "ADD COLUMN job TEXT NOT NULL DEFAULT ''"
+                )
+            except sqlite3.OperationalError:
+                pass  # column already present
             self._conn.commit()
         logger.info("brain datastore at %s", db_path)
+        # startup hygiene: long-lived masters append forever, and the
+        # reads are LIMITed but full-table scans (measured_workloads)
+        # and the file itself keep growing — drop ancient rows here so
+        # every restart bounds the store (ADVICE-r4).  The FIXED 30d
+        # floor applies globally; the operator's env override applies
+        # only to THIS job's rows when a job name is known — in a
+        # shared multi-job db, one short-retention job restarting must
+        # not delete its neighbours' history
+        self.prune(30.0 * 24 * 3600)
+        env_age = os.getenv("DLROVER_TPU_BRAIN_MAX_AGE_S")
+        if env_age:
+            try:
+                age = float(env_age)
+            except ValueError:
+                logger.warning(
+                    "ignoring malformed DLROVER_TPU_BRAIN_MAX_AGE_S"
+                    "=%r", env_age,
+                )
+            else:
+                own_job = os.getenv("DLROVER_TPU_JOB_NAME", "")
+                self.prune(age, job=own_job or None)
 
     # ------------------------------------------- strategy measurements
     def record_measurement(
-        self, workload: str, strategy: Dict, step_time_s: float
+        self,
+        workload: str,
+        strategy: Dict,
+        step_time_s: float,
+        job: str = "",
     ):
+        """``job`` tags provenance: measurements are keyed by
+        WORKLOAD (hardware+model signature), so any job's master can
+        learn from any other job's calibration through a shared db
+        file — the cluster-wide role of the reference's Brain."""
         with self._lock:
             self._conn.execute(
-                "INSERT INTO strategy_measurements VALUES (?,?,?,?)",
+                "INSERT INTO strategy_measurements "
+                "(workload, strategy, step_time_s, created_at, job) "
+                "VALUES (?,?,?,?,?)",
                 (
                     workload,
                     json.dumps(strategy, separators=(",", ":")),
                     float(step_time_s),
                     time.time(),
+                    job,
                 ),
             )
             self._conn.commit()
@@ -194,7 +247,11 @@ class BrainDatastore:
         ]
 
     # ------------------------------------------------------- hygiene
-    def prune(self, max_age_s: float):
+    def prune(self, max_age_s: float, job: Optional[str] = None):
+        """Drop rows older than ``max_age_s``; with ``job`` given,
+        only that job's rows (a finished job's master cleans up after
+        itself without touching its neighbours' history in a shared
+        db)."""
         cutoff = time.time() - max_age_s
         with self._lock:
             for table in (
@@ -202,10 +259,12 @@ class BrainDatastore:
                 "speed_samples",
                 "node_events",
             ):
-                self._conn.execute(
-                    f"DELETE FROM {table} WHERE created_at < ?",  # noqa: S608 - fixed table names
-                    (cutoff,),
-                )
+                q = f"DELETE FROM {table} WHERE created_at < ?"  # noqa: S608 - fixed table names
+                args: List = [cutoff]
+                if job is not None:
+                    q += " AND job = ?"
+                    args.append(job)
+                self._conn.execute(q, args)
             self._conn.commit()
 
     def close(self):

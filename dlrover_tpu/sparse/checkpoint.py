@@ -349,6 +349,16 @@ class SparseCheckpointManager:
                         "sparse ckpt: cleared %s live rows from %s "
                         "before restore", dropped, name,
                     )
+            else:
+                # clearing is REQUIRED for an exact rewind; a table
+                # type without clear() keeps whatever rows were
+                # inserted after the restore point (ADVICE-r4: the
+                # phantom-row risk must be visible, not silent)
+                logger.warning(
+                    "sparse ckpt: table %s has no clear(); rows "
+                    "written after the restore point survive the "
+                    "rewind (phantom-row risk)", name,
+                )
         for payload in loaded:
             for name, (keys, values) in payload.items():
                 if keys.size:

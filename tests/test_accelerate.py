@@ -543,11 +543,10 @@ class TestFlashBlockOverride:
             select_attention,
         )
 
+        del functools  # behavior, not representation, is the contract
         monkeypatch.setenv("DLROVER_TPU_FLASH_BLOCKS", "256,128")
         monkeypatch.setenv("DLROVER_TPU_FLASH_ATTENTION", "1")
         fn = select_attention(None, None)
-        assert isinstance(fn, functools.partial)
-        assert fn.keywords == {"block_q": 256, "block_k": 128}
         # the wrapped kernel still runs (interpret mode on CPU)
         import jax
         import jax.numpy as jnp
@@ -558,6 +557,26 @@ class TestFlashBlockOverride:
         )
         out = fn(q, q, q, causal=True)
         assert out.shape == q.shape
+        # an override sized for the GLOBAL seq must clamp to the
+        # local shard's seq instead of failing at kernel build
+        # (ADVICE-r4): local seq 64 < block_q 256
+        q_small = q[:, :64]
+        out_small = fn(q_small, q_small, q_small, causal=True)
+        assert out_small.shape == q_small.shape
+        # parity with the unclamped kernel on the small shard
+        from dlrover_tpu.ops.flash_attention import flash_attention
+
+        np.testing.assert_allclose(
+            np.asarray(out_small, np.float32),
+            np.asarray(
+                flash_attention(
+                    q_small, q_small, q_small, causal=True,
+                    block_q=64, block_k=64,
+                ),
+                np.float32,
+            ),
+            rtol=2e-3, atol=2e-3,
+        )
         assert np.isfinite(np.asarray(out)).all()
 
     def test_malformed_override_ignored(self, monkeypatch):
