@@ -47,6 +47,7 @@ optimizer (dynamic-range rationale in ``optimizers/low_bit.py``).
 """
 
 import functools
+import os
 from typing import Dict, NamedTuple, Optional
 
 import jax
@@ -58,6 +59,44 @@ from dlrover_tpu.common.log import default_logger as logger
 # 64M elements = 256 MB per fp32 chunk buffer; the update transient is
 # ~6 buffers (3 in, 3 out) plus the resident bf16 params and grads
 DEFAULT_CHUNK_ELEMS = 64 * 1024 * 1024
+
+_HOST_KIND_PROBED: Optional[bool] = None
+
+
+def _pinned_host_works() -> bool:
+    """Whether this backend supports the ``pinned_host`` memory kind
+    (TPU yes; the CPU test mesh no).  Probed once: a failed probe
+    downgrades host shardings to plain device shardings so the SAME
+    code path runs — with identical math — where no second memory
+    space exists."""
+    global _HOST_KIND_PROBED
+    if _HOST_KIND_PROBED is None:
+        from jax.sharding import SingleDeviceSharding
+
+        try:
+            dev = SingleDeviceSharding(jax.devices()[0])
+            host = dev.with_memory_kind("pinned_host")
+            x = jax.device_put(jnp.zeros((8,)), host)
+            # the fused path moves between memory spaces INSIDE jit
+            # (annotate_device_placement) — CPU accepts the plain
+            # device_put above but cannot lower the in-program form,
+            # so the probe must exercise it
+            fn = jax.jit(
+                lambda a: jax.device_put(
+                    jax.device_put(a, dev) + 1.0, host
+                ),
+                in_shardings=host,
+                out_shardings=host,
+            )
+            jax.block_until_ready(fn(x))
+            _HOST_KIND_PROBED = True
+        except Exception:  # noqa: BLE001 - any failure means "no"
+            _HOST_KIND_PROBED = False
+            logger.info(
+                "pinned_host memory kind unavailable; host-offload "
+                "shardings fall back to device memory"
+            )
+    return _HOST_KIND_PROBED
 
 
 class OffloadState(NamedTuple):
@@ -217,7 +256,10 @@ class HostOffloadAdamW:
         from jax.sharding import SingleDeviceSharding
 
         dev = SingleDeviceSharding(jax.devices()[0])
-        host = dev.with_memory_kind("pinned_host")
+        if _pinned_host_works():
+            host = dev.with_memory_kind("pinned_host")
+        else:
+            host = dev
         return dev, host
 
     def _pinned_update_fn(self):
@@ -308,28 +350,40 @@ class HostOffloadAdamW:
             for sl in self._chunk_slices(flat.shape[0]):
                 chunk = flat[sl]
                 m_chunks.append(jax.device_put(chunk, host))
+                # mu and nu get DISTINCT zero buffers: device_put of
+                # the same array can return an aliased buffer, and
+                # aliased leaves break donation in the fused step
                 if self.moments == "int8":
                     padded = self._q_padded(chunk.shape[0])
-                    zq = jnp.zeros((padded,), jnp.int8)
-                    zs = jnp.zeros(
-                        (padded // _QBLOCK,), jnp.float32
-                    )
+
+                    def zq():
+                        return jax.device_put(
+                            jnp.zeros((padded,), jnp.int8), host
+                        )
+
+                    def zs():
+                        return jax.device_put(
+                            jnp.zeros(
+                                (padded // _QBLOCK,), jnp.float32
+                            ),
+                            host,
+                        )
+
+                    mu_chunks.append((zq(), zs()))
+                    nu_chunks.append((zq(), zs()))
+                else:
                     mu_chunks.append(
-                        (
-                            jax.device_put(zq, host),
-                            jax.device_put(zs, host),
+                        jax.device_put(
+                            jnp.zeros(chunk.shape, jnp.float32),
+                            host,
                         )
                     )
                     nu_chunks.append(
-                        (
-                            jax.device_put(zq, host),
-                            jax.device_put(zs, host),
+                        jax.device_put(
+                            jnp.zeros(chunk.shape, jnp.float32),
+                            host,
                         )
                     )
-                else:
-                    zero = jnp.zeros(chunk.shape, jnp.float32)
-                    mu_chunks.append(jax.device_put(zero, host))
-                    nu_chunks.append(jax.device_put(zero, host))
             master.append(m_chunks)
             mu.append(mu_chunks)
             nu.append(nu_chunks)
@@ -385,16 +439,57 @@ class HostOffloadAdamW:
         )
 
     # --------------------------------------------------------- update
+    def start_prefetch(self, state: OffloadState):
+        """Dispatch async H2D of the first ``max_in_flight`` chunk
+        window of host state (numpy backend).  Called BEFORE backward
+        so the transfers overlap the compute; the returned dict feeds
+        :meth:`apply_gradients`.  The pinned_host backend overlaps
+        via :func:`build_fused_offload_step` instead (out-of-program
+        ``device_put`` dispatch overhead makes per-chunk prefetch a
+        loss there)."""
+        if self.backend != "numpy":
+            return None
+        leaves_m, treedef = jax.tree_util.tree_flatten(state.master)
+        leaves_mu = treedef.flatten_up_to(state.mu)
+        leaves_nu = treedef.flatten_up_to(state.nu)
+        prefetched = {}
+        budget = self.window
+        for li, m in enumerate(leaves_m):
+            flat_m = m.reshape(-1)
+            if self.moments == "fp32":
+                flat_mu = leaves_mu[li].reshape(-1)
+                flat_nu = leaves_nu[li].reshape(-1)
+            for j, sl in enumerate(self._chunk_slices(m.size)):
+                if budget <= 0:
+                    return prefetched
+                if self.moments == "int8":
+                    mu_q, mu_s = leaves_mu[li][j]
+                    nu_q, nu_s = leaves_nu[li][j]
+                    prefetched[(li, j)] = (
+                        jnp.asarray(flat_m[sl]),
+                        jnp.asarray(mu_q), jnp.asarray(mu_s),
+                        jnp.asarray(nu_q), jnp.asarray(nu_s),
+                    )
+                else:
+                    prefetched[(li, j)] = (
+                        jnp.asarray(flat_m[sl]),
+                        jnp.asarray(flat_mu[sl]),
+                        jnp.asarray(flat_nu[sl]),
+                    )
+                budget -= 1
+        return prefetched
+
     def apply_gradients(
-        self, state: OffloadState, grads
+        self, state: OffloadState, grads, prefetched=None
     ) -> OffloadState:
         """One AdamW step.  ``grads``: device pytree matching
         ``state.params``.  Streams chunks through the chip; host
         buffers are recycled (donation on pinned_host, in-place numpy
-        otherwise)."""
+        otherwise).  ``prefetched``: optional chunk window from
+        :meth:`start_prefetch`."""
         if self.backend == "pinned_host":
             return self._apply_pinned(state, grads)
-        return self._apply_numpy(state, grads)
+        return self._apply_numpy(state, grads, prefetched)
 
     def _apply_pinned(
         self, state: OffloadState, grads
@@ -454,8 +549,9 @@ class HostOffloadAdamW:
         )
 
     def _apply_numpy(
-        self, state: OffloadState, grads
+        self, state: OffloadState, grads, prefetched=None
     ) -> OffloadState:
+        prefetched = prefetched or {}
         step = state.step + 1
         bc1 = jnp.float32(1.0 - self.b1**step)
         bc2 = jnp.float32(1.0 - self.b2**step)
@@ -506,31 +602,32 @@ class HostOffloadAdamW:
             flat_g = leaves_g[li].reshape(-1)
             n = flat_m.shape[0]
             for j, sl in enumerate(self._chunk_slices(n)):
+                pre = prefetched.get((li, j))
                 if int8:
-                    mu_q, mu_s = leaves_mu[li][j]
-                    nu_q, nu_s = leaves_nu[li][j]
+                    if pre is None:
+                        mu_q, mu_s = leaves_mu[li][j]
+                        nu_q, nu_s = leaves_nu[li][j]
+                        pre = (
+                            jnp.asarray(flat_m[sl]),
+                            jnp.asarray(mu_q),
+                            jnp.asarray(mu_s),
+                            jnp.asarray(nu_q),
+                            jnp.asarray(nu_s),
+                        )
                     res = _chunk_update_q(
-                        jnp.asarray(flat_m[sl]),
-                        jnp.asarray(mu_q),
-                        jnp.asarray(mu_s),
-                        jnp.asarray(nu_q),
-                        jnp.asarray(nu_s),
-                        flat_g[sl],
-                        bc1,
-                        bc2,
-                        **hyper,
+                        *pre, flat_g[sl], bc1, bc2, **hyper
                     )
                 else:
-                    flat_mu = leaves_mu[li].reshape(-1)
-                    flat_nu = leaves_nu[li].reshape(-1)
+                    if pre is None:
+                        flat_mu = leaves_mu[li].reshape(-1)
+                        flat_nu = leaves_nu[li].reshape(-1)
+                        pre = (
+                            jnp.asarray(flat_m[sl]),
+                            jnp.asarray(flat_mu[sl]),
+                            jnp.asarray(flat_nu[sl]),
+                        )
                     res = _chunk_update(
-                        jnp.asarray(flat_m[sl]),
-                        jnp.asarray(flat_mu[sl]),
-                        jnp.asarray(flat_nu[sl]),
-                        flat_g[sl],
-                        bc1,
-                        bc2,
-                        **hyper,
+                        *pre, flat_g[sl], bc1, bc2, **hyper
                     )
                 in_flight.append((li, sl, j, res))
                 # bounded window: older chunks' HBM buffers are freed
@@ -560,25 +657,351 @@ class HostOffloadAdamW:
         )
 
 
+def make_accumulated_grads_fn(loss_fn, micro_steps: int):
+    """(params, batch) -> (mean loss, mean grads) over ``micro_steps``
+    microbatches (batch leading dim splits evenly).  The stream update
+    is the expensive part of an offloaded step (~6-12 B/param over
+    PCIe each way), so amortizing it over K microbatches is the
+    offload-native throughput lever — accumulation happens in bf16
+    (an fp32 accumulator would cost 4 B/param of the HBM the offload
+    exists to free)."""
+    micro_steps = max(1, int(micro_steps))
+
+    def grads_of(params, batch):
+        if micro_steps <= 1:
+            return jax.value_and_grad(loss_fn)(params, batch)
+        split = jax.tree_util.tree_map(
+            lambda x: x.reshape(
+                (micro_steps, x.shape[0] // micro_steps)
+                + x.shape[1:]
+            ),
+            batch,
+        )
+        loss_sum = jnp.float32(0.0)
+        acc = None
+        inv = 1.0 / micro_steps
+        for k in range(micro_steps):
+            mb = jax.tree_util.tree_map(lambda x: x[k], split)
+            loss_k, g = jax.value_and_grad(loss_fn)(params, mb)
+            loss_sum = loss_sum + loss_k
+            if acc is None:
+                acc = jax.tree_util.tree_map(
+                    lambda a: (a * inv).astype(a.dtype), g
+                )
+            else:
+                acc = jax.tree_util.tree_map(
+                    lambda s, a: (s + a * inv).astype(s.dtype),
+                    acc, g,
+                )
+        return loss_sum * inv, acc
+
+    return grads_of
+
+
+class FusedOffloadState(NamedTuple):
+    """Train state for the FUSED offload path.  ``master``/``mu``/
+    ``nu`` use the SAME chunked host layout as the pinned_host
+    backend (per-leaf lists of host chunk arrays; int8 moments as
+    ``(payload, scales)`` tuples) — chunking is what lets the fused
+    program bound its HBM transient.  ``grads`` holds the previous
+    step's gradients in delayed mode (``None`` in synchronous
+    mode)."""
+
+    step: jnp.ndarray  # int32 scalar, device
+    params: Dict       # bf16, device
+    master: Dict       # fp32 chunk lists, host memory kind
+    mu: Dict           # fp32 chunks or (int8 payload, scales), host
+    nu: Dict
+    grads: Optional[Dict]  # bf16, device (delayed mode only)
+
+
+def build_fused_offload_step(
+    loss_fn,
+    init_params_fn,
+    optimizer: Optional[HostOffloadAdamW] = None,
+    delayed: bool = True,
+    window: int = 2,
+    micro_steps: int = 1,
+):
+    """Host-offloaded train step as ONE jit program — the TPU-native
+    overlap design.
+
+    The reference overlaps its CPU-offloaded Adam with backward by
+    registering per-module inner optimizers on grad hooks
+    (``ref: atorch/atorch/optimizers/adam_offload.py:52-70``).  The
+    XLA equivalent is to put the whole update INSIDE the train-step
+    program with host-memory-kind shardings: the compiler turns each
+    host transfer into an async copy-start / copy-done pair and
+    overlaps it with the backward matmuls in the SAME program.
+    Measured on v5e: out-of-program ``device_put`` transfers run at
+    only 2.5-6 GB/s (per-dispatch overhead) while in-program copies
+    stream at ~11 GB/s — fusing is what makes the DMA both fast and
+    hidden.
+
+    Memory discipline: left alone, XLA hoists EVERY chunk's H2D copy
+    to the front of the program (measured: a 1.8B fused step demands
+    32.8 GB of 15.75 GB HBM).  The update therefore streams the SAME
+    chunked host layout the pinned backend uses, with a sliding
+    window enforced by ``lax.optimization_barrier``: chunk ``i``'s
+    host inputs are gated on chunk ``i-window``'s host OUTPUTS, so at
+    most ``window`` chunks of fp32 state are in flight on device at
+    once — the in-program form of the reference's bucket loop.
+
+    Two scheduling modes:
+
+    - ``delayed=True`` (default): backward runs on the CURRENT
+      params while the update applies the PREVIOUS step's gradients
+      to produce the next params — the two are data-independent, so
+      every host copy (H2D in, D2H out) and the update math itself
+      overlap the backward.  This is the delayed-parameter-update
+      schedule of ZeRO-Offload (gradients are applied one step after
+      they were computed; step 1 applies a zero gradient).
+    - ``delayed=False``: backward first, update after (exact
+      synchronous AdamW).  H2D copies still hoist into the backward;
+      the D2H tail is exposed but chunk-pipelined.
+
+    Returns ``(init_state, train_step)``; ``train_step`` jit-compiles
+    on first call (shardings are captured from the state built by
+    ``init_state``).
+    """
+    from jax import lax
+
+    opt = optimizer or HostOffloadAdamW()
+    int8 = opt.moments == "int8"
+    dev, host = opt._shardings()
+    # env override for on-chip tuning: the window trades HBM
+    # transient (~window * 5 * chunk_bytes) against copy/compute
+    # pipelining depth
+    env_window = os.getenv("DLROVER_TPU_OFFLOAD_WINDOW")
+    if env_window:
+        try:
+            window = int(env_window)
+        except ValueError:
+            logger.warning(
+                "ignoring malformed DLROVER_TPU_OFFLOAD_WINDOW=%r",
+                env_window,
+            )
+    window = max(1, int(window))
+    micro_steps = max(1, int(micro_steps))
+    hyper = dict(
+        lr=opt.lr, b1=opt.b1, b2=opt.b2, eps=opt.eps, wd=opt.wd
+    )
+    # when the backend has no second memory space (_shardings
+    # degraded host to dev), in-program device_put is an unlowerable
+    # no-op (CPU has no annotate_device_placement) — elide it
+    two_spaces = host is not dev
+
+    def _in(x):
+        return jax.device_put(x, dev) if two_spaces else x
+
+    def _out(x):
+        return jax.device_put(x, host) if two_spaces else x
+
+    def init_state(rng) -> FusedOffloadState:
+        params = init_params_fn(rng)
+        base = opt._init_pinned(params)  # chunked host layout
+        del params
+        grads = (
+            jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.bfloat16),
+                base.params,
+            )
+            if delayed
+            else None
+        )
+        return FusedOffloadState(
+            step=jnp.zeros((), jnp.int32),
+            params=base.params,
+            master=base.master,
+            mu=base.mu,
+            nu=base.nu,
+            grads=grads,
+        )
+
+    def _apply(params, grads, master, mu, nu, step):
+        """Traced chunk-streamed update: barrier-windowed H2D, the
+        shared AdamW math, D2H."""
+        stepf = step.astype(jnp.float32)
+        bc1 = 1.0 - jnp.power(jnp.float32(opt.b1), stepf)
+        bc2 = 1.0 - jnp.power(jnp.float32(opt.b2), stepf)
+        is_list = lambda x: isinstance(x, list)  # noqa: E731
+        leaves_m, treedef = jax.tree_util.tree_flatten(
+            master, is_leaf=is_list
+        )
+        leaves_mu = treedef.flatten_up_to(mu)
+        leaves_nu = treedef.flatten_up_to(nu)
+        leaves_g = treedef.flatten_up_to(grads)
+        leaves_p = treedef.flatten_up_to(params)
+        tokens = []  # chunk host outputs, in stream order
+        new_p, new_m, new_mu, new_nu = [], [], [], []
+        for li, m_chunks in enumerate(leaves_m):
+            flat_g = leaves_g[li].reshape(-1)
+            shape = leaves_p[li].shape
+            slices = opt._chunk_slices(flat_g.shape[0])
+            ms, mus, nus, ps = [], [], [], []
+            for j, sl in enumerate(slices):
+                if int8:
+                    mu_q, mu_s = leaves_mu[li][j]
+                    nu_q, nu_s = leaves_nu[li][j]
+                    ins = (m_chunks[j], mu_q, mu_s, nu_q, nu_s)
+                else:
+                    ins = (
+                        m_chunks[j],
+                        leaves_mu[li][j],
+                        leaves_nu[li][j],
+                    )
+                if len(tokens) >= window:
+                    # gate this chunk's H2D on the D2H completion of
+                    # the chunk `window` positions back: bounds the
+                    # in-flight fp32 transient to ~window chunks
+                    gated = lax.optimization_barrier(
+                        ins + (tokens[len(tokens) - window],)
+                    )
+                    ins = gated[:-1]
+                g = flat_g[sl]
+                if int8:
+                    (m2, mu_q2, mu_s2, nu_q2, nu_s2, pb) = (
+                        _adamw_chunk_math_q(
+                            _in(ins[0]), _in(ins[1]), _in(ins[2]),
+                            _in(ins[3]), _in(ins[4]),
+                            g, bc1, bc2, **hyper,
+                        )
+                    )
+                    m2h = _out(m2)
+                    mus.append((_out(mu_q2), _out(mu_s2)))
+                    nus.append((_out(nu_q2), _out(nu_s2)))
+                else:
+                    m2, mu2, nu2, pb = _adamw_chunk_math(
+                        _in(ins[0]), _in(ins[1]), _in(ins[2]),
+                        g, bc1, bc2, **hyper,
+                    )
+                    m2h = _out(m2)
+                    mus.append(_out(mu2))
+                    nus.append(_out(nu2))
+                ms.append(m2h)
+                tokens.append(m2h)
+                ps.append(pb)
+            new_m.append(ms)
+            new_mu.append(mus)
+            new_nu.append(nus)
+            flat_p = ps[0] if len(ps) == 1 else jnp.concatenate(ps)
+            new_p.append(flat_p.reshape(shape))
+        unf = jax.tree_util.tree_unflatten
+        return (
+            unf(treedef, new_p),
+            unf(treedef, new_m),
+            unf(treedef, new_mu),
+            unf(treedef, new_nu),
+        )
+
+    _grads_of = make_accumulated_grads_fn(loss_fn, micro_steps)
+
+    def step_fn(state: FusedOffloadState, batch):
+        step = state.step + 1
+        loss, grads = _grads_of(state.params, batch)
+        # delayed: backward ran on the CURRENT params while the
+        # update applies the PREVIOUS grads and only feeds the NEXT
+        # step — the two are data-independent, so copies and update
+        # math ride under the backward (ZeRO-Offload delayed
+        # parameter update).  sync: this step's grads apply now.
+        applied = state.grads if delayed else grads
+        new_p, new_m, new_mu, new_nu = _apply(
+            state.params, applied, state.master, state.mu,
+            state.nu, step,
+        )
+        new_state = FusedOffloadState(
+            step, new_p, new_m, new_mu, new_nu,
+            grads if delayed else None,
+        )
+        return new_state, {"loss": loss}
+
+    cache: Dict[object, object] = {}
+
+    def train_step(state: FusedOffloadState, batch):
+        jitted = cache.get("jit")
+        if jitted is None:
+            state_sh = jax.tree_util.tree_map(
+                lambda a: a.sharding, state
+            )
+            jitted = jax.jit(
+                step_fn,
+                in_shardings=(state_sh, None),
+                out_shardings=(state_sh, None),
+                donate_argnums=(0,),
+            )
+            cache["jit"] = jitted
+        # "k=v,k=v" -> per-program XLA overrides (scheduler tuning
+        # for the copy/compute overlap without touching global
+        # LIBTPU_INIT_ARGS).  AOT executables are shape-specialized,
+        # so they cache PER BATCH SHAPE — a different eval/tail batch
+        # must retrace, not crash
+        opts = os.getenv("DLROVER_TPU_OFFLOAD_XLA_OPTS", "")
+        if not opts:
+            return jitted(state, batch)
+        shape_key = tuple(
+            (tuple(x.shape), str(x.dtype))
+            for x in jax.tree_util.tree_leaves(batch)
+        )
+        fn = cache.get(shape_key)
+        if fn is None:
+            kv = dict(
+                item.split("=", 1)
+                for item in opts.split(",")
+                if "=" in item
+            )
+            fn = jitted.lower(state, batch).compile(
+                compiler_options=kv
+            )
+            cache[shape_key] = fn
+        return fn(state, batch)
+
+    return init_state, train_step
+
+
 def build_offloaded_train_step(
     loss_fn,
     init_params_fn,
     optimizer: Optional[HostOffloadAdamW] = None,
+    mode: str = "auto",
+    micro_steps: int = 1,
+    window: int = 2,
 ):
     """Single-chip train step with host-resident optimizer state.
 
+    ``mode`` selects the update scheduling:
+
+    - ``"auto"`` (default): ``"fused_delayed"`` when the backend is
+      ``pinned_host`` (TPU), else ``"chunked"``.
+    - ``"fused_delayed"`` / ``"fused"``: one-program update via
+      :func:`build_fused_offload_step` (overlapped; ``fused`` is the
+      exact-synchronous variant).
+    - any mode composes with ``micro_steps`` gradient accumulation
+      (``make_accumulated_grads_fn``) — the chunked mode is what the
+      accumulated 1.8B proofs use: per-chunk update programs keep
+      peak HBM far below the one-program fused form.
+    - ``"chunked"``: the streaming
+      :meth:`HostOffloadAdamW.apply_gradients` path, with the numpy
+      backend prefetching its first chunk window before backward.
+
     Returns ``(init_state, train_step)`` where ``train_step(state,
-    batch) -> (state, metrics)``:  backward is one jit over the bf16
-    device params; the update streams through
-    :meth:`HostOffloadAdamW.apply_gradients`.
+    batch) -> (state, metrics)``.
     """
     opt = optimizer or HostOffloadAdamW()
-
-    grad_fn = jax.jit(
-        lambda params, batch: jax.value_and_grad(loss_fn)(
-            params, batch
+    if mode == "auto":
+        mode = (
+            "fused_delayed"
+            if opt.backend == "pinned_host"
+            else "chunked"
         )
-    )
+    if mode in ("fused", "fused_delayed"):
+        return build_fused_offload_step(
+            loss_fn, init_params_fn, opt,
+            delayed=(mode == "fused_delayed"),
+            micro_steps=micro_steps,
+            window=window,
+        )
+    if mode != "chunked":
+        raise ValueError(f"unknown offload mode {mode!r}")
 
     def init_state(rng) -> OffloadState:
         params = init_params_fn(rng)
@@ -586,9 +1009,74 @@ def build_offloaded_train_step(
         del params
         return state
 
+    if micro_steps <= 1:
+        grad_fn = jax.jit(
+            lambda params, batch: jax.value_and_grad(loss_fn)(
+                params, batch
+            )
+        )
+
+        def train_step(state: OffloadState, batch):
+            # dispatch the H2D prefetch of the first chunk window
+            # BEFORE backward so the transfers ride under the compute
+            prefetched = opt.start_prefetch(state)
+            loss, grads = grad_fn(state.params, batch)
+            new_state = opt.apply_gradients(
+                state, grads, prefetched=prefetched
+            )
+            return new_state, {"loss": loss}
+
+        return init_state, train_step
+
+    # accumulated chunked path: one PROGRAM per microbatch plus tiny
+    # donated add programs, NOT one K-micro program — the fused
+    # accumulation program must co-reserve the accumulator, the
+    # per-micro grads and the backward residuals and exceeds a 16 GB
+    # chip at 1.8B (measured), while the per-micro program has the
+    # same footprint the non-accumulated r4 proofs already ran at.
+    single_grad = jax.jit(
+        lambda params, batch: jax.value_and_grad(loss_fn)(
+            params, batch
+        )
+    )
+    inv = 1.0 / micro_steps
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def _first(loss, g):
+        return loss * inv, jax.tree_util.tree_map(
+            lambda a: (a * inv).astype(a.dtype), g
+        )
+
+    @functools.partial(jax.jit, donate_argnums=(1, 3))
+    def _add(loss_sum, acc, loss, g):
+        return (
+            loss_sum + loss * inv,
+            jax.tree_util.tree_map(
+                lambda s, a: (s + a * inv).astype(s.dtype), acc, g
+            ),
+        )
+
     def train_step(state: OffloadState, batch):
-        loss, grads = grad_fn(state.params, batch)
-        new_state = opt.apply_gradients(state, grads)
-        return new_state, {"loss": loss}
+        prefetched = opt.start_prefetch(state)
+        split = jax.tree_util.tree_map(
+            lambda x: x.reshape(
+                (micro_steps, x.shape[0] // micro_steps)
+                + x.shape[1:]
+            ),
+            batch,
+        )
+        loss_sum = None
+        acc = None
+        for k in range(micro_steps):
+            mb = jax.tree_util.tree_map(lambda x: x[k], split)
+            loss_k, g = single_grad(state.params, mb)
+            if acc is None:
+                loss_sum, acc = _first(loss_k, g)
+            else:
+                loss_sum, acc = _add(loss_sum, acc, loss_k, g)
+        new_state = opt.apply_gradients(
+            state, acc, prefetched=prefetched
+        )
+        return new_state, {"loss": loss_sum}
 
     return init_state, train_step
