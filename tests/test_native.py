@@ -403,6 +403,53 @@ class TestExporterUpgrades:
         reg.set_gauge("weird-name.with chars", 7)
         assert "weird_name_with_chars" in reg._metrics
 
+    def test_cross_rank_rollups(self, tmp_path):
+        """VERDICT-r4 weak #7: the merged exposition must carry
+        _min/_max/_avg/_sum series aggregated across rank labels —
+        with a stale rank's series excluded from the aggregates."""
+        now = time.time()
+        (tmp_path / "r0.prom").write_text(
+            f'train_loss{{rank="0"}} 2.0 {now:.3f}\n'
+            f'step_time{{rank="0",phase="fwd"}} 0.5 {now:.3f}\n'
+        )
+        (tmp_path / "r1.prom").write_text(
+            f'train_loss{{rank="1"}} 4.0 {now:.3f}\n'
+            f'step_time{{rank="1",phase="fwd"}} 0.7 {now:.3f}\n'
+        )
+        # rank 2 crashed an hour ago: its flush must not pollute
+        # either the raw series or the rollups
+        (tmp_path / "r2.prom").write_text(
+            f'train_loss{{rank="2"}} 99.0 {now - 3600:.3f}\n'
+        )
+        reg = MetricsRegistry(
+            path=str(tmp_path / "live.prom"), flush_interval=0.0
+        )
+        reg.flush()
+        port = get_free_port()
+        exporter = MetricsExporter(
+            reg, port=port, stale_secs=60,
+            extra_files=[
+                str(tmp_path / "r0.prom"),
+                str(tmp_path / "r1.prom"),
+                str(tmp_path / "r2.prom"),
+            ],
+        )
+        exporter.start()
+        try:
+            body = self._fetch(port)
+            assert "train_loss_min 2" in body, body
+            assert "train_loss_max 4" in body, body
+            assert "train_loss_avg 3" in body, body
+            assert "train_loss_sum 6" in body, body
+            # non-rank labels survive into the rollup key
+            assert 'step_time_min{phase="fwd"} 0.5' in body, body
+            assert 'step_time_sum{phase="fwd"} 1.2' in body, body
+            # the stale rank is gone from raw AND aggregate series
+            assert 'rank="2"' not in body, body
+            assert "99" not in body, body
+        finally:
+            exporter.stop()
+
     def test_brace_inside_label_value(self, tmp_path):
         """A '}' inside a quoted label value must not shear the key
         (the value would then parse as the timestamp and get the
