@@ -12,6 +12,7 @@ stay client-side where the devices are (the reference's dry-run
 workers are device-local too).
 """
 
+import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -150,14 +151,18 @@ class StrategyService:
     # service memory AND keeps the fit tracking current hardware)
     MAX_MEASUREMENTS_PER_WORKLOAD = 64
 
-    def __init__(self, datastore=None):
+    def __init__(self, datastore=None, job: str = ""):
         """``datastore``: a
         :class:`~dlrover_tpu.master.datastore.BrainDatastore` making
         the fleet calibration durable across master restarts
         (reference: the Go Brain's MySQL recorders,
         ``dbbase/recorder.go:280``).  None = in-memory only; defaults
         to the process datastore when ``DLROVER_TPU_BRAIN_DB`` is
-        set."""
+        set.  ``job`` tags this master's measurements so a SHARED db
+        file serves as a multi-job Brain: measurements are keyed by
+        workload signature, so job B's planner adopts job A's
+        calibration on first touch (defaults to
+        ``DLROVER_TPU_JOB_NAME``)."""
         import threading
 
         # one lock over both maps: the gRPC pool serves record() and
@@ -174,6 +179,7 @@ class StrategyService:
 
             datastore = get_default_datastore()
         self._datastore = datastore
+        self._job = job or os.getenv("DLROVER_TPU_JOB_NAME", "")
 
     def _load_persisted(self, key: Tuple) -> List:
         """History for ``key`` from the datastore (restart recovery);
@@ -224,7 +230,7 @@ class StrategyService:
             try:
                 self._datastore.record_measurement(
                     workload_signature(key), dict(m.strategy),
-                    m.step_time_s,
+                    m.step_time_s, job=self._job,
                 )
             except Exception as e:  # noqa: BLE001 - best-effort
                 logger.warning("measurement persist failed: %s", e)

@@ -159,11 +159,16 @@ class MasterClient:
         )
 
     def brain_query(self, kind: str = "speed", job: str = "default",
-                    limit: int = 100):
+                    limit: int = 100, workload: str = ""):
         """Query the master's durable Brain datastore; returns the
-        payload dict, or None when no datastore is configured."""
+        payload dict, or None when no datastore is configured.
+        ``kind="measurements"`` + ``workload`` pulls calibration
+        history — usable from a DIFFERENT job's master (multi-job
+        Brain)."""
         res = self._channel.get(
-            msg.BrainQueryRequest(kind=kind, job=job, limit=limit)
+            msg.BrainQueryRequest(
+                kind=kind, job=job, limit=limit, workload=workload
+            )
         )
         if res is None or not getattr(res, "available", False):
             return None

@@ -435,9 +435,14 @@ class BrainQueryRequest(Message):
     node events / measured workloads) — the TPU analog of the Go
     Brain's query RPCs over its MySQL recorders."""
 
-    kind: str = "speed"  # speed | node_events | workloads
+    # speed | node_events | workloads | measurements (the last
+    # returns calibration history for ``workload`` — what lets a
+    # DIFFERENT job's master adopt this fleet's measurements over RPC
+    # instead of mounting the db file)
+    kind: str = "speed"
     job: str = "default"
     limit: int = 100
+    workload: str = ""  # measurements: a workload_signature string
 
 
 @dataclass

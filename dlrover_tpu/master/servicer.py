@@ -119,6 +119,16 @@ class MasterServicer:
             }
         elif request.kind == "workloads":
             payload = {"workloads": store.measured_workloads()}
+        elif request.kind == "measurements":
+            # cross-job calibration: ANY job's strategy service can
+            # pull this fleet's history for a workload signature
+            # (ref: the Go Brain serving all jobs' metrics,
+            # dlrover/go/brain/pkg/datastore/dbbase/recorder.go:280)
+            payload = {
+                "measurements": store.load_measurements(
+                    request.workload, limit=request.limit
+                )
+            }
         else:
             return msg.BrainQueryResponse(available=False)
         return msg.BrainQueryResponse(
