@@ -260,6 +260,13 @@ def solve(
             * bpd
             / max(s.num_micro_steps, 1)
         )
+        if s.pipe > 1:
+            # chunked-1F1B pipeline executor (parallel/pipeline.py):
+            # a stage holds only ITS layer shard's activations
+            # (1/pipe) for a window of `pipe` in-flight microbatches
+            # out of the 2*pipe-deep stream (module_replace default)
+            # — residency is act/(2*pipe), not the full batch's
+            full_acts /= 2.0 * s.pipe
         # accumulation is not free: every extra micro step re-reads
         # and re-writes the fp32 grad_sum (8 bytes/param over HBM) and
         # fragments the fused backward

@@ -238,6 +238,120 @@ class TestPipeline:
             np.asarray(out), np.asarray(seq), rtol=1e-5, atol=1e-5
         )
 
+    def _pipeline_problem(self, n_stages, num_mb, mb, dim):
+        """A stage with REAL intermediates (two matmuls) so backward
+        residual accounting has something to measure."""
+        ctx = create_parallel_mesh(
+            [(AxisName.PIPELINE, n_stages)],
+            devices=jax.devices()[:n_stages],
+        )
+        key = jax.random.PRNGKey(0)
+        per_stage = [
+            {
+                "w1": jax.random.normal(
+                    jax.random.fold_in(key, 2 * i), (dim, dim)
+                ) / np.sqrt(dim),
+                "w2": jax.random.normal(
+                    jax.random.fold_in(key, 2 * i + 1), (dim, dim)
+                ) / np.sqrt(dim),
+            }
+            for i in range(n_stages)
+        ]
+        stacked = stack_stage_params(per_stage)
+
+        def stage_fn(p, x):
+            h = jnp.tanh(x @ p["w1"][0])
+            return jnp.tanh(h @ p["w2"][0])
+
+        batch = jax.random.normal(
+            jax.random.PRNGKey(9), (num_mb * mb, dim)
+        )
+        stream = split_microbatches(batch, num_mb)
+        return ctx, stacked, stream, stage_fn
+
+    def test_chunked_matches_gpipe(self):
+        """The residency-bounded schedule is a pure rescheduling:
+        outputs and parameter gradients must match the naive scan."""
+        n_stages, num_mb = 4, 16
+        ctx, stacked, stream, stage_fn = self._pipeline_problem(
+            n_stages, num_mb, 2, 16
+        )
+
+        def run(schedule):
+            def f(params, s):
+                out = shard_map(
+                    lambda p, ss: pipeline_spmd(
+                        stage_fn, p, ss,
+                        axis_name=AxisName.PIPELINE,
+                        schedule=schedule,
+                    ),
+                    mesh=ctx.mesh,
+                    in_specs=(P(AxisName.PIPELINE), P()),
+                    out_specs=P(),
+                )(params, s)
+                return jnp.sum(out ** 2)
+
+            # jit required: checkpoint-of-scan inside shard_map has
+            # no eager path
+            loss, grads = jax.jit(jax.value_and_grad(f))(
+                stacked, stream
+            )
+            return float(loss), grads
+
+        loss_c, g_c = run("chunked")
+        loss_g, g_g = run("gpipe")
+        np.testing.assert_allclose(loss_c, loss_g, rtol=1e-5)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(g_c),
+            jax.tree_util.tree_leaves(g_g),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+            )
+        with pytest.raises(ValueError, match="schedule"):
+            run("bogus")
+
+    def test_chunked_schedule_bounds_residuals(self):
+        """VERDICT-r4 weak #6, done-criterion: buffer accounting of
+        the backward residuals.  The naive scan's vjp stores every
+        tick's stage intermediates (grows with microbatch COUNT); the
+        chunked schedule checkpoints at chunk boundaries so residuals
+        stay ~n_stages microbatches.  Measured as the concrete bytes
+        closed over by the vjp function."""
+        n_stages, num_mb = 4, 16  # stream 4x deeper than the window
+        ctx, stacked, stream, stage_fn = self._pipeline_problem(
+            n_stages, num_mb, 2, 16
+        )
+
+        def residual_bytes(schedule):
+            def f(params, s):
+                out = shard_map(
+                    lambda p, ss: pipeline_spmd(
+                        stage_fn, p, ss,
+                        axis_name=AxisName.PIPELINE,
+                        schedule=schedule,
+                    ),
+                    mesh=ctx.mesh,
+                    in_specs=(P(AxisName.PIPELINE), P()),
+                    out_specs=P(),
+                )(params, s)
+                return jnp.sum(out ** 2)
+
+            _, vjp_fn = jax.vjp(jax.jit(f), stacked, stream)
+            return sum(
+                leaf.nbytes
+                for leaf in jax.tree_util.tree_leaves(vjp_fn)
+                if hasattr(leaf, "nbytes")
+            )
+
+        res_gpipe = residual_bytes("gpipe")
+        res_chunked = residual_bytes("chunked")
+        # at M=16, S=4 the tick count is 19 vs a 4-tick window: the
+        # chunked residuals must come in at under half the naive ones
+        assert res_chunked < 0.5 * res_gpipe, (
+            res_chunked, res_gpipe,
+        )
+
 
 @pytest.fixture(scope="module")
 def tiny_cfg():
