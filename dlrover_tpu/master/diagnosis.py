@@ -10,6 +10,7 @@ TPU operators: step-stagnation (hang), OOM pattern in training logs,
 chip unhealthy (libtpu error strings), preemption notice.
 """
 
+import json
 import re
 import threading
 import time
@@ -152,6 +153,83 @@ class HangOperator(InferenceOperator):
         return []
 
 
+class GemmRegressionOperator(InferenceOperator):
+    """Op-time regression over the resident profiler's GEMM census.
+
+    The reference's xpu_timer watches per-kernel time for the whole
+    job and flags slow kernels (``atorch/dev/xpu_timer/common/
+    manager.h:201``).  Here the Trainer's ``trace_interval`` captures
+    drop per-GEMM-cluster step times as CHIP_METRICS JSON (content
+    carries a ``gemm_clusters`` list); this operator compares each
+    cluster's newest per-step time against the median of its history
+    and concludes when one slowed past ``ratio`` — the signature of a
+    thermally throttled / degraded chip, which per-STEP timing alone
+    cannot localize to an op."""
+
+    def __init__(self, ratio: float = 1.5, min_history: int = 3):
+        self._ratio = ratio
+        self._min_history = min_history
+
+    @staticmethod
+    def _reports(store: DiagnosisDataStore, rank: int):
+        out = []
+        for d in store.get(DiagnosisDataType.CHIP_METRICS):
+            if d.node_rank != rank:
+                continue
+            try:
+                content = json.loads(d.content)
+            except (TypeError, ValueError):
+                continue
+            if isinstance(content, dict) and content.get(
+                "gemm_clusters"
+            ):
+                out.append(content)
+        return out
+
+    def infer(self, store: DiagnosisDataStore) -> List[Inference]:
+        ranks = {
+            d.node_rank
+            for d in store.get(DiagnosisDataType.CHIP_METRICS)
+        }
+        results: List[Inference] = []
+        for rank in ranks:
+            reports = self._reports(store, rank)
+            if len(reports) < self._min_history:
+                continue
+            # per-cluster per-step time series, oldest -> newest
+            series: Dict[str, List[float]] = {}
+            for rep in reports:
+                steps = max(float(rep.get("steps", 1) or 1), 1.0)
+                for row in rep["gemm_clusters"]:
+                    key = row.get("key")
+                    t = row.get("time_us")
+                    if key is None or not t:
+                        continue
+                    series.setdefault(key, []).append(
+                        float(t) / steps
+                    )
+            for key, ts in series.items():
+                if len(ts) < self._min_history:
+                    continue
+                history = sorted(ts[:-1])
+                baseline = history[len(history) // 2]  # median
+                if baseline > 0 and ts[-1] > self._ratio * baseline:
+                    results.append(
+                        Inference(
+                            problem="op_time_regression",
+                            cause=(
+                                f"GEMM cluster {key} per-step time "
+                                f"{ts[-1]:.0f}us vs baseline "
+                                f"{baseline:.0f}us "
+                                f"(x{ts[-1] / baseline:.2f})"
+                            ),
+                            action="none",
+                            node_rank=rank,
+                        )
+                    )
+        return results
+
+
 class InferenceChain:
     def __init__(self, operators: List[InferenceOperator]):
         self._operators = operators
@@ -179,6 +257,7 @@ class DiagnosisManager:
                 OomOperator(),
                 ChipErrorOperator(),
                 PreemptionOperator(),
+                GemmRegressionOperator(),
             ]
             if speed_monitor is not None:
                 operators.append(HangOperator(speed_monitor))

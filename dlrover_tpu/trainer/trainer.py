@@ -13,6 +13,7 @@ or explicit strategy), flash-checkpoint engine (memory every
 progress reporting, hang detection, loss-spike capture, and metrics.
 """
 
+import json
 import os
 import time
 from dataclasses import dataclass, field
@@ -65,6 +66,17 @@ class TrainingArgs:
     # keep the interval coarse in production)
     replay_dir: str = ""
     replay_digest_interval: int = 50
+    # resident op profiler (xpu_timer analog: measurement for the
+    # WHOLE job, ref atorch/dev/xpu_timer/common/manager.h:201): every
+    # trace_interval steps, trace trace_steps real training steps,
+    # parse the chrome trace (observability/trace.py), export category
+    # shares + top GEMM clusters to the metrics registry, and drop the
+    # census JSON at trace_drop_file — where the agent's
+    # ChipMetricsCollector ships it to the master's diagnosis chain
+    # (GemmRegressionOperator).  0 = off.
+    trace_interval: int = 0
+    trace_steps: int = 2
+    trace_drop_file: str = ""
     extra: dict = field(default_factory=dict)
 
 
@@ -324,6 +336,47 @@ class Trainer:
             )
         return dt
 
+    def _process_trace(self, trace_dir: str, step: int):
+        """Resident-profiler post-processing: parse the captured
+        window, mirror op-time series onto the metrics registry (the
+        C++ exporter's surface), and drop the census JSON where the
+        agent's ChipMetricsCollector ships it into the master's
+        diagnosis chain (GemmRegressionOperator)."""
+        import shutil
+
+        from dlrover_tpu.observability.trace import parse_trace
+
+        try:
+            report = parse_trace(trace_dir)
+        except Exception as e:  # noqa: BLE001 - observability only
+            logger.warning("op trace parse failed: %s", e)
+            return
+        finally:
+            shutil.rmtree(trace_dir, ignore_errors=True)
+        self.last_op_report = report
+        if not report.total_device_us:
+            return  # no device op tracks (CPU backend)
+        if self._registry is not None:
+            report.export_to_registry(self._registry)
+        summary = report.summary(top_k=5)
+        drop = self._args.trace_drop_file
+        if drop:
+            payload = dict(summary, step=step)
+            tmp = f"{drop}.tmp"
+            try:
+                with open(tmp, "w") as f:
+                    json.dump(payload, f)
+                os.replace(tmp, drop)  # atomic vs collector reads
+            except OSError as e:
+                logger.warning("op census drop failed: %s", e)
+        top = summary["gemm_clusters"][:1]
+        logger.info(
+            "op profile @step %d: device %.0fus/step, top gemm %s",
+            step,
+            report.mean_step_us,
+            top[0]["key"] if top else "n/a",
+        )
+
     # ------------------------------------------------------------- eval
     def evaluate(self, eval_iter_fn=None, max_batches: int = 0):
         """One evaluation pass: mean forward loss over the eval
@@ -405,10 +458,39 @@ class Trainer:
             # real step duration.
             pending = None  # (step, metrics, batch)
             self._last_done = time.perf_counter()
+            trace_every = self._args.trace_interval
+            tracing_left = 0
+            trace_dir_cur = None
             while step < self._args.max_steps:
                 for batch in self._data_iter_fn():
                     if step >= self._args.max_steps:
                         break
+                    if (
+                        trace_every > 0
+                        and tracing_left == 0
+                        and step != start_step
+                        and step % trace_every == 0
+                    ):
+                        # resident profiler: trace the NEXT
+                        # trace_steps REAL steps (not replayed extras
+                        # — an out-of-band capture would advance the
+                        # optimizer off the training trajectory).
+                        # Settle the pipelined metrics first so the
+                        # window holds only whole steps.
+                        import tempfile
+
+                        if pending is not None:
+                            step_times.append(
+                                self._consume_metrics(*pending)
+                            )
+                            pending = None
+                        trace_dir_cur = tempfile.mkdtemp(
+                            prefix="dlrover_optrace_"
+                        )
+                        jax.profiler.start_trace(trace_dir_cur)
+                        tracing_left = max(
+                            1, self._args.trace_steps
+                        )
                     if self._replay is not None:
                         self._replay.record(step + 1, batch)
                     device_batch = jax.device_put(
@@ -434,6 +516,20 @@ class Trainer:
                             self._consume_metrics(*pending)
                         )
                     pending = (step, metrics, batch)
+                    if tracing_left > 0:
+                        tracing_left -= 1
+                        if tracing_left == 0:
+                            # close the window on a step boundary:
+                            # consume forces completion of every
+                            # traced step before stop_trace
+                            step_times.append(
+                                self._consume_metrics(*pending)
+                            )
+                            pending = None
+                            jax.profiler.stop_trace()
+                            self._process_trace(trace_dir_cur, step)
+                            trace_dir_cur = None
+                            self._last_done = time.perf_counter()
                     self._maybe_checkpoint(step)
                     if eval_every and step % eval_every == 0:
                         # settle the pipelined metrics first so the
@@ -450,6 +546,15 @@ class Trainer:
             if pending is not None:
                 step_times.append(self._consume_metrics(*pending))
         finally:
+            if trace_dir_cur is not None and tracing_left > 0:
+                # training ended mid-window: close it or the NEXT
+                # start_trace (this process or a later test) dies
+                # with "profile already started"
+                try:
+                    jax.profiler.stop_trace()
+                    self._process_trace(trace_dir_cur, step)
+                except Exception as e:  # noqa: BLE001
+                    logger.warning("trace close failed: %s", e)
             self._hang.stop()
             if self._exporter is not None:
                 self._exporter.stop()

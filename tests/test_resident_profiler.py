@@ -1,0 +1,190 @@
+"""Resident op profiler: Trainer trace cadence + diagnosis rule.
+
+Reference parity: the xpu_timer measures kernels for the WHOLE job
+(``atorch/dev/xpu_timer/common/manager.h:201``) and its Prometheus
+surface feeds slow-kernel alerts.  The TPU form: Trainer
+``trace_interval`` captures real in-loop steps with ``jax.profiler``,
+exports the census, and drops it where the agent's collector ships it
+to the master's GemmRegressionOperator.
+"""
+
+import json
+
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.accelerate import auto_accelerate, load_strategy
+from dlrover_tpu.master.diagnosis import (
+    DiagnosisData,
+    DiagnosisDataStore,
+    DiagnosisDataType,
+    DiagnosisManager,
+    GemmRegressionOperator,
+)
+from dlrover_tpu.models.llama import (
+    LlamaConfig,
+    init_params,
+    loss_fn,
+    param_logical_axes,
+)
+from dlrover_tpu.observability.trace import OpAggregate, TraceReport
+from dlrover_tpu.trainer.trainer import Trainer, TrainingArgs
+
+
+def _census(gemm_us: float, steps: int = 2) -> str:
+    return json.dumps(
+        {
+            "steps": steps,
+            "gemm_clusters": [
+                {"key": "bf16[8,256,256]", "time_us": gemm_us},
+                {"key": "bf16[8,64,64]", "time_us": gemm_us / 10},
+            ],
+        }
+    )
+
+
+class TestGemmRegressionOperator:
+    def _store_with(self, values, rank=0):
+        store = DiagnosisDataStore()
+        for v in values:
+            store.add(
+                DiagnosisData(
+                    data_type=DiagnosisDataType.CHIP_METRICS,
+                    content=_census(v),
+                    node_rank=rank,
+                )
+            )
+        return store
+
+    def test_synthetic_slowdown_fires(self):
+        """A cluster that doubles against its median baseline must
+        produce an op_time_regression conclusion for that node."""
+        op = GemmRegressionOperator(ratio=1.5, min_history=3)
+        store = self._store_with([1000.0, 1040.0, 980.0, 2200.0])
+        out = op.infer(store)
+        assert out, "regression not detected"
+        assert out[0].problem == "op_time_regression"
+        assert "bf16[8,256,256]" in out[0].cause
+        assert out[0].node_rank == 0
+        # the small cluster regressed too (same factor) — both fire
+        assert len(out) == 2
+
+    def test_steady_state_is_silent(self):
+        op = GemmRegressionOperator()
+        store = self._store_with([1000.0, 1020.0, 990.0, 1010.0])
+        assert op.infer(store) == []
+
+    def test_needs_history(self):
+        op = GemmRegressionOperator(min_history=3)
+        store = self._store_with([1000.0, 2500.0])
+        assert op.infer(store) == []
+
+    def test_garbage_content_ignored(self):
+        op = GemmRegressionOperator()
+        store = DiagnosisDataStore()
+        for content in ("not json", json.dumps({"hbm": 1}),
+                        _census(1000.0)):
+            store.add(
+                DiagnosisData(
+                    data_type=DiagnosisDataType.CHIP_METRICS,
+                    content=content,
+                )
+            )
+        assert op.infer(store) == []
+
+    def test_wired_into_default_chain(self):
+        mgr = DiagnosisManager()
+        assert any(
+            isinstance(op, GemmRegressionOperator)
+            for op in mgr.chain._operators
+        )
+
+
+class TestTrainerResidentProfiler:
+    def _trainer(self, tmp_path, monkeypatch, fake_report):
+        import os
+
+        os.environ["DLROVER_TPU_SOCKET_DIR"] = str(
+            tmp_path / "socks_prof"
+        )
+        cfg = LlamaConfig.tiny(remat="none")
+        result = auto_accelerate(
+            loss_fn=lambda p, b: loss_fn(p, b, cfg),
+            optimizer=optax.adamw(1e-3),
+            init_params_fn=lambda rng: init_params(rng, cfg),
+            param_axes=param_logical_axes(cfg),
+            load_strategy=load_strategy({"data": 8, "remat": "none"}),
+        )
+        tokens = np.ones((8, 17), dtype=np.int32)
+
+        def data_iter():
+            for _ in range(64):
+                yield {"tokens": tokens}
+
+        drop = tmp_path / "census.json"
+        args = TrainingArgs(
+            max_steps=7,
+            checkpoint_dir=str(tmp_path / "ckpt"),
+            save_memory_interval=100,
+            save_storage_interval=100,
+            log_interval=100,
+            trace_interval=3,
+            trace_steps=2,
+            trace_drop_file=str(drop),
+        )
+        # CPU traces carry no device ops; the flow under test is the
+        # cadence + export + drop plumbing, so substitute the parser
+        import dlrover_tpu.trainer.trainer as trainer_mod
+
+        calls = []
+
+        def fake_parse(path):
+            calls.append(path)
+            return fake_report
+
+        monkeypatch.setattr(
+            "dlrover_tpu.observability.trace.parse_trace",
+            fake_parse,
+        )
+        return Trainer(result, args, data_iter), drop, calls
+
+    def test_cadence_capture_and_drop_file(
+        self, tmp_path, monkeypatch
+    ):
+        report = TraceReport(
+            total_device_us=2000.0,
+            step_count=2,
+            mean_step_us=1000.0,
+            by_category={"convolution fusion": 1500.0,
+                         "copy-done": 500.0},
+            gemm_clusters=[
+                OpAggregate(
+                    key="bf16[8,256,256]",
+                    category="convolution fusion",
+                    time_us=1500.0,
+                    count=4,
+                )
+            ],
+        )
+        t, drop, calls = self._trainer(tmp_path, monkeypatch, report)
+        summary = t.train()
+        assert summary["final_step"] == 7
+        # max_steps 7, interval 3 -> captures start after steps 3, 6
+        assert len(calls) == 2
+        assert t.last_op_report is report
+        payload = json.loads(drop.read_text())
+        assert payload["gemm_clusters"][0]["key"] == "bf16[8,256,256]"
+        assert payload["steps"] == 2
+        # last capture window closed at step 6 + trace_steps = 8?
+        # no — window is steps 7..8 clipped by max_steps: the drop
+        # records the closing step
+        assert payload["step"] >= 6
+
+    def test_empty_report_skips_drop(self, tmp_path, monkeypatch):
+        t, drop, calls = self._trainer(
+            tmp_path, monkeypatch, TraceReport()
+        )
+        t.train()
+        assert len(calls) >= 1
+        assert not drop.exists()  # nothing useful to ship
