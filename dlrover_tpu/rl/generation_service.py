@@ -1,0 +1,272 @@
+"""Cross-process RLHF generation engine.
+
+Reference parity: ``atorch/atorch/rl/inference_backend/
+vllm_backend.py`` — actor weights are SHIPPED to a dedicated vLLM
+serving engine, not pointer-shared — plus ``rl/ds_hybrid_engine/``
+(train<->inference layout resharding).  The TPU redesign:
+
+- a dedicated GENERATION PROCESS runs the sampler (its own jax
+  runtime / mesh, its own compiled programs);
+- actor weights travel over the flash-checkpoint shm substrate
+  (``agent/ckpt_shm.SharedMemoryHandler``: double-buffered segment +
+  SharedDict meta) — the same zero-extra-infrastructure path training
+  snapshots already ride, so a policy update is ONE ``save_state``;
+- train->inference RESHARDING happens at restore: the worker's params
+  template carries the inference shardings, and
+  ``restore_to_target`` device_puts every leaf onto them in one
+  batched call (train-side layouts never leak into the generator);
+- requests/responses ride ``common/multi_process.SharedQueue``
+  (unix-socket, crash-isolated), and every response carries the
+  serving stats the reference's engine exposes: weight-handoff
+  latency, generation seconds, tokens/s, weight version.
+
+The in-process backends (``rl/inference.py``) remain for co-located
+generation; this module is the serving-engine form.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+
+WORKER_SPEC_ENV = "DLROVER_TPU_GEN_SPEC"
+
+
+def _import_factory(path: str) -> Callable:
+    """"pkg.module:attr" -> callable."""
+    mod_name, _, attr = path.partition(":")
+    if not attr:
+        raise ValueError(
+            f"factory must be 'module:callable', got {path!r}"
+        )
+    import importlib
+
+    return getattr(importlib.import_module(mod_name), attr)
+
+
+def tiny_llama_factory(**cfg_kwargs):
+    """Built-in factory: a llama sampler whose config comes from the
+    spec (tests / example).  Returns the worker contract:
+    ``forward_fn``, ``params_template_fn`` (inference-sharded params
+    the shm snapshot restores ONTO)."""
+    import jax
+
+    from dlrover_tpu.models.llama import (
+        LlamaConfig,
+        forward,
+        init_params,
+    )
+
+    cfg = LlamaConfig(**cfg_kwargs)
+
+    def forward_fn(params, tokens):
+        return forward(params, tokens, cfg)
+
+    def params_template_fn():
+        # the template's shardings ARE the inference layout; default:
+        # replicated on this process's devices.  A multi-chip serving
+        # mesh would device_put leaves onto its NamedShardings here.
+        return init_params(jax.random.PRNGKey(0), cfg)
+
+    return {
+        "forward_fn": forward_fn,
+        "params_template_fn": params_template_fn,
+    }
+
+
+def worker_main() -> int:
+    """Generation-process entry (``python -m
+    dlrover_tpu.rl.generation_service``); spec arrives via env."""
+    import jax
+    import jax.numpy as jnp
+
+    from dlrover_tpu.agent.ckpt_shm import (
+        SharedMemoryHandler,
+        restore_to_target,
+    )
+    from dlrover_tpu.common.multi_process import SharedQueue
+    from dlrover_tpu.rl.inference import JitSamplerBackend
+
+    spec = json.loads(os.environ[WORKER_SPEC_ENV])
+    name = spec["name"]
+    factory = _import_factory(spec["factory"])
+    parts = factory(**spec.get("factory_kwargs", {}))
+    backend = JitSamplerBackend(
+        parts["forward_fn"],
+        max_new_tokens=int(spec["max_new_tokens"]),
+        temperature=float(spec.get("temperature", 1.0)),
+    )
+    template = parts["params_template_fn"]()
+
+    shm = SharedMemoryHandler(rank=0, name=name)
+    req = SharedQueue(f"{name}-req", create=False)
+    resp = SharedQueue(f"{name}-resp", create=False)
+    version = -1
+    handoff_s = 0.0
+    resp.put({"ready": True, "pid": os.getpid()})
+    logger.info("generation worker %s ready (pid %d)", name,
+                os.getpid())
+    while True:
+        msg = req.get()
+        cmd = msg.get("cmd")
+        if cmd == "stop":
+            resp.put({"stopped": True})
+            return 0
+        if cmd != "generate":
+            resp.put({"error": f"unknown cmd {cmd!r}"})
+            continue
+        # weight refresh: adopt the newest published snapshot.
+        # restore_to_target device_puts onto the TEMPLATE's shardings
+        # — this is where the train layout reshards to the inference
+        # layout (ref: ds_hybrid_engine's train<->infer repartition)
+        t0 = time.perf_counter()
+        step, arrays = shm.load_state(copy=False)
+        if step > version:
+            template = restore_to_target(
+                template, arrays, to_device=True, copy_host=True
+            )
+            jax.block_until_ready(template)
+            backend.sync_weights(template)
+            version = step
+            handoff_s = time.perf_counter() - t0
+        del arrays
+        prompts = jnp.asarray(msg["prompts"])
+        rng = jax.random.PRNGKey(int(msg.get("seed", 0)))
+        t1 = time.perf_counter()
+        tokens = np.asarray(backend.generate(prompts, rng))
+        gen_s = max(time.perf_counter() - t1, 1e-9)
+        new_tokens = tokens.shape[1] - prompts.shape[1]
+        resp.put(
+            {
+                "tokens": tokens,
+                "version": version,
+                "handoff_s": round(handoff_s, 6),
+                "gen_s": round(gen_s, 6),
+                "tokens_per_s": round(
+                    tokens.shape[0] * new_tokens / gen_s, 2
+                ),
+            }
+        )
+
+
+class CrossProcessGenerationEngine:
+    """Trainer-side handle on the generation process.
+
+    Same surface as the in-process backends (``sync_weights`` /
+    ``generate``) so PPO code swaps engines without edits; the
+    difference is that ``sync_weights`` PUBLISHES the policy through
+    shm (no pointer sharing) and ``generate`` is served by the worker
+    process.  ``last_stats`` carries the serving metrics of the most
+    recent call.
+    """
+
+    def __init__(
+        self,
+        factory: str,
+        max_new_tokens: int,
+        temperature: float = 1.0,
+        factory_kwargs: Optional[Dict] = None,
+        name: Optional[str] = None,
+        start_timeout: float = 300.0,
+    ):
+        from dlrover_tpu.agent.ckpt_shm import SharedMemoryHandler
+        from dlrover_tpu.common.multi_process import SharedQueue
+
+        self._name = name or f"gen-{os.getpid()}"
+        # trainer side hosts the meta service + queues (it outlives
+        # worker restarts)
+        self._shm = SharedMemoryHandler(
+            rank=0, name=self._name, host=True
+        )
+        self._req = SharedQueue(f"{self._name}-req", create=True)
+        self._resp = SharedQueue(f"{self._name}-resp", create=True)
+        self._version = 0
+        self.last_stats: Dict = {}
+        self.publish_s = 0.0
+
+        spec = {
+            "name": self._name,
+            "factory": factory,
+            "factory_kwargs": factory_kwargs or {},
+            "max_new_tokens": int(max_new_tokens),
+            "temperature": float(temperature),
+        }
+        env = dict(os.environ)
+        env[WORKER_SPEC_ENV] = json.dumps(spec)
+        import jax
+
+        if jax.default_backend() == "cpu":
+            # tests / CPU: the worker must not grab a TPU runtime
+            env.setdefault("JAX_PLATFORMS", "cpu")
+        self._proc = subprocess.Popen(
+            [sys.executable, "-m", "dlrover_tpu.rl.generation_service"],
+            env=env,
+        )
+        ready = self._resp.get(timeout=start_timeout)
+        if not ready.get("ready"):
+            raise RuntimeError(f"generation worker failed: {ready}")
+        logger.info(
+            "cross-process generation engine %s up (worker pid %s)",
+            self._name, ready.get("pid"),
+        )
+
+    # ------------------------------------------------------------ API
+    def sync_weights(self, params) -> float:
+        """Publish the actor params through the shm substrate; the
+        worker adopts them before serving the next request.  Returns
+        the publish (snapshot) latency in seconds."""
+        self._version += 1
+        t0 = time.perf_counter()
+        self._shm.save_state(self._version, params)
+        self.publish_s = time.perf_counter() - t0
+        return self.publish_s
+
+    def generate(self, prompts, rng=None, seed: Optional[int] = None):
+        if seed is None:
+            seed = 0
+            if rng is not None:
+                import jax
+
+                seed = int(
+                    np.asarray(jax.random.key_data(rng)).ravel()[-1]
+                )
+        self._req.put(
+            {
+                "cmd": "generate",
+                "prompts": np.asarray(prompts),
+                "seed": int(seed),
+            }
+        )
+        out = self._resp.get(timeout=600.0)
+        if "error" in out:
+            raise RuntimeError(out["error"])
+        self.last_stats = {
+            k: out[k]
+            for k in ("version", "handoff_s", "gen_s", "tokens_per_s")
+        }
+        return out["tokens"]
+
+    def close(self):
+        try:
+            self._req.put({"cmd": "stop"})
+            self._resp.get(timeout=30.0)
+        except Exception:  # noqa: BLE001 - worker may be dead already
+            pass
+        if self._proc.poll() is None:
+            try:
+                self._proc.wait(timeout=30.0)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+        self._shm.close(unlink=True)
+        self._req.close()
+        self._resp.close()
+
+
+if __name__ == "__main__":
+    sys.exit(worker_main())

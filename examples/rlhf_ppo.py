@@ -26,6 +26,9 @@ def parse_args():
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--prompt_len", type=int, default=4)
     p.add_argument("--max_new", type=int, default=8)
+    # serve generation from a dedicated process (the reference's
+    # vLLM-engine topology): weights ship over the shm substrate
+    p.add_argument("--cross_process", action="store_true")
     return p.parse_args()
 
 
@@ -102,10 +105,38 @@ def main():
     engine.init_role_state("actor", jax.random.PRNGKey(0))
     engine.init_role_state("critic", jax.random.PRNGKey(1))
 
+    if args.cross_process:
+        # generation in a SEPARATE process: each policy update is
+        # published through shared memory and resharded onto the
+        # worker's inference layout (rl/generation_service.py; ref
+        # vllm_backend.py) — no in-process pointer sharing
+        import dataclasses
+
+        from dlrover_tpu.rl.generation_service import (
+            CrossProcessGenerationEngine,
+        )
+
+        backend = CrossProcessGenerationEngine(
+            factory=(
+                "dlrover_tpu.rl.generation_service:"
+                "tiny_llama_factory"
+            ),
+            # the spec crosses a process boundary as JSON — ship only
+            # the primitive config fields (dtype stays the default)
+            factory_kwargs={
+                k: v
+                for k, v in dataclasses.asdict(cfg).items()
+                if isinstance(v, (int, float, str, bool))
+            },
+            max_new_tokens=args.max_new,
+        )
+    else:
+        backend = KVCacheBackend(cfg, max_new_tokens=args.max_new)
+
     trainer = RLHFTrainer(
         config,
         engine,
-        KVCacheBackend(cfg, max_new_tokens=args.max_new),
+        backend,
         actor_forward=actor_forward,
         critic_value=critic_value,
         reward_fn=lambda tokens: np.asarray(
@@ -127,6 +158,16 @@ def main():
             f"kl {h['mean_kl']:.4f} actor_loss {h['actor_loss']:.4f}",
             flush=True,
         )
+    if args.cross_process:
+        s = backend.last_stats
+        print(
+            f"generation service: {s['tokens_per_s']:.1f} tok/s, "
+            f"weight handoff {s['handoff_s'] * 1e3:.1f} ms "
+            f"(publish {backend.publish_s * 1e3:.1f} ms), "
+            f"policy version {s['version']}",
+            flush=True,
+        )
+        backend.close()
 
 
 if __name__ == "__main__":

@@ -59,6 +59,17 @@ class TestExamples:
         out = _run_example(tmp_path, "rlhf_ppo.py", "--rounds", "1")
         assert "reward" in out
 
+    def test_rlhf_ppo_cross_process(self, tmp_path):
+        """VERDICT-r4 missing #4: generation served by a separate
+        process, weights over shm, serving stats recorded."""
+        out = _run_example(
+            tmp_path, "rlhf_ppo.py", "--rounds", "1",
+            "--cross_process",
+        )
+        assert "reward" in out
+        assert "generation service:" in out
+        assert "tok/s" in out and "handoff" in out
+
     def test_vit_train(self, tmp_path):
         out = _run_example(
             tmp_path, "vit_train.py", "--steps", "4",
