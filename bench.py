@@ -142,12 +142,10 @@ def main() -> int:
     import jax.numpy as jnp
 
     on_tpu = jax.default_backend() == "tpu"
-    # Auto-sized state, small on CPU CI.  The tunnel this env reaches
-    # the chip through has WILDLY variable d2h bandwidth (0.065 GB/s
-    # in round 2, 0.002 GB/s in round 3): probe it first and cap the
-    # state so one full drain stays ~<=45s — the headline (dispatch
-    # blocking) is size-insensitive and d2h_gbps in extras normalizes
-    # the drains.
+    # PINNED state size (VERDICT-r4 weak #5: the auto-sized state made
+    # the blocking-save headline incomparable across rounds — 1.7ms at
+    # 0.45GB, 6.2ms at 1.45GB).  0.5 GB bf16 on TPU, small on CPU CI;
+    # the d2h probe is kept for normalization only.
     d2h_probe_gbps = None
     n_params = 50_000_000
     if on_tpu:
@@ -162,15 +160,7 @@ def main() -> int:
         d2h_probe_gbps = host.nbytes / 1e9 / max(
             time.perf_counter() - t0, 1e-9
         )
-        # target ~45s/drain: the 64 MB probe amortizes tunnel latency
-        # better than the real leaf-wise drain, so observed drains run
-        # ~2x the budget (r4 preflight: 90s target -> 130-178s
-        # drains, 326s restore).  The cap keeps the whole ckpt phase
-        # bounded; d2h_gbps in extras still normalizes to real HW.
-        budget_bytes = d2h_probe_gbps * 1e9 * 45.0
-        n_params = int(
-            min(max(budget_bytes / 2, 50_000_000), 400_000_000)
-        )
+        n_params = 250_000_000  # 0.5 GB bf16, FIXED across rounds
     chunk = 25_000_000
     n_params = max(n_params // chunk, 1) * chunk
     n_chunks = n_params // chunk
@@ -247,6 +237,14 @@ def main() -> int:
     step, restored = engine.load(target=state)
     restore_device_s = time.perf_counter() - t0
     assert step == 4 and restored is not None
+    # restore-side blocking headline (VERDICT-r4 #9): time from
+    # "restart decided" to the FIRST step completing on the restored
+    # state — shm read + H2D restore + one training step
+    t0 = time.perf_counter()
+    _step, rerestored = engine.load(target=state)
+    first = update(rerestored)
+    jax.block_until_ready(first)
+    time_to_first_step_s = time.perf_counter() - t0
 
     engine.close()
 
@@ -265,6 +263,9 @@ def main() -> int:
                     "persisted": bool(persisted),
                     "shm_read_s": round(shm_read_s, 4),
                     "restore_to_device_s": round(restore_device_s, 2),
+                    "time_to_first_step_s": round(
+                        time_to_first_step_s, 2
+                    ),
                     "prealloc_s": round(prealloc_s, 2),
                     "first_save_block_s": round(first_block_s, 4),
                     "first_save_total_s": round(first_total_s, 2),

@@ -5,8 +5,12 @@ The reference's headline claim is goodput — 69% -> 95% on GLM-65B with
 fault tolerance (``README.md:56-58``) and the chaosblade kill-a-pod
 runbook (``docs/tech_report/fault_tolerance_exps.md:27-80``).  This
 harness reproduces that experiment at CI scale: launch a 2-process
-elastic run (``dlrover_tpu.run``), SIGKILL a worker at configured
-training steps, and measure
+elastic run (``dlrover_tpu.run``), inject a MIX of faults at
+configured training steps — hard SIGKILLs and GRACEFUL preemptions
+(a fake GCE metadata endpoint flips to TERMINATE, the agent's
+PreemptionWatcher flushes the shm snapshot to storage and reports,
+then the worker is SIGTERMed like the dying VM would be) — and
+measure
 
 - ``goodput``            = final_step x steady-state step time / wall
                            clock from first to last completed step
@@ -50,24 +54,79 @@ def _read_progress(path):
     return out
 
 
-def run_goodput(
-    target_steps: int = 2000,
-    kill_at_steps=(500, 1100),
-    step_sleep: float = 0.1,
-    timeout: float = 900.0,
-) -> dict:
-    """Run the kill-and-recover experiment; returns the metrics dict.
+class _FakeMetadata:
+    """Local stand-in for the GCE metadata server: answers the two
+    endpoints the PreemptionWatcher polls; the harness flips it to
+    TERMINATE to inject a graceful preemption."""
 
-    Defaults space the kills ~60 s of useful work apart (600 steps x
-    ~0.11 s), so the MEASURED goodput is comparable to the reference's
-    ">=95% under preemptions" claim instead of a 15 s-spacing toy that
-    only clears the bar after projection.
+    def __init__(self):
+        import http.server
+        import threading
+
+        self.event = "NONE"
+        harness = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - stdlib contract
+                if self.path.endswith("maintenance-event"):
+                    body = harness.event
+                elif self.path.endswith("preempted"):
+                    body = "FALSE"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                payload = body.encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self._srv = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", 0), Handler
+        )
+        self.base = f"http://127.0.0.1:{self._srv.server_port}/"
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+def run_goodput(
+    target_steps: int = 3200,
+    faults=(
+        (500, "sigkill"),
+        (1050, "preempt"),
+        (1600, "sigkill"),
+        (2150, "preempt"),
+        (2700, "sigkill"),
+    ),
+    step_sleep: float = 0.1,
+    timeout: float = 1500.0,
+) -> dict:
+    """Run the fault-and-recover experiment; returns the metrics dict.
+
+    Defaults inject FIVE faults ~55-60 s of useful work apart — three
+    hard SIGKILLs and two watcher-driven graceful preemptions (fake
+    metadata endpoint -> PreemptionWatcher -> storage flush -> SIGTERM)
+    — so the MEASURED goodput covers both fault kinds at a spacing
+    comparable to the reference's ">=95% under preemptions" claim
+    (ref: docs/tech_report/fault_tolerance_exps.md:27-80, chaosblade
+    kill + preemption mix).
 
     Raises RuntimeError on harness failure (launcher died, steps not
-    reached, step continuity broken).
+    reached, step continuity broken, graceful path not engaged).
     """
     workdir = tempfile.mkdtemp(prefix="dlrover_goodput_")
     progress = os.path.join(workdir, "progress.jsonl")
+    metadata = _FakeMetadata()
     env = dict(
         os.environ,
         GOODPUT_TARGET_STEPS=str(target_steps),
@@ -75,6 +134,9 @@ def run_goodput(
         GOODPUT_PROGRESS_FILE=progress,
         GOODPUT_CKPT_DIR=os.path.join(workdir, "ckpt"),
         DLROVER_TPU_SOCKET_DIR=os.path.join(workdir, "socks"),
+        # the agent's REAL preemption watcher polls the fake endpoint
+        DLROVER_TPU_METADATA_BASE=metadata.base,
+        DLROVER_TPU_PREEMPTION_POLL="0.3",
         JAX_PLATFORMS="cpu",
         # persist even sub-second compiles: the toy model's jits are
         # below the default 1.0s persistence threshold, which would
@@ -93,7 +155,7 @@ def run_goodput(
                 "--nnodes=1", "--nproc_per_node=2",
                 "--monitor_interval=0.3",
                 "--stop_timeout=2",
-                f"--max_restarts={len(kill_at_steps) + 2}",
+                f"--max_restarts={len(faults) + 2}",
                 # the three restart-latency levers, all on by default
                 # in the harness because they ARE the product defaults
                 # for preemption-heavy TPU fleets:
@@ -113,8 +175,8 @@ def run_goodput(
             cwd=workdir,
         )
 
-    kills = []  # (kill_time, last_step_seen, inc_at_kill)
-    pending = list(kill_at_steps)
+    kills = []  # (kill_time, last_step_seen, inc_at_kill, kind)
+    pending = [(int(s), str(k)) for s, k in faults]
     deadline = time.time() + timeout
     try:
         while proc.poll() is None:
@@ -124,25 +186,43 @@ def run_goodput(
             if lines and pending:
                 max_step = max(e["step"] for e in lines)
                 max_inc = max(e["inc"] for e in lines)
-                # arm the next kill only after the previous kill's
+                # arm the next fault only after the previous fault's
                 # restart has been observed (a new incarnation logged
                 # progress) — otherwise a fast loop can blow through
-                # both thresholds inside one monitor interval
+                # several thresholds inside one monitor interval
                 restart_seen = (
                     not kills or max_inc > kills[-1][2]
                 )
-                if max_step >= pending[0] and restart_seen:
-                    # kill the most recent rank-1 worker
+                if max_step >= pending[0][0] and restart_seen:
+                    _step, kind = pending.pop(0)
+                    # fault the most recent rank-1 worker
                     rank1 = [e for e in lines if e["rank"] == 1]
                     victim = (rank1 or lines)[-1]["pid"]
+                    if kind == "preempt":
+                        # graceful path: metadata flips, the agent's
+                        # watcher flushes + reports (<=0.3s poll) —
+                        # and then the host DIES anyway (that is what
+                        # a preemption is; a SIGTERM alone would be
+                        # swallowed by the worker's flush handler and
+                        # the worker would keep running)
+                        metadata.event = (
+                            "TERMINATE_ON_HOST_MAINTENANCE"
+                        )
+                        time.sleep(1.0)  # watcher poll + flush window
                     try:
                         os.kill(victim, signal.SIGKILL)
                     except ProcessLookupError:
                         pass
-                    kills.append((time.time(), max_step, max_inc))
-                    pending.pop(0)
+                    kills.append(
+                        (time.time(), max_step, max_inc, kind)
+                    )
+                    if kind == "preempt":
+                        # clear the event once delivered so the NEXT
+                        # preemption is a distinct edge
+                        metadata.event = "NONE"
             time.sleep(0.1)
     finally:
+        metadata.close()
         if proc.poll() is None:
             proc.kill()
             proc.wait()
@@ -201,9 +281,9 @@ def run_goodput(
     useful = (target_steps - rank0[0]["step"]) * step_time
     goodput = min(useful / wall, 1.0) if wall > 0 else 0.0
 
-    recoveries = []
-    for kill_t, _, inc_at_kill in kills:
-        # recovery = kill -> first completed step of a NEW incarnation
+    recoveries = []  # (kind, seconds)
+    for kill_t, _, inc_at_kill, kind in kills:
+        # recovery = fault -> first completed step of a NEW incarnation
         # (the old rank-0 keeps logging until the agent tears it down)
         after = [
             e
@@ -211,19 +291,34 @@ def run_goodput(
             if e["t"] > kill_t and e["inc"] > inc_at_kill
         ]
         if after:
-            recoveries.append(min(e["t"] for e in after) - kill_t)
+            recoveries.append(
+                (kind, min(e["t"] for e in after) - kill_t)
+            )
 
     if len(recoveries) != len(kills):
-        # an unmeasured kill must fail the harness, not inflate the
+        # an unmeasured fault must fail the harness, not inflate the
         # numbers (mean of fewer recoveries -> silently optimistic)
         raise RuntimeError(
-            f"{len(kills)} kills but only {len(recoveries)} measured "
+            f"{len(kills)} faults but only {len(recoveries)} measured "
             "recoveries"
         )
+    # the graceful path must have ENGAGED (watcher saw the event and
+    # flushed) — otherwise the preempt faults were just slow SIGTERMs
+    n_preempt = sum(1 for *_x, kind in kills if kind == "preempt")
+    if n_preempt:
+        log_text = open(log_path).read()
+        engaged = log_text.count("maintenance event")
+        if engaged < n_preempt:
+            raise RuntimeError(
+                f"{n_preempt} preemptions injected but the watcher "
+                f"logged only {engaged} maintenance events"
+            )
     # zero-kill baseline run: no faults -> no recovery loss (1.0 is
     # then exact, not an artifact of an empty mean)
     mean_rec = (
-        sum(recoveries) / len(recoveries) if recoveries else 0.0
+        sum(r for _, r in recoveries) / len(recoveries)
+        if recoveries
+        else 0.0
     )
     # Secondary PROJECTION onto the reference experiment's (roughly
     # hourly) fault rate: each fault costs measured recovery latency
@@ -246,7 +341,9 @@ def run_goodput(
         "restarts_observed": len(by_inc) - 1,
         "step_time_s": round(step_time, 4),
         "wall_s": round(wall, 2),
-        "recovery_latency_s": [round(r, 2) for r in recoveries],
+        "recovery_latency_s": [
+            {"kind": k, "s": round(r, 2)} for k, r in recoveries
+        ],
         "mean_recovery_s": round(mean_rec, 2),
         "rollback_steps": rollback_steps,
         "mean_rollback_s": round(mean_rollback_s, 3),

@@ -20,6 +20,7 @@ The metadata fetcher is injectable (tests and non-GCE environments
 never touch the network).
 """
 
+import os
 import threading
 from typing import Callable, List, Optional
 
@@ -28,6 +29,13 @@ from dlrover_tpu.common.log import default_logger as logger
 _METADATA_BASE = (
     "http://metadata.google.internal/computeMetadata/v1/instance/"
 )
+
+
+def _metadata_base() -> str:
+    """Metadata server base URL; overridable so fault-injection
+    harnesses (bench_goodput) can stand in a fake endpoint and drive
+    the REAL watcher->flush->restart path."""
+    return os.getenv("DLROVER_TPU_METADATA_BASE", _METADATA_BASE)
 # Hosted-VM migration/termination and spot/preemptible termination
 # are surfaced on DIFFERENT endpoints (maintenance-event says
 # NONE/MIGRATE.../TERMINATE...; preempted says TRUE/FALSE) — a
@@ -41,7 +49,8 @@ def _fetch_metadata(path: str, timeout: float) -> Optional[str]:
     import urllib.request
 
     req = urllib.request.Request(
-        _METADATA_BASE + path, headers={"Metadata-Flavor": "Google"}
+        _metadata_base() + path,
+        headers={"Metadata-Flavor": "Google"},
     )
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
@@ -77,9 +86,15 @@ class PreemptionWatcher:
     def __init__(
         self,
         fetcher: Optional[Callable[[], Optional[str]]] = None,
-        poll_interval: float = 5.0,
+        poll_interval: Optional[float] = None,
     ):
         self._fetch = fetcher or _default_fetcher
+        if poll_interval is None:
+            # well inside the ~60s preemption lead; harnesses shrink
+            # it so graceful-path recovery is measurable at CI scale
+            poll_interval = float(
+                os.getenv("DLROVER_TPU_PREEMPTION_POLL", "5.0")
+            )
         self._interval = poll_interval
         self._callbacks: List[Callable[[str], None]] = []
         self._stopped = threading.Event()
