@@ -265,9 +265,17 @@ class HostOffloadAdamW:
     def _pinned_update_fn(self):
         """Chunk update compiled with host-memory in/out shardings;
         donation recycles the TPU-host buffers so steady state
-        allocates nothing."""
+        allocates nothing.
+
+        The grad arrives as the WHOLE flat leaf plus a traced offset
+        and is sliced INSIDE the program: slicing outside would
+        materialize a second full copy of the grads as in-flight
+        slice buffers (measured: the difference between the 1.8B
+        accumulated proof fitting and OOMing)."""
         if getattr(self, "_pinned_fn", None) is not None:
             return self._pinned_fn
+        from jax import lax
+
         dev, host = self._shardings()
         hyper = dict(
             lr=self.lr, b1=self.b1, b2=self.b2, eps=self.eps,
@@ -276,7 +284,11 @@ class HostOffloadAdamW:
 
         if self.moments == "int8":
 
-            def body(master, mu_q, mu_s, nu_q, nu_s, grad, bc1, bc2):
+            def body(master, mu_q, mu_s, nu_q, nu_s, grad_flat, off,
+                     bc1, bc2):
+                grad = lax.dynamic_slice(
+                    grad_flat, (off,), (master.shape[0],)
+                )
                 outs = _adamw_chunk_math_q(
                     jax.device_put(master, dev),
                     jax.device_put(mu_q, dev),
@@ -291,13 +303,16 @@ class HostOffloadAdamW:
 
             self._pinned_fn = jax.jit(
                 body,
-                in_shardings=(host,) * 5 + (dev, None, None),
+                in_shardings=(host,) * 5 + (dev, None, None, None),
                 out_shardings=(host,) * 5 + (dev,),
                 donate_argnums=(0, 1, 2, 3, 4),
             )
         else:
 
-            def body(master, mu, nu, grad, bc1, bc2):
+            def body(master, mu, nu, grad_flat, off, bc1, bc2):
+                grad = lax.dynamic_slice(
+                    grad_flat, (off,), (master.shape[0],)
+                )
                 # host->HBM in, shared AdamW math, HBM->host out
                 m_d, mu_d, nu_d, p_bf16 = _adamw_chunk_math(
                     jax.device_put(master, dev),
@@ -314,7 +329,8 @@ class HostOffloadAdamW:
 
             self._pinned_fn = jax.jit(
                 body,
-                in_shardings=(host, host, host, dev, None, None),
+                in_shardings=(host, host, host, dev, None, None,
+                              None),
                 out_shardings=(host, host, host, dev),
                 donate_argnums=(0, 1, 2),
             )
@@ -512,12 +528,13 @@ class HostOffloadAdamW:
             slices = self._chunk_slices(flat_g.shape[0])
             ms, mus, nus, ps = [], [], [], []
             for j, sl in enumerate(slices):
+                off = jnp.int32(sl.start)
                 if self.moments == "int8":
                     mu_q, mu_s = leaves_mu[li][j]
                     nu_q, nu_s = leaves_nu[li][j]
                     (m_h, mu_q2, mu_s2, nu_q2, nu_s2, p_d) = fn(
                         m_chunks[j], mu_q, mu_s, nu_q, nu_s,
-                        flat_g[sl], bc1, bc2,
+                        flat_g, off, bc1, bc2,
                     )
                     mus.append((mu_q2, mu_s2))
                     nus.append((nu_q2, nu_s2))
@@ -526,7 +543,8 @@ class HostOffloadAdamW:
                         m_chunks[j],
                         leaves_mu[li][j],
                         leaves_nu[li][j],
-                        flat_g[sl],
+                        flat_g,
+                        off,
                         bc1,
                         bc2,
                     )
@@ -1028,35 +1046,39 @@ def build_offloaded_train_step(
 
         return init_state, train_step
 
-    # accumulated chunked path: one PROGRAM per microbatch plus tiny
-    # donated add programs, NOT one K-micro program — the fused
-    # accumulation program must co-reserve the accumulator, the
-    # per-micro grads and the backward residuals and exceeds a 16 GB
-    # chip at 1.8B (measured), while the per-micro program has the
-    # same footprint the non-accumulated r4 proofs already ran at.
-    single_grad = jax.jit(
-        lambda params, batch: jax.value_and_grad(loss_fn)(
-            params, batch
-        )
-    )
+    # accumulated chunked path: one PROGRAM per microbatch, NOT one
+    # K-micro program — the fused accumulation program must co-reserve
+    # the accumulator, the per-micro grads and the backward residuals
+    # and exceeds a 16 GB chip at 1.8B (measured).  The accumulator is
+    # DONATED into each micro's backward program so the grad add is an
+    # epilogue on the aliased buffer: peak stays at the r4-proven
+    # (params + one grads tree + residuals), not + a separate acc.
     inv = 1.0 / micro_steps
+    scaled_vag = jax.value_and_grad(
+        lambda p, b: loss_fn(p, b) * inv
+    )
+    first_grad = jax.jit(scaled_vag)
 
-    @functools.partial(jax.jit, donate_argnums=(1,))
-    def _first(loss, g):
-        return loss * inv, jax.tree_util.tree_map(
-            lambda a: (a * inv).astype(a.dtype), g
-        )
-
-    @functools.partial(jax.jit, donate_argnums=(1, 3))
-    def _add(loss_sum, acc, loss, g):
+    @functools.partial(jax.jit, donate_argnums=(2, 3))
+    def _grad_into(params, mb, acc, loss_sum):
+        loss_k, g = scaled_vag(params, mb)
         return (
-            loss_sum + loss * inv,
+            loss_sum + loss_k,
             jax.tree_util.tree_map(
-                lambda s, a: (s + a * inv).astype(s.dtype), acc, g
+                lambda s, a: (s + a).astype(s.dtype), acc, g
             ),
         )
 
+    pending: Dict[str, object] = {}
+
     def train_step(state: OffloadState, batch):
+        # completion barrier on the PREVIOUS step: async dispatch
+        # otherwise pipelines steps, and at 1.8B two in-flight steps'
+        # buffers exceed HBM (runtime OOM) — a one-element readback
+        # of the previous step's assembled params serializes steps
+        prev = pending.pop("probe", None)
+        if prev is not None:
+            float(prev)
         prefetched = opt.start_prefetch(state)
         split = jax.tree_util.tree_map(
             lambda x: x.reshape(
@@ -1065,18 +1087,18 @@ def build_offloaded_train_step(
             ),
             batch,
         )
-        loss_sum = None
-        acc = None
-        for k in range(micro_steps):
+        mb0 = jax.tree_util.tree_map(lambda x: x[0], split)
+        loss_sum, acc = first_grad(state.params, mb0)
+        for k in range(1, micro_steps):
             mb = jax.tree_util.tree_map(lambda x: x[k], split)
-            loss_k, g = single_grad(state.params, mb)
-            if acc is None:
-                loss_sum, acc = _first(loss_k, g)
-            else:
-                loss_sum, acc = _add(loss_sum, acc, loss_k, g)
+            loss_sum, acc = _grad_into(
+                state.params, mb, acc, loss_sum
+            )
         new_state = opt.apply_gradients(
             state, acc, prefetched=prefetched
         )
+        leaf0 = jax.tree_util.tree_leaves(new_state.params)[0]
+        pending["probe"] = leaf0.reshape(-1)[0].astype(jnp.float32)
         return new_state, {"loss": loss_sum}
 
     return init_state, train_step

@@ -135,3 +135,51 @@ class JsonlLoggerCallback(TrainerCallback):
 
     def on_train_end(self, summary):
         self._append({"kind": "end", "t": time.time(), **summary})
+
+
+class TensorBoardCallback(TrainerCallback):
+    """Write train/eval curves as TensorBoard event files.
+
+    Reference parity: ``atorch/atorch/trainer/atorch_trainer.py:216``
+    integrates TensorBoard into the trainer loop; the TPU trainer
+    reaches the same surface through torch's bundled SummaryWriter
+    (torch-cpu ships in every image this framework targets — no
+    TensorFlow dependency).  Rank-0-only by construction: attach the
+    callback on rank 0 or give each rank its own log dir.  Raises
+    ImportError at CONSTRUCTION when no writer implementation exists,
+    so a misconfigured job fails loudly instead of silently logging
+    nothing.
+    """
+
+    def __init__(self, log_dir: str, train_every: int = 1):
+        from torch.utils.tensorboard import SummaryWriter
+
+        self._writer = SummaryWriter(log_dir=log_dir)
+        self._train_every = max(train_every, 1)
+
+    def _scalars(self, prefix: str, step: int, metrics: Dict):
+        for key, value in metrics.items():
+            if isinstance(value, (int, float)):
+                self._writer.add_scalar(
+                    f"{prefix}/{key}", value, global_step=step
+                )
+
+    def on_step_end(self, step, metrics):
+        if step % self._train_every:
+            return
+        self._scalars("train", step, metrics)
+
+    def on_eval(self, step, metrics):
+        self._scalars("eval", step, metrics)
+
+    def on_save(self, step, storage):
+        self._writer.add_scalar(
+            "checkpoint/persisted" if storage else "checkpoint/memory",
+            1.0,
+            global_step=step,
+        )
+
+    def on_train_end(self, summary):
+        self._scalars("summary", summary.get("final_step", 0), summary)
+        self._writer.flush()
+        self._writer.close()

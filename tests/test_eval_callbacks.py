@@ -122,6 +122,24 @@ class TestCallbackList:
         assert rec.steps == [(1, {"loss": 0.5})]
 
 
+class TestTensorBoardCallback:
+    def test_writes_event_files(self, tmp_path):
+        """The reference trainer integrates TensorBoard
+        (atorch_trainer.py:216); the TPU callback must produce real
+        event files from the standard hook stream."""
+        pytest.importorskip("torch.utils.tensorboard")
+        from dlrover_tpu.trainer.callbacks import TensorBoardCallback
+
+        cb = TensorBoardCallback(str(tmp_path / "tb"), train_every=2)
+        cb.on_step_end(1, {"loss": 1.0})   # skipped (train_every=2)
+        cb.on_step_end(2, {"loss": 0.9, "lr": 1e-3, "tag": "x"})
+        cb.on_eval(2, {"eval_loss": 0.8})
+        cb.on_save(2, storage=True)
+        cb.on_train_end({"final_step": 2, "mean_step_time": 0.1})
+        events = list((tmp_path / "tb").glob("events.out.tfevents.*"))
+        assert events and events[0].stat().st_size > 0
+
+
 def _build_trainer(tmp_path, socket_name, max_steps, schedule=None,
                    callbacks=None, eval_interval=0, with_eval=True):
     import os
