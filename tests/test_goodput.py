@@ -25,7 +25,7 @@ def test_goodput_recovers_from_kill():
     try:
         result = bench_goodput.run_goodput(
             target_steps=30,
-            kill_at_steps=(10,),
+            faults=((10, "sigkill"),),
             step_sleep=0.08,
             timeout=240,
         )
@@ -34,7 +34,7 @@ def test_goodput_recovers_from_kill():
         # can stretch past the deadline without any product fault
         result = bench_goodput.run_goodput(
             target_steps=30,
-            kill_at_steps=(10,),
+            faults=((10, "sigkill"),),
             step_sleep=0.08,
             timeout=240,
         )
@@ -44,4 +44,7 @@ def test_goodput_recovers_from_kill():
     assert result["restarts_observed"] >= 1
     # and the new incarnation produced progress after the kill
     assert result["recovery_latency_s"]
-    assert all(r > 0 for r in result["recovery_latency_s"])
+    assert all(
+        r["s"] > 0 and r["kind"] == "sigkill"
+        for r in result["recovery_latency_s"]
+    )
