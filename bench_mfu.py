@@ -307,28 +307,38 @@ def _run_candidate(
         except Exception:  # noqa: BLE001
             pass
 
-    def run_chain(st, n):
+    # the state lives in a single-slot holder so run_chain can DROP
+    # the entry reference before stepping: a caller-held name would
+    # pin the entry params tree (3.5 GB at 1.8B) for the whole chain
+    # — exactly the margin that OOMs the accumulated offload proofs
+    holder = [state]
+    del state
+
+    def run_chain(n):
         """Dispatch n steps back-to-back, then force completion by
         reading back the final scalar loss (a data dependency on the
         whole chain).  block_until_ready alone does NOT wait on remote
         tunnel backends, so completion is proven by the readback."""
+        st = holder.pop()
         t0 = time.perf_counter()
         m = None
         for _ in range(n):
             st, m = fns.train_step(st, batch_dict)
         loss = float(m["loss"])
-        return time.perf_counter() - t0, st, loss
+        holder.append(st)
+        return time.perf_counter() - t0, loss
 
     t_compile0 = time.perf_counter()
-    warmup_t, state, _ = run_chain(state, 2)  # first call compiles
+    warmup_t, _ = run_chain(2)  # first call compiles
     warmup_s = time.perf_counter() - t_compile0
 
     # differential timing: two chain lengths share the same dispatch +
     # readback round-trip overhead; the slope is the pure step time
     n_short = 2
     n_long = n_short + steps
-    t_short, state, _ = run_chain(state, n_short)
-    t_long, state, loss = run_chain(state, n_long)
+    t_short, _ = run_chain(n_short)
+    t_long, loss = run_chain(n_long)
+    state = holder.pop()
     step_s = max((t_long - t_short) / (n_long - n_short), 1e-9)
 
     tokens_per_step = batch * seq
