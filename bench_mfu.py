@@ -172,15 +172,19 @@ def _candidates(on_tpu: bool):
               ce_chunk_rows=256),
          24, 2048, 4, "offload_int8_m3"),
         # the 3B ceiling proof (VERDICT-r4 #2): ~3.0B params on ONE
-        # 16 GB chip — bf16 params + bf16 grad accumulator are 12 GB
-        # alone, so microbatch 4 keeps backward residuals ~1.5 GB and
-        # the int8-moment host stream holds the optimizer state.  The
-        # proof is FITTING + loss decreasing; throughput is secondary.
-        ("llama-3b-offload8-m6",
+        # 16 GB chip.  A single backward's full dW tree cannot
+        # coexist with the bf16 params at this scale (measured: needs
+        # ~19 GB), so the step runs the GROUPED two-pass backward
+        # (build_grouped_offload_step): one dW-half at a time, group
+        # A's grads staged to host between passes, int8-moment host
+        # stream for the optimizer state.  The proof is FITTING +
+        # loss decreasing; throughput is secondary (two forwards per
+        # step by construction).
+        ("llama-3b-offload8-g2",
          dict(common, dim=2560, n_heads=20, n_kv_heads=20,
               n_layers=36, mlp_dim=6912, remat="full",
-              ce_chunk_rows=256),
-         24, 2048, 3, "offload_int8_m6"),
+              ce_chunk_rows=128),
+         4, 2048, 3, "offload_int8_g2"),
     ]
 
 
@@ -218,46 +222,91 @@ def _run_candidate(
             build_offloaded_train_step,
         )
 
-        micro = (
-            int(optimizer.rsplit("_m", 1)[1])
-            if "_m" in optimizer
-            else 1
-        )
-        init_state_fn, offload_step = build_offloaded_train_step(
-            lambda p, b: loss_fn(p, b, cfg),
-            lambda rng: init_params(rng, cfg),
-            HostOffloadAdamW(
+        if optimizer.endswith("_g2"):
+            from dlrover_tpu.models.llama import (
+                init_grouped_params,
+                loss_fn_grouped,
+            )
+            from dlrover_tpu.optimizers.host_offload import (
+                build_grouped_offload_step,
+            )
+
+            init_a, init_b = init_grouped_params(
+                jax.random.PRNGKey(0), cfg, cfg.n_layers // 2
+            )
+            opt_kw = dict(
                 learning_rate=3e-4,
-                moments=(
-                    "int8" if "int8" in optimizer else "fp32"
-                ),
-                # 32M-elem chunks bound the fused step's in-flight
-                # fp32 transient (window * ~5 chunk buffers); 64M
-                # chunks at window 2 still exceeded HBM at 1.8B
-                # accumulated configs shave the last few hundred
-                # MB with 16M-elem chunks (transient ~5 buffers/chunk)
+                moments="int8" if "int8" in optimizer else "fp32",
                 chunk_elems=_env_int(
-                    "BENCH_OFFLOAD_CHUNK",
-                    (16 if "_m" in optimizer else 32) * 1024 * 1024,
+                    "BENCH_OFFLOAD_CHUNK", 16 * 1024 * 1024
                 ),
-            ),
-            # accumulated configs pair the micro-grad program with
-            # the CHUNKED per-program update stream: the one-program
-            # fused form must co-reserve the accumulator, per-micro
-            # grads and both param generations and exceeds HBM at
-            # 1.8B (measured +2.8 GB)
-            mode="chunked" if micro > 1 else "auto",
-            micro_steps=micro,
-        )
-        state = init_state_fn(jax.random.PRNGKey(0))
-        jax.block_until_ready(state.params)
-        n_params = count_params(state.params)
+            )
+            init_state_fn, offload_step = (
+                build_grouped_offload_step(
+                    lambda a, b, bt: loss_fn_grouped(
+                        a, b, bt, cfg
+                    ),
+                    init_a,
+                    init_b,
+                    HostOffloadAdamW(**opt_kw),
+                    HostOffloadAdamW(**opt_kw),
+                )
+            )
+            state = init_state_fn(None)
+            jax.block_until_ready(
+                (state[0].params, state[1].params)
+            )
+            n_params = count_params(state[0].params) + count_params(
+                state[1].params
+            )
 
-        class _OffloadFns:
-            train_step = staticmethod(offload_step)
-            batch_sharding = None
+            class _GroupedFns:
+                train_step = staticmethod(offload_step)
+                batch_sharding = None
 
-        fns = _OffloadFns()
+            fns = _GroupedFns()
+        else:
+            micro = (
+                int(optimizer.rsplit("_m", 1)[1])
+                if "_m" in optimizer
+                else 1
+            )
+            init_state_fn, offload_step = build_offloaded_train_step(
+                lambda p, b: loss_fn(p, b, cfg),
+                lambda rng: init_params(rng, cfg),
+                HostOffloadAdamW(
+                    learning_rate=3e-4,
+                    moments=(
+                        "int8" if "int8" in optimizer else "fp32"
+                    ),
+                    # 32M-elem chunks bound the fused step's
+                    # in-flight fp32 transient (window * ~5 chunk
+                    # buffers); 64M chunks at window 2 still exceeded
+                    # HBM at 1.8B.  Accumulated configs shave the
+                    # last few hundred MB with 16M-elem chunks.
+                    chunk_elems=_env_int(
+                        "BENCH_OFFLOAD_CHUNK",
+                        (16 if "_m" in optimizer else 32)
+                        * 1024 * 1024,
+                    ),
+                ),
+                # accumulated configs pair the micro-grad program
+                # with the CHUNKED per-program update stream: the
+                # one-program fused form must co-reserve the
+                # accumulator, per-micro grads and both param
+                # generations and exceeds HBM at 1.8B (measured)
+                mode="chunked" if micro > 1 else "auto",
+                micro_steps=micro,
+            )
+            state = init_state_fn(jax.random.PRNGKey(0))
+            jax.block_until_ready(state.params)
+            n_params = count_params(state.params)
+
+            class _OffloadFns:
+                train_step = staticmethod(offload_step)
+                batch_sharding = None
+
+            fns = _OffloadFns()
     else:
         ctx = create_parallel_mesh(
             [(AxisName.DATA, len(jax.devices()))],
@@ -318,14 +367,17 @@ def _run_candidate(
         """Dispatch n steps back-to-back, then force completion by
         reading back the final scalar loss (a data dependency on the
         whole chain).  block_until_ready alone does NOT wait on remote
-        tunnel backends, so completion is proven by the readback."""
-        st = holder.pop()
+        tunnel backends, so completion is proven by the readback.
+        The state is passed as a consumed temporary (slot.pop() IN the
+        call): a loop variable would pin each step's entry params for
+        the duration of the call — the offload steps rely on the old
+        params freeing the moment backward completes."""
         t0 = time.perf_counter()
         m = None
         for _ in range(n):
-            st, m = fns.train_step(st, batch_dict)
+            new_st, m = fns.train_step(holder.pop(), batch_dict)
+            holder.append(new_st)
         loss = float(m["loss"])
-        holder.append(st)
         return time.perf_counter() - t0, loss
 
     t_compile0 = time.perf_counter()

@@ -124,6 +124,59 @@ def init_params(key, cfg: LlamaConfig) -> Dict:
     }
 
 
+def loss_fn_grouped(
+    params_a: Dict,
+    params_b: Dict,
+    batch: Dict,
+    cfg: LlamaConfig,
+    attention_fn=None,
+    fused_ce: Optional[bool] = None,
+) -> jnp.ndarray:
+    """``loss_fn`` over a two-group param split: group A carries the
+    embedding + the first layer segment, group B the second segment +
+    final norm + lm head.  ``jax.grad(..., argnums=0 or 1)``
+    materializes only THAT group's dW carries — at ~3B params on a
+    16 GB chip the full grads tree cannot coexist with the params, so
+    the offloaded step runs one backward per group
+    (``optimizers.host_offload.build_grouped_offload_step``)."""
+    params = {
+        "embed": params_a["embed"],
+        "layers": (params_a["layers"], params_b["layers"]),
+        "final_norm": params_b["final_norm"],
+        "lm_head": params_b["lm_head"],
+    }
+    return loss_fn(params, batch, cfg, attention_fn, fused_ce)
+
+
+def init_grouped_params(key, cfg: LlamaConfig, boundary: int):
+    """Build the two-group split WITHOUT materializing the full
+    stacked tree (at 3B the fp32 full tree plus its slices would not
+    fit): each group initializes from a per-segment config.  Returns
+    ``(init_a, init_b)`` thunks so the caller can free group A's fp32
+    source before group B materializes."""
+    import dataclasses
+
+    cfg_a = dataclasses.replace(cfg, n_layers=boundary)
+    cfg_b = dataclasses.replace(
+        cfg, n_layers=cfg.n_layers - boundary
+    )
+    k_a, k_b = jax.random.split(key)
+
+    def init_a() -> Dict:
+        t = init_params(k_a, cfg_a)
+        return {"embed": t["embed"], "layers": t["layers"]}
+
+    def init_b() -> Dict:
+        t = init_params(k_b, cfg_b)
+        return {
+            "layers": t["layers"],
+            "final_norm": t["final_norm"],
+            "lm_head": t["lm_head"],
+        }
+
+    return init_a, init_b
+
+
 def param_logical_axes(cfg: LlamaConfig) -> Dict:
     """Same structure as ``init_params``, leaves = logical-axes tuples
     (None = replicated dim)."""
@@ -326,7 +379,16 @@ def forward_hidden(
     from dlrover_tpu.parallel.mesh import get_mesh_context
 
     execute_layers = select_layer_executor(get_mesh_context())
-    x = execute_layers(block, params["layers"], x, cos, sin)
+    layers = params["layers"]
+    # a tuple/list of stacked subtrees runs as SEQUENTIAL scan
+    # segments — the grouped-backward path (host_offload
+    # build_grouped_offload_step) splits the stack so each group's
+    # dW carries materialize alone
+    segments = (
+        layers if isinstance(layers, (list, tuple)) else (layers,)
+    )
+    for seg in segments:
+        x = execute_layers(block, seg, x, cos, sin)
     return rms_norm(x, params["final_norm"], cfg.norm_eps)
 
 

@@ -166,6 +166,102 @@ class TestOffloadedTrainStep:
         assert state.step == 60
 
 
+class TestGroupedOffload:
+    """Two-group backward (build_grouped_offload_step): the ceiling
+    lever past ~2B params.  Exactness is the whole point — the split
+    must reproduce the single-backward chunked trajectory to float
+    noise (same grads at the same step-start params, same AdamW)."""
+
+    def test_matches_single_group_exactly(self):
+        from dlrover_tpu.models.llama import (
+            LlamaConfig,
+            init_params,
+            loss_fn,
+            loss_fn_grouped,
+        )
+        from dlrover_tpu.optimizers.host_offload import (
+            build_grouped_offload_step,
+        )
+
+        cfg = LlamaConfig.tiny(remat="none")
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        boundary = 1
+        part_a = {
+            "embed": params["embed"],
+            "layers": jax.tree_util.tree_map(
+                lambda l: l[:boundary], params["layers"]
+            ),
+        }
+        part_b = {
+            "layers": jax.tree_util.tree_map(
+                lambda l: l[boundary:], params["layers"]
+            ),
+            "final_norm": params["final_norm"],
+            "lm_head": params["lm_head"],
+        }
+        kw = dict(learning_rate=0.01, chunk_elems=1000)
+        init_g, step_g = build_grouped_offload_step(
+            lambda a, b, batch: loss_fn_grouped(a, b, batch, cfg),
+            lambda: part_a,
+            lambda: part_b,
+            HostOffloadAdamW(**kw),
+            HostOffloadAdamW(**kw),
+        )
+        init_p, step_p = build_offloaded_train_step(
+            lambda p, b: loss_fn(p, b, cfg),
+            lambda rng: params,
+            HostOffloadAdamW(backend="numpy", **kw),
+            mode="chunked",
+        )
+        sg = init_g(None)
+        sp = init_p(jax.random.PRNGKey(9))
+        tokens = np.ones((4, 17), dtype=np.int32)
+        tokens[:, ::3] = 5
+        batch = {"tokens": jnp.asarray(tokens)}
+        for _ in range(3):
+            sg, mg = step_g(sg, batch)
+            sp, mp = step_p(sp, batch)
+        np.testing.assert_allclose(
+            float(mg["loss"]), float(mp["loss"]), rtol=1e-5
+        )
+        sa, sb = sg
+        # group A's first-layer masters == the plain run's layer 0
+        np.testing.assert_allclose(
+            np.asarray(sa.master["layers"]["wq"]),
+            np.asarray(sp.master["layers"]["wq"][:boundary]),
+            rtol=2e-4, atol=2e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(sb.master["lm_head"]),
+            np.asarray(sp.master["lm_head"]),
+            rtol=2e-4, atol=2e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(sb.master["layers"]["w_down"]),
+            np.asarray(sp.master["layers"]["w_down"][boundary:]),
+            rtol=2e-4, atol=2e-5,
+        )
+
+    def test_grouped_init_builds_disjoint_groups(self):
+        from dlrover_tpu.models.llama import (
+            LlamaConfig,
+            init_grouped_params,
+        )
+
+        cfg = LlamaConfig.tiny(remat="none")
+        init_a, init_b = init_grouped_params(
+            jax.random.PRNGKey(1), cfg, boundary=1
+        )
+        a = init_a()
+        b = init_b()
+        assert set(a) == {"embed", "layers"}
+        assert set(b) == {"layers", "final_norm", "lm_head"}
+        assert a["layers"]["wq"].shape[0] == 1
+        assert (
+            b["layers"]["wq"].shape[0] == cfg.n_layers - 1
+        )
+
+
 def _pinned_host_supported():
     import jax as _jax
     from jax.sharding import SingleDeviceSharding
