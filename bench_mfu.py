@@ -184,7 +184,7 @@ def _candidates(on_tpu: bool):
          dict(common, dim=2560, n_heads=20, n_kv_heads=20,
               n_layers=36, mlp_dim=6912, remat="full",
               ce_chunk_rows=128),
-         4, 2048, 3, "offload_int8_g2"),
+         8, 2048, 3, "offload_int8_g2"),
     ]
 
 
@@ -377,6 +377,11 @@ def _run_candidate(
         for _ in range(n):
             new_st, m = fns.train_step(holder.pop(), batch_dict)
             holder.append(new_st)
+            # drop the name NOW: keeping it bound through the next
+            # call would pin the previous state (params and all)
+            # for that call's entire dispatch — at 3B that margin
+            # is the difference between fitting and OOM
+            del new_st
         loss = float(m["loss"])
         return time.perf_counter() - t0, loss
 

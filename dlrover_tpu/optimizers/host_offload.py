@@ -284,10 +284,15 @@ class HostOffloadAdamW:
 
         if self.moments == "int8":
 
-            def body(master, mu_q, mu_s, nu_q, nu_s, grad_flat, off,
+            def body(master, mu_q, mu_s, nu_q, nu_s, grad_leaf, off,
                      bc1, bc2):
+                # flatten + slice IN-program: an eager reshape outside
+                # would materialize a full second copy of the grads
+                # at dispatch time (jit specializes per leaf shape —
+                # a handful of executables, not one per chunk)
                 grad = lax.dynamic_slice(
-                    grad_flat, (off,), (master.shape[0],)
+                    grad_leaf.reshape(-1), (off,),
+                    (master.shape[0],),
                 )
                 outs = _adamw_chunk_math_q(
                     jax.device_put(master, dev),
@@ -309,9 +314,10 @@ class HostOffloadAdamW:
             )
         else:
 
-            def body(master, mu, nu, grad_flat, off, bc1, bc2):
+            def body(master, mu, nu, grad_leaf, off, bc1, bc2):
                 grad = lax.dynamic_slice(
-                    grad_flat, (off,), (master.shape[0],)
+                    grad_leaf.reshape(-1), (off,),
+                    (master.shape[0],),
                 )
                 # host->HBM in, shared AdamW math, HBM->host out
                 m_d, mu_d, nu_d, p_bf16 = _adamw_chunk_math(
@@ -524,8 +530,8 @@ class HostOffloadAdamW:
         new_m, new_mu, new_nu, new_p = [], [], [], []
         for li, m_chunks in enumerate(leaves_m):
             shape = leaves_p[li].shape
-            flat_g = leaves_g[li].reshape(-1)
-            slices = self._chunk_slices(flat_g.shape[0])
+            flat_g = leaves_g[li]  # flattened INSIDE the chunk jit
+            slices = self._chunk_slices(flat_g.size)
             ms, mus, nus, ps = [], [], [], []
             for j, sl in enumerate(slices):
                 off = jnp.int32(sl.start)
@@ -1119,8 +1125,12 @@ def build_offloaded_train_step(
         new_state = opt.apply_gradients(
             state, acc, prefetched=prefetched
         )
-        leaf0 = jax.tree_util.tree_leaves(new_state.params)[0]
-        pending["probe"] = leaf0.reshape(-1)[0].astype(jnp.float32)
+        # the LAST-dispatched leaf: its completion implies the whole
+        # stream's on this serially-executing runtime
+        last = jax.tree_util.tree_leaves(new_state.params)[-1]
+        pending["probe"] = (
+            last.reshape(-1)[-1].astype(jnp.float32)
+        )
         return new_state, {"loss": loss_sum}
 
     return init_state, train_step
@@ -1162,6 +1172,23 @@ def build_grouped_offload_step(
     # and these are no-ops)
     stage_out = jax.jit(lambda g: g, out_shardings=host)
     stage_in = jax.jit(lambda g: g, out_shardings=dev)
+    two_spaces = host is not dev
+    host_scalar = jax.jit(
+        lambda l: jax.device_put(l, dev).reshape(-1)[0].astype(
+            jnp.float32
+        ),
+        out_shardings=dev,
+    )
+
+    def _barrier(value):
+        """Force completion of everything dispatched so far: at 3B
+        the phases' OUTPUT buffers are allocated at dispatch on this
+        runtime, so letting all five phases enqueue at once demands
+        every phase's outputs simultaneously (~16 GB of outputs
+        alone).  Only needed where a second memory space exists —
+        the CPU test mesh runs phases eagerly anyway."""
+        if two_spaces and value is not None:
+            float(value)
 
     def init_state(rng=None):
         del rng  # group inits carry their own keys
@@ -1171,26 +1198,72 @@ def build_grouped_offload_step(
 
     pending: Dict[str, object] = {}
 
+    debug = os.getenv("DLROVER_TPU_GROUPED_DEBUG", "") == "1"
+
+    def _dbg(msg):
+        if debug:
+            import time as _time
+
+            mem = ""
+            try:
+                stats = jax.local_devices()[0].memory_stats()
+                mem = (
+                    f" hbm={stats.get('bytes_in_use', 0) / 1e9:.2f}G"
+                    f" peak={stats.get('peak_bytes_in_use', 0) / 1e9:.2f}G"
+                )
+            except Exception:  # noqa: BLE001
+                pass
+            print(
+                f"[grouped {_time.strftime('%H:%M:%S')}] {msg}{mem}",
+                flush=True,
+            )
+
     def train_step(state, batch):
         state_a, state_b = state
         del state
         prev = pending.pop("probe", None)
         if prev is not None:
             float(prev)  # serialize steps (HBM cannot hold two)
+        _dbg("step start")
         # pass 1: group A grads at step-start params -> host staging
         loss, g_a = vag_a(state_a.params, state_b.params, batch)
+        _barrier(loss)
+        _dbg("vag_a done")
         g_a = stage_out(g_a)
+        _barrier(
+            host_scalar(jax.tree_util.tree_leaves(g_a)[0])
+            if two_spaces
+            else None
+        )
         # pass 2: group B grads at the SAME step-start params
-        _, g_b = vag_b(state_a.params, state_b.params, batch)
-        state_b = opt_b.apply_gradients(
-            _release_params(state_b), g_b
+        loss_b, g_b = vag_b(state_a.params, state_b.params, batch)
+        _barrier(loss_b)
+        _dbg("vag_b done")
+        # rebinding FIRST matters: inlining _release_params in the
+        # call would keep the name bound to the original state (real
+        # params pinned) for the whole dispatch
+        state_b = _release_params(state_b)
+        state_b = opt_b.apply_gradients(state_b, g_b)
+        # force the LAST-dispatched leaf: programs execute in
+        # dispatch order on this runtime, so its completion implies
+        # the whole stream's (the first leaf would only cover the
+        # head of the stream)
+        _barrier(
+            jax.tree_util.tree_leaves(state_b.params)[-1]
+            .reshape(-1)[-1]
+            .astype(jnp.float32)
+            if two_spaces
+            else None
         )
+        _dbg("apply_b done")
         g_a = stage_in(g_a)
-        state_a = opt_a.apply_gradients(
-            _release_params(state_a), g_a
+        state_a = _release_params(state_a)
+        state_a = opt_a.apply_gradients(state_a, g_a)
+        _dbg("apply_a dispatched")
+        last = jax.tree_util.tree_leaves(state_a.params)[-1]
+        pending["probe"] = (
+            last.reshape(-1)[-1].astype(jnp.float32)
         )
-        leaf0 = jax.tree_util.tree_leaves(state_a.params)[0]
-        pending["probe"] = leaf0.reshape(-1)[0].astype(jnp.float32)
         return (state_a, state_b), {"loss": loss}
 
     return init_state, train_step
