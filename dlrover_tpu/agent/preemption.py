@@ -92,9 +92,15 @@ class PreemptionWatcher:
         if poll_interval is None:
             # well inside the ~60s preemption lead; harnesses shrink
             # it so graceful-path recovery is measurable at CI scale
-            poll_interval = float(
-                os.getenv("DLROVER_TPU_PREEMPTION_POLL", "5.0")
-            )
+            raw = os.getenv("DLROVER_TPU_PREEMPTION_POLL", "5.0")
+            try:
+                poll_interval = float(raw)
+            except ValueError:
+                logger.warning(
+                    "ignoring malformed DLROVER_TPU_PREEMPTION_POLL"
+                    "=%r", raw,
+                )
+                poll_interval = 5.0
         self._interval = poll_interval
         self._callbacks: List[Callable[[str], None]] = []
         self._stopped = threading.Event()

@@ -215,14 +215,24 @@ def parse_trace(path: str, device_prefix: str = "/device:") -> TraceReport:
             step_durs.append(dur)
             windows.append((ts, ts + dur))
     windows.sort()
+    # merge overlaps: multi-device traces interleave module spans
+    # (device A's long step may cover device B's short one), and a
+    # bisect against raw spans would misclassify ops inside an
+    # earlier, longer window as outside-step
+    merged: List[Tuple[float, float]] = []
+    for lo, hi in windows:
+        if merged and lo <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], hi))
+        else:
+            merged.append((lo, hi))
 
     def in_step(ts: float) -> bool:
-        if not windows:
+        if not merged:
             return True  # no module track (CPU): keep everything
         import bisect
 
-        i = bisect.bisect_right(windows, (ts, float("inf"))) - 1
-        return i >= 0 and ts < windows[i][1]
+        i = bisect.bisect_right(merged, (ts, float("inf"))) - 1
+        return i >= 0 and ts < merged[i][1]
 
     for e in events:
         if e.get("ph") != "X":

@@ -121,38 +121,46 @@ def worker_main() -> int:
         if cmd != "generate":
             resp.put({"error": f"unknown cmd {cmd!r}"})
             continue
-        # weight refresh: adopt the newest published snapshot.
-        # restore_to_target device_puts onto the TEMPLATE's shardings
-        # — this is where the train layout reshards to the inference
-        # layout (ref: ds_hybrid_engine's train<->infer repartition)
-        t0 = time.perf_counter()
-        step, arrays = shm.load_state(copy=False)
-        if step > version:
-            template = restore_to_target(
-                template, arrays, to_device=True, copy_host=True
+        # a bad request (ragged prompts, shape-mismatched publish)
+        # must answer {"error": ...}, not kill the worker — a dead
+        # worker leaves every later client call blocking to timeout
+        try:
+            # weight refresh: adopt the newest published snapshot.
+            # restore_to_target device_puts onto the TEMPLATE's
+            # shardings — this is where the train layout reshards to
+            # the inference layout (ref: ds_hybrid_engine's
+            # train<->infer repartition)
+            t0 = time.perf_counter()
+            step, arrays = shm.load_state(copy=False)
+            if step > version:
+                template = restore_to_target(
+                    template, arrays, to_device=True, copy_host=True
+                )
+                jax.block_until_ready(template)
+                backend.sync_weights(template)
+                version = step
+                handoff_s = time.perf_counter() - t0
+            del arrays
+            prompts = jnp.asarray(msg["prompts"])
+            rng = jax.random.PRNGKey(int(msg.get("seed", 0)))
+            t1 = time.perf_counter()
+            tokens = np.asarray(backend.generate(prompts, rng))
+            gen_s = max(time.perf_counter() - t1, 1e-9)
+            new_tokens = tokens.shape[1] - prompts.shape[1]
+            resp.put(
+                {
+                    "tokens": tokens,
+                    "version": version,
+                    "handoff_s": round(handoff_s, 6),
+                    "gen_s": round(gen_s, 6),
+                    "tokens_per_s": round(
+                        tokens.shape[0] * new_tokens / gen_s, 2
+                    ),
+                }
             )
-            jax.block_until_ready(template)
-            backend.sync_weights(template)
-            version = step
-            handoff_s = time.perf_counter() - t0
-        del arrays
-        prompts = jnp.asarray(msg["prompts"])
-        rng = jax.random.PRNGKey(int(msg.get("seed", 0)))
-        t1 = time.perf_counter()
-        tokens = np.asarray(backend.generate(prompts, rng))
-        gen_s = max(time.perf_counter() - t1, 1e-9)
-        new_tokens = tokens.shape[1] - prompts.shape[1]
-        resp.put(
-            {
-                "tokens": tokens,
-                "version": version,
-                "handoff_s": round(handoff_s, 6),
-                "gen_s": round(gen_s, 6),
-                "tokens_per_s": round(
-                    tokens.shape[0] * new_tokens / gen_s, 2
-                ),
-            }
-        )
+        except Exception as e:  # noqa: BLE001 - per-request isolation
+            logger.error("generation request failed: %s", e)
+            resp.put({"error": f"{type(e).__name__}: {e}"})
 
 
 class CrossProcessGenerationEngine:

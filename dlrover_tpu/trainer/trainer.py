@@ -534,10 +534,13 @@ class Trainer:
                     if eval_every and step % eval_every == 0:
                         # settle the pipelined metrics first so the
                         # eval pause is not booked as a step time
-                        step_times.append(
-                            self._consume_metrics(*pending)
-                        )
-                        pending = None
+                        # (a trace window closing on this step may
+                        # already have consumed them)
+                        if pending is not None:
+                            step_times.append(
+                                self._consume_metrics(*pending)
+                            )
+                            pending = None
                         self.evaluate()
                         self._last_done = time.perf_counter()
                 else:

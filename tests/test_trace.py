@@ -157,6 +157,22 @@ class TestStepSegmentation:
         # and the device total is now consistent with the step time
         assert report.total_device_us <= report.mean_step_us * 2
 
+    def test_overlapping_windows_merge(self, tmp_path):
+        """Multi-device traces interleave module spans; an op inside
+        an earlier LONGER window must not be misclassified as
+        outside-step just because a shorter later window ended."""
+        path = _synthetic_trace(
+            tmp_path,
+            ops=[
+                # inside the long window, after the short one closed
+                ("fusion.1", "convolution fusion", 1550, 100),
+            ],
+            modules=[(1000, 4000), (1010, 490)],
+        )
+        report = parse_trace(path)
+        assert report.total_device_us == 100
+        assert report.outside_step_us == 0
+
     def test_no_module_track_keeps_everything(self, tmp_path):
         """Traces without a modules track (some backends) must not
         silently drop all ops."""
