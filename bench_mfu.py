@@ -184,7 +184,7 @@ def _candidates(on_tpu: bool):
          dict(common, dim=2560, n_heads=20, n_kv_heads=20,
               n_layers=36, mlp_dim=6912, remat="full",
               ce_chunk_rows=128),
-         8, 2048, 3, "offload_int8_g2"),
+         12, 2048, 3, "offload_int8_g2"),
     ]
 
 
