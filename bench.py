@@ -38,6 +38,18 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 BASELINE_BLOCKING_S = 0.5  # reference flash-ckpt save blocking time
 
 
+def _read_result_file(path: str, stdout: str):
+    """Child result: the ``--out`` artifact first (immune to pipe
+    truncation), stdout JSON-line parse as the fallback."""
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        import bench_mfu
+
+        return bench_mfu._parse_json_line(stdout)
+
+
 def _run_train_bench() -> dict:
     """Run bench_mfu.py in a subprocess (its model must release HBM
     before the checkpoint bench allocates the 3 GB state) and return its
@@ -47,9 +59,12 @@ def _run_train_bench() -> dict:
     script = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "bench_mfu.py"
     )
+    out_file = os.path.join(
+        tempfile.mkdtemp(prefix="dlrover_bench_mfu_"), "out.json"
+    )
     try:
         proc = subprocess.run(
-            [sys.executable, script],
+            [sys.executable, script, "--out", out_file],
             capture_output=True,
             text=True,
             # bench_mfu worst case: 300s backend probe + 5 candidates
@@ -57,9 +72,7 @@ def _run_train_bench() -> dict:
             # OOM-fallback chain mid-run
             timeout=5400,
         )
-        import bench_mfu
-
-        parsed = bench_mfu._parse_json_line(proc.stdout)
+        parsed = _read_result_file(out_file, proc.stdout)
         if parsed is not None:
             out = dict(parsed.get("extras", {}))
             out["vs_mfu_bar_0.40"] = parsed.get("vs_baseline")
@@ -80,16 +93,20 @@ def _run_goodput_bench() -> dict:
     script = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "bench_goodput.py"
     )
+    workdir = tempfile.mkdtemp(prefix="dlrover_bench_goodput_")
+    out_file = os.path.join(workdir, "out.json")
     try:
         proc = subprocess.run(
-            [sys.executable, script],
+            [
+                sys.executable, script,
+                "--out", out_file,
+                "--trace_out", os.path.join(workdir, "trace.json"),
+            ],
             capture_output=True,
             text=True,
             timeout=900,
         )
-        import bench_mfu
-
-        parsed = bench_mfu._parse_json_line(proc.stdout)
+        parsed = _read_result_file(out_file, proc.stdout)
         if parsed is not None:
             return dict(parsed.get("extras", {}))
         return {
@@ -131,7 +148,18 @@ def _host_fault_gbps(nbytes: int = 512 * 1024 * 1024) -> float:
     return nbytes / 1e9 / max(time.perf_counter() - t0, 1e-9)
 
 
-def main() -> int:
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(description="headline bench")
+    parser.add_argument(
+        "--out",
+        default="BENCH_OUT.json",
+        help="write the result JSON here as well as stdout (the "
+        "driver's stdout tail capture can truncate; a file cannot)",
+    )
+    args = parser.parse_args(argv)
+
     # training throughput first, in its own process (frees HBM on exit)
     train_bench = _run_train_bench()
     goodput_bench = _run_goodput_bench()
@@ -248,43 +276,42 @@ def main() -> int:
 
     engine.close()
 
-    print(
-        json.dumps(
-            {
-                "metric": "flash_ckpt_blocking_save_s",
-                "value": round(blocking, 4),
-                "unit": "s",
-                "vs_baseline": round(BASELINE_BLOCKING_S / blocking, 2),
-                "extras": {
-                    "state_gb": round(gb, 2),
-                    "snapshot_drain_s": round(drain_s, 2),
-                    "d2h_gbps": round(gb / drain_s, 3),
-                    "async_persist_s": round(persist_s, 2),
-                    "persisted": bool(persisted),
-                    "shm_read_s": round(shm_read_s, 4),
-                    "restore_to_device_s": round(restore_device_s, 2),
-                    "time_to_first_step_s": round(
-                        time_to_first_step_s, 2
-                    ),
-                    "prealloc_s": round(prealloc_s, 2),
-                    "first_save_block_s": round(first_block_s, 4),
-                    "first_save_total_s": round(first_total_s, 2),
-                    "backend": jax.default_backend(),
-                    "d2h_probe_gbps": (
-                        round(d2h_probe_gbps, 4)
-                        if d2h_probe_gbps is not None
-                        else None
-                    ),
-                    "baseline_blocking_s": BASELINE_BLOCKING_S,
-                    "host_memcpy_gbps": round(memcpy_gbps, 3),
-                    "host_fault_gbps": round(fault_gbps, 3),
-                    "train": train_bench,
-                    "goodput": goodput_bench,
-                },
-            }
-        ),
-        flush=True,
-    )
+    payload = {
+        "metric": "flash_ckpt_blocking_save_s",
+        "value": round(blocking, 4),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_BLOCKING_S / blocking, 2),
+        "extras": {
+            "state_gb": round(gb, 2),
+            "snapshot_drain_s": round(drain_s, 2),
+            "d2h_gbps": round(gb / drain_s, 3),
+            "async_persist_s": round(persist_s, 2),
+            "persisted": bool(persisted),
+            "shm_read_s": round(shm_read_s, 4),
+            "restore_to_device_s": round(restore_device_s, 2),
+            "time_to_first_step_s": round(
+                time_to_first_step_s, 2
+            ),
+            "prealloc_s": round(prealloc_s, 2),
+            "first_save_block_s": round(first_block_s, 4),
+            "first_save_total_s": round(first_total_s, 2),
+            "backend": jax.default_backend(),
+            "d2h_probe_gbps": (
+                round(d2h_probe_gbps, 4)
+                if d2h_probe_gbps is not None
+                else None
+            ),
+            "baseline_blocking_s": BASELINE_BLOCKING_S,
+            "host_memcpy_gbps": round(memcpy_gbps, 3),
+            "host_fault_gbps": round(fault_gbps, 3),
+            "train": train_bench,
+            "goodput": goodput_bench,
+        },
+    }
+    print(json.dumps(payload), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(payload, f, indent=1)
     return 0
 
 

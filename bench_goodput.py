@@ -126,6 +126,7 @@ def run_goodput(
     """
     workdir = tempfile.mkdtemp(prefix="dlrover_goodput_")
     progress = os.path.join(workdir, "progress.jsonl")
+    events_file = os.path.join(workdir, "events.jsonl")
     metadata = _FakeMetadata()
     env = dict(
         os.environ,
@@ -134,6 +135,10 @@ def run_goodput(
         GOODPUT_PROGRESS_FILE=progress,
         GOODPUT_CKPT_DIR=os.path.join(workdir, "ckpt"),
         DLROVER_TPU_SOCKET_DIR=os.path.join(workdir, "socks"),
+        # unified timeline: launcher/agent/workers all append here;
+        # the goodput ledger below is computed FROM it instead of
+        # re-deriving timings
+        DLROVER_TPU_EVENTS_FILE=events_file,
         # the agent's REAL preemption watcher polls the fake endpoint
         DLROVER_TPU_METADATA_BASE=metadata.base,
         DLROVER_TPU_PREEMPTION_POLL="0.3",
@@ -333,7 +338,23 @@ def run_goodput(
     )
     fault_cost = mean_rec + mean_rollback_s
     goodput_hourly = 3600.0 / (3600.0 + fault_cost)
+
+    # goodput LEDGER from the event timeline: every lost second named
+    # (restart/rendezvous/compile/checkpoint/...), losses summing
+    # exactly to wall − useful.  The measured goodput above stays the
+    # headline; the ledger says WHERE its complement went.
+    from dlrover_tpu.observability.events import (
+        compute_ledger,
+        read_events,
+    )
+
+    timeline = read_events(events_file)
+    ledger = compute_ledger(timeline)
     return {
+        "ledger": ledger,
+        "loss_breakdown": ledger.get("loss_breakdown", {}),
+        "events_file": events_file,
+        "timeline_events": len(timeline),
         "goodput": round(goodput, 4),
         "goodput_hourly_preemptions": round(goodput_hourly, 4),
         "steps": target_steps,
@@ -350,23 +371,53 @@ def run_goodput(
     }
 
 
-def main() -> int:
-    result = run_goodput()
-    print(
-        json.dumps(
-            {
-                "metric": "goodput_under_kills",
-                # headline: the MEASURED goodput at ~60s kill spacing
-                # (the hourly-rate projection, now charged with
-                # measured rollback too, stays in extras)
-                "value": result["goodput"],
-                "unit": "fraction",
-                "vs_baseline": round(result["goodput"] / 0.95, 3),
-                "extras": result,
-            }
-        ),
-        flush=True,
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(description="goodput harness")
+    parser.add_argument(
+        "--out",
+        default="BENCH_OUT.json",
+        help="write the full result JSON here as well as stdout (the "
+        "driver's stdout tail capture can truncate; a file cannot)",
     )
+    parser.add_argument(
+        "--trace_out",
+        default="BENCH_TRACE.json",
+        help="write the merged timeline as a Perfetto-loadable "
+        "chrome-trace JSON here ('' = skip)",
+    )
+    args = parser.parse_args(argv)
+
+    result = run_goodput()
+    if args.trace_out:
+        from dlrover_tpu.observability.events import (
+            export_chrome_trace,
+            read_events,
+        )
+
+        export_chrome_trace(
+            read_events(result["events_file"]), args.trace_out
+        )
+        result["trace_file"] = os.path.abspath(args.trace_out)
+    payload = {
+        "metric": "goodput_under_kills",
+        # headline: the MEASURED goodput at ~60s kill spacing
+        # (the hourly-rate projection, now charged with
+        # measured rollback too, stays in extras)
+        "value": result["goodput"],
+        "unit": "fraction",
+        "vs_baseline": round(result["goodput"] / 0.95, 3),
+        # the artifact contract: goodput + the per-phase attribution
+        # of its complement, top-level
+        "goodput": result["goodput"],
+        "loss_breakdown": result["loss_breakdown"],
+        "extras": result,
+    }
+    print(json.dumps(payload), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(payload, f, indent=1)
     return 0
 
 

@@ -538,6 +538,13 @@ def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--candidate", type=int, default=None)
     parser.add_argument("--on-tpu", type=int, default=None)
+    parser.add_argument(
+        "--out",
+        default="BENCH_OUT.json",
+        help="write the result JSON here as well as stdout (parent "
+        "mode only; the driver's stdout tail capture can truncate, "
+        "a file cannot)",
+    )
     args = parser.parse_args()
     import os
 
@@ -560,18 +567,17 @@ def main() -> int:
         return 0
 
     result = run_mfu()
-    print(
-        json.dumps(
-            {
-                "metric": "train_mfu",
-                "value": result["mfu"],
-                "unit": "fraction_of_peak",
-                "vs_baseline": round(result["mfu"] / 0.40, 3),
-                "extras": result,
-            }
-        ),
-        flush=True,
-    )
+    payload = {
+        "metric": "train_mfu",
+        "value": result["mfu"],
+        "unit": "fraction_of_peak",
+        "vs_baseline": round(result["mfu"] / 0.40, 3),
+        "extras": result,
+    }
+    print(json.dumps(payload), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(payload, f, indent=1)
     return 0
 
 

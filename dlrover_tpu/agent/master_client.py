@@ -314,6 +314,25 @@ class MasterClient:
     def report_succeeded(self) -> bool:
         return self._channel.report(msg.SucceededRequest())
 
+    def report_timeline_events(self, events: list) -> bool:
+        """Ship a batch of timeline records (``observability/events``
+        JSONL schema) to the master's TimelineAggregator."""
+        return self._channel.report(
+            msg.TimelineEventsReport(events=list(events))
+        )
+
+    def get_goodput_ledger(
+        self, job: str = "", limit: int = 0
+    ) -> Optional[Tuple[Dict, list]]:
+        """Fetch the master's merged goodput ledger (and the newest
+        ``limit`` raw events); None when no aggregator is serving."""
+        res = self._channel.get(
+            msg.TimelineQueryRequest(job=job, limit=limit)
+        )
+        if res is None or not getattr(res, "available", False):
+            return None
+        return res.ledger, res.events
+
     # -------------------------------------------------------------- control
     def get_running_nodes(self) -> list:
         res = self._channel.get(msg.RunningNodesRequest())

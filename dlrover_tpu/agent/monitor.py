@@ -152,3 +152,95 @@ class TrainingMonitor(PeriodicReporter):
             # _last_step or the step would never be re-reported
             self._client.report_global_step(step, ts)
             self._last_step = step
+
+
+class TimelineReporter(PeriodicReporter):
+    """Tails the node-local event timeline (the JSONL every process on
+    this node appends to — see ``observability/events.py``) and ships
+    the delta to the master's TimelineAggregator each tick.
+
+    Only whole lines past the last shipped offset are consumed, so a
+    write caught mid-line is picked up next tick; a truncated file
+    (fresh run reusing the path) resets the offset.
+    """
+
+    name = "timeline-reporter"
+
+    def __init__(
+        self,
+        events_file: str,
+        client: Optional[MasterClient] = None,
+        interval: float = 5.0,
+        max_batch: int = 1000,
+    ):
+        super().__init__(client, interval)
+        self._events_file = events_file
+        self._offset = 0
+        self._max_batch = max_batch
+
+    def _read_delta(self):
+        """New complete JSONL records past the shipped offset, each
+        paired with the file offset consuming it advances to."""
+        try:
+            size = os.path.getsize(self._events_file)
+        except OSError:
+            return []
+        if size < self._offset:
+            self._offset = 0  # truncated/recreated file
+        if size == self._offset:
+            return []
+        try:
+            with open(self._events_file, "rb") as f:
+                f.seek(self._offset)
+                chunk = f.read(size - self._offset)
+        except OSError:
+            return []
+        cut = chunk.rfind(b"\n")
+        if cut < 0:
+            return []  # only a partial line so far
+        out = []  # (record, end_offset)
+        pos = self._offset
+        for line in chunk[: cut + 1].splitlines(keepends=True):
+            pos += len(line)
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "name" in rec:
+                out.append((rec, pos))
+        # torn/blank trailing lines must still be consumed
+        if out:
+            out[-1] = (out[-1][0], self._offset + cut + 1)
+        else:
+            self._offset += cut + 1
+        return out
+
+    def _tick(self):
+        delta = self._read_delta()
+        # the offset advances PER DELIVERED BATCH: a ConnectionError
+        # mid-loop re-ships only the undelivered tail next tick (no
+        # duplicates for batches the master already accepted, no loss
+        # for the ones it didn't)
+        for i in range(0, len(delta), self._max_batch):
+            batch = delta[i:i + self._max_batch]
+            ok = self._client.report_timeline_events(
+                [rec for rec, _ in batch]
+            )
+            if not ok:
+                # master refused (no aggregator / old master): drop
+                # with a trace rather than re-shipping forever
+                logger.warning(
+                    "master rejected a timeline batch of %d events; "
+                    "dropping it", len(batch),
+                )
+            self._offset = batch[-1][1]
+
+    def flush(self):
+        """One synchronous drain (agent shutdown / tests)."""
+        try:
+            self._tick()
+        except ConnectionError as e:
+            logger.warning("timeline flush failed: %s", e)

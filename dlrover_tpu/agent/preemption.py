@@ -136,6 +136,9 @@ class PreemptionWatcher:
             return None  # already reported this event
         self._last_event = value
         logger.warning("maintenance event: %s — flushing state", value)
+        from dlrover_tpu.observability.events import get_event_logger
+
+        get_event_logger().instant("preemption_signal", event=value)
         for cb in self._callbacks:
             try:
                 cb(value)

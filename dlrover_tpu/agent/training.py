@@ -37,6 +37,7 @@ from dlrover_tpu.common.constants import (
 )
 from dlrover_tpu.common.env import get_free_port
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.observability.events import get_event_logger
 
 
 @dataclass
@@ -205,7 +206,10 @@ class ElasticTrainingAgent:
             self._config.nproc_per_node,
             timeout=self._config.rdzv_timeout,
         )
-        rnd, _group, world = handler.next_rendezvous()
+        with get_event_logger().span(
+            "rendezvous", inc=self._restart_count
+        ):
+            rnd, _group, world = handler.next_rendezvous()
         return rnd, world
 
     def _assign_worker_ranks(self, world: Dict[int, int]):
@@ -404,16 +408,23 @@ class ElasticTrainingAgent:
             reason,
             self._remaining_restarts,
         )
-        self._save_ckpt_to_storage(reason)
-        # failure restarts: the group is broken and the shm snapshot
-        # is already flushed — survivors wedged in collectives would
-        # eat the full stop grace for nothing
-        self._stop_workers(
-            timeout=self._config.failure_stop_timeout
-            if consume_budget
-            else None
-        )
-        return self._initialize_workers()
+        # the span's inc is the NEW incarnation this restart produces,
+        # correlating it with the relaunched workers' step/compile
+        # spans; the nested rendezvous span carves its own share out
+        # of the restart loss in the ledger
+        with get_event_logger().span(
+            "restart", reason=reason, inc=self._restart_count
+        ):
+            self._save_ckpt_to_storage(reason)
+            # failure restarts: the group is broken and the shm
+            # snapshot is already flushed — survivors wedged in
+            # collectives would eat the full stop grace for nothing
+            self._stop_workers(
+                timeout=self._config.failure_stop_timeout
+                if consume_budget
+                else None
+            )
+            return self._initialize_workers()
 
     def _report_failure(self, result: RunResult):
         self._try_report_failure(
@@ -483,6 +494,15 @@ class ElasticTrainingAgent:
         """Agent main loop. Returns a process exit code."""
         factory_queue = None
         preemption_watcher = None
+        timeline_reporter = None
+        events = get_event_logger()
+        if events.enabled:
+            from dlrover_tpu.agent.monitor import TimelineReporter
+
+            timeline_reporter = TimelineReporter(
+                events.path, client=self._client
+            )
+            timeline_reporter.start()
         if self._start_ckpt_saver:
             factory_queue = AsyncCheckpointSaver.start_async_saving_ckpt()
         if self._config.watch_preemption:
@@ -513,6 +533,9 @@ class ElasticTrainingAgent:
             if preemption_watcher is not None:
                 preemption_watcher.stop()
             self._stop_workers()
+            if timeline_reporter is not None:
+                timeline_reporter.stop()
+                timeline_reporter.flush()  # the final partial batch
             if self._zygote is not None:
                 self._zygote.close()
                 self._zygote = None
@@ -524,11 +547,12 @@ class ElasticTrainingAgent:
         """Maintenance event: flush the newest shm snapshot to storage
         and fence this node at the master BEFORE the hardware goes
         away (the SIGTERM path may never run)."""
-        self._save_ckpt_to_storage(f"preemption:{event}")
-        self._try_report_failure(
-            f"maintenance event {event}",
-            TrainingExceptionLevel.NODE_ERROR,
-        )
+        with get_event_logger().span("preemption_drain", event=event):
+            self._save_ckpt_to_storage(f"preemption:{event}")
+            self._try_report_failure(
+                f"maintenance event {event}",
+                TrainingExceptionLevel.NODE_ERROR,
+            )
 
     def _invoke_run(self) -> int:
         if not self._initialize_workers():

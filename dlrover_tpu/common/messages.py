@@ -430,6 +430,31 @@ class SucceededRequest(Message):
 
 
 @dataclass
+class TimelineEventsReport(Message):
+    """One node's batch of timeline events (the JSONL records from
+    ``observability/events.py``, shipped by the agent's
+    ``TimelineReporter``) for the master's ``TimelineAggregator``."""
+
+    events: List[Dict] = field(default_factory=list)
+
+
+@dataclass
+class TimelineQueryRequest(Message):
+    """Get the master's merged goodput ledger (and optionally the
+    newest ``limit`` raw events; 0 = ledger only)."""
+
+    job: str = ""
+    limit: int = 0
+
+
+@dataclass
+class TimelineQueryResponse(Message):
+    ledger: Dict = field(default_factory=dict)
+    events: List[Dict] = field(default_factory=list)
+    available: bool = False  # False = no aggregator on this master
+
+
+@dataclass
 class BrainQueryRequest(Message):
     """Query the master's durable Brain datastore (speed history /
     node events / measured workloads) — the TPU analog of the Go

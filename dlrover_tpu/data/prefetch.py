@@ -7,38 +7,59 @@ pipeline never stalls the device (double/triple buffering).
 """
 
 import collections
+import time
 from typing import Iterable, Iterator, Optional
 
 import jax
+
+from dlrover_tpu.observability.events import get_event_logger
 
 
 def device_prefetch(
     iterator: Iterable,
     size: int = 2,
     sharding: Optional[object] = None,
+    stall_threshold_s: float = 0.05,
 ) -> Iterator:
     """Yield device-resident batches with ``size`` transfers in flight.
 
     ``sharding`` (a NamedSharding / prefix pytree) places each batch
     directly in its training layout — no host-side reshard later.
+
+    A host fetch (``next(iterator)``) slower than
+    ``stall_threshold_s`` is emitted as a ``data_stall`` span on the
+    job timeline: with ``size`` batches in flight a slow fetch here is
+    exactly the input pipeline failing to hide behind device compute.
     """
     queue = collections.deque()
+    events = get_event_logger()
 
     def _put(batch):
         if sharding is not None:
             return jax.device_put(batch, sharding)
         return jax.device_put(batch)
 
+    def _fetch(it):
+        """next(it) with stall accounting; raises StopIteration."""
+        if not events.enabled:
+            return next(it)
+        t0_wall, t0_mono = time.time(), time.monotonic()
+        batch = next(it)
+        dur = time.monotonic() - t0_mono
+        if dur >= stall_threshold_s:
+            events.complete("data_stall", t0_wall, dur)
+        return batch
+
     it = iter(iterator)
     try:
         for _ in range(size):
-            queue.append(_put(next(it)))
+            queue.append(_put(_fetch(it)))
     except StopIteration:
         pass
     while queue:
         out = queue.popleft()
         try:
-            queue.append(_put(next(it)))
+            queue.append(_put(_fetch(it)))
         except StopIteration:
             pass
         yield out

@@ -59,6 +59,23 @@ CREATE TABLE IF NOT EXISTS node_events (
 );
 CREATE INDEX IF NOT EXISTS idx_events_job
     ON node_events (job, created_at);
+CREATE TABLE IF NOT EXISTS timeline_events (
+    job TEXT NOT NULL,
+    node INTEGER NOT NULL DEFAULT 0,
+    rank INTEGER NOT NULL DEFAULT -1,
+    inc INTEGER NOT NULL DEFAULT 0,
+    name TEXT NOT NULL,
+    ph TEXT NOT NULL,
+    wall REAL NOT NULL,
+    mono REAL NOT NULL DEFAULT 0,
+    dur REAL,
+    sid INTEGER,
+    pid INTEGER,
+    labels TEXT NOT NULL DEFAULT '{}',
+    created_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_timeline_job
+    ON timeline_events (job, wall);
 """
 
 
@@ -123,7 +140,20 @@ class BrainDatastore:
                 )
             else:
                 own_job = os.getenv("DLROVER_TPU_JOB_NAME", "")
-                self.prune(age, job=own_job or None)
+                if own_job:
+                    self.prune(age, job=own_job)
+                else:
+                    # no job identity: a job=None prune would be
+                    # GLOBAL and delete every other job's rows from a
+                    # shared db (ADVICE-r5) — refuse, keep the fixed
+                    # 30d floor above as the only global hygiene
+                    logger.warning(
+                        "DLROVER_TPU_BRAIN_MAX_AGE_S=%s set but "
+                        "DLROVER_TPU_JOB_NAME is empty; skipping the "
+                        "job-scoped startup prune (a global prune "
+                        "would delete other jobs' history)",
+                        env_age,
+                    )
 
     # ------------------------------------------- strategy measurements
     def record_measurement(
@@ -246,6 +276,89 @@ class BrainDatastore:
             for n, e, d, t in rows
         ]
 
+    # ---------------------------------------------- timeline events
+    def record_timeline_events(self, job: str, events: List[Dict]):
+        """Persist a batch of timeline records (the JSONL schema of
+        ``observability/events.py``) — the master's merged job-event
+        timeline survives master restarts like the rest of the Brain."""
+        now = time.time()
+        rows = []
+        for e in events:
+            if not isinstance(e, dict) or "name" not in e:
+                continue
+            rows.append(
+                (
+                    job,
+                    int(e.get("node", 0) or 0),
+                    int(e.get("rank", -1) if e.get("rank")
+                        is not None else -1),
+                    int(e.get("inc", 0) or 0),
+                    str(e.get("name", "")),
+                    str(e.get("ph", "i")),
+                    float(e.get("wall", now) or now),
+                    float(e.get("mono", 0.0) or 0.0),
+                    float(e["dur"]) if e.get("dur") is not None
+                    else None,
+                    int(e["sid"]) if e.get("sid") is not None
+                    else None,
+                    int(e.get("pid", 0) or 0),
+                    json.dumps(
+                        e.get("labels") or {}, separators=(",", ":")
+                    ),
+                    now,
+                )
+            )
+        if not rows:
+            return
+        with self._lock:
+            self._conn.executemany(
+                "INSERT INTO timeline_events VALUES "
+                "(?,?,?,?,?,?,?,?,?,?,?,?,?)",
+                rows,
+            )
+            self._conn.commit()
+
+    def timeline_events(
+        self, job: str, limit: int = 10000
+    ) -> List[Dict]:
+        """Newest ``limit`` timeline records, oldest first (ready for
+        ``compute_ledger`` / ``export_chrome_trace``)."""
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT node, rank, inc, name, ph, wall, mono, dur, "
+                "sid, pid, labels FROM ("
+                "  SELECT * FROM timeline_events WHERE job = ?"
+                "  ORDER BY wall DESC LIMIT ?"
+                ") ORDER BY wall ASC",
+                (job, limit),
+            ).fetchall()
+        out = []
+        for (node, rank, inc, name, ph, wall, mono, dur, sid, pid,
+             labels) in rows:
+            rec = {
+                "name": name,
+                "ph": ph,
+                "wall": wall,
+                "mono": mono,
+                "job": job,
+                "node": node,
+                "rank": rank,
+                "inc": inc,
+                "pid": pid,
+            }
+            if dur is not None:
+                rec["dur"] = dur
+            if sid is not None:
+                rec["sid"] = sid
+            try:
+                parsed = json.loads(labels) if labels else {}
+            except json.JSONDecodeError:
+                parsed = {}
+            if parsed:
+                rec["labels"] = parsed
+            out.append(rec)
+        return out
+
     # ------------------------------------------------------- hygiene
     def prune(self, max_age_s: float, job: Optional[str] = None):
         """Drop rows older than ``max_age_s``; with ``job`` given,
@@ -258,6 +371,7 @@ class BrainDatastore:
                 "strategy_measurements",
                 "speed_samples",
                 "node_events",
+                "timeline_events",
             ):
                 q = f"DELETE FROM {table} WHERE created_at < ?"  # noqa: S608 - fixed table names
                 args: List = [cutoff]

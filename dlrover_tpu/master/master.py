@@ -41,7 +41,21 @@ class JobMaster:
 
     def __init__(self, port: int, node_num: int = 1,
                  job_manager=None, diagnosis_manager=None):
+        import os
+
+        from dlrover_tpu.master.datastore import get_default_datastore
+        from dlrover_tpu.observability.events import TimelineAggregator
+        from dlrover_tpu.observability.metrics import get_registry
+
         self.speed_monitor = SpeedMonitor()
+        # unified job-event timeline: per-node streams merge here, the
+        # goodput ledger is served live (get-RPC + exporter gauges) and
+        # durably (sqlite datastore when configured)
+        self.timeline_aggregator = TimelineAggregator(
+            job=os.getenv("DLROVER_TPU_JOB_NAME", "default"),
+            registry=get_registry(),
+            datastore=get_default_datastore(),
+        )
         self.task_manager = TaskManager(speed_monitor=self.speed_monitor)
         self.rdzv_managers = {
             RendezvousName.ELASTIC_TRAINING:
@@ -87,6 +101,7 @@ class JobMaster:
             rdzv_managers=self.rdzv_managers,
             kv_store=self.kv_store,
             diagnosis_manager=self.diagnosis_manager,
+            timeline_aggregator=self.timeline_aggregator,
         )
         self._server = create_master_service(self._port, servicer)
         self._server.start()

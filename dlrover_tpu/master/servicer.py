@@ -28,6 +28,7 @@ class MasterServicer:
         kv_store=None,
         diagnosis_manager=None,
         sync_service=None,
+        timeline_aggregator=None,
     ):
         self._task_manager = task_manager
         self._job_manager = job_manager
@@ -36,6 +37,7 @@ class MasterServicer:
         self._kv_store = kv_store
         self._diagnosis_manager = diagnosis_manager
         self._sync_service = sync_service
+        self._timeline_aggregator = timeline_aggregator
         self._start_training_time = 0.0
 
     # ------------------------------------------------------------------ get
@@ -96,8 +98,22 @@ class MasterServicer:
             return msg.ElasticRunConfig()
         if isinstance(request, msg.BrainQueryRequest):
             return self._brain_query(request)
+        if isinstance(request, msg.TimelineQueryRequest):
+            return self._timeline_query(request)
         logger.warning("unhandled get request: %r", request)
         return None
+
+    def _timeline_query(
+        self, request: msg.TimelineQueryRequest
+    ) -> msg.TimelineQueryResponse:
+        agg = self._timeline_aggregator
+        if agg is None:
+            return msg.TimelineQueryResponse(available=False)
+        return msg.TimelineQueryResponse(
+            ledger=agg.ledger(),
+            events=agg.events(request.limit) if request.limit else [],
+            available=True,
+        )
 
     def _brain_query(
         self, request: msg.BrainQueryRequest
@@ -312,6 +328,12 @@ class MasterServicer:
                         content=request.data_content,
                         node_rank=request.node_rank,
                     )
+                )
+            return True
+        if isinstance(request, msg.TimelineEventsReport):
+            if self._timeline_aggregator is not None:
+                self._timeline_aggregator.add_events(
+                    node_id, request.events
                 )
             return True
         if isinstance(request, msg.Event):

@@ -1,3 +1,11 @@
+from dlrover_tpu.observability.events import (  # noqa: F401
+    EventLogger,
+    TimelineAggregator,
+    compute_ledger,
+    export_chrome_trace,
+    get_event_logger,
+    read_events,
+)
 from dlrover_tpu.observability.metrics import (  # noqa: F401
     MetricsExporter,
     MetricsRegistry,

@@ -1,0 +1,679 @@
+"""Unified job-event timeline: structured spans + goodput attribution.
+
+The reference's headline metric is goodput (69% -> 95% under faults),
+but a single ratio cannot say WHERE the lost wall clock went —
+rendezvous, recompile, checkpoint stalls, restarts.  This module is
+the repo-wide answer:
+
+- every process (master, agent, trainer, launcher) appends structured
+  begin/end span and instant events to one JSONL file — one
+  ``os.write`` per line on an ``O_APPEND`` fd, so concurrent writers
+  never interleave; each record carries BOTH clocks (``wall`` for
+  cross-process merging, ``mono`` for drift-free durations) plus the
+  job/node/rank/incarnation labels that correlate a restart's spans
+  across worker generations;
+- :func:`compute_ledger` partitions a merged timeline's wall clock
+  into phases by priority sweep — the **goodput ledger**: phase losses
+  sum EXACTLY to ``wall − useful`` (the invariant the tests assert),
+  so ``1 − goodput`` is fully attributed, never hand-waved;
+- :func:`export_chrome_trace` renders the same timeline as a
+  Perfetto-loadable chrome trace (one track per node/rank);
+- :class:`TimelineAggregator` is the master-side sink: per-node event
+  batches arrive over the report RPC (``common/messages.py``
+  ``TimelineEventsReport``), merge into the sqlite Brain datastore,
+  and serve the live ledger through a get RPC and as gauges on the
+  ``MetricsRegistry`` the native Prometheus exporter reads.
+
+Phase names are a CLOSED set (``PHASES`` + ``INSTANT_EVENTS``);
+``scripts/check_event_schema.py`` lints every emit site against it so
+a typo'd phase can never silently drop out of the ledger.
+"""
+
+import io
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.common.log import default_logger as logger
+
+EVENTS_FILE_ENV = "DLROVER_TPU_EVENTS_FILE"
+
+#: Span phases, HIGHEST attribution priority first.  When spans
+#: overlap, each instant of wall clock is charged to the
+#: highest-priority covering phase.  ``step`` is the only USEFUL
+#: phase; ``data_stall`` outranks it because a step span measured
+#: step_done-to-step_done covers the between-step input wait — a
+#: named 10s pipeline stall must surface as loss, not as useful time.
+#: Everything below ``step`` loses to it on overlap: an ASYNC
+#: checkpoint drain or a preemption flush running while steps
+#: complete charges the step (training progressed, nothing was
+#: lost), and a rendezvous nested inside a restart charges
+#: rendezvous.
+PHASE_DATA_STALL = "data_stall"
+PHASE_STEP = "step"
+PHASE_PREEMPTION_DRAIN = "preemption_drain"
+PHASE_CHECKPOINT_RESTORE = "checkpoint_restore"
+PHASE_COMPILE = "compile"
+PHASE_RENDEZVOUS = "rendezvous"
+PHASE_CHECKPOINT_SAVE = "checkpoint_save"
+PHASE_RESTART = "restart"
+
+PHASES: Tuple[str, ...] = (
+    PHASE_DATA_STALL,
+    PHASE_STEP,
+    PHASE_PREEMPTION_DRAIN,
+    PHASE_CHECKPOINT_RESTORE,
+    PHASE_COMPILE,
+    PHASE_RENDEZVOUS,
+    PHASE_CHECKPOINT_SAVE,
+    PHASE_RESTART,
+)
+
+#: Phases that count as useful training time in the ledger.
+USEFUL_PHASES = frozenset({PHASE_STEP})
+
+#: Wall clock covered by no span at all (monitor-detection gaps,
+#: wedged-in-collective survivors, scheduler noise).  Kept as its own
+#: ledger bucket so the losses still sum exactly to ``wall − useful``.
+UNATTRIBUTED = "unattributed"
+
+#: Point events (``ph: "i"``) — markers, not ledger input.
+INSTANT_EVENTS = frozenset(
+    {"preemption_signal", "job_start", "job_end", "worker_kill"}
+)
+
+#: Labels an emit SITE must pass explicitly (beyond the automatic
+#: job/node/rank/inc/pid identity labels); enforced by
+#: ``scripts/check_event_schema.py``.
+REQUIRED_SPAN_LABELS: Dict[str, Tuple[str, ...]] = {
+    PHASE_STEP: ("step",),
+    PHASE_CHECKPOINT_SAVE: ("step",),
+    PHASE_CHECKPOINT_RESTORE: ("step",),
+    PHASE_RESTART: ("reason",),
+    PHASE_PREEMPTION_DRAIN: ("event",),
+}
+
+
+class EventLogger:
+    """Append structured events to a JSONL timeline file.
+
+    Disabled (every call a cheap no-op) when no path is configured —
+    library code can instrument unconditionally.  One ``os.write`` per
+    line on an ``O_APPEND`` descriptor keeps concurrent writers from
+    ever interleaving bytes (POSIX atomic append).
+    """
+
+    def __init__(
+        self,
+        path: str = "",
+        job: str = "",
+        node: Optional[int] = None,
+        rank: Optional[int] = None,
+        incarnation: Optional[int] = None,
+    ):
+        self._path = path or os.getenv(EVENTS_FILE_ENV, "")
+        self._job = job or os.getenv("DLROVER_TPU_JOB_NAME", "default")
+        self._node = (
+            node
+            if node is not None
+            else int(os.getenv("DLROVER_TPU_NODE_RANK", "0") or 0)
+        )
+        # -1 = not a training process (agent / launcher / master)
+        self._rank = (
+            rank
+            if rank is not None
+            else int(os.getenv("DLROVER_TPU_PROCESS_RANK", "-1") or -1)
+        )
+        self._inc = (
+            incarnation
+            if incarnation is not None
+            else int(os.getenv("DLROVER_TPU_RESTART_COUNT", "0") or 0)
+        )
+        self._fd: Optional[int] = None
+        self._lock = threading.Lock()
+        self._sid = 0
+        # per-(thread, phase) open-span stack for begin/end pairing
+        self._open: Dict[Tuple[int, str], List[dict]] = {}
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self._path)
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    # ------------------------------------------------------------- emit
+    def _record(self, name: str, ph: str, **labels) -> dict:
+        rec = {
+            "name": name,
+            "ph": ph,
+            "wall": time.time(),
+            "mono": time.monotonic(),
+            "job": self._job,
+            "node": self._node,
+            "rank": self._rank,
+            "inc": labels.pop("inc", self._inc),
+            "pid": os.getpid(),
+        }
+        if labels:
+            rec["labels"] = {k: v for k, v in labels.items()}
+        return rec
+
+    def emit(self, record: dict):
+        """Write one record as one atomic appended JSONL line."""
+        if not self._path:
+            return
+        try:
+            line = (
+                json.dumps(record, separators=(",", ":"), default=str)
+                + "\n"
+            )
+        except (TypeError, ValueError):
+            return
+        with self._lock:
+            try:
+                if self._fd is None:
+                    parent = os.path.dirname(
+                        os.path.abspath(self._path)
+                    )
+                    os.makedirs(parent, exist_ok=True)
+                    self._fd = os.open(
+                        self._path,
+                        os.O_WRONLY | os.O_APPEND | os.O_CREAT,
+                        0o644,
+                    )
+                os.write(self._fd, line.encode())
+            except OSError as e:
+                logger.warning("event emit failed: %s", e)
+
+    def begin(self, phase: str, **labels) -> int:
+        """Open a span; returns the span id ``end`` pairs on."""
+        if not self._path:
+            return -1
+        with self._lock:
+            self._sid += 1
+            sid = self._sid
+        rec = self._record(phase, "B", **labels)
+        rec["sid"] = sid
+        key = (threading.get_ident(), phase)
+        self._open.setdefault(key, []).append(rec)
+        self.emit(rec)
+        return sid
+
+    def end(self, phase: str, sid: int = -1, **labels):
+        if not self._path:
+            return
+        rec = self._record(phase, "E", **labels)
+        key = (threading.get_ident(), phase)
+        stack = self._open.get(key)
+        if sid < 0 and stack:
+            sid = stack[-1].get("sid", -1)
+        if stack:
+            stack.pop()
+        rec["sid"] = sid
+        self.emit(rec)
+
+    def complete(
+        self, phase: str, start_wall: float, duration_s: float, **labels
+    ):
+        """One finished span, emitted after the fact (``ph: "X"``)."""
+        if not self._path:
+            return
+        rec = self._record(phase, "X", **labels)
+        rec["wall"] = float(start_wall)
+        rec["dur"] = max(float(duration_s), 0.0)
+        self.emit(rec)
+
+    def instant(self, name: str, **labels):
+        if not self._path:
+            return
+        self.emit(self._record(name, "i", **labels))
+
+    @contextmanager
+    def span(self, phase: str, **labels):
+        """``with events.span("rendezvous"): ...`` — ends on exit,
+        even on exception (the failed attempt's time is still loss)."""
+        sid = self.begin(phase, **labels)
+        try:
+            yield sid
+        finally:
+            self.end(phase, sid=sid)
+
+    def close(self):
+        with self._lock:
+            if self._fd is not None:
+                try:
+                    os.close(self._fd)
+                except OSError:
+                    pass
+                self._fd = None
+
+
+_default_logger: Optional[EventLogger] = None
+_default_logger_lock = threading.Lock()
+
+
+def get_event_logger() -> EventLogger:
+    """Process-wide logger configured from the environment
+    (``DLROVER_TPU_EVENTS_FILE`` etc.); disabled no-op when unset."""
+    global _default_logger
+    with _default_logger_lock:
+        if _default_logger is None:
+            _default_logger = EventLogger()
+        return _default_logger
+
+
+def set_default_event_logger(event_logger: Optional[EventLogger]):
+    """Install (or with ``None`` reset) the process default — tests
+    and harnesses that flip the env mid-process need this."""
+    global _default_logger
+    with _default_logger_lock:
+        _default_logger = event_logger
+
+
+# --------------------------------------------------------------------------
+# timeline reading / merging
+# --------------------------------------------------------------------------
+
+
+def read_events(path: str) -> List[dict]:
+    """Parse a JSONL timeline file; skips torn/partial lines (a
+    SIGKILLed writer's final line may be incomplete)."""
+    if not os.path.exists(path):
+        return []
+    out = []
+    with io.open(path, "r", errors="replace") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict) and "name" in rec:
+                out.append(rec)
+    return out
+
+
+def pair_spans(events: List[dict]) -> List[dict]:
+    """Turn raw events into closed intervals on the WALL clock.
+
+    ``X`` records map directly; ``B``/``E`` pairs match by
+    ``(pid, sid)`` (falling back to a per-``(pid, name)`` LIFO stack
+    for sid-less writers), and the duration comes from the MONOTONIC
+    clock — a wall-clock step (NTP) cannot corrupt a span length, only
+    shift its anchor.  A ``B`` whose writer died before ``E`` closes at
+    the writer's last observed monotonic instant, so a killed worker's
+    half-open span still lands in the ledger instead of vanishing.
+    """
+    intervals: List[dict] = []
+    # writers are identified by (node, pid), never bare pid: in a
+    # master-side MERGED stream, containers on different hosts reuse
+    # the same pids (and per-process sid counters all start at 1) — a
+    # bare-pid key would close node0's B with node1's E and subtract
+    # monotonic clocks from different hosts
+    open_by_sid: Dict[Tuple, dict] = {}
+    open_stacks: Dict[Tuple, List[dict]] = {}
+    last_mono: Dict[Tuple, float] = {}
+    for e in sorted(events, key=lambda e: e.get("mono", 0.0)):
+        ph = e.get("ph")
+        pid = (e.get("node", 0), e.get("pid", 0))
+        mono = float(e.get("mono", 0.0))
+        last_mono[pid] = max(last_mono.get(pid, mono), mono)
+        if ph == "X":
+            start = float(e.get("wall", 0.0))
+            dur = max(float(e.get("dur", 0.0)), 0.0)
+            intervals.append(
+                {
+                    "phase": e.get("name", ""),
+                    "start": start,
+                    "end": start + dur,
+                    **_identity(e),
+                }
+            )
+        elif ph == "B":
+            sid = e.get("sid", -1)
+            if sid >= 0:
+                open_by_sid[(pid, sid)] = e
+            open_stacks.setdefault(
+                (pid, e.get("name", "")), []
+            ).append(e)
+        elif ph == "E":
+            b = open_by_sid.pop((pid, e.get("sid", -1)), None)
+            stack = open_stacks.get((pid, e.get("name", "")))
+            if b is None and stack:
+                b = stack.pop()
+            elif b is not None and stack and b in stack:
+                stack.remove(b)
+            if b is None:
+                continue  # E without B: writer restarted mid-span
+            dur = max(mono - float(b.get("mono", mono)), 0.0)
+            start = float(b.get("wall", 0.0))
+            labels = dict(b.get("labels") or {})
+            labels.update(e.get("labels") or {})
+            iv = {
+                "phase": b.get("name", ""),
+                "start": start,
+                "end": start + dur,
+                **_identity(b),
+            }
+            if labels:
+                iv["labels"] = labels
+            intervals.append(iv)
+    # close writer-died spans at the writer's last seen instant
+    leftovers = list(open_by_sid.values())
+    seen = {id(b) for b in leftovers}
+    for stack in open_stacks.values():
+        leftovers.extend(b for b in stack if id(b) not in seen)
+    for b in leftovers:
+        pid = (b.get("node", 0), b.get("pid", 0))
+        dur = max(
+            last_mono.get(pid, 0.0) - float(b.get("mono", 0.0)), 0.0
+        )
+        start = float(b.get("wall", 0.0))
+        intervals.append(
+            {
+                "phase": b.get("name", ""),
+                "start": start,
+                "end": start + dur,
+                "truncated": True,
+                **_identity(b),
+            }
+        )
+    intervals.sort(key=lambda iv: (iv["start"], iv["end"]))
+    return intervals
+
+
+def _identity(e: dict) -> dict:
+    out = {
+        "job": e.get("job", ""),
+        "node": e.get("node", 0),
+        "rank": e.get("rank", -1),
+        "inc": e.get("inc", 0),
+        "pid": e.get("pid", 0),
+    }
+    if e.get("labels"):
+        out["labels"] = e["labels"]
+    return out
+
+
+def compute_ledger(
+    events: List[dict],
+    window: Optional[Tuple[float, float]] = None,
+) -> dict:
+    """Partition wall clock into phases — the goodput ledger.
+
+    Sweep-line over all span intervals: every elementary segment of
+    the window is charged to the highest-priority covering phase
+    (``PHASES`` order), or to ``unattributed`` when nothing covers it.
+    Because the partition is exact,
+
+        ``sum(loss_breakdown.values()) == wall_s − useful_s``
+
+    holds to float precision — losses can never silently leak.
+    """
+    intervals = pair_spans(events)
+    if window is None:
+        if not intervals:
+            return {
+                "wall_s": 0.0,
+                "useful_s": 0.0,
+                "goodput": 0.0,
+                "loss_breakdown": {},
+                "spans": 0,
+                "incarnations": [],
+            }
+        window = (
+            min(iv["start"] for iv in intervals),
+            max(iv["end"] for iv in intervals),
+        )
+    w0, w1 = float(window[0]), float(window[1])
+    # priority index: declared phases first, then undeclared span names
+    # (still attributable, ranked after every declared phase), then
+    # the unattributed bucket
+    order: List[str] = list(PHASES)
+    for iv in intervals:
+        if iv["phase"] not in order:
+            order.append(iv["phase"])
+    order.append(UNATTRIBUTED)
+    idx = {p: i for i, p in enumerate(order)}
+    unattr_idx = idx[UNATTRIBUTED]
+
+    # boundary sweep with per-phase active counters
+    bounds: List[Tuple[float, int, int]] = []  # (t, 0=end/1=start, phase)
+    for iv in intervals:
+        lo = max(iv["start"], w0)
+        hi = min(iv["end"], w1)
+        if hi <= lo:
+            continue
+        p = idx[iv["phase"]]
+        bounds.append((lo, 1, p))
+        bounds.append((hi, 0, p))
+    bounds.sort(key=lambda b: (b[0], b[1]))
+    active = [0] * len(order)
+    acc = [0.0] * len(order)
+    prev_t = w0
+    covered = 0
+    for t, kind, p in bounds:
+        if t > prev_t:
+            seg = t - prev_t
+            if covered:
+                winner = next(
+                    i for i, n in enumerate(active) if n > 0
+                )
+            else:
+                winner = unattr_idx
+            acc[winner] += seg
+            prev_t = t
+        if kind == 1:
+            active[p] += 1
+            covered += 1
+        else:
+            active[p] -= 1
+            covered -= 1
+    if w1 > prev_t:
+        acc[unattr_idx] += w1 - prev_t
+
+    useful = sum(
+        acc[idx[p]] for p in USEFUL_PHASES if p in idx
+    )
+    wall = max(w1 - w0, 0.0)
+    loss = {
+        order[i]: round(acc[i], 6)
+        for i in range(len(order))
+        if order[i] not in USEFUL_PHASES and acc[i] > 0.0
+    }
+    # the bucket is always present: "no unattributed time" is a
+    # statement, not an omission
+    loss.setdefault(UNATTRIBUTED, 0.0)
+    return {
+        "wall_s": round(wall, 6),
+        "useful_s": round(useful, 6),
+        "goodput": round(useful / wall, 6) if wall > 0 else 0.0,
+        "loss_breakdown": loss,
+        "spans": len(intervals),
+        "incarnations": sorted(
+            {iv.get("inc", 0) for iv in intervals}
+        ),
+    }
+
+
+def export_chrome_trace(events: List[dict], path: str) -> dict:
+    """Write the timeline as a chrome-trace JSON Perfetto loads
+    directly: one process track per node, one thread per rank (the
+    agent's rank ``-1`` renders as its own "agent" track).  Returns
+    the trace dict."""
+    intervals = pair_spans(events)
+    t0 = min(
+        (iv["start"] for iv in intervals), default=0.0
+    )
+    trace_events: List[dict] = []
+    seen_tracks = set()
+    for iv in intervals:
+        pid = int(iv.get("node", 0))
+        rank = int(iv.get("rank", -1))
+        tid = rank + 1  # agent (-1) -> tid 0, rank r -> r+1
+        if (pid, None) not in seen_tracks:
+            seen_tracks.add((pid, None))
+            trace_events.append(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "args": {"name": f"node{pid}"},
+                }
+            )
+        if (pid, tid) not in seen_tracks:
+            seen_tracks.add((pid, tid))
+            tname = "agent" if rank < 0 else f"rank{rank}"
+            trace_events.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": tid,
+                    "args": {"name": tname},
+                }
+            )
+        args = dict(iv.get("labels") or {})
+        args["inc"] = iv.get("inc", 0)
+        trace_events.append(
+            {
+                "name": iv["phase"],
+                "ph": "X",
+                "ts": round((iv["start"] - t0) * 1e6, 1),
+                "dur": round((iv["end"] - iv["start"]) * 1e6, 1),
+                "pid": pid,
+                "tid": tid,
+                "args": args,
+            }
+        )
+    for e in events:
+        if e.get("ph") != "i":
+            continue
+        trace_events.append(
+            {
+                "name": e.get("name", ""),
+                "ph": "i",
+                "s": "g",
+                "ts": round((float(e.get("wall", t0)) - t0) * 1e6, 1),
+                "pid": int(e.get("node", 0)),
+                "tid": int(e.get("rank", -1)) + 1,
+                "args": dict(e.get("labels") or {}),
+            }
+        )
+    trace = {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(trace, f)
+    os.replace(tmp, path)
+    return trace
+
+
+# --------------------------------------------------------------------------
+# master-side aggregation
+# --------------------------------------------------------------------------
+
+
+class TimelineAggregator:
+    """Master-side sink merging per-node event streams.
+
+    Batches arrive through the report RPC (``TimelineEventsReport``;
+    the agent's ``TimelineReporter`` tails the node-local JSONL and
+    ships deltas).  The merged stream is durable when a Brain
+    datastore is wired (``timeline_events`` table) and the live ledger
+    is served three ways: the ``TimelineQueryRequest`` get-RPC,
+    :class:`MetricsRegistry` gauges (native Prometheus exporter), and
+    the chrome-trace export.
+    """
+
+    MAX_EVENTS = 200_000  # in-memory ring bound (control-plane rates)
+    #: gauge refresh cadence: the ledger sweep is O(ring log ring),
+    #: so it must not run on every node's report RPC
+    GAUGE_REFRESH_S = 5.0
+
+    def __init__(self, job: str = "", registry=None, datastore=None):
+        self._job = job or os.getenv(
+            "DLROVER_TPU_JOB_NAME", "default"
+        )
+        self._registry = registry
+        self._datastore = datastore
+        self._events: List[dict] = []
+        self._lock = threading.Lock()
+        self._last_gauge_refresh = 0.0
+
+    @property
+    def job(self) -> str:
+        return self._job
+
+    def add_events(self, node_id: int, events: List[dict]) -> int:
+        """Merge one node's batch; returns the count accepted."""
+        accepted = []
+        for e in events:
+            if not isinstance(e, dict) or "name" not in e:
+                continue
+            e.setdefault("node", node_id)
+            e.setdefault("job", self._job)
+            accepted.append(e)
+        with self._lock:
+            self._events.extend(accepted)
+            if len(self._events) > self.MAX_EVENTS:
+                self._events = self._events[-self.MAX_EVENTS:]
+        if self._datastore is not None and accepted:
+            try:
+                self._datastore.record_timeline_events(
+                    self._job, accepted
+                )
+            except Exception as e:  # noqa: BLE001 - durability is best-effort
+                logger.warning("timeline persist failed: %s", e)
+        if accepted:
+            now = time.monotonic()
+            if (
+                now - self._last_gauge_refresh
+                >= self.GAUGE_REFRESH_S
+            ):
+                self._last_gauge_refresh = now
+                self._refresh_gauges()
+        return len(accepted)
+
+    def events(self, limit: int = 0) -> List[dict]:
+        with self._lock:
+            if limit and limit > 0:
+                return list(self._events[-limit:])
+            return list(self._events)
+
+    def ledger(self) -> dict:
+        """Current goodput ledger over everything merged so far."""
+        return compute_ledger(self.events())
+
+    def export_chrome_trace(self, path: str) -> dict:
+        return export_chrome_trace(self.events(), path)
+
+    def _refresh_gauges(self):
+        if self._registry is None:
+            return
+        try:
+            ledger = self.ledger()
+            self._registry.set_gauge(
+                "dlrover_tpu_goodput", ledger["goodput"]
+            )
+            self._registry.set_gauge(
+                "dlrover_tpu_timeline_useful_seconds",
+                ledger["useful_s"],
+            )
+            self._registry.set_gauge(
+                "dlrover_tpu_timeline_wall_seconds", ledger["wall_s"]
+            )
+            for phase, sec in ledger["loss_breakdown"].items():
+                self._registry.set_gauge(
+                    "dlrover_tpu_goodput_loss_seconds",
+                    sec,
+                    labels={"phase": phase},
+                )
+        except Exception as e:  # noqa: BLE001 - metrics must never break reports
+            logger.warning("ledger gauge refresh failed: %s", e)

@@ -251,7 +251,7 @@ class CrossProcessGenerationEngine:
                 "seed": int(seed),
             }
         )
-        out = self._resp.get(timeout=600.0)
+        out = self._get_response(timeout=600.0)
         if "error" in out:
             raise RuntimeError(out["error"])
         self.last_stats = {
@@ -259,6 +259,39 @@ class CrossProcessGenerationEngine:
             for k in ("version", "handoff_s", "gen_s", "tokens_per_s")
         }
         return out["tokens"]
+
+    def _get_response(self, timeout: float, poll: float = 2.0) -> Dict:
+        """Wait for the worker's response, watching the worker process:
+        a dead worker must fail the call IMMEDIATELY with its exit
+        code, not block the trainer for the full queue timeout
+        (ADVICE-r5: generate() after a worker crash hung 600 s)."""
+        import queue as _queue
+
+        deadline = time.time() + timeout
+        while True:
+            try:
+                return self._resp.get(
+                    timeout=min(poll, max(deadline - time.time(), 0.1))
+                )
+            except _queue.Empty:
+                rc = self._proc.poll()
+                if rc is not None:
+                    # the worker may have answered and THEN exited
+                    # (queue flush is async): drain once more before
+                    # declaring the request dead
+                    try:
+                        return self._resp.get(timeout=1.0)
+                    except _queue.Empty:
+                        pass
+                    raise RuntimeError(
+                        f"generation worker {self._name} died with "
+                        f"exit code {rc} while serving a request"
+                    ) from None
+                if time.time() >= deadline:
+                    raise TimeoutError(
+                        f"generation worker {self._name} gave no "
+                        f"response within {timeout}s"
+                    ) from None
 
     def close(self):
         try:

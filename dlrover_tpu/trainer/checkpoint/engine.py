@@ -19,6 +19,7 @@ from typing import Optional
 
 from dlrover_tpu.common.constants import CheckpointConstant
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.observability.events import get_event_logger
 from dlrover_tpu.common.multi_process import SharedQueue
 from dlrover_tpu.common.storage import (
     get_checkpoint_storage,
@@ -131,6 +132,10 @@ class CheckpointEngine:
         self._step_sync_fn = step_sync_fn
         self._snapshot_thread = None
         self._last_drain_ok = True
+        # per-process consensus round counter: namespaces the
+        # coordination-service fallback's keys so repeated load()
+        # calls in one world never read a stale row
+        self._consensus_seq = 0
         # saves dropped because the previous drain was still running or
         # the saver held the lock — the effective RPO degrades with each
         # skip, so it must be observable (exported as
@@ -263,6 +268,7 @@ class CheckpointEngine:
     def _drain_snapshot(self, step: int, state,
                         persist_dir: Optional[str]) -> bool:
         start = time.time()
+        start_mono = time.monotonic()
         self._last_drain_ok = False
         if not self._lock.acquire(timeout=60):
             self._count_skip()
@@ -275,6 +281,12 @@ class CheckpointEngine:
             nbytes = self._shm_handler.save_state(step, state)
         finally:
             self._lock.release()
+        get_event_logger().complete(
+            "checkpoint_save",
+            start,
+            time.monotonic() - start_mono,
+            step=step,
+        )
         logger.info(
             "rank %s: step %s snapshot (%.1f MB) to shm in %.3fs",
             self._rank, step, nbytes / 1e6, time.time() - start,
@@ -337,6 +349,7 @@ class CheckpointEngine:
         target pytree was given, else {keypath: ndarray}; (-1, None)
         when nothing exists.
         """
+        t0_wall, t0_mono = time.time(), time.monotonic()
         shm_steps = self._shm_handler.steps_available()
         shm_step = shm_steps[0] if shm_steps else -1
         storage_step, latest_dir = self._latest_storage_step(
@@ -374,9 +387,15 @@ class CheckpointEngine:
             )
         if target is not None:
             # copy_host guards non-device leaves from aliasing live shm
-            return step, restore_to_target(
+            arrays = restore_to_target(
                 target, arrays, copy_host=zero_copy
             )
+        get_event_logger().complete(
+            "checkpoint_restore",
+            t0_wall,
+            time.monotonic() - t0_mono,
+            step=agreed,
+        )
         return step, arrays
 
     def _sync_restore_step(self, shm_steps, storage_step: int) -> int:
@@ -415,12 +434,60 @@ class CheckpointEngine:
             )  # [P, width]
             return _newest_common_step(rows)
         except Exception as exc:
+            # data-plane collective unavailable (CPU backends lack
+            # multiprocess XLA computations): run the SAME all-to-all
+            # consensus over the jax coordination-service KV store —
+            # still never one-sided, every rank reads every row
+            agreed = self._coordination_consensus(avail)
+            if agreed is not None:
+                logger.info(
+                    "rank %s: restore-step consensus via coordination"
+                    " service (collective unavailable: %s)",
+                    self._rank, exc,
+                )
+                return agreed
             # a one-sided fallback to the local step would recreate the
             # mixed-step divergence this sync exists to prevent (and
             # peers may be blocked inside the collective) — fail loudly
             raise RuntimeError(
                 f"rank {self._rank}: restore-step consensus failed"
             ) from exc
+
+    def _coordination_consensus(self, avail) -> Optional[int]:
+        """Availability-row exchange over the coordination-service KV
+        (control plane).  Returns the agreed step, or None when no
+        coordination client exists / a peer never published."""
+        import json as _json
+
+        from dlrover_tpu.trainer.elastic.context import (
+            coordination_client,
+        )
+
+        client = coordination_client()
+        if client is None:
+            return None
+        self._consensus_seq += 1
+        ns = (
+            f"dlrover_ckpt_consensus/{self._name}/"
+            f"{self._consensus_seq}"
+        )
+        try:
+            client.key_value_set(
+                f"{ns}/{self._rank}", _json.dumps(avail)
+            )
+            rows = []
+            for r in range(self._world):
+                raw = client.blocking_key_value_get(
+                    f"{ns}/{r}", 120_000
+                )
+                rows.append(_json.loads(raw))
+        except Exception as e:  # noqa: BLE001 - jax runtime error types vary
+            logger.warning(
+                "rank %s: coordination-service consensus failed: %s",
+                self._rank, e,
+            )
+            return None
+        return _newest_common_step(rows)
 
     def _latest_storage_step(self, checkpoint_dir: Optional[str] = None):
         root = checkpoint_dir or self.checkpoint_dir

@@ -66,11 +66,17 @@ def init_distributed(initialize_jax: bool = True) -> ElasticContext:
     if initialize_jax and world > 1 and coord:
         import jax
 
-        jax.distributed.initialize(
-            coordinator_address=coord,
-            num_processes=world,
-            process_id=rank,
-        )
+        from dlrover_tpu.observability.events import get_event_logger
+
+        # trainer-side rendezvous: connecting to the coordinator and
+        # assembling the device world is restart overhead the goodput
+        # ledger must see
+        with get_event_logger().span("rendezvous"):
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=world,
+                process_id=rank,
+            )
         logger.info(
             "jax.distributed initialized: rank %d/%d via %s",
             rank,
@@ -96,3 +102,35 @@ def get_context() -> Optional[ElasticContext]:
 def reset_context():
     global _context
     _context = None
+
+
+def coordination_client():
+    """The jax.distributed coordination-service client, or None when
+    the process is not in a distributed world.  The service's KV store
+    and barriers are CONTROL-PLANE primitives: they work on every
+    backend, including CPU worlds where XLA multiprocess computations
+    (and therefore every ``multihost_utils`` collective) are
+    unavailable."""
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client
+    except Exception:  # noqa: BLE001 - private API drift across jax versions
+        return None
+
+
+def control_plane_barrier(
+    name: str, timeout_s: float = 600.0
+) -> bool:
+    """Block at a named coordination-service barrier until every
+    process arrives; returns False (no-op) outside a distributed
+    world.  ``name`` must be unique per barrier instance (suffix a
+    step/round counter).  Unlike ``sync_global_devices`` this never
+    launches an XLA computation, so it also COUPLES processes on CPU
+    CI exactly like a data-plane collective does on TPU: when a peer
+    dies, the survivors stall here until the agent tears them down."""
+    client = coordination_client()
+    if client is None:
+        return False
+    client.wait_at_barrier(name, int(timeout_s * 1000))
+    return True

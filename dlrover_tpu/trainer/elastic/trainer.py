@@ -20,6 +20,7 @@ from typing import Callable, Optional
 
 from dlrover_tpu.common.constants import NodeEnv
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.observability.events import get_event_logger
 from dlrover_tpu.trainer.elastic.context import (
     process_count,
     process_rank,
@@ -61,6 +62,10 @@ class ElasticTrainer:
         self._report_interval = report_interval
         self._last_report = 0.0
         self._client = master_client
+        # timeline: each step_done closes a `step` span back to the
+        # previous one — the useful-time side of the goodput ledger
+        self._events = get_event_logger()
+        self._step_mark = None  # (wall, mono) of the last step_done
 
     # ------------------------------------------------------------ progress
     def _master_client(self):
@@ -73,6 +78,14 @@ class ElasticTrainer:
     def step_done(self, steps: int = 1):
         """Advance the global step; rank 0 reports progress."""
         self.global_step += steps
+        if self._events.enabled:
+            now_w, now_m = time.time(), time.monotonic()
+            if self._step_mark is not None:
+                dur = now_m - self._step_mark[1]
+                self._events.complete(
+                    "step", now_w - dur, dur, step=self.global_step
+                )
+            self._step_mark = (now_w, now_m)
         if self.rank != 0:
             return
         now = time.time()

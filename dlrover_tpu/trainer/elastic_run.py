@@ -95,6 +95,13 @@ def parse_args(argv=None):
         default=os.getenv("JAX_COMPILATION_CACHE_DIR", ""),
         help="persistent XLA compile cache (keeps restarts cheap)",
     )
+    parser.add_argument(
+        "--events_file",
+        default=os.getenv("DLROVER_TPU_EVENTS_FILE", ""),
+        help="node-local JSONL timeline every process appends to "
+        "(spans: step/compile/rendezvous/checkpoint/restart...); the "
+        "agent ships it to the master's goodput ledger",
+    )
     # torchrun-style: with -m/--module the positional IS the module
     # name; the required positional keeps REMAINDER working for
     # option-like script/module args, and a "-m" token after the
@@ -198,6 +205,13 @@ def run(args) -> int:
 
     entrypoint = _build_entrypoint(args)
 
+    if args.events_file:
+        # exported BEFORE any spawn so the master, the agent, and every
+        # training process append to the same node-local timeline
+        os.environ["DLROVER_TPU_EVENTS_FILE"] = os.path.abspath(
+            args.events_file
+        )
+
     if args.standalone:
         # no master / agent: spawn procs directly with local coordinator
         return _run_standalone(args, entrypoint)
@@ -233,9 +247,21 @@ def run(args) -> int:
         node_rank=node_rank,
         compile_cache_dir=args.compile_cache_dir,
     )
+    from dlrover_tpu.observability.events import get_event_logger
+
+    events = get_event_logger()
+    events.instant(
+        "job_start",
+        nnodes=args.nnodes,
+        nproc_per_node=args.nproc_per_node,
+        node_rank=node_rank,
+    )
+    rc = 1
     try:
-        return launch_agent(config, entrypoint, master_addr)
+        rc = launch_agent(config, entrypoint, master_addr)
+        return rc
     finally:
+        events.instant("job_end", exit_code=rc)
         if master_proc is not None and master_proc.poll() is None:
             master_proc.terminate()
             try:

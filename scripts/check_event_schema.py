@@ -1,0 +1,172 @@
+"""Lint every timeline emit site against the declared event schema.
+
+The goodput ledger is only trustworthy if emit sites use the CLOSED
+phase vocabulary (``observability/events.py`` ``PHASES`` /
+``INSTANT_EVENTS``): a typo'd phase name would still be written, still
+render in the trace — and silently fall out of the declared loss
+buckets.  This lint walks the repo's Python with ``ast`` and checks
+every call to an event-logger method (``span`` / ``begin`` / ``end`` /
+``complete`` / ``instant`` on a receiver whose expression mentions
+``event``):
+
+- the phase/name argument is a STRING LITERAL (no computed names — the
+  vocabulary must be greppable) drawn from the declared sets;
+- the labels ``REQUIRED_SPAN_LABELS`` demands for that phase are
+  passed as keyword arguments at span-opening sites (``span`` /
+  ``begin`` / ``complete``).
+
+Usage: ``python scripts/check_event_schema.py [paths...]``
+(default: the package, scripts/, tests/ and bench*.py).  Exit 1 on any
+violation; ``tests/test_event_schema_lint.py`` runs it in tier-1.
+"""
+
+import ast
+import glob
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from dlrover_tpu.observability.events import (  # noqa: E402
+    INSTANT_EVENTS,
+    PHASES,
+    REQUIRED_SPAN_LABELS,
+)
+
+EMIT_METHODS = {"span", "begin", "end", "complete", "instant"}
+#: methods that OPEN a span and must carry the phase's required labels
+OPENING_METHODS = {"span", "begin", "complete"}
+
+
+def _default_paths():
+    paths = [
+        os.path.join(REPO, "dlrover_tpu"),
+        os.path.join(REPO, "scripts"),
+        os.path.join(REPO, "tests"),
+    ]
+    paths.extend(glob.glob(os.path.join(REPO, "bench*.py")))
+    return paths
+
+
+def _python_files(paths):
+    for path in paths:
+        if os.path.isfile(path):
+            yield path
+        else:
+            for root, _dirs, files in os.walk(path):
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+
+
+def _is_event_receiver(func: ast.Attribute) -> bool:
+    """True when the call receiver looks like an event logger —
+    ``self._events``, ``events``, ``EVENTS``, ``get_event_logger()``;
+    this is the repo-wide naming convention the lint enforces
+    alongside the schema."""
+    try:
+        receiver = ast.unparse(func.value)
+    except Exception:  # noqa: BLE001 - very old nodes
+        return False
+    return "event" in receiver.lower()
+
+
+def _literal_phase(call: ast.Call):
+    """The phase argument if it is a string literal; (found, value)."""
+    if call.args:
+        arg = call.args[0]
+    else:
+        arg = next(
+            (
+                kw.value
+                for kw in call.keywords
+                if kw.arg in ("phase", "name")
+            ),
+            None,
+        )
+    if arg is None:
+        return False, None
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return True, arg.value
+    return True, None  # present but not a literal
+
+
+def check_file(path: str):
+    violations = []
+    try:
+        tree = ast.parse(open(path).read(), filename=path)
+    except SyntaxError as e:
+        return [f"{path}: syntax error: {e}"]
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not (
+            isinstance(func, ast.Attribute)
+            and func.attr in EMIT_METHODS
+        ):
+            continue
+        if not _is_event_receiver(func):
+            continue
+        where = f"{os.path.relpath(path, REPO)}:{node.lineno}"
+        method = func.attr
+        found, phase = _literal_phase(node)
+        if not found:
+            violations.append(
+                f"{where}: {method}() without a phase argument"
+            )
+            continue
+        if phase is None:
+            violations.append(
+                f"{where}: {method}() phase must be a string "
+                "literal from the declared schema, not an expression"
+            )
+            continue
+        declared = (
+            INSTANT_EVENTS if method == "instant" else set(PHASES)
+        )
+        if phase not in declared:
+            violations.append(
+                f"{where}: {method}({phase!r}) is not a declared "
+                f"{'instant event' if method == 'instant' else 'phase'}"
+                f" (declared: {sorted(declared)})"
+            )
+            continue
+        if method in OPENING_METHODS:
+            kwargs = {kw.arg for kw in node.keywords if kw.arg}
+            has_splat = any(
+                kw.arg is None for kw in node.keywords
+            )
+            missing = [
+                lab
+                for lab in REQUIRED_SPAN_LABELS.get(phase, ())
+                if lab not in kwargs
+            ]
+            if missing and not has_splat:
+                violations.append(
+                    f"{where}: {method}({phase!r}) missing required "
+                    f"label(s) {missing}"
+                )
+    return violations
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    paths = argv or _default_paths()
+    violations = []
+    n_files = 0
+    for path in _python_files(paths):
+        n_files += 1
+        violations.extend(check_file(path))
+    for v in violations:
+        print(v)
+    print(
+        f"event_schema_violations={len(violations)} "
+        f"files_checked={n_files}"
+    )
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,8 +28,11 @@ import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 import optax  # noqa: E402
 
+from dlrover_tpu.observability.events import get_event_logger  # noqa: E402
 from dlrover_tpu.parallel.mesh import AxisName, create_parallel_mesh  # noqa: E402
 from dlrover_tpu.trainer.checkpoint.engine import CheckpointEngine  # noqa: E402
+
+EVENTS = get_event_logger()
 
 TARGET = int(os.environ["GOODPUT_TARGET_STEPS"])
 STEP_SLEEP = float(os.environ.get("GOODPUT_STEP_SLEEP", "0.05"))
@@ -96,25 +99,54 @@ def main() -> int:
         }, loss
 
     distributed = ctx.master_addr and ctx.world_size > 1
+    on_cpu = jax.default_backend() == "cpu"
+    barrier_seq = [0]
 
     def step_barrier():
         """Couple the ranks like a real data-parallel grad allreduce
         does: when a peer dies, the survivors stall here until the
         agent tears them down and restarts the group — that stalled
-        time is exactly the goodput loss being measured."""
-        if distributed:
+        time is exactly the goodput loss being measured.  On CPU
+        worlds XLA has no multiprocess computations, so the coupling
+        runs over the coordination service instead (same blocking
+        semantics, no device collective)."""
+        if not distributed:
+            return
+        if on_cpu:
+            from dlrover_tpu.trainer.elastic.context import (
+                control_plane_barrier,
+            )
+
+            barrier_seq[0] += 1
+            control_plane_barrier(f"goodput_step_{barrier_seq[0]}")
+        else:
             from jax.experimental import multihost_utils
 
             multihost_utils.sync_global_devices("goodput_step")
 
     step = int(state["step"])
     x = jax.random.normal(jax.random.PRNGKey(ctx.rank), (16, 32))
+    first_step = True
     while step < TARGET:
         step_barrier()
-        state, loss = train_step(state, x)
-        jax.block_until_ready(state)
+        t0_wall, t0_mono = time.time(), time.monotonic()
+        if first_step:
+            # this incarnation's warmup: trace+compile (or compile
+            # cache hit) is restart overhead the ledger must see, not
+            # useful step time
+            with EVENTS.span("compile"):
+                state, loss = train_step(state, x)
+                jax.block_until_ready(state)
+        else:
+            state, loss = train_step(state, x)
+            jax.block_until_ready(state)
         time.sleep(STEP_SLEEP)  # simulated per-step device work
         step += 1
+        if not first_step:
+            EVENTS.complete(
+                "step", t0_wall, time.monotonic() - t0_mono, step=step
+            )
+        first_step = False
         # blocking memory snapshot: RPO 0 — resume must be step+1
         engine.save_to_memory(step, jax.device_get(state))
         engine.wait_for_snapshot()

@@ -199,6 +199,29 @@ class TestMultiJobBrain:
         assert [s["s"] for s, _ in ds.load_measurements("wl")] == [2]
         ds.close()
 
+    def test_env_prune_without_job_name_keeps_other_jobs(
+        self, db_path, monkeypatch
+    ):
+        """ADVICE-r5: DLROVER_TPU_BRAIN_MAX_AGE_S set while the job
+        name is EMPTY must not run a global prune — a short-retention
+        master restarting would wipe every neighbour's history from a
+        shared db."""
+        ds = BrainDatastore(db_path)
+        ds.record_speed("neighbour", 2, 10.0)
+        ds.record_node_event("neighbour", "n0", "oom")
+        ds.close()
+        monkeypatch.setenv("DLROVER_TPU_BRAIN_MAX_AGE_S", "0.0")
+        monkeypatch.delenv("DLROVER_TPU_JOB_NAME", raising=False)
+        ds2 = BrainDatastore(db_path)  # startup prune path runs here
+        assert ds2.speed_history("neighbour") == {2: 10.0}
+        assert len(ds2.node_events("neighbour")) == 1
+        ds2.close()
+        # with a job name set, the scoped prune still works
+        monkeypatch.setenv("DLROVER_TPU_JOB_NAME", "neighbour")
+        ds3 = BrainDatastore(db_path)
+        assert ds3.speed_history("neighbour") == {}
+        ds3.close()
+
     def test_measurements_over_rpc(self, db_path, monkeypatch):
         """A different job's master pulls calibration over the wire
         instead of mounting the db file."""

@@ -1,0 +1,45 @@
+"""Tier-1 wrapper for ``scripts/check_event_schema.py``: the repo's
+emit sites must all use the declared phase vocabulary + required
+labels, and the lint must actually catch violations (a lint that
+passes everything proves nothing)."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT = os.path.join(REPO, "scripts", "check_event_schema.py")
+
+
+def _run(*args):
+    return subprocess.run(
+        [sys.executable, LINT, *args],
+        capture_output=True,
+        text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        timeout=120,
+    )
+
+
+def test_repo_emit_sites_conform():
+    proc = _run()
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "event_schema_violations=0" in proc.stdout
+
+
+def test_lint_catches_violations(tmp_path):
+    bad = tmp_path / "bad_emit.py"
+    bad.write_text(
+        "events = None\n"
+        "def f(events, phase):\n"
+        "    events.span('not_a_phase')\n"        # undeclared phase
+        "    events.complete('step', 0.0, 1.0)\n"  # missing step label
+        "    events.begin(phase)\n"                # non-literal phase
+        "    events.instant('job_start')\n"        # fine
+    )
+    proc = _run(str(bad))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "event_schema_violations=3" in proc.stdout, proc.stdout
+    assert "not_a_phase" in proc.stdout
+    assert "missing required label(s) ['step']" in proc.stdout
+    assert "string literal" in proc.stdout

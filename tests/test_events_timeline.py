@@ -1,0 +1,516 @@
+"""Unified job-event timeline: span pairing, clock discipline, the
+goodput-ledger attribution invariant, master-side aggregation, and the
+kill-one-worker integration case.
+
+The load-bearing assertion everywhere: the ledger PARTITIONS wall
+clock — phase losses sum (to float precision, asserted at ±1%) to
+``wall − useful``, so ``1 − goodput`` is fully attributed.
+"""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.master.servicer import MasterServicer
+from dlrover_tpu.observability.events import (
+    PHASES,
+    UNATTRIBUTED,
+    EventLogger,
+    TimelineAggregator,
+    compute_ledger,
+    export_chrome_trace,
+    pair_spans,
+    read_events,
+)
+
+
+def _mk(name, ph, wall, mono, pid=1, inc=0, rank=0, node=0, **kw):
+    rec = {
+        "name": name,
+        "ph": ph,
+        "wall": wall,
+        "mono": mono,
+        "job": "t",
+        "node": node,
+        "rank": rank,
+        "inc": inc,
+        "pid": pid,
+    }
+    rec.update(kw)
+    return rec
+
+
+class TestEventLogger:
+    def test_disabled_logger_is_noop(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("DLROVER_TPU_EVENTS_FILE", raising=False)
+        log = EventLogger(path="")
+        assert not log.enabled
+        with log.span("rendezvous"):
+            pass
+        log.complete("step", time.time(), 0.1, step=1)
+        log.instant("job_start")  # nothing raised, nothing written
+
+    def test_span_pairing_and_labels(self, tmp_path):
+        p = str(tmp_path / "ev.jsonl")
+        log = EventLogger(path=p, job="j", node=2, rank=1,
+                          incarnation=3)
+        with log.span("rendezvous"):
+            time.sleep(0.01)
+        log.complete("step", time.time() - 0.05, 0.02, step=7)
+        log.instant("worker_kill", victim=123)
+        events = read_events(p)
+        assert len(events) == 4  # B + E + X + i
+        ivs = pair_spans(events)
+        assert len(ivs) == 2
+        by_phase = {iv["phase"]: iv for iv in ivs}
+        assert by_phase["rendezvous"]["end"] >= (
+            by_phase["rendezvous"]["start"] + 0.01
+        )
+        assert by_phase["step"]["labels"]["step"] == 7
+        # identity labels ride every record
+        for e in events:
+            assert (e["job"], e["node"], e["rank"], e["inc"]) == (
+                "j", 2, 1, 3,
+            )
+
+    def test_nested_and_unclosed_spans(self, tmp_path):
+        p = str(tmp_path / "ev.jsonl")
+        log = EventLogger(path=p, job="j")
+        outer = log.begin("restart", reason="kill")
+        time.sleep(0.01)
+        with log.span("rendezvous"):
+            time.sleep(0.01)
+        # writer "dies" before closing the restart span
+        del outer
+        events = read_events(p)
+        ivs = pair_spans(events)
+        restart = next(iv for iv in ivs if iv["phase"] == "restart")
+        rdzv = next(iv for iv in ivs if iv["phase"] == "rendezvous")
+        # unclosed span truncates at the writer's last instant, which
+        # still covers the nested rendezvous
+        assert restart.get("truncated") is True
+        assert restart["start"] <= rdzv["start"]
+        assert restart["end"] >= rdzv["end"] - 1e-6
+
+    def test_atomic_append_from_threads(self, tmp_path):
+        import threading
+
+        p = str(tmp_path / "ev.jsonl")
+        log = EventLogger(path=p, job="j")
+
+        def emit_many(k):
+            for i in range(50):
+                log.complete(
+                    "step", time.time(), 0.001, step=k * 1000 + i
+                )
+
+        threads = [
+            threading.Thread(target=emit_many, args=(k,))
+            for k in range(4)
+        ]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        events = read_events(p)
+        assert len(events) == 200  # no torn/interleaved lines
+
+    def test_clock_monotonicity(self, tmp_path):
+        p = str(tmp_path / "ev.jsonl")
+        log = EventLogger(path=p, job="j")
+        for i in range(20):
+            log.complete("step", time.time(), 0.0005, step=i)
+        events = read_events(p)
+        monos = [e["mono"] for e in events]
+        assert monos == sorted(monos)
+        assert all(e["wall"] > 0 and e["mono"] > 0 for e in events)
+
+
+class TestLedger:
+    def test_losses_sum_to_wall_minus_useful(self):
+        # 10s window: 6s of steps, a restart [6,9] with a nested
+        # rendezvous [7,8.5], 1s idle tail
+        events = []
+        for i in range(6):
+            events.append(
+                _mk("step", "X", 100.0 + i, 10.0 + i, dur=1.0)
+            )
+        events.append(_mk("restart", "B", 106.0, 16.0, pid=2, sid=1))
+        events.append(_mk("restart", "E", 109.0, 19.0, pid=2, sid=1))
+        events.append(
+            _mk("rendezvous", "B", 107.0, 17.0, pid=2, sid=2)
+        )
+        events.append(
+            _mk("rendezvous", "E", 108.5, 18.5, pid=2, sid=2)
+        )
+        ledger = compute_ledger(events, window=(100.0, 110.0))
+        assert ledger["wall_s"] == pytest.approx(10.0)
+        assert ledger["useful_s"] == pytest.approx(6.0)
+        assert ledger["goodput"] == pytest.approx(0.6)
+        loss = ledger["loss_breakdown"]
+        # priority: nested rendezvous carves its share OUT of restart
+        assert loss["rendezvous"] == pytest.approx(1.5)
+        assert loss["restart"] == pytest.approx(1.5)
+        assert loss[UNATTRIBUTED] == pytest.approx(1.0)
+        # the invariant, to well under the ±1% the spec allows
+        assert sum(loss.values()) == pytest.approx(
+            ledger["wall_s"] - ledger["useful_s"], rel=1e-6
+        )
+
+    def test_overlapping_step_wins(self):
+        # an async checkpoint drain overlapping a step charges the
+        # step (training progressed): zero checkpoint loss
+        events = [
+            _mk("step", "X", 0.0, 0.0, dur=2.0),
+            _mk("checkpoint_save", "X", 0.5, 0.5, dur=1.0),
+        ]
+        ledger = compute_ledger(events, window=(0.0, 2.0))
+        assert ledger["useful_s"] == pytest.approx(2.0)
+        assert ledger["loss_breakdown"].get(
+            "checkpoint_save", 0.0
+        ) == 0.0
+        assert sum(ledger["loss_breakdown"].values()) == pytest.approx(
+            0.0, abs=1e-9
+        )
+
+    def test_empty_timeline(self):
+        ledger = compute_ledger([])
+        assert ledger["wall_s"] == 0.0
+        assert ledger["goodput"] == 0.0
+        assert ledger["loss_breakdown"] == {}
+
+    def test_data_stall_outranks_step(self):
+        # a step span measured step_done-to-step_done covers the
+        # between-step input wait: a named stall inside it must
+        # surface as loss, not hide under useful time
+        events = [
+            _mk("step", "X", 0.0, 0.0, dur=10.0),
+            _mk("data_stall", "X", 2.0, 2.0, dur=3.0),
+        ]
+        ledger = compute_ledger(events, window=(0.0, 10.0))
+        assert ledger["useful_s"] == pytest.approx(7.0)
+        assert ledger["loss_breakdown"]["data_stall"] == (
+            pytest.approx(3.0)
+        )
+
+    def test_cross_node_pid_collision_pairs_per_node(self):
+        # two hosts reuse pid 17 and sid 1: node0's B must be closed
+        # by node0's E, never by node1's — a bare-pid key would
+        # subtract monotonic clocks from different hosts
+        events = [
+            _mk("rendezvous", "B", 100.0, 5000.0, pid=17, node=0,
+                sid=1),
+            _mk("rendezvous", "E", 102.0, 5002.0, pid=17, node=0,
+                sid=1),
+            _mk("restart", "B", 101.0, 9.0, pid=17, node=1, sid=1,
+                rank=-1),
+            _mk("restart", "E", 104.0, 12.0, pid=17, node=1, sid=1,
+                rank=-1),
+        ]
+        ivs = pair_spans(events)
+        assert len(ivs) == 2
+        by_phase = {iv["phase"]: iv for iv in ivs}
+        assert by_phase["rendezvous"]["end"] - (
+            by_phase["rendezvous"]["start"]
+        ) == pytest.approx(2.0)
+        assert by_phase["restart"]["end"] - (
+            by_phase["restart"]["start"]
+        ) == pytest.approx(3.0)
+        assert not any(iv.get("truncated") for iv in ivs)
+
+    def test_undeclared_phase_still_attributed(self):
+        events = [
+            _mk("step", "X", 0.0, 0.0, dur=1.0),
+            _mk("mystery", "X", 1.0, 1.0, dur=1.0),
+        ]
+        ledger = compute_ledger(events, window=(0.0, 2.0))
+        assert ledger["loss_breakdown"]["mystery"] == pytest.approx(
+            1.0
+        )
+
+    def test_declared_phase_set(self):
+        # the ledger's vocabulary is the ISSUE's contract
+        for phase in ("step", "compile", "rendezvous",
+                      "checkpoint_save", "checkpoint_restore",
+                      "restart", "data_stall", "preemption_drain"):
+            assert phase in PHASES
+
+
+class TestChromeTrace:
+    def test_export_shape(self, tmp_path):
+        events = [
+            _mk("step", "X", 100.0, 0.0, dur=1.0, rank=0, node=1),
+            _mk("restart", "B", 101.0, 1.0, pid=9, sid=4, rank=-1),
+            _mk("restart", "E", 102.0, 2.0, pid=9, sid=4, rank=-1),
+            _mk("preemption_signal", "i", 101.5, 1.5),
+        ]
+        out = str(tmp_path / "trace.json")
+        export_chrome_trace(events, out)
+        trace = json.load(open(out))
+        assert "traceEvents" in trace
+        xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        assert len(xs) == 2
+        for e in xs:
+            assert {"ph", "ts", "pid", "tid", "dur", "name"} <= set(e)
+            assert e["ts"] >= 0
+        # agent rank -1 gets its own named thread track
+        names = [
+            e["args"]["name"]
+            for e in trace["traceEvents"]
+            if e["ph"] == "M"
+        ]
+        assert "agent" in names
+        assert any(e["ph"] == "i" for e in trace["traceEvents"])
+
+
+class TestAggregatorAndRpc:
+    def _servicer(self, aggregator):
+        return MasterServicer(timeline_aggregator=aggregator)
+
+    def _envelope(self, request, node_id=0):
+        return msg.Envelope(
+            node_id=node_id,
+            node_type="worker",
+            data=msg.serialize_message(request),
+        )
+
+    def test_report_and_query_roundtrip(self):
+        agg = TimelineAggregator(job="j")
+        servicer = self._servicer(agg)
+        events = [
+            _mk("step", "X", 100.0 + i, float(i), dur=1.0)
+            for i in range(3)
+        ] + [_mk("restart", "X", 103.0, 3.0, dur=2.0)]
+        res = servicer.report(
+            self._envelope(msg.TimelineEventsReport(events=events),
+                           node_id=4)
+        )
+        assert res.success
+        out = servicer.get(
+            self._envelope(msg.TimelineQueryRequest(limit=10))
+        )
+        assert out.available
+        assert out.ledger["useful_s"] == pytest.approx(3.0)
+        assert out.ledger["loss_breakdown"]["restart"] == (
+            pytest.approx(2.0)
+        )
+        assert len(out.events) == 4
+
+    def test_query_without_aggregator(self):
+        servicer = self._servicer(None)
+        out = servicer.get(
+            self._envelope(msg.TimelineQueryRequest())
+        )
+        assert out.available is False
+
+    def test_gauges_mirrored_to_registry(self, tmp_path):
+        from dlrover_tpu.observability.metrics import MetricsRegistry
+
+        registry = MetricsRegistry(
+            path=str(tmp_path / "m.prom"), flush_interval=0.0
+        )
+        agg = TimelineAggregator(job="j", registry=registry)
+        agg.add_events(
+            0,
+            [
+                _mk("step", "X", 0.0, 0.0, dur=3.0),
+                _mk("rendezvous", "X", 3.0, 3.0, dur=1.0),
+            ],
+        )
+        registry.flush()
+        text = open(registry.path).read()
+        assert "dlrover_tpu_goodput" in text
+        assert 'phase="rendezvous"' in text
+
+    def test_datastore_persistence_roundtrip(self, tmp_path):
+        from dlrover_tpu.master.datastore import BrainDatastore
+
+        store = BrainDatastore(str(tmp_path / "brain.db"))
+        agg = TimelineAggregator(job="j", datastore=store)
+        agg.add_events(
+            2,
+            [
+                _mk("step", "X", 50.0, 1.0, dur=1.0, inc=1,
+                    labels={"step": 9}),
+                _mk("restart", "B", 51.0, 2.0, sid=3, rank=-1),
+            ],
+        )
+        rows = store.timeline_events("j")
+        assert len(rows) == 2
+        back = {r["name"]: r for r in rows}
+        assert back["step"]["dur"] == pytest.approx(1.0)
+        assert back["step"]["labels"] == {"step": 9}
+        assert back["restart"]["sid"] == 3
+        assert back["restart"]["rank"] == -1
+        # the persisted rows are ledger-ready
+        ledger = compute_ledger(rows)
+        assert ledger["useful_s"] == pytest.approx(1.0)
+        store.close()
+
+
+class TestTimelineReporter:
+    def test_tail_and_ship_batches(self, tmp_path):
+        from dlrover_tpu.agent.monitor import TimelineReporter
+
+        p = str(tmp_path / "ev.jsonl")
+        log = EventLogger(path=p, job="j")
+
+        shipped = []
+
+        class FakeClient:
+            def report_timeline_events(self, events):
+                shipped.extend(events)
+                return True
+
+        reporter = TimelineReporter(
+            p, client=FakeClient(), max_batch=2
+        )
+        for i in range(5):
+            log.complete("step", time.time(), 0.001, step=i)
+        reporter._tick()
+        assert len(shipped) == 5
+        # second tick ships only the delta
+        log.complete("step", time.time(), 0.001, step=5)
+        reporter._tick()
+        assert len(shipped) == 6
+        # partial trailing line is left for the next tick
+        with open(p, "a") as f:
+            f.write('{"name": "step", "ph": "X"')
+        reporter._tick()
+        assert len(shipped) == 6
+
+    def test_connection_error_reships_only_undelivered(
+        self, tmp_path
+    ):
+        from dlrover_tpu.agent.monitor import TimelineReporter
+
+        p = str(tmp_path / "ev.jsonl")
+        log = EventLogger(path=p, job="j")
+        for i in range(4):
+            log.complete("step", time.time(), 0.001, step=i)
+
+        shipped = []
+
+        class FlakyClient:
+            calls = 0
+
+            def report_timeline_events(self, events):
+                FlakyClient.calls += 1
+                if FlakyClient.calls == 2:
+                    raise ConnectionError("master away")
+                shipped.extend(events)
+                return True
+
+        reporter = TimelineReporter(
+            p, client=FlakyClient(), max_batch=2
+        )
+        with pytest.raises(ConnectionError):
+            reporter._tick()  # batch 1 delivered, batch 2 raised
+        assert len(shipped) == 2
+        reporter._tick()  # only the undelivered tail re-ships
+        assert len(shipped) == 4
+        steps = [e["labels"]["step"] for e in shipped]
+        assert steps == [0, 1, 2, 3]  # no duplicates, no loss
+
+    def test_rejected_batch_dropped_not_looped(self, tmp_path):
+        from dlrover_tpu.agent.monitor import TimelineReporter
+
+        p = str(tmp_path / "ev.jsonl")
+        log = EventLogger(path=p, job="j")
+        log.complete("step", time.time(), 0.001, step=1)
+
+        attempts = []
+
+        class RefusingClient:
+            def report_timeline_events(self, events):
+                attempts.append(len(events))
+                return False  # old master / no aggregator
+
+        reporter = TimelineReporter(p, client=RefusingClient())
+        reporter._tick()
+        reporter._tick()  # must not re-ship the refused batch forever
+        assert attempts == [1]
+
+
+@pytest.mark.timeout(600)
+def test_kill_one_worker_timeline_attribution():
+    """Kill-one-worker integration on the real two-process elastic
+    harness: the merged timeline must show BOTH incarnations with a
+    ``restart`` span between them, and the ledger must attribute loss
+    to the restart/rendezvous/checkpoint_restore family with losses
+    summing (±1%) to ``wall − useful``."""
+    import bench_goodput
+
+    kwargs = dict(
+        target_steps=30,
+        faults=((10, "sigkill"),),
+        step_sleep=0.08,
+        timeout=240,
+    )
+    try:
+        result = bench_goodput.run_goodput(**kwargs)
+    except RuntimeError:
+        # one retry: a saturated CI can stretch the restart window
+        # past the deadline without any product fault
+        result = bench_goodput.run_goodput(**kwargs)
+
+    events = read_events(result["events_file"])
+    assert events, "no timeline events written"
+    ledger = result["ledger"]
+
+    # both incarnations present, correlated by the inc label
+    step_incs = {
+        e["inc"]
+        for e in events
+        if e["name"] == "step" and e["ph"] == "X"
+    }
+    assert len(step_incs) >= 2, step_incs
+
+    # a restart span sits BETWEEN the two incarnations' steps and
+    # carries the new incarnation's id
+    ivs = pair_spans(events)
+    restarts = [iv for iv in ivs if iv["phase"] == "restart"]
+    assert restarts, "no restart span on the timeline"
+    inc0_last = max(
+        iv["end"]
+        for iv in ivs
+        if iv["phase"] == "step" and iv["inc"] == min(step_incs)
+    )
+    inc1_first = min(
+        iv["start"]
+        for iv in ivs
+        if iv["phase"] == "step" and iv["inc"] == max(step_incs)
+    )
+    spanning = [
+        iv
+        for iv in restarts
+        if iv["start"] >= inc0_last - 1.0
+        and iv["end"] <= inc1_first + 1.0
+    ]
+    assert spanning, (restarts, inc0_last, inc1_first)
+    assert any(
+        iv["inc"] in step_incs and iv["inc"] > min(step_incs)
+        for iv in restarts
+    ), "restart span not correlated with the new incarnation id"
+
+    # loss attributed to the restart family
+    loss = ledger["loss_breakdown"]
+    fault_family = (
+        loss.get("restart", 0.0)
+        + loss.get("rendezvous", 0.0)
+        + loss.get("checkpoint_restore", 0.0)
+        + loss.get("compile", 0.0)
+    )
+    assert fault_family > 0.0, loss
+
+    # the invariant, at the spec's ±1% of wall
+    assert abs(
+        sum(loss.values()) - (ledger["wall_s"] - ledger["useful_s"])
+    ) <= 0.01 * ledger["wall_s"] + 1e-6
+    assert 0.0 < ledger["goodput"] <= 1.0

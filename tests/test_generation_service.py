@@ -78,6 +78,23 @@ class TestCrossProcessGeneration:
             assert stats["handoff_s"] > 0
         assert engine.publish_s > 0
 
+    def test_dead_worker_fails_fast(self, engine):
+        """A killed worker must fail generate() immediately with its
+        exit code — not block the trainer for the full 600 s queue
+        timeout (ADVICE-r5)."""
+        import signal
+        import time
+
+        engine._proc.send_signal(signal.SIGKILL)
+        engine._proc.wait(timeout=30)
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="died with exit code"):
+            engine.generate(
+                np.array([[1, 2]], dtype=np.int32), seed=0
+            )
+        # poll interval is 2s: detection must be near-immediate
+        assert time.monotonic() - t0 < 30
+
     def test_same_version_skips_handoff(self, engine):
         cfg = LlamaConfig(**CFG_KW)
         engine.sync_weights(init_params(jax.random.PRNGKey(3), cfg))

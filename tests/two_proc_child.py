@@ -92,10 +92,13 @@ def main() -> int:
     pushed = manager.backup(payload)
     result["replicas_pushed"] = pushed
 
-    # barrier so both pushes land before any wipe
-    from jax.experimental import multihost_utils
+    # barrier so both pushes land before any wipe (control-plane:
+    # CPU worlds have no multiprocess XLA computations)
+    from dlrover_tpu.trainer.elastic.context import (
+        control_plane_barrier,
+    )
 
-    multihost_utils.sync_global_devices("replica_pushed")
+    control_plane_barrier("replica_pushed")
 
     if RANK == 1:
         # simulate the relaunched node: local store wiped, shard must
@@ -105,7 +108,7 @@ def main() -> int:
         result["replica_restored"] = (
             restored == payload if restored is not None else False
         )
-    multihost_utils.sync_global_devices("replica_done")
+    control_plane_barrier("replica_done")
     service.stop()
 
     with open(os.path.join(WORKDIR, f"result_{RANK}.json"), "w") as f:
