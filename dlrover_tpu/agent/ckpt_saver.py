@@ -181,10 +181,13 @@ class AsyncCheckpointSaver:
         A shard whose shm snapshot is at a different step makes the
         whole save fail — persisting a mixed-step checkpoint would
         silently corrupt a later restore."""
+        t0_wall, t0_mono = time.time(), time.monotonic()
         root = root or self.config.checkpoint_dir
         stage = self._stage_dir(root, step)
         self._storage.safe_makedirs(stage)
         ok = True
+        persisted_bytes = 0
+        io_seconds = 0.0  # pure dump time: lock waits excluded
         for local_rank, handler in enumerate(self._shm_handlers):
             global_rank = self._global_rank(local_rank)
             lock = self._locks[local_rank]
@@ -210,15 +213,45 @@ class AsyncCheckpointSaver:
                 path = os.path.join(
                     stage, f"shard_{global_rank}.drckpt"
                 )
-                ok = (
-                    handler.dump_to_file(path, self._storage, step=step)
-                    and ok
+                t_io = time.monotonic()
+                nbytes = handler.dump_to_file(
+                    path, self._storage, step=step
                 )
+                if nbytes is None:
+                    ok = False
+                else:
+                    persisted_bytes += nbytes
+                    io_seconds += time.monotonic() - t_io
             finally:
                 lock.release()
         if not ok:
             logger.error("step %s: some shards failed to persist", step)
             return False
+        # persist-side data-plane visibility: the streamed
+        # shm->storage write as a checkpoint_save span (async in the
+        # agent, so overlapping train steps still charge the step in
+        # the ledger) plus throughput gauges.  Span duration is full
+        # wall (ledger input); throughput_gbps is computed from PURE
+        # dump time so a trainer holding a shard lock for 50 s cannot
+        # make a healthy storage write look like a bandwidth
+        # regression.
+        from dlrover_tpu.common.parallel_io import throughput_gbps
+        from dlrover_tpu.observability.events import get_event_logger
+        from dlrover_tpu.observability.metrics import record_ckpt_io
+
+        persist_dur = time.monotonic() - t0_mono
+        get_event_logger().complete(
+            "checkpoint_save",
+            t0_wall,
+            persist_dur,
+            step=step,
+            bytes=persisted_bytes,
+            throughput_gbps=throughput_gbps(
+                persisted_bytes, io_seconds
+            ),
+            stage="persist",
+        )
+        record_ckpt_io("persist", persisted_bytes, io_seconds)
         self._write_done_file(stage)
         if self.config.node_rank == 0:
             committed = self.commit_checkpoint(step, root)

@@ -70,9 +70,22 @@ class CheckpointStorage(metaclass=ABCMeta):
         ...
 
     def write_chunks(self, chunks, path: str):
-        """Write a sequence of byte-like chunks as one file. Default
+        """Write an iterable of byte-like chunks as one file. Default
         joins in memory; byte-addressable backends should stream."""
         self.write(b"".join(bytes(c) for c in chunks), path)
+
+    def open_read(self, path: str):
+        """A binary file-like handle for streaming reads (the restore
+        path fills a preallocated buffer chunk by chunk instead of
+        materializing the whole object).  Default buffers the full
+        read; real backends override with a true stream.  Raises
+        FileNotFoundError on absence."""
+        import io
+
+        data = self.read(path, "rb")
+        if not data and not self.exists(path):
+            raise FileNotFoundError(path)
+        return io.BytesIO(data)
 
     @abstractmethod
     def read(self, path: str, mode: str = "r"):
@@ -138,6 +151,9 @@ class PosixDiskStorage(CheckpointStorage):
         with open(path, mode) as f:
             return f.read()
 
+    def open_read(self, path: str):
+        return open(path, "rb")
+
     def safe_rmtree(self, dir_path: str):
         shutil.rmtree(dir_path, ignore_errors=True)
 
@@ -200,6 +216,12 @@ class FsspecStorage(CheckpointStorage):
         with self._fs.open(self._p(path), "wb") as f:
             for chunk in chunks:
                 f.write(bytes(chunk))
+
+    def open_read(self, path: str):
+        # a true stream: fsspec buffers block-sized reads, so the
+        # restore path never holds shard-sized bytes besides its own
+        # destination buffer
+        return self._fs.open(self._p(path), "rb")
 
     def read(self, path: str, mode: str = "r"):
         p = self._p(path)
@@ -292,6 +314,9 @@ class StorageWithDeletion(CheckpointStorage):
 
     def read(self, path: str, mode: str = "r"):
         return self._base.read(path, mode)
+
+    def open_read(self, path: str):
+        return self._base.open_read(path)
 
     def safe_rmtree(self, dir_path: str):
         self._base.safe_rmtree(dir_path)

@@ -90,8 +90,11 @@ INSTANT_EVENTS = frozenset(
 #: ``scripts/check_event_schema.py``.
 REQUIRED_SPAN_LABELS: Dict[str, Tuple[str, ...]] = {
     PHASE_STEP: ("step",),
-    PHASE_CHECKPOINT_SAVE: ("step",),
-    PHASE_CHECKPOINT_RESTORE: ("step",),
+    # checkpoint data-plane spans carry their size and measured
+    # bandwidth so throughput regressions surface in the ledger and
+    # in bench_goodput's loss breakdown, not only in wall time
+    PHASE_CHECKPOINT_SAVE: ("step", "bytes", "throughput_gbps"),
+    PHASE_CHECKPOINT_RESTORE: ("step", "bytes", "throughput_gbps"),
     PHASE_RESTART: ("reason",),
     PHASE_PREEMPTION_DRAIN: ("event",),
 }

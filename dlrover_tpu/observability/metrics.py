@@ -143,6 +143,26 @@ def set_default_registry(registry: MetricsRegistry):
         _default_registry = registry
 
 
+def record_ckpt_io(kind: str, nbytes: int, seconds: float):
+    """Export one checkpoint data-plane measurement as gauges
+    (``dlrover_tpu_ckpt_io_gbps{kind=...}`` / ``_bytes{kind=...}``).
+    ``kind``: drain | restore | persist | prealloc.  Never raises —
+    metrics must not break a save."""
+    try:
+        reg = get_registry()
+        gbps = nbytes / 1e9 / max(seconds, 1e-9)
+        reg.set_gauge(
+            "dlrover_tpu_ckpt_io_gbps", gbps, labels={"kind": kind}
+        )
+        reg.set_gauge(
+            "dlrover_tpu_ckpt_io_bytes",
+            float(nbytes),
+            labels={"kind": kind},
+        )
+    except Exception as e:  # noqa: BLE001
+        logger.warning("ckpt io metric export failed: %s", e)
+
+
 class MetricsExporter:
     """Builds (once) and supervises the native exporter daemon.
 

@@ -281,15 +281,24 @@ class CheckpointEngine:
             nbytes = self._shm_handler.save_state(step, state)
         finally:
             self._lock.release()
+        from dlrover_tpu.common.parallel_io import throughput_gbps
+        from dlrover_tpu.observability.metrics import record_ckpt_io
+
+        dur = time.monotonic() - start_mono
         get_event_logger().complete(
             "checkpoint_save",
             start,
-            time.monotonic() - start_mono,
+            dur,
             step=step,
+            bytes=nbytes,
+            throughput_gbps=throughput_gbps(nbytes, dur),
         )
+        record_ckpt_io("drain", nbytes, dur)
         logger.info(
-            "rank %s: step %s snapshot (%.1f MB) to shm in %.3fs",
-            self._rank, step, nbytes / 1e6, time.time() - start,
+            "rank %s: step %s snapshot (%.1f MB) to shm in %.3fs "
+            "(%.2f GB/s)",
+            self._rank, step, nbytes / 1e6, dur,
+            throughput_gbps(nbytes, dur),
         )
         if persist_dir is not None:
             self._event_queue.put(
@@ -385,17 +394,27 @@ class CheckpointEngine:
                 f"{agreed} unavailable locally (shm={shm_step} "
                 f"storage={storage_step})"
             )
+        restored_bytes = sum(
+            int(getattr(v, "nbytes", 0)) for v in arrays.values()
+        )
         if target is not None:
             # copy_host guards non-device leaves from aliasing live shm
             arrays = restore_to_target(
                 target, arrays, copy_host=zero_copy
             )
+        from dlrover_tpu.common.parallel_io import throughput_gbps
+        from dlrover_tpu.observability.metrics import record_ckpt_io
+
+        dur = time.monotonic() - t0_mono
         get_event_logger().complete(
             "checkpoint_restore",
             t0_wall,
-            time.monotonic() - t0_mono,
+            dur,
             step=agreed,
+            bytes=restored_bytes,
+            throughput_gbps=throughput_gbps(restored_bytes, dur),
         )
+        record_ckpt_io("restore", restored_bytes, dur)
         return step, arrays
 
     def _sync_restore_step(self, shm_steps, storage_step: int) -> int:
@@ -529,12 +548,21 @@ class CheckpointEngine:
         return int(content) if content else -1
 
     def wait_for_persist(self, step: int, timeout: float = 120) -> bool:
+        """Block until the tracker shows ``step`` persisted.
+
+        Exponential backoff (0.1 s → 2 s cap): each poll is a storage
+        read, and on a remote tracker (gs://) a flat 100 ms cadence
+        hammers the object store for the full timeout."""
         deadline = time.time() + timeout
+        delay = 0.1
         while time.time() < deadline:
             if self.latest_persisted_step() >= step:
                 return True
-            time.sleep(0.1)
-        return False
+            time.sleep(min(delay, max(deadline - time.time(), 0.01)))
+            delay = min(delay * 2, 2.0)
+        # one post-deadline read: the persist may have landed during
+        # the final (long) sleep
+        return self.latest_persisted_step() >= step
 
     def close(self):
         self.wait_for_snapshot(timeout=300)

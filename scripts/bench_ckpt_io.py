@@ -1,0 +1,184 @@
+"""Micro-benchmark for the flash-checkpoint data plane.
+
+Measures the four host-side hot loops in isolation — shm drain
+(``save_state``), restore copy (``load_state(copy=True)``), segment
+preallocation (``preallocate``) and persist streaming
+(``dump_to_file``) — on a synthetic state, once with the configured
+worker pool and once pinned serial (``DLROVER_TPU_CKPT_COPY_WORKERS=1``,
+the byte-identical pre-parallel path).  GB/s per phase + speedups as
+JSON to ``--out`` and stdout.
+
+Usage::
+
+    python scripts/bench_ckpt_io.py [--state_mb 256] [--out OUT.json]
+
+No device, no agent, no saver process: pure data-plane numbers, so a
+regression here is a regression in ``parallel_io``/``ckpt_shm``, not
+in the device link or storage backend.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+from dlrover_tpu.agent.ckpt_shm import (  # noqa: E402
+    SharedMemoryHandler,
+    read_shard_file,
+)
+from dlrover_tpu.common.parallel_io import (  # noqa: E402
+    CHUNK_MB_ENV,
+    COPY_WORKERS_ENV,
+    copy_workers,
+)
+from dlrover_tpu.common.storage import PosixDiskStorage  # noqa: E402
+
+
+def _gbps(nbytes: int, seconds: float) -> float:
+    return round(nbytes / 1e9 / max(seconds, 1e-9), 3)
+
+
+def synthetic_state(nbytes: int, n_leaves: int = 2) -> dict:
+    """A model-shaped synthetic state: few large float64 leaves, so
+    each leaf splits across the worker pool (the shape the drain
+    pipeline is built for).  Shared with ``bench.py``'s per-round
+    drain comparison — one definition of the measured state."""
+    leaf = max(nbytes // n_leaves // 8, 1)
+    return {
+        f"l{i}": np.full(leaf, float(i + 1), np.float64)
+        for i in range(n_leaves)
+    }
+
+
+def timed_drain_gbps(handler: SharedMemoryHandler, state: dict,
+                     total: int, preallocate: bool = True) -> float:
+    """Best-of-2 ``save_state`` drain throughput after warming both
+    double-buffer slots' pages (the steady-state number: a training
+    job's segment is preallocated and slot pages stay resident)."""
+    if preallocate:
+        handler.preallocate(total)
+    handler.save_state(0, state)  # warm the second slot's pages
+    handler.save_state(1, state)
+    best = float("inf")
+    for step in (2, 3):
+        t0 = time.perf_counter()
+        handler.save_state(step, state)
+        best = min(best, time.perf_counter() - t0)
+    return _gbps(total, best)
+
+
+def _bench_one(name: str, state: dict, total: int,
+               persist_dir: str) -> dict:
+    """One full pass (prealloc -> drains -> restore -> persist) with
+    whatever worker config is currently in the environment."""
+    out = {"workers": copy_workers()}
+    handler = SharedMemoryHandler(0, name=name, host=True)
+    storage = PosixDiskStorage()
+    try:
+        t0 = time.perf_counter()
+        handler.preallocate(total)
+        # prealloc zero-fills both double-buffer slots
+        out["prealloc_gbps"] = _gbps(
+            2 * total, time.perf_counter() - t0
+        )
+
+        out["drain_gbps"] = timed_drain_gbps(
+            handler, state, total, preallocate=False
+        )
+
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            step, arrays = handler.load_state(copy=True)
+            best = min(best, time.perf_counter() - t0)
+            del arrays
+        assert step == 3
+        out["restore_gbps"] = _gbps(total, best)
+
+        path = os.path.join(persist_dir, f"{name}.drckpt")
+        t0 = time.perf_counter()
+        assert handler.dump_to_file(path, storage) is not None
+        out["persist_gbps"] = _gbps(total, time.perf_counter() - t0)
+
+        t0 = time.perf_counter()
+        step, arrays = read_shard_file(path)
+        out["shard_read_gbps"] = _gbps(
+            total, time.perf_counter() - t0
+        )
+        assert step == 3 and arrays
+    finally:
+        handler.close(unlink=True)
+    return out
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="flash-checkpoint data-plane micro-benchmark"
+    )
+    parser.add_argument("--state_mb", type=int, default=256)
+    parser.add_argument("--out", default="")
+    args = parser.parse_args(argv)
+
+    os.environ.setdefault(
+        "DLROVER_TPU_SOCKET_DIR",
+        tempfile.mkdtemp(prefix="dlrover_benchio_socks_"),
+    )
+    persist_dir = tempfile.mkdtemp(prefix="dlrover_benchio_ckpt_")
+
+    nbytes = args.state_mb * 1024 * 1024
+    # two model-scale leaves so each splits across the pool; 16 MB
+    # chunks keep every worker fed even at small --state_mb
+    state = synthetic_state(nbytes)
+    total = sum(a.nbytes for a in state.values())
+    prev_chunk = os.environ.get(CHUNK_MB_ENV)
+    if prev_chunk is None:
+        os.environ[CHUNK_MB_ENV] = "16"
+
+    prev_workers = os.environ.get(COPY_WORKERS_ENV)
+    result = {
+        "state_mb": round(total / 1e6, 1),
+        "cpu_count": os.cpu_count(),
+        "chunk_mb": int(os.environ[CHUNK_MB_ENV]),
+    }
+    try:
+        result["parallel"] = _bench_one(
+            "benchio_par", state, total, persist_dir
+        )
+        os.environ[COPY_WORKERS_ENV] = "1"
+        result["serial"] = _bench_one(
+            "benchio_ser", state, total, persist_dir
+        )
+    finally:
+        for env, prev in (
+            (COPY_WORKERS_ENV, prev_workers),
+            (CHUNK_MB_ENV, prev_chunk),
+        ):
+            if prev is None:
+                os.environ.pop(env, None)
+            else:
+                os.environ[env] = prev
+    for phase in ("prealloc", "drain", "restore", "persist",
+                  "shard_read"):
+        ser = result["serial"].get(f"{phase}_gbps", 0)
+        par = result["parallel"].get(f"{phase}_gbps", 0)
+        if ser:
+            result[f"{phase}_speedup"] = round(par / ser, 2)
+
+    print(json.dumps(result), flush=True)
+    if args.out:
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(result, f, indent=1)
+        os.replace(tmp, args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

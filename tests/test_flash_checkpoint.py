@@ -248,6 +248,26 @@ def _crashing_trainer(ckpt_dir, sock_dir):
     os._exit(1)  # crash without cleanup
 
 
+def _crashing_parallel_trainer(ckpt_dir, sock_dir):
+    """Like _crashing_trainer, but drains through the chunk-parallel
+    pipeline (multi-MB leaves split across the worker pool) before
+    dying — the snapshot the agent flushes must be complete even
+    though the writer's pool threads died with it."""
+    os.environ["DLROVER_TPU_SOCKET_DIR"] = sock_dir
+    os.environ["DLROVER_TPU_CKPT_COPY_WORKERS"] = "4"
+    os.environ["DLROVER_TPU_CKPT_CHUNK_MB"] = "1"
+    from dlrover_tpu.agent.ckpt_shm import SharedMemoryHandler as H
+
+    handler = H(0, name="pcrash", host=False)
+    state = {
+        "big": np.arange(4 * 1024 * 1024, dtype=np.float64),  # 32 MB
+        "w": np.full((8, 8), 3.5, np.float32),
+        "step": np.int64(88),
+    }
+    handler.save_state(88, state)
+    os._exit(1)  # crash without cleanup
+
+
 class TestCrashSurvival:
     def test_agent_flushes_after_trainer_crash(self, tmp_ckpt_dir):
         """The agent-side saver persists the shm snapshot of a training
@@ -281,6 +301,48 @@ class TestCrashSurvival:
             np.testing.assert_array_equal(
                 arrays["['w']"],
                 np.arange(64, dtype=np.float32).reshape(8, 8),
+            )
+        finally:
+            saver.close(unlink=True)
+            AsyncCheckpointSaver._instance = None
+
+    def test_agent_flushes_parallel_drain_after_crash(
+        self, tmp_ckpt_dir
+    ):
+        """Kill-one-worker under the PARALLEL data plane: a trainer
+        that drained its snapshot through the chunk-parallel pipeline
+        dies; the agent's emergency flush persists a complete,
+        correct shard and a fresh process restores it."""
+        sock_dir = os.environ["DLROVER_TPU_SOCKET_DIR"]
+        config = SaverConfig(
+            checkpoint_dir=tmp_ckpt_dir,
+            local_shard_num=1,
+            global_shard_num=1,
+            node_rank=0,
+            name="pcrash",
+        )
+        saver = AsyncCheckpointSaver(config)
+        saver.start()
+        try:
+            proc = mp.get_context("spawn").Process(
+                target=_crashing_parallel_trainer,
+                args=(tmp_ckpt_dir, sock_dir),
+            )
+            proc.start()
+            proc.join(timeout=120)
+            assert proc.exitcode == 1  # it crashed as intended
+            assert saver.save_shm_to_storage(reason="worker crash")
+            final = os.path.join(tmp_ckpt_dir, "checkpoint-88")
+            step, arrays = read_shard_file(
+                os.path.join(final, "shard_0.drckpt")
+            )
+            assert step == 88
+            np.testing.assert_array_equal(
+                arrays["['big']"],
+                np.arange(4 * 1024 * 1024, dtype=np.float64),
+            )
+            np.testing.assert_array_equal(
+                arrays["['w']"], np.full((8, 8), 3.5, np.float32)
             )
         finally:
             saver.close(unlink=True)

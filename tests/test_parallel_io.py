@@ -1,0 +1,458 @@
+"""Parallel checkpoint data-plane tests: chunked copy/fill
+correctness, workers=1 vs N equivalence, pipelined-vs-serial drain
+round-trips, byte-identical shard files, and the throughput labels the
+timeline spans must carry (ISSUE 2 acceptance)."""
+
+import pickle
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.common import parallel_io
+from dlrover_tpu.common.parallel_io import (
+    CHUNK_MB_ENV,
+    COPY_WORKERS_ENV,
+    chunked_iter,
+    parallel_fill,
+    parallel_memcpy,
+)
+
+
+class TestChunkedIter:
+    def test_covers_range_exactly(self):
+        spans = list(chunked_iter(100, 30))
+        assert spans == [(0, 30), (30, 30), (60, 30), (90, 10)]
+
+    def test_single_chunk(self):
+        assert list(chunked_iter(5, 30)) == [(0, 5)]
+
+    def test_empty(self):
+        assert list(chunked_iter(0, 30)) == []
+
+    def test_exact_multiple_no_tail(self):
+        spans = list(chunked_iter(90, 30))
+        assert spans == [(0, 30), (30, 30), (60, 30)]
+        assert sum(n for _, n in spans) == 90
+
+
+class TestParallelMemcpy:
+    @pytest.mark.parametrize("nbytes", [
+        0, 1, 7, 4096, 4097,             # tiny / odd
+        1 << 20,                          # 1 MB (serial fallback)
+        (1 << 20) * 3 + 13,               # odd size spanning chunks
+    ])
+    def test_roundtrip_odd_sizes(self, nbytes):
+        rng = np.random.default_rng(nbytes)
+        src = rng.integers(0, 256, nbytes, dtype=np.uint8)
+        dst = np.zeros(nbytes, dtype=np.uint8)
+        copied = parallel_memcpy(dst, src, workers=4, chunk=1 << 18)
+        assert copied == nbytes
+        np.testing.assert_array_equal(dst, src)
+
+    def test_chunk_boundary_exact_multiple(self):
+        chunk = 1 << 16
+        src = np.arange(4 * chunk, dtype=np.uint8)
+        dst = np.zeros_like(src)
+        parallel_memcpy(dst, src, workers=3, chunk=chunk)
+        np.testing.assert_array_equal(dst, src)
+
+    def test_workers_one_equals_workers_n(self):
+        rng = np.random.default_rng(0)
+        src = rng.random(3_000_017).astype(np.float64)
+        d1 = np.empty_like(src)
+        dn = np.empty_like(src)
+        parallel_memcpy(d1, src, workers=1, chunk=1 << 20)
+        parallel_memcpy(dn, src, workers=8, chunk=1 << 20)
+        assert d1.tobytes() == dn.tobytes()
+
+    def test_typed_views(self):
+        # float32 dst over a shm-like bytes buffer
+        buf = bytearray(64)
+        dst = np.ndarray((16,), dtype=np.float32, buffer=buf)
+        src = np.arange(16, dtype=np.float32)
+        parallel_memcpy(dst, src, workers=2)
+        np.testing.assert_array_equal(dst, src)
+
+    def test_size_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            parallel_memcpy(np.zeros(4, np.uint8),
+                            np.zeros(5, np.uint8))
+
+    def test_non_contiguous_raises(self):
+        a = np.zeros((8, 8))[::2]
+        with pytest.raises(ValueError):
+            parallel_memcpy(a, np.zeros(32))
+
+
+class TestParallelFill:
+    @pytest.mark.parametrize("nbytes", [1, 8191, (1 << 20) + 3])
+    def test_fill_odd_sizes(self, nbytes):
+        dst = np.full(nbytes, 0xAB, dtype=np.uint8)
+        touched = parallel_fill(dst, 0, workers=4, chunk=1 << 18)
+        assert touched == nbytes
+        assert not dst.any()
+
+    def test_fill_value(self):
+        dst = np.zeros(1 << 19, dtype=np.uint8)
+        parallel_fill(dst, 7, workers=3, chunk=1 << 16)
+        assert (dst == 7).all()
+
+
+class TestEnvTunables:
+    def test_workers_env(self, monkeypatch):
+        monkeypatch.setenv(COPY_WORKERS_ENV, "3")
+        assert parallel_io.copy_workers() == 3
+        monkeypatch.setenv(COPY_WORKERS_ENV, "0")
+        assert parallel_io.copy_workers() == 1  # floor
+        monkeypatch.setenv(COPY_WORKERS_ENV, "junk")
+        assert parallel_io.copy_workers() >= 1
+
+    def test_chunk_env(self, monkeypatch):
+        monkeypatch.setenv(CHUNK_MB_ENV, "2")
+        assert parallel_io.chunk_nbytes() == 2 * 1024 * 1024
+        monkeypatch.setenv(CHUNK_MB_ENV, "0")
+        assert parallel_io.chunk_nbytes() == 1024 * 1024  # floor 1 MB
+
+
+def _random_pytree(seed=0):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    return {
+        "params": {
+            "w": jnp.asarray(
+                rng.standard_normal((37, 53)).astype(np.float32)
+            ),
+            "b": jnp.asarray(
+                rng.standard_normal(101).astype(np.float32)
+            ).astype(jnp.bfloat16),
+        },
+        "opt": {
+            "mu": rng.standard_normal((64, 3)).astype(np.float64),
+            "nu": rng.integers(0, 9, 17, dtype=np.int32),
+        },
+        "step": np.int64(11),
+    }
+
+
+class TestPipelinedDrainRoundTrip:
+    """save_state's two-stage pipeline vs the workers=1 serial path:
+    identical restored arrays AND byte-identical persisted shards."""
+
+    def _drain(self, monkeypatch, tmp_path, name, workers):
+        from dlrover_tpu.agent.ckpt_shm import SharedMemoryHandler
+        from dlrover_tpu.common.storage import PosixDiskStorage
+
+        monkeypatch.setenv(COPY_WORKERS_ENV, str(workers))
+        # small chunk so the test state actually exercises splitting
+        monkeypatch.setenv(CHUNK_MB_ENV, "1")
+        handler = SharedMemoryHandler(0, name=name, host=True)
+        try:
+            state = _random_pytree()
+            handler.save_state(11, state)
+            step, arrays = handler.load_state(copy=True)
+            assert step == 11
+            path = str(tmp_path / f"{name}.drckpt")
+            assert handler.dump_to_file(
+                path, PosixDiskStorage()
+            ) is not None
+        finally:
+            handler.close(unlink=True)
+        return arrays, open(path, "rb").read()
+
+    def test_serial_and_parallel_agree(self, monkeypatch, tmp_path):
+        serial_arrays, serial_bytes = self._drain(
+            monkeypatch, tmp_path, "pio_ser", 1
+        )
+        par_arrays, par_bytes = self._drain(
+            monkeypatch, tmp_path, "pio_par", 4
+        )
+        assert serial_arrays.keys() == par_arrays.keys()
+        for key in serial_arrays:
+            np.testing.assert_array_equal(
+                np.asarray(serial_arrays[key], dtype=np.float64)
+                if serial_arrays[key].dtype.kind == "f"
+                else serial_arrays[key],
+                np.asarray(par_arrays[key], dtype=np.float64)
+                if par_arrays[key].dtype.kind == "f"
+                else par_arrays[key],
+            )
+        # the persisted shard is byte-identical: the parallel data
+        # plane is a pure speed knob, never a format change
+        assert serial_bytes == par_bytes
+
+    def test_workers1_matches_reference_serial_format(
+        self, monkeypatch, tmp_path
+    ):
+        """workers=1 must produce exactly the pre-change serial file
+        layout: 8-byte header length + pickled {step, specs} + leaf
+        bytes concatenated at their spec offsets."""
+        _arrays, file_bytes = self._drain(
+            monkeypatch, tmp_path, "pio_ref", 1
+        )
+        hdr_struct = struct.Struct("<Q")
+        (hdr_len,) = hdr_struct.unpack(file_bytes[: hdr_struct.size])
+        meta = pickle.loads(
+            file_bytes[hdr_struct.size : hdr_struct.size + hdr_len]
+        )
+        assert meta["step"] == 11
+        base = hdr_struct.size + hdr_len
+        # reference construction from the source pytree, serially
+        import jax
+
+        flat, _ = jax.tree_util.tree_flatten_with_path(
+            _random_pytree()
+        )
+        expected = b"".join(
+            np.asarray(leaf).tobytes() for _p, leaf in flat
+        )
+        assert file_bytes[base:] == expected
+        # and the header is the exact reference pickle
+        assert file_bytes[:base] == hdr_struct.pack(
+            len(pickle.dumps({"step": 11, "specs": meta["specs"]}))
+        ) + pickle.dumps({"step": 11, "specs": meta["specs"]})
+
+
+class TestReadShardFile:
+    def test_streamed_read_matches(self, monkeypatch, tmp_path):
+        from dlrover_tpu.agent.ckpt_shm import (
+            SharedMemoryHandler,
+            read_shard_file,
+        )
+        from dlrover_tpu.common.storage import PosixDiskStorage
+
+        handler = SharedMemoryHandler(0, name="pio_read", host=True)
+        try:
+            state = _random_pytree(3)
+            handler.save_state(4, state)
+            path = str(tmp_path / "s.drckpt")
+            handler.dump_to_file(path, PosixDiskStorage())
+        finally:
+            handler.close(unlink=True)
+        # tiny chunk: the streamed read crosses many chunk boundaries
+        monkeypatch.setenv(CHUNK_MB_ENV, "1")
+        step, arrays = read_shard_file(path)
+        assert step == 4
+        np.testing.assert_array_equal(
+            arrays["['opt']['mu']"],
+            np.asarray(state["opt"]["mu"]),
+        )
+        # arrays are private (standalone), not mmapped file views
+        arrays["['opt']['mu']"][0, 0] = 123.0
+
+    def test_missing_file(self, tmp_path):
+        from dlrover_tpu.agent.ckpt_shm import read_shard_file
+        from dlrover_tpu.common.storage import PosixDiskStorage
+
+        # storage-mediated absence -> "no checkpoint" (old
+        # storage.read()->b"" semantics)
+        step, arrays = read_shard_file(
+            str(tmp_path / "nope.drckpt"), PosixDiskStorage()
+        )
+        assert step == -1 and arrays == {}
+        # bare local path keeps raising loudly (pre-change behavior;
+        # a shard vanishing mid-merge must not yield a partial export)
+        with pytest.raises(FileNotFoundError):
+            read_shard_file(str(tmp_path / "nope.drckpt"))
+
+    def test_truncated_file(self, tmp_path):
+        from dlrover_tpu.agent.ckpt_shm import (
+            SharedMemoryHandler,
+            read_shard_file,
+        )
+        from dlrover_tpu.common.storage import PosixDiskStorage
+
+        handler = SharedMemoryHandler(0, name="pio_trunc", host=True)
+        try:
+            handler.save_state(1, {"x": np.ones(4096, np.float64)})
+            path = str(tmp_path / "t.drckpt")
+            handler.dump_to_file(path, PosixDiskStorage())
+        finally:
+            handler.close(unlink=True)
+        whole = open(path, "rb").read()
+        open(path, "wb").write(whole[: len(whole) - 100])
+        step, arrays = read_shard_file(path)
+        assert step == -1 and arrays == {}
+
+    def test_storage_stream_fallback_without_readinto(self, tmp_path):
+        """A storage whose open_read handle lacks readinto still
+        streams correctly (chunked read() fallback)."""
+        from dlrover_tpu.agent.ckpt_shm import (
+            SharedMemoryHandler,
+            read_shard_file,
+        )
+        from dlrover_tpu.common.storage import PosixDiskStorage
+
+        class NoReadinto:
+            def __init__(self, f):
+                self._f = f
+
+            def read(self, n=-1):
+                return self._f.read(n)
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *exc):
+                self._f.close()
+
+        class Wrapped(PosixDiskStorage):
+            def open_read(self, path):
+                return NoReadinto(open(path, "rb"))
+
+        handler = SharedMemoryHandler(0, name="pio_nori", host=True)
+        try:
+            state = {"w": np.arange(5000, dtype=np.float32)}
+            handler.save_state(2, state)
+            path = str(tmp_path / "w.drckpt")
+            handler.dump_to_file(path, PosixDiskStorage())
+        finally:
+            handler.close(unlink=True)
+        step, arrays = read_shard_file(path, Wrapped())
+        assert step == 2
+        np.testing.assert_array_equal(arrays["['w']"], state["w"])
+
+
+class TestEnsureShmGrowth:
+    def test_grow_over_stale_segment(self):
+        """A stale same-name segment (dead predecessor) must not make
+        segment growth raise FileExistsError: unlink-then-recreate."""
+        from dlrover_tpu.agent.ckpt_shm import (
+            SHM_PREFIX,
+            SharedMemoryHandler,
+        )
+        from dlrover_tpu.common.multi_process import SharedMemory
+
+        name = f"{SHM_PREFIX}_growfix_0"
+        stale = SharedMemory(name, create=True, size=4096)
+        stale.close()
+        handler = SharedMemoryHandler(0, name="growfix", host=True)
+        try:
+            handler._ensure_shm(1 << 20)  # grow past the stale 4 KiB
+            assert handler._shm.size >= 1 << 20
+            handler.save_state(1, {"a": np.ones(2048, np.float64)})
+            step, arrays = handler.load_state()
+            assert step == 1
+            assert arrays["['a']"].shape == (2048,)
+        finally:
+            handler.close(unlink=True)
+
+    def test_relaunched_writer_preserves_predecessor_snapshot(self):
+        """A relaunched training process (fresh handler, same-size
+        state) must ATTACH the predecessor's segment, not zero it: the
+        double-buffered previous snapshot is the crash-survivable
+        state.  Regression guard: an unlink-then-recreate on the
+        non-growth path returned step-7 meta over all-zero data."""
+        from dlrover_tpu.agent.ckpt_shm import SharedMemoryHandler
+
+        host = SharedMemoryHandler(0, name="relaunch", host=True)
+        try:
+            host.save_state(7, {"w": np.full(4096, 7.0)})
+            # relaunched process: new handler, no mapping yet
+            writer2 = SharedMemoryHandler(0, name="relaunch",
+                                          host=False)
+            writer2.save_state(8, {"w": np.full(4096, 8.0)})
+            assert writer2.steps_available() == [8, 7]
+            step, arrays = writer2.load_state(step=7)
+            assert step == 7
+            assert float(arrays["['w']"][0]) == 7.0  # NOT zeroed
+            step, arrays = writer2.load_state(step=8)
+            assert float(arrays["['w']"][0]) == 8.0
+            writer2.close()
+        finally:
+            host.close(unlink=True)
+
+    def test_repeated_growth(self):
+        from dlrover_tpu.agent.ckpt_shm import SharedMemoryHandler
+
+        handler = SharedMemoryHandler(0, name="growrep", host=True)
+        try:
+            for i, n in enumerate((10, 10_000, 2_000_000)):
+                handler.save_state(i, {"a": np.ones(n, np.float64)})
+                step, arrays = handler.load_state()
+                assert step == i
+                assert arrays["['a']"].size == n
+        finally:
+            handler.close(unlink=True)
+
+
+class TestThroughputSmoke:
+    """Tier-1 smoke (ISSUE 2 satellite): the parallel path must not be
+    slower than serial on a small state, and the engine's timeline
+    spans must carry bytes + throughput_gbps labels."""
+
+    def test_parallel_not_slower_on_small_state(self, monkeypatch):
+        from dlrover_tpu.agent.ckpt_shm import SharedMemoryHandler
+
+        state = {"w": np.ones(512 * 1024, np.float64)}  # 4 MB
+
+        def drain_time(name, workers):
+            monkeypatch.setenv(COPY_WORKERS_ENV, str(workers))
+            handler = SharedMemoryHandler(0, name=name, host=True)
+            try:
+                handler.save_state(0, state)  # warm pages + pool
+                handler.save_state(1, state)
+                best = float("inf")
+                for step in (2, 3, 4):
+                    t0 = time.perf_counter()
+                    handler.save_state(step, state)
+                    best = min(best, time.perf_counter() - t0)
+            finally:
+                handler.close(unlink=True)
+            return best
+
+        serial = drain_time("smoke_ser", 1)
+        parallel = drain_time("smoke_par", 4)
+        # below MIN_PARALLEL_BYTES the parallel config falls back to
+        # the serial copy, so any large gap is a dispatch-overhead
+        # regression; 2.5x bounds CI scheduling noise
+        assert parallel <= max(serial * 2.5, serial + 0.05)
+
+    def test_spans_carry_throughput_labels(
+        self, tmp_ckpt_dir, tmp_path
+    ):
+        from dlrover_tpu.observability.events import (
+            EventLogger,
+            read_events,
+            set_default_event_logger,
+        )
+        from dlrover_tpu.trainer.checkpoint import (
+            Checkpointer,
+            StorageType,
+        )
+
+        events_file = str(tmp_path / "events.jsonl")
+        set_default_event_logger(EventLogger(path=events_file))
+        try:
+            ckpt = Checkpointer(tmp_ckpt_dir, process_rank=0,
+                                process_count=1, node_rank=0,
+                                name="spansmoke")
+            state = _random_pytree(7)
+            assert ckpt.save_checkpoint(11, state, StorageType.DISK)
+            assert ckpt.wait_latest_checkpoint(11, timeout=30)
+            step, _restored = ckpt.load_checkpoint(target=state)
+            assert step == 11
+            ckpt.close()
+        finally:
+            set_default_event_logger(None)
+        events = read_events(events_file)
+        saves = [
+            e for e in events
+            if e["name"] == "checkpoint_save" and e["ph"] == "X"
+        ]
+        restores = [
+            e for e in events
+            if e["name"] == "checkpoint_restore" and e["ph"] == "X"
+        ]
+        assert saves and restores
+        for e in saves + restores:
+            labels = e.get("labels") or {}
+            assert labels.get("bytes", 0) > 0
+            assert labels.get("throughput_gbps", 0) > 0
+        # the persist-side (agent) save span is tagged as such
+        assert any(
+            (e.get("labels") or {}).get("stage") == "persist"
+            for e in saves
+        )
