@@ -211,6 +211,43 @@ def _run_goodput_bench(budget: "BenchBudget" = None) -> dict:
         return {"error": str(e)}
 
 
+def _run_restart_bench(budget: "BenchBudget" = None) -> dict:
+    """Run scripts/bench_restart.py in a subprocess (it builds its own
+    model + engine; isolation keeps its compile/restore work off this
+    process's backend) and return its payload: restart_serial_s vs
+    restart_overlap_s on the same host."""
+    if os.getenv("DLROVER_BENCH_SKIP_RESTART"):
+        return {"skipped": True}
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "scripts", "bench_restart.py",
+    )
+    out_file = os.path.join(
+        tempfile.mkdtemp(prefix="dlrover_bench_restart_"), "out.json"
+    )
+    timeout_s = 600
+    if budget is not None:
+        timeout_s = budget.cap_timeout(600, reserve_s=120)
+    try:
+        proc = subprocess.run(
+            [sys.executable, script, "--out", out_file],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+        parsed = _read_result_file(out_file, proc.stdout)
+        if parsed is not None:
+            return parsed
+        return {
+            "error": f"no JSON output (rc={proc.returncode})",
+            "stderr_tail": proc.stderr[-500:],
+        }
+    except subprocess.TimeoutExpired as e:
+        return {"error": str(e), "partial": _partial_extras(out_file)}
+    except Exception as e:  # noqa: BLE001
+        return {"error": str(e)}
+
+
 def _host_memcpy_gbps(nbytes: int = 256 * 1024 * 1024) -> float:
     """This machine's single-threaded memcpy bandwidth — the floor
     under every host-side number (shm_read, drain memcpy legs).  The
@@ -346,6 +383,17 @@ def main(argv=None) -> int:
     else:
         goodput_bench = _run_goodput_bench(budget)
     extras["goodput"] = goodput_bench
+    flush_partial(args.out, payload)
+    # restart critical path: serial vs overlapped MTTR on this host
+    # (trainer/restart_path.py; scripts/bench_restart.py)
+    if budget.tight(150):
+        restart_bench = {"skipped": "budget"}
+    else:
+        restart_bench = _run_restart_bench(budget)
+    extras["restart"] = restart_bench
+    for key in ("restart_serial_s", "restart_overlap_s"):
+        if isinstance(restart_bench.get(key), (int, float)):
+            extras[key] = restart_bench[key]
     flush_partial(args.out, payload)
     memcpy_gbps = _host_memcpy_gbps()
     fault_gbps = _host_fault_gbps()

@@ -345,12 +345,51 @@ def run_goodput(
     # headline; the ledger says WHERE its complement went.
     from dlrover_tpu.observability.events import (
         compute_ledger,
+        pair_spans,
         read_events,
     )
 
     timeline = read_events(events_file)
     ledger = compute_ledger(timeline)
+    # restart-critical-path visibility: per-leg span totals and the
+    # MEASURED concurrency between the restore prefetch and the AOT
+    # compile (sum of per-process interval intersections) — the
+    # overlap the restart_path scheduler is supposed to buy
+    leg_ivs = {}
+    for iv in pair_spans(timeline):
+        if iv["phase"] in (
+            "restore_prefetch", "aot_compile", "finish_restore",
+            "rendezvous_wait", "restart_path",
+        ):
+            leg_ivs.setdefault(iv["phase"], []).append(iv)
+    by_proc = {}
+    for phase in ("restore_prefetch", "aot_compile"):
+        for iv in leg_ivs.get(phase, []):
+            by_proc.setdefault((iv["node"], iv["pid"]), {})[
+                phase
+            ] = iv
+    overlap_s = 0.0
+    for d in by_proc.values():
+        if len(d) == 2:
+            a, b = d["restore_prefetch"], d["aot_compile"]
+            overlap_s += max(
+                0.0,
+                min(a["end"], b["end"]) - max(a["start"], b["start"]),
+            )
+    restart_path = {
+        "span_counts": {k: len(v) for k, v in leg_ivs.items()},
+        "measured_overlap_s": round(overlap_s, 4),
+    }
+    for phase in ("restore_prefetch", "aot_compile"):
+        restart_path[f"{phase}_s"] = round(
+            sum(
+                iv["end"] - iv["start"]
+                for iv in leg_ivs.get(phase, [])
+            ),
+            4,
+        )
     return {
+        "restart_path": restart_path,
         "ledger": ledger,
         "loss_breakdown": ledger.get("loss_breakdown", {}),
         "events_file": events_file,

@@ -46,6 +46,37 @@ SP_KERNEL_ENV = "DLROVER_TPU_SP_KERNEL"
 FLASH_BLOCKS_ENV = "DLROVER_TPU_FLASH_BLOCKS"
 
 
+def _tile_multiple(dtype) -> int:
+    """Smallest legal sublane tile for the flash kernel's seq-blocked
+    dimension on TPU (Mosaic min tiles: fp32 (8,128), bf16/fp16
+    (16,128), 1-byte types (32,128))."""
+    import numpy as np
+
+    dt = np.dtype(dtype) if not hasattr(dtype, "itemsize") else dtype
+    if dt.itemsize >= 4:
+        return 8
+    if dt.itemsize == 2:
+        return 16
+    return 32
+
+
+def round_block_to_tile(block: int, local_seq: int, dtype) -> int:
+    """Clamp a solver/env flash-block override to the LOCAL sequence,
+    rounding DOWN to the largest supported tile multiple that fits.
+
+    A bare ``min(block, local_seq)`` can hand the Pallas kernel a
+    non-tile-aligned block (e.g. override 256 against a local seq of
+    100 → 100, not a multiple of the (8|16|32, 128) Mosaic tile) and
+    fail at kernel build.  When the local sequence is itself below one
+    tile, the kernel's internal ``min(block, s)`` + bounds masks
+    handle the padding — return the local seq unchanged."""
+    tile = _tile_multiple(dtype)
+    b = min(int(block), int(local_seq))
+    if local_seq < tile:
+        return b
+    return max(b - b % tile, tile)
+
+
 def _flash_enabled(flash: Optional[bool]) -> bool:
     if flash is not None:
         return flash
@@ -109,18 +140,31 @@ def select_attention(
                 # seq-sharded mesh the kernel sees seq/s.seq, and a
                 # well-formed override sized for the global seq would
                 # otherwise fail at kernel build (ADVICE-r4).  The
-                # clamp point is the first place local shapes exist.
+                # clamp point is the first place local shapes exist;
+                # the clamped block additionally rounds DOWN to the
+                # largest supported Mosaic tile multiple — a bare min
+                # (override 256, local seq 100 → 100) is not a legal
+                # tile and dies at kernel build.
                 base = inner
 
                 def inner(q, k, v, *a, _base=base, _bq=bq, _bk=bk,
                           **kw):
-                    lbq = min(_bq, q.shape[1])
-                    lbk = min(_bk, k.shape[1])
+                    lbq = round_block_to_tile(
+                        _bq, q.shape[1], q.dtype
+                    )
+                    lbk = round_block_to_tile(
+                        _bk, k.shape[1], k.dtype
+                    )
                     if (lbq, lbk) != (_bq, _bk):
+                        reason = (
+                            "exceeds local seq"
+                            if _bq > q.shape[1] or _bk > k.shape[1]
+                            else "is not a Mosaic tile multiple"
+                        )
                         logger.warning(
-                            "%s=%r exceeds local seq (q=%d k=%d); "
-                            "clamped to %d,%d",
-                            FLASH_BLOCKS_ENV, blocks,
+                            "%s=%r %s (local q=%d k=%d); adjusted "
+                            "to tile-aligned %d,%d",
+                            FLASH_BLOCKS_ENV, blocks, reason,
                             q.shape[1], k.shape[1], lbq, lbk,
                         )
                     return _base(

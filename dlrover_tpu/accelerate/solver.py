@@ -164,12 +164,18 @@ def solve(
     vmem_budget: int = DEFAULT_VMEM_BUDGET,
     headroom: float = 0.85,
     top_k: int = 5,
+    pipe_microbatches: int = 0,
 ) -> List[JointPlan]:
     """Exact solve over the pruned joint space; best plan first.
 
     ``weights``: calibrated per-term coefficients from
     ``CalibratedPlanner.calibrate`` (None = analytic prior) — the
     solver and the measured calibration share one objective.
+
+    ``pipe_microbatches``: the GPipe microbatch count the job will
+    actually run (``MeshContext.pipeline_microbatches``); 0 keeps the
+    module_replace auto default of ``2*pipe``.  The activation
+    residency of pipe>1 candidates scales with it.
     """
     hbm = device_memory_bytes() * headroom
     w = (
@@ -232,10 +238,20 @@ def solve(
         for m in (1, 2, 4, 8):
             if m < s0.num_micro_steps or (m > 1 and bpd0 % m):
                 continue
-            s = dataclasses.replace(s0, num_micro_steps=m)
+            s = dataclasses.replace(
+                s0,
+                num_micro_steps=m,
+                # stamp the configured GPipe depth so the residency
+                # estimate below tracks what the executor will run
+                pipe_microbatches=(
+                    pipe_microbatches
+                    if s0.pipe > 1 and pipe_microbatches
+                    else s0.pipe_microbatches
+                ),
+            )
             key = (
                 s.data, s.fsdp, s.tensor, s.seq, s.expert, s.pipe,
-                s.num_micro_steps,
+                s.num_micro_steps, s.pipe_microbatches,
             )
             if key not in seen_keys:
                 seen_keys.add(key)
@@ -264,9 +280,14 @@ def solve(
             # chunked-1F1B pipeline executor (parallel/pipeline.py):
             # a stage holds only ITS layer shard's activations
             # (1/pipe) for a window of `pipe` in-flight microbatches
-            # out of the 2*pipe-deep stream (module_replace default)
-            # — residency is act/(2*pipe), not the full batch's
-            full_acts /= 2.0 * s.pipe
+            # out of the num_mb-deep stream — residency is
+            # act * (pipe/num_mb) * (1/pipe) = act/num_mb.  num_mb is
+            # the strategy's ACTUAL microbatch count (0 = the
+            # module_replace auto default of 2*pipe); hard-coding
+            # 2*pipe made the memory estimate wrong by the ratio for
+            # any other configured count.
+            num_mb = s.pipe_microbatches or 2 * s.pipe
+            full_acts /= float(num_mb)
         # accumulation is not free: every extra micro step re-reads
         # and re-writes the fp32 grad_sum (8 bytes/param over HBM) and
         # fragments the fused backward

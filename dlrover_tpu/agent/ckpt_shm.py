@@ -490,6 +490,77 @@ class SharedMemoryHandler:
         self.meta.close()
 
 
+class TruncatedShardError(ValueError):
+    """The shard file ended before the raw section was complete."""
+
+
+def stream_shard_leaves(path: str, storage=None):
+    """Generator over a persisted ``*.drckpt`` shard, leaf by leaf.
+
+    Yields ``("meta", step, specs)`` first, then ``("leaf", key,
+    ndarray)`` for each leaf THE MOMENT its bytes land, in file
+    (offset) order.  All leaf views share ONE preallocated private
+    buffer (the ``read_shard_file`` memory discipline) — peak memory
+    is the shard size.  The leaf-granular stream is what lets a
+    restore consumer pipeline ``device_put`` against the tail of the
+    read (trainer/checkpoint restart prefetch) instead of waiting on
+    a whole-shard barrier.
+
+    Raises :class:`TruncatedShardError` on a short file; propagates
+    the backend's own errors on absence.
+    """
+    if storage is not None:
+        f = storage.open_read(path)
+    else:
+        f = open(path, "rb")
+    with f:
+        hdr = f.read(_HDR.size)
+        if not hdr or len(hdr) < _HDR.size:
+            raise TruncatedShardError(f"no header in {path}")
+        (hdr_len,) = _HDR.unpack(hdr)
+        meta = pickle.loads(f.read(hdr_len))
+        specs = meta["specs"]
+        total = max(
+            (int(off) + int(nbytes) for _k, _d, _s, off, nbytes in specs),
+            default=0,
+        )
+        yield "meta", meta.get("step", -1), specs
+        raw = np.empty(total, dtype=np.uint8)
+        mv = memoryview(raw)
+        filled = 0
+        chunk = parallel_io.chunk_nbytes()
+
+        def _fill_to(limit: int):
+            nonlocal filled
+            while filled < limit:
+                want = min(chunk, limit - filled)
+                if hasattr(f, "readinto"):
+                    got = f.readinto(mv[filled : filled + want])
+                else:  # buffered remote reader without readinto
+                    data = f.read(want)
+                    got = len(data)
+                    if got:
+                        mv[filled : filled + got] = data
+                if not got:
+                    raise TruncatedShardError(
+                        f"truncated shard file {path} "
+                        f"({filled} of {total} raw bytes)"
+                    )
+                filled += got
+
+        # specs are written in increasing-offset order (save_state);
+        # sort defensively so a reordered header can't yield a leaf
+        # whose bytes haven't landed
+        for key, dtype, shape, off, nbytes in sorted(
+            specs, key=lambda s: int(s[3])
+        ):
+            _fill_to(int(off) + int(nbytes))
+            yield "leaf", key, np.ndarray(
+                tuple(shape), dtype=np.dtype(dtype), buffer=raw,
+                offset=int(off),
+            )
+
+
 def read_shard_file(path: str, storage=None) -> Tuple[int, Dict[str, np.ndarray]]:
     """Load a persisted ``*.drckpt`` shard.
 
@@ -498,10 +569,19 @@ def read_shard_file(path: str, storage=None) -> Tuple[int, Dict[str, np.ndarray]
     it — peak memory is the shard size, not the former raw-bytes
     object + a ``.copy()`` per leaf (2× shard RAM).
     """
-    if storage is not None:
-        try:
-            f = storage.open_read(path)
-        except (FileNotFoundError, IsADirectoryError):
+    try:
+        step, arrays = -1, {}
+        for item in stream_shard_leaves(path, storage):
+            if item[0] == "meta":
+                step = item[1]
+            else:
+                arrays[item[1]] = item[2]
+        return step, arrays
+    except TruncatedShardError as e:
+        logger.warning("%s", e)
+        return -1, {}
+    except (FileNotFoundError, IsADirectoryError):
+        if storage is not None:
             # genuine absence maps to "no checkpoint", matching the
             # old storage.read()->b"" semantics; transient IO errors
             # still raise.  A bare LOCAL path keeps raising on
@@ -509,49 +589,7 @@ def read_shard_file(path: str, storage=None) -> Tuple[int, Dict[str, np.ndarray]
             # merge list-then-read and must fail loudly if a shard
             # vanishes mid-merge, not export a partial checkpoint.
             return -1, {}
-    else:
-        f = open(path, "rb")
-    with f:
-        hdr = f.read(_HDR.size)
-        if not hdr or len(hdr) < _HDR.size:
-            return -1, {}
-        (hdr_len,) = _HDR.unpack(hdr)
-        meta = pickle.loads(f.read(hdr_len))
-        specs = meta["specs"]
-        total = max(
-            (int(off) + int(nbytes) for _k, _d, _s, off, nbytes in specs),
-            default=0,
-        )
-        raw = np.empty(total, dtype=np.uint8)
-        mv = memoryview(raw)
-        filled = 0
-        chunk = parallel_io.chunk_nbytes()
-        while filled < total:
-            want = min(chunk, total - filled)
-            if hasattr(f, "readinto"):
-                got = f.readinto(mv[filled : filled + want])
-                if not got:
-                    break
-            else:  # buffered remote reader without readinto
-                data = f.read(want)
-                if not data:
-                    break
-                got = len(data)
-                mv[filled : filled + got] = data
-            filled += got
-        if filled < total:
-            logger.warning(
-                "truncated shard file %s (%d of %d raw bytes)",
-                path, filled, total,
-            )
-            return -1, {}
-    arrays = {}
-    for key, dtype, shape, off, nbytes in specs:
-        arrays[key] = np.ndarray(
-            tuple(shape), dtype=np.dtype(dtype), buffer=raw,
-            offset=int(off),
-        )
-    return meta.get("step", -1), arrays
+        raise
 
 
 def shard_lock(rank: int, name: str = "default", create: bool = False) -> SharedLock:

@@ -76,6 +76,11 @@ class ElasticLaunchConfig:
     envs: Dict[str, str] = field(default_factory=dict)
     # persistent XLA compilation cache keeps post-restart warmup cheap
     compile_cache_dir: str = ""
+    # overlapped restart critical path in the workers (restore byte
+    # prefetch + background AOT compile, trainer/restart_path.py);
+    # False exports DLROVER_TPU_RESTART_OVERLAP=0 so every worker runs
+    # the serial restore->compile order
+    restart_overlap: bool = True
     # watch the GCE metadata maintenance-event endpoint: on TPU-VMs
     # preemption fires there ~60s before any SIGTERM (agent/preemption.py)
     watch_preemption: bool = True
@@ -280,6 +285,8 @@ class ElasticTrainingAgent:
             env.setdefault(
                 "JAX_COMPILATION_CACHE_DIR", self._config.compile_cache_dir
             )
+        if not self._config.restart_overlap:
+            env["DLROVER_TPU_RESTART_OVERLAP"] = "0"
         return env
 
     def _initialize_workers(self) -> bool:

@@ -56,9 +56,22 @@ PHASE_DATA_STALL = "data_stall"
 PHASE_STEP = "step"
 PHASE_PREEMPTION_DRAIN = "preemption_drain"
 PHASE_CHECKPOINT_RESTORE = "checkpoint_restore"
+# restart-critical-path legs (trainer/restart_path.py): the restore
+# byte stream, the background AOT compile, the device-world wait and
+# the staged-bytes -> device finish.  They outrank their serial
+# cousins' parent (restart_path) but rank BELOW checkpoint_restore /
+# compile so a serial-path span that covers the same instant keeps
+# its attribution.
+PHASE_RESTORE_PREFETCH = "restore_prefetch"
+PHASE_FINISH_RESTORE = "finish_restore"
 PHASE_COMPILE = "compile"
+PHASE_AOT_COMPILE = "aot_compile"
 PHASE_RENDEZVOUS = "rendezvous"
+PHASE_RENDEZVOUS_WAIT = "rendezvous_wait"
 PHASE_CHECKPOINT_SAVE = "checkpoint_save"
+# parent span covering one whole overlapped (or fallen-back serial)
+# restart critical path; the child legs above carve their shares out
+PHASE_RESTART_PATH = "restart_path"
 PHASE_RESTART = "restart"
 
 PHASES: Tuple[str, ...] = (
@@ -66,9 +79,14 @@ PHASES: Tuple[str, ...] = (
     PHASE_STEP,
     PHASE_PREEMPTION_DRAIN,
     PHASE_CHECKPOINT_RESTORE,
+    PHASE_RESTORE_PREFETCH,
+    PHASE_FINISH_RESTORE,
     PHASE_COMPILE,
+    PHASE_AOT_COMPILE,
     PHASE_RENDEZVOUS,
+    PHASE_RENDEZVOUS_WAIT,
     PHASE_CHECKPOINT_SAVE,
+    PHASE_RESTART_PATH,
     PHASE_RESTART,
 )
 

@@ -123,12 +123,14 @@ def _adamw_chunk_math(master, mu, nu, grad, bc1, bc2,
                       *, lr, b1, b2, eps, wd):
     """THE AdamW update over one fp32 chunk — the single source of
     the math for both storage backends (a fix applied to one must not
-    silently miss the other)."""
+    silently miss the other).  ``wd`` may be a traced scalar (the
+    fused delayed schedule gates decay off on its no-op first step);
+    a static 0 still skips the term entirely."""
     g = grad.astype(jnp.float32)
     mu = b1 * mu + (1.0 - b1) * g
     nu = b2 * nu + (1.0 - b2) * g * g
     update = (mu / bc1) / (jnp.sqrt(nu / bc2) + eps)
-    if wd:
+    if not isinstance(wd, (int, float)) or wd:
         update = update + wd * master
     master = master - lr * update
     return master, mu, nu, master.astype(jnp.bfloat16)
@@ -779,7 +781,11 @@ def build_fused_offload_step(
       every host copy (H2D in, D2H out) and the update math itself
       overlap the backward.  This is the delayed-parameter-update
       schedule of ZeRO-Offload (gradients are applied one step after
-      they were computed; step 1 applies a zero gradient).
+      they were computed).  Step 1 is a TRUE no-op: it has no
+      previous gradients, weight decay is gated off (it would move
+      every param before any real gradient) and bias correction
+      counts real moment updates — the trajectory equals the
+      synchronous one run on the shifted grad sequence, exactly.
     - ``delayed=False``: backward first, update after (exact
       synchronous AdamW).  H2D copies still hoist into the backward;
       the D2H tail is exposed but chunk-pipelined.
@@ -842,9 +848,12 @@ def build_fused_offload_step(
             grads=grads,
         )
 
-    def _apply(params, grads, master, mu, nu, step):
+    def _apply(params, grads, master, mu, nu, step, wd):
         """Traced chunk-streamed update: barrier-windowed H2D, the
-        shared AdamW math, D2H."""
+        shared AdamW math, D2H.  ``step`` is the bias-correction step
+        (the number of REAL moment updates so far); ``wd`` may be a
+        traced scalar (delayed mode gates decay off at step 1)."""
+        hyper_t = dict(hyper, wd=wd)
         stepf = step.astype(jnp.float32)
         bc1 = 1.0 - jnp.power(jnp.float32(opt.b1), stepf)
         bc2 = 1.0 - jnp.power(jnp.float32(opt.b2), stepf)
@@ -888,7 +897,7 @@ def build_fused_offload_step(
                         _adamw_chunk_math_q(
                             _in(ins[0]), _in(ins[1]), _in(ins[2]),
                             _in(ins[3]), _in(ins[4]),
-                            g, bc1, bc2, **hyper,
+                            g, bc1, bc2, **hyper_t,
                         )
                     )
                     m2h = _out(m2)
@@ -897,7 +906,7 @@ def build_fused_offload_step(
                 else:
                     m2, mu2, nu2, pb = _adamw_chunk_math(
                         _in(ins[0]), _in(ins[1]), _in(ins[2]),
-                        g, bc1, bc2, **hyper,
+                        g, bc1, bc2, **hyper_t,
                     )
                     m2h = _out(m2)
                     mus.append(_out(mu2))
@@ -929,9 +938,26 @@ def build_fused_offload_step(
         # math ride under the backward (ZeRO-Offload delayed
         # parameter update).  sync: this step's grads apply now.
         applied = state.grads if delayed else grads
+        if delayed:
+            # step 1 has no previous gradients, so its update must be
+            # a TRUE no-op: weight decay is gated off (a bare
+            # bias-corrected decay would move every param before any
+            # real gradient), and bias correction counts REAL moment
+            # updates (step t applies the grads computed at t-1, the
+            # (t-1)-th update) — the delayed trajectory is exactly the
+            # synchronous one run on the shifted grad sequence.
+            upd_step = jnp.maximum(step - 1, 1)
+            wd_t = (
+                jnp.float32(opt.wd)
+                * (step > 1).astype(jnp.float32)
+                if opt.wd
+                else opt.wd
+            )
+        else:
+            upd_step, wd_t = step, opt.wd
         new_p, new_m, new_mu, new_nu = _apply(
             state.params, applied, state.master, state.mu,
-            state.nu, step,
+            state.nu, upd_step, wd_t,
         )
         new_state = FusedOffloadState(
             step, new_p, new_m, new_mu, new_nu,

@@ -40,6 +40,40 @@ class TrainStepFns:
     # must not consume the train state's buffers); None on artifacts
     # built before eval existed
     eval_step: Optional[Callable] = None  # (state, batch) -> metrics
+    # eval_shape of the train state (ShapeDtypeStructs) — what the AOT
+    # path lowers against; None on artifacts built before AOT existed
+    state_shape: Any = None
+
+    def aot_compile(self, sample_batch):
+        """AOT-compile the train step from shape specs alone:
+        ``jit(...).lower(state_specs, batch_specs).compile()``.
+
+        Needs NO live state and NO data — only the mesh — so it can
+        run on a background thread the moment the mesh exists,
+        concurrently with the restore byte stream (the restart
+        critical path, ``trainer/restart_path.py``).  A warm
+        ``JAX_COMPILATION_CACHE_DIR`` turns this into a cache load;
+        cold, it is the full XLA compile that would otherwise
+        serialize in front of the first step.
+
+        ``sample_batch``: a pytree of arrays OR ShapeDtypeStructs
+        giving the batch layout.  Returns the compiled executable —
+        call it exactly like ``train_step`` (same shardings, same
+        donation); inputs with other shapes must go through the
+        retracing ``train_step`` instead.
+        """
+        if self.state_shape is None:
+            raise ValueError(
+                "artifacts built before the AOT path existed "
+                "(rebuild with build_train_step)"
+            )
+        batch_shape = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            sample_batch,
+        )
+        return self.train_step.lower(
+            self.state_shape, batch_shape
+        ).compile()
 
 
 def make_train_state(params, optimizer):
@@ -204,4 +238,5 @@ def build_train_step(
         state_shardings=state_shardings,
         batch_sharding=batch_sharding,
         eval_step=eval_step,
+        state_shape=state_shape,
     )

@@ -14,8 +14,9 @@ survives a crashed or preempted training process.
 """
 
 import os
+import threading
 import time
-from typing import Optional
+from typing import Dict, List, Optional
 
 from dlrover_tpu.common.constants import CheckpointConstant
 from dlrover_tpu.common.log import default_logger as logger
@@ -38,6 +39,7 @@ from dlrover_tpu.agent.ckpt_shm import (
     read_shard_file,
     restore_to_target,
     shard_lock,
+    stream_shard_leaves,
 )
 
 
@@ -81,6 +83,227 @@ def _agent_factory_queue_exists() -> bool:
         return False
     finally:
         probe.close()
+
+
+class _StagedCandidate:
+    """Leaves of one restorable step, published as their bytes land.
+
+    The prefetch thread is the single producer; ``finish_restore`` is
+    the single consumer.  A condition variable lets the consumer
+    ``device_put`` leaf k while the producer is still streaming leaf
+    k+1 — the restore's device transfers pipeline against the tail of
+    the byte read instead of waiting on a whole-state barrier."""
+
+    def __init__(self, source: str, zero_copy: bool):
+        self.source = source  # "shm" | "storage"
+        #: True when arrays are views onto live shm (the consumer must
+        #: copy any leaf that stays on host, like the serial path)
+        self.zero_copy = zero_copy
+        self.arrays: Dict[str, object] = {}
+        self._order: List[str] = []
+        self._cv = threading.Condition()
+        self._done = False
+        self.failed = False
+
+    def publish(self, key: str, arr):
+        with self._cv:
+            self.arrays[key] = arr
+            self._order.append(key)
+            self._cv.notify_all()
+
+    def finish(self, failed: bool = False):
+        with self._cv:
+            if self._done:
+                return
+            self.failed = failed
+            self._done = True
+            self._cv.notify_all()
+
+    def iter_leaves(self, timeout: float = 600.0):
+        """Yield ``(key, array)`` in arrival order, blocking for the
+        next leaf while the producer is still streaming."""
+        i = 0
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._cv:
+                while i >= len(self._order) and not self._done:
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"restore prefetch ({self.source}) stalled"
+                        )
+                    self._cv.wait(0.5)
+                if i >= len(self._order):
+                    if self.failed:
+                        raise RuntimeError(
+                            f"prefetch candidate ({self.source}) failed"
+                        )
+                    return
+                key = self._order[i]
+            yield key, self.arrays[key]
+            i += 1
+
+    def wait_all(self, timeout: float = 600.0) -> Dict[str, object]:
+        for _ in self.iter_leaves(timeout):
+            pass
+        return self.arrays
+
+
+class RestorePrefetch:
+    """Background staging of restore bytes into host RAM, started the
+    moment the worker knows its rank and checkpoint dir — before the
+    device world exists, so the byte stream overlaps rendezvous and
+    compilation (the restart critical path's other legs).
+
+    Stages the newest shm snapshot (zero-copy views: the bytes already
+    live in host shared memory, and the early attach fronts the
+    MADV_WILLNEED page population) and, when storage holds a step shm
+    does not, streams that shard file leaf-by-leaf into one private
+    buffer.  Everything here is preparation only — no jax arrays, no
+    consensus; :meth:`CheckpointEngine.finish_restore` consumes the
+    staged leaves after the cross-rank step agreement, and ANY failure
+    in this thread degrades the restore to the serial ``load`` path
+    (``error`` is set, nothing is ever half-applied)."""
+
+    def __init__(self, engine: "CheckpointEngine",
+                 checkpoint_dir: Optional[str] = None,
+                 start_gate=None):
+        self._engine = engine
+        self._dir = checkpoint_dir
+        self._gate = start_gate
+        self.error: Optional[BaseException] = None
+        self.shm_steps: List[int] = []
+        self.storage_step = -1
+        self.storage_dir: Optional[str] = None
+        self.staged_bytes = 0
+        self._avail = threading.Event()
+        self._candidates: Dict[int, _StagedCandidate] = {}
+        self._thread = threading.Thread(
+            target=self._run, name="ckpt-restore-prefetch", daemon=True
+        )
+        self._thread.start()
+
+    def wait_available(self, timeout: float = 300.0) -> bool:
+        """Block until the availability snapshot (shm steps + latest
+        storage step) is resolved — the input the consensus needs."""
+        return self._avail.wait(timeout)
+
+    def candidate(self, step: int) -> Optional[_StagedCandidate]:
+        cand = self._candidates.get(step)
+        if cand is None or cand.failed:
+            return None
+        return cand
+
+    def join(self, timeout: float = 300.0):
+        self._thread.join(timeout)
+
+    @property
+    def done(self) -> bool:
+        return not self._thread.is_alive()
+
+    # ------------------------------------------------------- producer
+    def _run(self):
+        if self._gate is not None:
+            try:
+                # start-alignment gate (restart_path coordinator's
+                # barrier): both overlapped legs begin together so the
+                # timeline shows the real concurrency
+                self._gate()
+            except Exception:  # noqa: BLE001 - alignment is best-effort
+                pass
+        t0_wall, t0_mono = time.time(), time.monotonic()
+        eng = self._engine
+        try:
+            self.shm_steps = eng._shm_handler.steps_available()
+            self.storage_step, self.storage_dir = (
+                eng._latest_storage_step(self._dir)
+            )
+        except Exception as e:  # noqa: BLE001 - degrade, never corrupt
+            self.error = e
+            self._avail.set()
+            logger.warning(
+                "rank %s: restore prefetch failed resolving "
+                "availability: %s (serial fallback)", eng._rank, e,
+            )
+            return
+        # register EMPTY candidates for every step about to be staged
+        # BEFORE publishing availability: a near-instant consensus on
+        # the main thread would otherwise see an empty candidate map
+        # and silently take the serial path (the consumer blocks on
+        # iter_leaves until the bytes land instead)
+        newest_shm = self.shm_steps[0] if self.shm_steps else -1
+        shm_cand = None
+        if newest_shm >= 0:
+            shm_cand = _StagedCandidate("shm", zero_copy=True)
+            self._candidates[newest_shm] = shm_cand
+        storage_cand = None
+        if (
+            self.storage_step >= 0
+            and self.storage_dir
+            # stage storage only when shm cannot serve the newest
+            # step: a warm restart (live shm snapshot, older committed
+            # storage) must not pay a full state-sized download that
+            # consensus will almost surely discard — the rare
+            # consensus-picks-older case falls back to the serial
+            # fetch of exactly that step
+            and self.storage_step > newest_shm
+        ):
+            storage_cand = _StagedCandidate("storage", zero_copy=False)
+            self._candidates[self.storage_step] = storage_cand
+        self._avail.set()
+        if shm_cand is not None:
+            self._stage_shm(newest_shm, shm_cand)
+        if storage_cand is not None:
+            self._stage_storage(
+                self.storage_step, self.storage_dir, storage_cand
+            )
+        dur = time.monotonic() - t0_mono
+        get_event_logger().complete(
+            "restore_prefetch",
+            t0_wall,
+            dur,
+            bytes=self.staged_bytes,
+            steps=sorted(self._candidates),
+        )
+
+    def _stage_shm(self, step: int, cand: _StagedCandidate):
+        try:
+            got, arrays = self._engine._shm_handler.load_state(
+                copy=False, step=step
+            )
+            if got != step:
+                cand.finish(failed=True)
+                return
+            for key, value in arrays.items():
+                self.staged_bytes += int(getattr(value, "nbytes", 0))
+                cand.publish(key, value)
+            cand.finish()
+        except Exception as e:  # noqa: BLE001
+            cand.finish(failed=True)
+            logger.warning(
+                "rank %s: shm prefetch of step %s failed: %s",
+                self._engine._rank, step, e,
+            )
+
+    def _stage_storage(self, step: int, ckpt_dir: str,
+                       cand: _StagedCandidate):
+        path = os.path.join(
+            ckpt_dir, f"shard_{self._engine._rank}.drckpt"
+        )
+        try:
+            got = -1
+            for item in stream_shard_leaves(path, self._engine._storage):
+                if item[0] == "meta":
+                    got = item[1]
+                else:
+                    self.staged_bytes += int(item[2].nbytes)
+                    cand.publish(item[1], item[2])
+            cand.finish(failed=(got != step))
+        except Exception as e:  # noqa: BLE001
+            cand.finish(failed=True)
+            logger.warning(
+                "rank %s: storage prefetch of step %s failed: %s",
+                self._engine._rank, step, e,
+            )
 
 
 class CheckpointEngine:
@@ -360,13 +583,24 @@ class CheckpointEngine:
         """
         t0_wall, t0_mono = time.time(), time.monotonic()
         shm_steps = self._shm_handler.steps_available()
-        shm_step = shm_steps[0] if shm_steps else -1
         storage_step, latest_dir = self._latest_storage_step(
             checkpoint_dir
         )
         agreed = self._sync_restore_step(shm_steps, storage_step)
         if agreed < 0:
             return -1, None
+        return self._restore_agreed(
+            agreed, target, checkpoint_dir, shm_steps, storage_step,
+            latest_dir, t0_wall, t0_mono,
+        )
+
+    def _restore_agreed(self, agreed, target, checkpoint_dir,
+                        shm_steps, storage_step, latest_dir,
+                        t0_wall, t0_mono):
+        """Fetch + apply an already-agreed restore step (the serial
+        data path, shared by ``load`` and ``finish_restore``'s
+        fallback)."""
+        shm_step = shm_steps[0] if shm_steps else -1
         zero_copy = False
         step, arrays = -1, {}
         if agreed in shm_steps:
@@ -416,6 +650,145 @@ class CheckpointEngine:
         )
         record_ckpt_io("restore", restored_bytes, dur)
         return step, arrays
+
+    def start_prefetch(self, checkpoint_dir: Optional[str] = None,
+                       start_gate=None) -> RestorePrefetch:
+        """Begin streaming restore bytes into host RAM on a background
+        thread — the first leg of the overlapped restart critical path
+        (see ``trainer/restart_path.py``).  Callable before the mesh
+        or ``jax.distributed`` exist: it touches only shm and storage.
+        Pair with :meth:`finish_restore`; ``load`` stays the serial
+        equivalent."""
+        return RestorePrefetch(
+            self, checkpoint_dir=checkpoint_dir, start_gate=start_gate
+        )
+
+    def finish_restore(self, prefetch: Optional[RestorePrefetch],
+                       target=None,
+                       checkpoint_dir: Optional[str] = None):
+        """Complete an overlapped restore started by
+        :meth:`start_prefetch`.
+
+        Runs the SAME cross-rank step consensus as ``load`` (over the
+        prefetch's availability snapshot — the row this rank publishes
+        must describe the bytes it staged), then applies the staged
+        leaves with per-leaf ``jax.device_put`` pipelined against any
+        still-streaming tail.  Any prefetch failure, consensus miss on
+        the staged step, or staging error degrades to the serial
+        ``_restore_agreed``/``load`` path — byte-identical result,
+        never a half-applied state."""
+        t0_wall, t0_mono = time.time(), time.monotonic()
+        if (
+            prefetch is None
+            or not prefetch.wait_available(300)
+            or prefetch.error is not None
+        ):
+            if prefetch is not None:
+                prefetch.join()
+            return self.load(target=target, checkpoint_dir=checkpoint_dir)
+        agreed = self._sync_restore_step(
+            prefetch.shm_steps, prefetch.storage_step
+        )
+        if agreed < 0:
+            prefetch.join()
+            return -1, None
+
+        def _serial():
+            prefetch.join()
+            return self._restore_agreed(
+                agreed, target, checkpoint_dir, prefetch.shm_steps,
+                prefetch.storage_step, prefetch.storage_dir,
+                t0_wall, t0_mono,
+            )
+
+        cand = prefetch.candidate(agreed)
+        if cand is None:
+            return _serial()
+        try:
+            step, state, nbytes = self._consume_staged(
+                cand, agreed, target
+            )
+        except Exception as e:  # noqa: BLE001 - degrade, never corrupt
+            logger.warning(
+                "rank %s: staged restore of step %s failed (%s); "
+                "serial fallback", self._rank, agreed, e,
+            )
+            return _serial()
+        from dlrover_tpu.common.parallel_io import throughput_gbps
+        from dlrover_tpu.observability.metrics import record_ckpt_io
+
+        dur = time.monotonic() - t0_mono
+        events = get_event_logger()
+        events.complete(
+            "checkpoint_restore",
+            t0_wall,
+            dur,
+            step=agreed,
+            bytes=nbytes,
+            throughput_gbps=throughput_gbps(nbytes, dur),
+            stage="overlap",
+        )
+        events.complete(
+            "finish_restore", t0_wall, dur, step=agreed, bytes=nbytes
+        )
+        record_ckpt_io("restore", nbytes, dur)
+        return step, state
+
+    def _consume_staged(self, cand: _StagedCandidate, agreed: int,
+                        target):
+        """Apply one staged candidate.  With a target, each leaf is
+        ``device_put`` the moment its bytes land (async dispatch; one
+        completion barrier at the end) — same values, same sharding,
+        same host-copy discipline as ``restore_to_target``."""
+        import numpy as np
+
+        if target is None:
+            arrays = dict(cand.wait_all())
+            if cand.zero_copy:
+                # serial parity: load(target=None) returns standalone
+                # copies (shm may be overwritten afterwards)
+                arrays = {
+                    k: np.array(v, copy=True) if isinstance(
+                        v, np.ndarray
+                    ) else v
+                    for k, v in arrays.items()
+                }
+            nbytes = sum(
+                int(getattr(v, "nbytes", 0)) for v in arrays.values()
+            )
+            return agreed, arrays, nbytes
+        import jax
+
+        flat, treedef = jax.tree_util.tree_flatten_with_path(target)
+        targets = {
+            jax.tree_util.keystr(path): (i, leaf)
+            for i, (path, leaf) in enumerate(flat)
+        }
+        out = [None] * len(flat)
+        puts = []
+        nbytes = 0
+        seen = set()
+        for key, value in cand.iter_leaves():
+            slot = targets.get(key)
+            if slot is None:
+                continue  # extra leaves are ignored, like the serial path
+            i, leaf = slot
+            nbytes += int(getattr(value, "nbytes", 0))
+            if hasattr(leaf, "dtype") and value.dtype != leaf.dtype:
+                value = value.astype(leaf.dtype)
+            if isinstance(leaf, jax.Array):
+                value = jax.device_put(value, leaf.sharding)
+                puts.append(value)
+            elif cand.zero_copy and isinstance(value, np.ndarray):
+                value = np.array(value, copy=True)
+            out[i] = value
+            seen.add(key)
+        missing = sorted(set(targets) - seen)
+        if missing:
+            raise KeyError(f"checkpoint missing leaf {missing[0]}")
+        if puts:
+            jax.block_until_ready(puts)
+        return agreed, jax.tree_util.tree_unflatten(treedef, out), nbytes
 
     def _sync_restore_step(self, shm_steps, storage_step: int) -> int:
         """Cross-process consensus on the restore step: the NEWEST step

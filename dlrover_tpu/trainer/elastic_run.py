@@ -79,6 +79,14 @@ def parse_args(argv=None):
         "(removes the Python/jax import chain from restart latency)",
     )
     parser.add_argument(
+        "--no_restart_overlap",
+        action="store_true",
+        help="disable the overlapped restart critical path (restore "
+        "prefetch + background AOT compile; trainer/restart_path.py) "
+        "— workers then run the serial restore->compile order "
+        "(exports DLROVER_TPU_RESTART_OVERLAP=0)",
+    )
+    parser.add_argument(
         "--network-check",
         "--network_check",
         dest="network_check",
@@ -246,6 +254,7 @@ def run(args) -> int:
         prefork=args.prefork,
         node_rank=node_rank,
         compile_cache_dir=args.compile_cache_dir,
+        restart_overlap=not args.no_restart_overlap,
     )
     from dlrover_tpu.observability.events import get_event_logger
 

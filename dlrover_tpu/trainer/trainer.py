@@ -123,9 +123,13 @@ class Trainer:
             rank=self._ctx.rank,
         )
         self._engine = None
+        self._restart_coord = None
         if args.checkpoint_dir:
             from dlrover_tpu.trainer.checkpoint.engine import (
                 CheckpointEngine,
+            )
+            from dlrover_tpu.trainer.restart_path import (
+                RestartCoordinator,
             )
 
             self._engine = CheckpointEngine(
@@ -137,6 +141,12 @@ class Trainer:
                     os.getenv("DLROVER_TPU_LOCAL_PROCESS_COUNT", "1")
                 ),
             )
+            # restart critical path: kick the restore byte prefetch
+            # NOW, so it streams while init_state traces+compiles in
+            # _init_or_restore_state; DLROVER_TPU_RESTART_OVERLAP=0
+            # (or any prefetch failure) reproduces the serial load
+            self._restart_coord = RestartCoordinator(self._engine)
+            self._restart_coord.start()
         self._sparse_mgr = None
         if args.sparse_tables and args.checkpoint_dir:
             from dlrover_tpu.sparse.checkpoint import (
@@ -204,9 +214,20 @@ class Trainer:
         )
         start_step = 0
         if self._engine is not None:
-            # restore straight onto the initialized state's shardings
-            # (zero-copy shm views -> one batched device transfer)
-            step, restored = self._engine.load(target=self.state)
+            # restore straight onto the initialized state's shardings;
+            # the coordinator consumes the bytes the __init__-time
+            # prefetch staged while init_state compiled (falls back to
+            # the serial engine.load on any overlap failure)
+            if self._restart_coord is not None:
+                step, restored = self._restart_coord.finish_restore(
+                    target=self.state
+                )
+                # one restart, one prefetch: a later re-init must read
+                # FRESH availability (training may have snapshotted
+                # past the staged step), i.e. the serial load below
+                self._restart_coord = None
+            else:
+                step, restored = self._engine.load(target=self.state)
             if step >= 0 and restored is not None:
                 self.state = restored
                 start_step = step

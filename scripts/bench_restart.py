@@ -1,0 +1,280 @@
+"""Micro-benchmark: serial vs overlapped restart critical path (MTTR).
+
+Measures "restart decided" → "first step completed on the restored
+state" twice on the SAME host and checkpoint:
+
+- **serial**: today's order — rendezvous wait, then
+  ``CheckpointEngine.load`` (committed storage shard), then the train
+  step's first-call trace+compile, then the step
+  (``DLROVER_TPU_RESTART_OVERLAP=0`` through the real
+  ``RestartCoordinator``, so the measured code path is the product's
+  fallback, not a reimplementation);
+- **overlapped**: ``RestartCoordinator.start`` runs the restore byte
+  prefetch and the AOT compile concurrently, the SAME rendezvous wait
+  rides under them, ``finish_restore`` pipelines per-leaf
+  ``device_put`` against the staged bytes, and the first step waits
+  on the compiled artifact.
+
+Both modes pay an identical ``--rendezvous_s`` coordination wait
+(default 0.5 s — the goodput harness's measured worker-side
+rendezvous+backend-init leg): it is the third leg of the real
+critical path, dead time for the serial order and a free overlap
+window for the other two legs.  ``--rendezvous_s 0`` measures the
+pure two-leg overlap.
+
+Each mode gets a FRESH jit function (a new executable cache entry —
+no cross-mode compile reuse) and a fresh engine namespace (no shm
+reuse); both restore the same committed shard.  Single-leg baselines
+(``restore_only_s``, ``compile_only_s``) bound the ideal:
+``max(legs) <= overlap <= serial ~= sum(legs)``.
+
+Honors ``DLROVER_TPU_BENCH_BUDGET_S`` (scales the state down and
+drops to one round), flushes the payload-so-far to ``--out`` after
+every phase, and prints one JSON line.
+
+Usage::
+
+    python scripts/bench_restart.py [--state_mb 64] [--rounds 2]
+        [--out OUT.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# ONE definition of the budget/flush semantics across all benches: a
+# fix there (e.g. PR 2's rc=124 partial-flush defense) must not have
+# to be re-applied here
+from bench import BenchBudget, flush_partial as _flush  # noqa: E402
+
+
+def build_workload(state_mb: int, depth: int = 4):
+    """A scan-over-layers MLP: enough XLA work that compile is a real
+    restart leg, with a params tree sized to ``state_mb`` so the byte
+    stream is the other real leg (the 7B-class shape: restore and
+    compile are both seconds; a tiny batch keeps the step itself from
+    diluting the MTTR measurement)."""
+    import jax
+    import jax.numpy as jnp
+
+    hidden = max(int((state_mb * 1024 * 1024 / 4 / depth) ** 0.5), 32)
+
+    def init_state(rng):
+        return {
+            "layers": jax.random.normal(
+                rng, (depth, hidden, hidden), jnp.float32
+            )
+            * 0.01,
+            "step": jnp.zeros((), jnp.int32),
+        }
+
+    def loss_fn(params, x):
+        def body(h, w):
+            return jnp.tanh(h @ w), None
+
+        h, _ = jax.lax.scan(body, x, params["layers"])
+        return jnp.mean(h * h)
+
+    def make_step():
+        # a FRESH function object per mode: its own executable cache
+        # entry, so neither mode rides the other's compile
+        def _step(state, x):
+            loss, grads = jax.value_and_grad(loss_fn)(
+                {"layers": state["layers"]}, x
+            )
+            return {
+                "layers": state["layers"] - 0.01 * grads["layers"],
+                "step": state["step"] + 1,
+            }, loss
+
+        return jax.jit(_step)
+
+    batch_shape = (2, hidden)
+    return init_state, make_step, batch_shape, hidden
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="serial vs overlapped restart MTTR"
+    )
+    parser.add_argument("--state_mb", type=int, default=192)
+    parser.add_argument("--depth", type=int, default=4)
+    parser.add_argument("--rounds", type=int, default=3)
+    parser.add_argument("--rendezvous_s", type=float, default=0.5)
+    parser.add_argument("--out", default="")
+    args = parser.parse_args(argv)
+
+    budget = BenchBudget()
+    state_mb, rounds = args.state_mb, args.rounds
+    if budget.tight(300):
+        # keep a REAL byte leg even when scaled down: below ~100 MB
+        # the restore is milliseconds and the measurement degenerates
+        # into pure fixed-overhead comparison (one full round pair is
+        # well under a minute at this size)
+        state_mb = min(state_mb, 96)
+        rounds = min(rounds, 2)
+
+    os.environ.setdefault(
+        "DLROVER_TPU_SOCKET_DIR",
+        tempfile.mkdtemp(prefix="dlrover_benchrs_socks_"),
+    )
+    ckpt_dir = tempfile.mkdtemp(prefix="dlrover_benchrs_ckpt_")
+
+    import jax
+    import jax.numpy as jnp
+
+    from dlrover_tpu.trainer.checkpoint.engine import CheckpointEngine
+    from dlrover_tpu.trainer.restart_path import (
+        OVERLAP_ENV,
+        RestartCoordinator,
+    )
+
+    init_state, make_step, batch_shape, hidden = build_workload(
+        state_mb, args.depth
+    )
+    state = init_state(jax.random.PRNGKey(0))
+    jax.block_until_ready(state)
+    state_bytes = sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree_util.tree_leaves(state)
+    )
+
+    payload = {
+        "metric": "restart_mttr_s",
+        "value": None,
+        "unit": "s",
+        "state_mb": round(state_bytes / 1e6, 1),
+        "hidden": hidden,
+        "depth": args.depth,
+        "rounds": rounds,
+        "rendezvous_s": args.rendezvous_s,
+        "backend": jax.default_backend(),
+        "bench_budget_s": budget.total,
+    }
+
+    # commit the checkpoint once; every measured restore reads THIS
+    # shard from storage (the relaunched-node path — shm is gone)
+    seed_engine = CheckpointEngine(
+        checkpoint_dir=ckpt_dir, process_rank=0, process_count=1,
+        local_shard_num=1, name="br_seed",
+    )
+    host_state = jax.device_get(state)
+    assert seed_engine.save_to_storage(7, host_state)
+    assert seed_engine.wait_for_persist(7, timeout=300)
+    seed_engine.close()
+    _flush(args.out, payload)
+
+    batch = jnp.ones(batch_shape, jnp.float32)
+
+    def measure(overlap: bool, tag: str) -> float:
+        prev = os.environ.get(OVERLAP_ENV)
+        os.environ[OVERLAP_ENV] = "1" if overlap else "0"
+        try:
+            engine = CheckpointEngine(
+                checkpoint_dir=ckpt_dir, process_rank=0,
+                process_count=1, local_shard_num=1, name=tag,
+            )
+            step_fn = make_step()
+
+            def aot():
+                specs = jax.tree_util.tree_map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                    state,
+                )
+                return step_fn.lower(
+                    specs,
+                    jax.ShapeDtypeStruct(batch_shape, jnp.float32),
+                ).compile()
+
+            t0 = time.perf_counter()
+            coord = RestartCoordinator(engine)
+            coord.start(compile_fn=aot)
+            if args.rendezvous_s > 0:
+                # the coordination wait both orders pay: the worker
+                # blocks on the device world assembling — pure dead
+                # time serially, a free window for the launched legs
+                with coord.rendezvous_wait():
+                    time.sleep(args.rendezvous_s)
+            got, restored = coord.finish_restore(target=state)
+            assert got == 7, got
+            fn = coord.resolve_train_step(fallback=step_fn)
+            out_state, _loss = fn(restored, batch)
+            jax.block_until_ready(out_state)
+            elapsed = time.perf_counter() - t0
+            engine.close()
+            return elapsed
+        finally:
+            if prev is None:
+                os.environ.pop(OVERLAP_ENV, None)
+            else:
+                os.environ[OVERLAP_ENV] = prev
+
+    # single-leg baselines bound the ideal: max(legs) is the floor
+    # the overlapped path aims at, their sum is ~the serial path
+    t0 = time.perf_counter()
+    probe_engine = CheckpointEngine(
+        checkpoint_dir=ckpt_dir, process_rank=0, process_count=1,
+        local_shard_num=1, name="br_probe",
+    )
+    _s, _r = probe_engine.load(target=state)
+    payload["restore_only_s"] = round(time.perf_counter() - t0, 4)
+    probe_engine.close()
+    probe_step = make_step()
+    t0 = time.perf_counter()
+    probe_step.lower(
+        jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), state
+        ),
+        jax.ShapeDtypeStruct(batch_shape, jnp.float32),
+    ).compile()
+    payload["compile_only_s"] = round(time.perf_counter() - t0, 4)
+    _flush(args.out, payload)
+
+    serial, overlapped = [], []
+    for r in range(rounds):
+        if budget.tight(30):
+            payload["rounds_completed"] = r
+            break
+        # alternate the order each round: container-level throttling
+        # drifts over the run, and a fixed order would systematically
+        # charge the drift to whichever mode always runs second
+        order = (False, True) if r % 2 == 0 else (True, False)
+        for overlap in order:
+            runs = overlapped if overlap else serial
+            tag = f"br_{'o' if overlap else 's'}{r}"
+            runs.append(measure(overlap, tag))
+            _flush(
+                args.out,
+                dict(payload, serial_runs=serial,
+                     overlap_runs=overlapped),
+            )
+
+    if serial and overlapped:
+        payload["restart_serial_s"] = round(min(serial), 4)
+        payload["restart_overlap_s"] = round(min(overlapped), 4)
+        payload["value"] = payload["restart_overlap_s"]
+        payload["serial_runs"] = [round(s, 4) for s in serial]
+        payload["overlap_runs"] = [round(s, 4) for s in overlapped]
+        payload["speedup"] = round(
+            payload["restart_serial_s"]
+            / max(payload["restart_overlap_s"], 1e-9),
+            3,
+        )
+        ideal = max(
+            payload["restore_only_s"], payload["compile_only_s"]
+        )
+        payload["ideal_max_leg_s"] = round(ideal, 4)
+
+    print(json.dumps(payload), flush=True)
+    _flush(args.out, payload)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -579,6 +579,56 @@ class TestFlashBlockOverride:
         )
         assert np.isfinite(np.asarray(out)).all()
 
+    def test_clamp_rounds_down_to_tile_multiple(self, monkeypatch):
+        """A clamp to the local seq must yield a LEGAL Mosaic tile:
+        override 256 against local seq 100 (fp32) is 96 (8-multiple),
+        not 100; bf16 rounds to 16-multiples; below one tile the
+        kernel's own min+mask path takes over."""
+        from dlrover_tpu.accelerate.module_replace import (
+            round_block_to_tile,
+            select_attention,
+        )
+
+        import jax.numpy as jnp
+
+        assert round_block_to_tile(256, 100, jnp.float32) == 96
+        assert round_block_to_tile(256, 96, jnp.float32) == 96
+        assert round_block_to_tile(256, 100, jnp.bfloat16) == 96
+        assert round_block_to_tile(256, 90, jnp.bfloat16) == 80
+        assert round_block_to_tile(64, 2048, jnp.float32) == 64
+        # local seq under one tile: hand back the local seq (the
+        # kernel masks the padded tail itself)
+        assert round_block_to_tile(256, 5, jnp.float32) == 5
+        # never rounds to zero at exactly one tile
+        assert round_block_to_tile(9, 16, jnp.bfloat16) == 16
+
+        # end to end: a non-tile-aligned local seq runs and matches
+        # the reference kernel (beyond the aligned seq==64 case)
+        monkeypatch.setenv("DLROVER_TPU_FLASH_BLOCKS", "256,128")
+        monkeypatch.setenv("DLROVER_TPU_FLASH_ATTENTION", "1")
+        fn = select_attention(None, None)
+        import jax
+        import numpy as np
+
+        q = jax.random.normal(
+            jax.random.PRNGKey(1), (1, 100, 2, 128), jnp.float32
+        )
+        out = fn(q, q, q, causal=True)
+        assert out.shape == q.shape
+        from dlrover_tpu.ops.flash_attention import flash_attention
+
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32),
+            np.asarray(
+                flash_attention(
+                    q, q, q, causal=True, block_q=96, block_k=96
+                ),
+                np.float32,
+            ),
+            rtol=2e-3, atol=2e-3,
+        )
+        assert np.isfinite(np.asarray(out)).all()
+
     def test_malformed_override_ignored(self, monkeypatch):
         from dlrover_tpu.accelerate.module_replace import (
             select_attention,

@@ -509,6 +509,32 @@ def test_kill_one_worker_timeline_attribution():
     )
     assert fault_family > 0.0, loss
 
+    # restart critical path (trainer/restart_path.py): every worker
+    # incarnation ran the restore byte prefetch CONCURRENTLY with the
+    # AOT compile — their spans' mono-anchored intervals intersect in
+    # at least one process (spans pair per (node, pid), so both legs
+    # share one process's clock)
+    by_proc = {}
+    for iv in ivs:
+        if iv["phase"] in ("restore_prefetch", "aot_compile"):
+            key = (iv["node"], iv["pid"])
+            by_proc.setdefault(key, {})[iv["phase"]] = iv
+    both = [
+        d
+        for d in by_proc.values()
+        if "restore_prefetch" in d and "aot_compile" in d
+    ]
+    assert both, "no process emitted both restart-path legs"
+    overlapping = [
+        d
+        for d in both
+        if max(
+            d["restore_prefetch"]["start"], d["aot_compile"]["start"]
+        )
+        < min(d["restore_prefetch"]["end"], d["aot_compile"]["end"])
+    ]
+    assert overlapping, both
+
     # the invariant, at the spec's ±1% of wall
     assert abs(
         sum(loss.values()) - (ledger["wall_s"] - ledger["useful_s"])

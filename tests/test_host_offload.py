@@ -466,16 +466,19 @@ class TestFusedOffload:
         assert int(sf.step) == 4
 
     def test_delayed_equivalence_to_shifted_grads(self):
-        """Delayed mode's DOCUMENTED semantics: step t applies the
-        grads computed at step t-1 (zeros at t=1).  Replaying the
-        recorded grad sequence, shifted, through the chunked
-        optimizer must land on the same masters exactly."""
+        """Delayed mode's DOCUMENTED semantics: step 1 is a true
+        no-op (no previous gradients — weight decay gated, bias
+        correction counting real moment updates), and step t>=2
+        applies the grads computed at step t-1.  T delayed steps must
+        therefore land EXACTLY where T-1 synchronous chunked steps on
+        the recorded grad sequence land — weight decay included."""
         loss_fn, init_fn, batch = _ls_problem()
-        opt = HostOffloadAdamW(learning_rate=0.05)
+        opt = HostOffloadAdamW(learning_rate=0.05, weight_decay=0.01)
         init_f, step_f = build_fused_offload_step(
             loss_fn, init_fn, opt, delayed=True
         )
         state = init_f(jax.random.PRNGKey(3))
+        init_master = _cat_chunks(state.master["w"]).copy()
         grads_seen = []
         T = 4
         for _ in range(T):
@@ -483,16 +486,19 @@ class TestFusedOffload:
             grads_seen.append(
                 {"w": np.asarray(state.grads["w"], np.float32)}
             )
+            if len(grads_seen) == 1:
+                # the step-1 gate: with wd > 0 and no real gradient
+                # yet, NOTHING may move before the first real update
+                np.testing.assert_array_equal(
+                    _cat_chunks(state.master["w"]), init_master
+                )
         final_master = _cat_chunks(state.master["w"])
 
         ref_opt = HostOffloadAdamW(
-            learning_rate=0.05, backend="numpy"
+            learning_rate=0.05, weight_decay=0.01, backend="numpy"
         )
         ref = ref_opt.init(init_fn(jax.random.PRNGKey(3)))
-        shifted = [
-            {"w": np.zeros_like(grads_seen[0]["w"])}
-        ] + grads_seen[:-1]
-        for g in shifted:
+        for g in grads_seen[:-1]:  # shifted schedule: T-1 sync steps
             ref = ref_opt.apply_gradients(
                 ref, jax.tree_util.tree_map(jnp.asarray, g)
             )
@@ -592,10 +598,9 @@ class TestFusedOffload:
             learning_rate=0.05, backend="numpy"
         )
         ref = ref_opt.init(init_fn(jax.random.PRNGKey(3)))
-        shifted = [
-            {"w": np.zeros_like(grads_seen[0]["w"])}
-        ] + grads_seen[:-1]
-        for g in shifted:
+        # shifted schedule: the delayed no-op step 1 means T delayed
+        # steps == T-1 sync steps on the recorded mean grads
+        for g in grads_seen[:-1]:
             ref = ref_opt.apply_gradients(
                 ref, jax.tree_util.tree_map(jnp.asarray, g)
             )

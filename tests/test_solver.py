@@ -154,6 +154,61 @@ class TestSolve:
 
         assert gap(heavy) > gap(base)
 
+    def test_pipe_residency_tracks_configured_microbatches(self):
+        """The pipeline activation-residency divisor must come from
+        the strategy's ACTUAL microbatch count, not the hard-coded
+        2*pipe default: a deeper microbatch stream (smaller
+        microbatches in flight) lowers per-stage residency, so the
+        same pipe plan reports LOWER memory utilization at
+        pipe_microbatches=16 than at the default (2*pipe), and a
+        SHALLOWER stream (pipe_microbatches=pipe) reports higher."""
+
+        def pipe_util(**kw):
+            plans = solve(
+                bench_profile(), n_devices=8, batch_per_replica=8,
+                seq_len=2048, n_heads=16, global_batch=64,
+                top_k=500, **kw,
+            )
+            by_key = {}
+            for p in plans:
+                if p.strategy.pipe > 1:
+                    key = (
+                        p.strategy.data, p.strategy.fsdp,
+                        p.strategy.tensor, p.strategy.seq,
+                        p.strategy.expert, p.strategy.pipe,
+                        p.strategy.num_micro_steps, p.remat,
+                        p.block_q, p.block_kv,
+                    )
+                    by_key[key] = p.memory_utilization
+            assert by_key, "no pipe>1 plans surfaced"
+            return by_key
+
+        default = pipe_util()
+        deep = pipe_util(pipe_microbatches=16)
+        shallow = pipe_util(pipe_microbatches=2)
+        shared_deep = set(default) & set(deep)
+        assert shared_deep
+        assert all(deep[k] <= default[k] for k in shared_deep)
+        assert any(deep[k] < default[k] for k in shared_deep)
+        shared_shallow = set(default) & set(shallow)
+        assert shared_shallow
+        # pipe=2 default IS 2*pipe=4 mb: halving the stream to 2
+        # raises residency for plans whose activations matter
+        assert any(
+            shallow[k] > default[k] for k in shared_shallow
+        )
+        # the stamped count rides the returned strategy
+        plans = solve(
+            bench_profile(), n_devices=8, batch_per_replica=8,
+            seq_len=2048, n_heads=16, global_batch=64,
+            top_k=500, pipe_microbatches=16,
+        )
+        assert any(
+            p.strategy.pipe > 1
+            and p.strategy.pipe_microbatches == 16
+            for p in plans
+        )
+
     def test_remat_policy_table_sane(self):
         fracs = [f for f, _ in REMAT_POLICIES.values()]
         mults = [m for _, m in REMAT_POLICIES.values()]
