@@ -346,6 +346,27 @@ def _shm_drain_micro(nbytes: int) -> dict:
     return out
 
 
+def _input_micro(batch_mb: int, batches: int) -> dict:
+    """Input-plane throughput, pipelined zero-copy vs the legacy
+    serial ring path, same host (``scripts/bench_input.py`` owns the
+    measurement — ONE definition)."""
+    sys.path.insert(
+        0,
+        os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts"
+        ),
+    )
+    from bench_input import run_all
+
+    result = run_all(batch_mb, batches, slots=4)
+    out = {"input_batch_mb": batch_mb}
+    out["input_gbps"] = result["pipelined"]["gbps"]
+    out["input_serial_gbps"] = result["serial"]["gbps"]
+    if "pipelined_vs_serial" in result:
+        out["input_speedup"] = result["pipelined_vs_serial"]
+    return out
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -409,6 +430,18 @@ def main(argv=None) -> int:
         extras.update(_shm_drain_micro(drain_state_mb * 1024 * 1024))
     except Exception as e:  # noqa: BLE001
         extras["drain_micro_error"] = str(e)
+    flush_partial(args.out, payload)
+
+    # input-plane comparison, host-only and early for the same reason
+    try:
+        extras.update(
+            _input_micro(
+                batch_mb=16 if budget.tight(300) else 64,
+                batches=4 if budget.tight(300) else 8,
+            )
+        )
+    except Exception as e:  # noqa: BLE001
+        extras["input_micro_error"] = str(e)
     flush_partial(args.out, payload)
 
     import jax

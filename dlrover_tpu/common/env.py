@@ -60,6 +60,20 @@ def get_restart_count() -> int:
     return _get_int(NodeEnv.RESTART_COUNT, 0)
 
 
+INPUT_PIPELINE_ENV = "DLROVER_TPU_INPUT_PIPELINE"
+
+
+def input_pipeline_enabled() -> bool:
+    """Kill-switch for the pipelined input plane (background host
+    fetch in ``ElasticDataLoader``/``device_prefetch`` and the
+    shard-task RPC prefetch).  ``DLROVER_TPU_INPUT_PIPELINE=0``
+    reproduces the serial path — same batch order, byte-identical
+    batches (pinned by tests).  Default: enabled."""
+    return os.getenv(INPUT_PIPELINE_ENV, "1").lower() not in (
+        "0", "false", "off",
+    )
+
+
 def get_free_port(host: str = "127.0.0.1") -> int:
     import socket
 

@@ -1,10 +1,15 @@
 from dlrover_tpu.data.elastic_dataloader import (  # noqa: F401
     ElasticDataLoader,
 )
-from dlrover_tpu.data.prefetch import device_prefetch  # noqa: F401
+from dlrover_tpu.data.prefetch import (  # noqa: F401
+    batch_nbytes,
+    device_prefetch,
+    host_prefetch,
+)
 from dlrover_tpu.data.shm_dataloader import (  # noqa: F401
     ShmDataLoader,
     ShmBatchWriter,
+    ShmSlotTimeout,
 )
 from dlrover_tpu.data.coworker import (  # noqa: F401
     CoworkerClient,

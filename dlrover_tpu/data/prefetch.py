@@ -4,15 +4,150 @@ Reference parity: ``atorch/atorch/data/preloader.py`` (GPU data
 preloader with a side CUDA stream).  On TPU the idiom is simpler:
 ``jax.device_put`` is async — keep N batches in flight so the host
 pipeline never stalls the device (double/triple buffering).
+
+Two pipeline stages, separately attributable on the job timeline:
+
+- ``host_fetch`` — producing the next host batch
+  (``next(iterator)``; with ``pipelined=True`` a bounded
+  background-thread producer runs it concurrently, so the fetch of
+  batch k+1 overlaps the ``device_put`` of batch k and the compute of
+  batch k−1);
+- ``h2d`` — staging the host batch onto devices (``jax.device_put``
+  dispatch; normally asynchronous and ~free, so a slow dispatch is a
+  transfer-queue backpressure signal).
+
+A stage slower than ``stall_threshold_s`` emits a ``data_stall`` span
+tagged ``stage=host_fetch`` / ``stage=h2d`` — the split tells a
+too-slow storage read apart from a saturated host-to-device link.
+The measured host-fetch bandwidth is exported as the
+``dlrover_tpu_input_gbps{stage="host_fetch"}`` gauge.
 """
 
 import collections
+import queue
+import threading
 import time
 from typing import Iterable, Iterator, Optional
 
-import jax
-
 from dlrover_tpu.observability.events import get_event_logger
+from dlrover_tpu.observability.metrics import record_input_io
+
+#: gauge refresh window: batch rates are noisy, export ~1/s
+_METER_WINDOW_S = 1.0
+
+
+def batch_nbytes(batch) -> int:
+    """Total array bytes in a (possibly nested) batch structure; 0 for
+    leaves without ``nbytes`` (lists of strings, scalars, ...)."""
+    if hasattr(batch, "nbytes"):
+        return int(batch.nbytes)
+    if isinstance(batch, dict):
+        return sum(batch_nbytes(v) for v in batch.values())
+    if isinstance(batch, (list, tuple)):
+        return sum(batch_nbytes(v) for v in batch)
+    return 0
+
+
+class _ThroughputMeter:
+    """Windowed bytes/s accumulator feeding the input-gbps gauge."""
+
+    def __init__(self, stage: str):
+        self._stage = stage
+        self._bytes = 0
+        self._seconds = 0.0
+        self._last_export = time.monotonic()
+
+    def observe(self, nbytes: int, seconds: float):
+        self._bytes += nbytes
+        self._seconds += seconds
+        now = time.monotonic()
+        if (
+            now - self._last_export >= _METER_WINDOW_S
+            and self._bytes > 0
+            and self._seconds > 0.0
+        ):
+            record_input_io(self._stage, self._bytes, self._seconds)
+            self._bytes = 0
+            self._seconds = 0.0
+            self._last_export = now
+
+
+class _EndOfStream:
+    """Queue sentinel: clean iterator end, or carries the exception."""
+
+    def __init__(self, error: Optional[BaseException] = None):
+        self.error = error
+
+
+def host_prefetch(
+    iterator: Iterable,
+    size: int = 2,
+    stall_threshold_s: float = 0.05,
+) -> Iterator:
+    """Yield host batches produced by a bounded background thread.
+
+    The producer thread runs ``next(iterator)`` up to ``size`` batches
+    ahead; the consumer blocks only when the producer cannot keep up —
+    that wait is the true pipeline stall and is emitted as a
+    ``data_stall`` span tagged ``stage=host_fetch``.  Batch order is
+    exactly the serial iteration order; an iterator exception is
+    re-raised at the consuming call site.
+    """
+    events = get_event_logger()
+    meter = _ThroughputMeter("host_fetch")
+    q: "queue.Queue" = queue.Queue(maxsize=max(1, size))
+    stop = threading.Event()
+
+    def _put_until_stopped(item):
+        """Blocking put that still notices consumer shutdown — the
+        END/ERROR sentinels MUST land (a dropped error sentinel would
+        leave the consumer blocked on q.get() forever)."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.5)
+                return
+            except queue.Full:
+                continue
+
+    def _produce():
+        it = iter(iterator)
+        try:
+            while not stop.is_set():
+                t0 = time.monotonic()
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    _put_until_stopped(_EndOfStream())
+                    return
+                # the gauge measures the PRODUCTION bandwidth (the
+                # fetch itself), not the backpressure wait below
+                meter.observe(
+                    batch_nbytes(batch), time.monotonic() - t0
+                )
+                _put_until_stopped(batch)
+        except BaseException as e:  # noqa: BLE001 - re-raised consumer-side
+            _put_until_stopped(_EndOfStream(e))
+
+    thread = threading.Thread(
+        target=_produce, name="input-host-prefetch", daemon=True
+    )
+    thread.start()
+    try:
+        while True:
+            t0_wall, t0_mono = time.time(), time.monotonic()
+            item = q.get()
+            wait = time.monotonic() - t0_mono
+            if isinstance(item, _EndOfStream):
+                if item.error is not None:
+                    raise item.error
+                return
+            if events.enabled and wait >= stall_threshold_s:
+                events.complete(
+                    "data_stall", t0_wall, wait, stage="host_fetch"
+                )
+            yield item
+    finally:
+        stop.set()
 
 
 def device_prefetch(
@@ -20,24 +155,38 @@ def device_prefetch(
     size: int = 2,
     sharding: Optional[object] = None,
     stall_threshold_s: float = 0.05,
+    pipelined: bool = False,
 ) -> Iterator:
     """Yield device-resident batches with ``size`` transfers in flight.
 
     ``sharding`` (a NamedSharding / prefix pytree) places each batch
     directly in its training layout — no host-side reshard later.
 
-    A host fetch (``next(iterator)``) slower than
-    ``stall_threshold_s`` is emitted as a ``data_stall`` span on the
-    job timeline: with ``size`` batches in flight a slow fetch here is
-    exactly the input pipeline failing to hide behind device compute.
+    ``pipelined=True`` adds the background host producer
+    (:func:`host_prefetch`): ``next(iterator)`` for batch k+1 runs
+    concurrently with the ``device_put`` of batch k and the compute of
+    batch k−1.  ``pipelined=False`` is the serial fallback — identical
+    batch order, host fetch inline on the consumer thread.
+
+    A host fetch slower than ``stall_threshold_s`` is emitted as a
+    ``data_stall`` span tagged ``stage=host_fetch``; a ``device_put``
+    dispatch slower than the threshold as ``stage=h2d``.
     """
-    queue = collections.deque()
+    import jax
+
+    q = collections.deque()
     events = get_event_logger()
 
     def _put(batch):
+        t0_wall, t0_mono = time.time(), time.monotonic()
         if sharding is not None:
-            return jax.device_put(batch, sharding)
-        return jax.device_put(batch)
+            out = jax.device_put(batch, sharding)
+        else:
+            out = jax.device_put(batch)
+        dur = time.monotonic() - t0_mono
+        if events.enabled and dur >= stall_threshold_s:
+            events.complete("data_stall", t0_wall, dur, stage="h2d")
+        return out
 
     def _fetch(it):
         """next(it) with stall accounting; raises StopIteration."""
@@ -47,19 +196,34 @@ def device_prefetch(
         batch = next(it)
         dur = time.monotonic() - t0_mono
         if dur >= stall_threshold_s:
-            events.complete("data_stall", t0_wall, dur)
+            events.complete(
+                "data_stall", t0_wall, dur, stage="host_fetch"
+            )
         return batch
 
-    it = iter(iterator)
+    if pipelined:
+        # host_prefetch already accounts the host_fetch stalls (the
+        # queue wait); fetching from it again through _fetch would
+        # double-book the same wall clock
+        it = iter(
+            host_prefetch(
+                iterator, size=size,
+                stall_threshold_s=stall_threshold_s,
+            )
+        )
+        fetch = next
+    else:
+        it = iter(iterator)
+        fetch = _fetch
     try:
         for _ in range(size):
-            queue.append(_put(_fetch(it)))
+            q.append(_put(fetch(it)))
     except StopIteration:
         pass
-    while queue:
-        out = queue.popleft()
+    while q:
+        out = q.popleft()
         try:
-            queue.append(_put(_fetch(it)))
+            q.append(_put(fetch(it)))
         except StopIteration:
             pass
         yield out

@@ -7,7 +7,6 @@ checkpoints/restores splitter + queue state.
 """
 
 import threading
-import time
 from typing import Dict, Optional
 
 from dlrover_tpu.common.global_context import Context
@@ -25,13 +24,15 @@ _ctx = Context.singleton_instance()
 
 class TaskManager:
     def __init__(self, worker_restart_timeout: float = 0.0,
-                 speed_monitor=None):
+                 speed_monitor=None, check_interval: float = 30.0):
         self._lock = threading.Lock()
         self._worker_restart_timeout = worker_restart_timeout
         self._datasets: Dict[str, BatchDatasetManager] = {}
         self._speed_monitor = speed_monitor
         self._task_timeout = _ctx.seconds_to_timeout_task
-        self._stopped = False
+        self._check_interval = check_interval
+        self._stopped = threading.Event()
+        self._watcher: Optional[threading.Thread] = None
         self._worker_client_hosts: Dict[int, str] = {}
 
     def new_dataset(self, params: DatasetShardParams):
@@ -110,17 +111,20 @@ class TaskManager:
             return True
 
     def start(self):
-        threading.Thread(
+        self._watcher = threading.Thread(
             target=self._check_timeout_tasks,
             name="task-timeout-watcher",
             daemon=True,
-        ).start()
+        )
+        self._watcher.start()
 
     def stop(self):
-        self._stopped = True
+        self._stopped.set()
 
     def _check_timeout_tasks(self):
-        while not self._stopped:
+        # Event.wait instead of time.sleep so stop() interrupts the
+        # 30 s pause immediately — master shutdown is prompt
+        while not self._stopped.is_set():
             with self._lock:
                 for dataset in self._datasets.values():
                     for task_id in dataset.get_timeout_tasks(
@@ -134,4 +138,4 @@ class TaskManager:
                                 doing.node_id,
                             )
                             dataset.recover_task(doing.task)
-            time.sleep(30)
+            self._stopped.wait(self._check_interval)

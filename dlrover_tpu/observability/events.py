@@ -108,6 +108,11 @@ INSTANT_EVENTS = frozenset(
 #: ``scripts/check_event_schema.py``.
 REQUIRED_SPAN_LABELS: Dict[str, Tuple[str, ...]] = {
     PHASE_STEP: ("step",),
+    # input-pipeline stalls carry the stage that stalled
+    # (host_fetch — producing the host batch — vs h2d — staging it
+    # onto devices) so a slow storage read and a saturated transfer
+    # link stay distinguishable in the ledger
+    PHASE_DATA_STALL: ("stage",),
     # checkpoint data-plane spans carry their size and measured
     # bandwidth so throughput regressions surface in the ledger and
     # in bench_goodput's loss breakdown, not only in wall time

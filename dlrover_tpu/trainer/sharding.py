@@ -11,15 +11,26 @@ consumed exactly once per epoch across an elastic worker set.
 import threading
 import time
 from collections import deque
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Iterator, Optional
 
 from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.env import input_pipeline_enabled
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.common.messages import DataShard, Task, TaskType
 
 
 class ShardingClient:
-    """Fetches data-shard tasks from the master and acknowledges them."""
+    """Fetches data-shard tasks from the master and acknowledges them.
+
+    With the input pipeline enabled (``DLROVER_TPU_INPUT_PIPELINE``,
+    default on; also ``prefetch_tasks=``), the *next* shard task is
+    requested from the master in the background the moment the current
+    one is handed out — consuming a shard completely hides the
+    ``get_task`` RPC round trip.  A prefetched-but-never-consumed task
+    is recovered master-side by the ordinary timeout/dead-worker
+    requeue, same as a shard in flight at a worker crash.
+    """
 
     def __init__(
         self,
@@ -31,12 +42,20 @@ class ShardingClient:
         num_minibatches_per_shard: int = 2,
         client: Optional[MasterClient] = None,
         storage_type: str = "table",
+        prefetch_tasks: Optional[bool] = None,
     ):
         self._client = client or MasterClient.singleton_instance()
         self._dataset_name = dataset_name
         self._batch_size = batch_size
         self._pending: deque = deque()
         self._lock = threading.Lock()
+        self._prefetch_enabled = (
+            input_pipeline_enabled()
+            if prefetch_tasks is None
+            else bool(prefetch_tasks)
+        )
+        self._prefetched: Optional[Future] = None
+        self._rpc_pool: Optional[ThreadPoolExecutor] = None
         if dataset_size > 0:
             self._client.report_dataset_shard_params(
                 dataset_name=dataset_name,
@@ -52,11 +71,31 @@ class ShardingClient:
     def dataset_name(self) -> str:
         return self._dataset_name
 
+    def _next_task(self) -> Task:
+        """One ``get_task`` RPC — prefetched result when available."""
+        if self._prefetched is not None:
+            fut, self._prefetched = self._prefetched, None
+            return fut.result()
+        return self._client.get_task(self._dataset_name)
+
+    def _kick_prefetch(self):
+        """Request the NEXT task in the background so the RPC overlaps
+        the consumption of the shard just handed out."""
+        if not self._prefetch_enabled or self._prefetched is not None:
+            return
+        if self._rpc_pool is None:
+            self._rpc_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="shard-prefetch"
+            )
+        self._prefetched = self._rpc_pool.submit(
+            self._client.get_task, self._dataset_name
+        )
+
     def fetch_shard(self, wait_interval: float = 2.0) -> Optional[DataShard]:
         """Next shard, or None when the dataset is exhausted.  Blocks
         through WAIT tasks (dataset not fully dispatched yet)."""
         while True:
-            task: Task = self._client.get_task(self._dataset_name)
+            task: Task = self._next_task()
             if task.task_type == TaskType.WAIT:
                 time.sleep(wait_interval)
                 continue
@@ -64,6 +103,7 @@ class ShardingClient:
                 return None
             with self._lock:
                 self._pending.append(task)
+            self._kick_prefetch()
             return task.shard
 
     def report_batch_done(self, task_ids=None) -> bool:

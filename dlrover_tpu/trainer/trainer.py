@@ -454,12 +454,20 @@ class Trainer:
 
     # ------------------------------------------------------------- train
     def train(self):
+        from dlrover_tpu.common.env import input_pipeline_enabled
+        from dlrover_tpu.data.prefetch import device_prefetch
+
         start_step = self._init_or_restore_state()
         if self._exporter is not None:
             self._exporter.start()
         self._hang.start()
         self._callbacks.on_train_begin(start_step)
         batch_sharding = self._fns.batch_sharding
+        # pipelined input plane: host fetch of batch k+1 runs on a
+        # background thread while batch k stages h2d and batch k-1
+        # computes; DLROVER_TPU_INPUT_PIPELINE=0 reproduces the serial
+        # fetch + inline device_put path exactly
+        pipeline_on = input_pipeline_enabled()
         step = start_step
         step_times = []
         eval_every = (
@@ -483,7 +491,19 @@ class Trainer:
             tracing_left = 0
             trace_dir_cur = None
             while step < self._args.max_steps:
-                for batch in self._data_iter_fn():
+                if pipeline_on:
+                    # batches arrive device-resident, with `size`
+                    # transfers in flight and the NEXT host fetch
+                    # already running in the background
+                    epoch_iter = device_prefetch(
+                        self._data_iter_fn(),
+                        size=2,
+                        sharding=batch_sharding,
+                        pipelined=True,
+                    )
+                else:
+                    epoch_iter = self._data_iter_fn()
+                for batch in epoch_iter:
                     if step >= self._args.max_steps:
                         break
                     if (
@@ -513,10 +533,17 @@ class Trainer:
                             1, self._args.trace_steps
                         )
                     if self._replay is not None:
+                        # on the pipelined path `batch` is already
+                        # device-resident; the recorder's np.asarray
+                        # pulls it back — replay is an opt-in debug
+                        # mode, correctness over overlap
                         self._replay.record(step + 1, batch)
-                    device_batch = jax.device_put(
-                        batch, batch_sharding
-                    )
+                    if pipeline_on:
+                        device_batch = batch
+                    else:
+                        device_batch = jax.device_put(
+                            batch, batch_sharding
+                        )
                     self.state, metrics = self._fns.train_step(
                         self.state, device_batch
                     )

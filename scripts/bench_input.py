@@ -1,0 +1,202 @@
+"""Micro-benchmark for the training input plane.
+
+Pushes a synthetic >= 64 MB batch stream through the shm batch ring
+(``data/shm_dataloader.py``) three ways and reports batches/s + GB/s
+for each:
+
+- ``serial`` — the legacy data plane: ``zero_copy=False`` ring
+  (``tobytes()`` on write, ``bytes()+frombuffer`` on read — four full
+  serial copies per batch), producer inline with the consumer, consume
+  copy from the private batch.  The pre-rewrite reference path.
+- ``zero_copy`` — the new ring: ``np.ndarray`` views over the segment
+  + chunked ``parallel_memcpy`` writes, ``copy=False`` reads (the
+  consume stage reads straight out of the slot), still fully inline.
+- ``pipelined`` — ``zero_copy`` plus the producer on a background
+  thread, so the write of batch k+1 overlaps the consume of batch k
+  (the shape ``ElasticDataLoader``'s producer pool / ``host_prefetch``
+  give a real training loop).
+
+The consume stage is one ``np.copyto`` into a preallocated staging
+buffer — a stand-in for the h2d staging copy — so every mode pays the
+same downstream cost and the deltas isolate the ring data plane.
+
+Usage::
+
+    python scripts/bench_input.py [--batch_mb 64] [--batches 12]
+                                  [--slots 4] [--out OUT.json]
+
+Honors ``DLROVER_TPU_BENCH_BUDGET_S`` (scales batch count/size down)
+and flushes the payload-so-far to ``--out`` after every mode.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+# ONE definition of the budget/flush semantics across all benches
+from bench import BenchBudget, flush_partial as _flush  # noqa: E402
+
+from dlrover_tpu.data.shm_dataloader import (  # noqa: E402
+    BatchSpec,
+    ShmBatchWriter,
+    ShmDataLoader,
+)
+
+MODES = ("serial", "zero_copy", "pipelined")
+
+
+def _gbps(nbytes: int, seconds: float) -> float:
+    return round(nbytes / 1e9 / max(seconds, 1e-9), 3)
+
+
+def make_sources(batch_mb: int, n_distinct: int = 2):
+    """A few distinct source batches to rotate through (a single
+    reused source would understate cache pressure)."""
+    n = batch_mb * 1024 * 1024 // 4
+    return [
+        {"x": np.full((n,), float(i + 1), np.float32)}
+        for i in range(n_distinct)
+    ]
+
+
+def run_mode(mode: str, name: str, sources, batches: int,
+             slots: int) -> dict:
+    """One measured pass; returns {batches_s, gbps, elapsed_s}."""
+    spec = BatchSpec({"x": (sources[0]["x"].shape, "float32")})
+    batch_bytes = sources[0]["x"].nbytes
+    zero_copy = mode != "serial"
+    loader = ShmDataLoader(
+        name, spec, num_slots=slots, timeout=120.0,
+        zero_copy=zero_copy,
+    )
+    writer = ShmBatchWriter(name, zero_copy=zero_copy)
+    stage = np.empty_like(sources[0]["x"])  # simulated h2d staging
+    err: list = []
+    try:
+        # warmup: fault the slot + staging pages outside the timing
+        writer.put(sources[0])
+        b = loader.next_batch(copy=not zero_copy)
+        np.copyto(stage, b["x"])
+        loader.release_slot()
+
+        t0 = time.perf_counter()
+        if mode == "pipelined":
+
+            def _produce():
+                try:
+                    for i in range(batches):
+                        writer.put(sources[i % len(sources)],
+                                   timeout=120.0)
+                except Exception as e:  # noqa: BLE001
+                    err.append(e)
+
+            thread = threading.Thread(target=_produce, daemon=True)
+            thread.start()
+            for _ in range(batches):
+                b = loader.next_batch(copy=False)
+                np.copyto(stage, b["x"])
+            loader.release_slot()
+            thread.join()
+            if err:
+                raise err[0]
+        else:
+            for i in range(batches):
+                writer.put(sources[i % len(sources)])
+                b = loader.next_batch(copy=not zero_copy)
+                np.copyto(stage, b["x"])
+                loader.release_slot()
+        elapsed = time.perf_counter() - t0
+    finally:
+        b = None  # noqa: F841 - drop slot views so close() can unmap
+        writer.close()
+        loader.close()
+    return {
+        "batches_s": round(batches / max(elapsed, 1e-9), 2),
+        "gbps": _gbps(batches * batch_bytes, elapsed),
+        "elapsed_s": round(elapsed, 3),
+    }
+
+
+def run_all(batch_mb: int, batches: int, slots: int,
+            out_path: str = "", payload: dict = None) -> dict:
+    """All three modes + speedups; shared with ``bench.py`` extras."""
+    sources = make_sources(batch_mb)
+    result = {
+        "batch_mb": batch_mb,
+        "batches": batches,
+        "slots": slots,
+        "cpu_count": os.cpu_count(),
+    }
+    for mode in MODES:
+        result[mode] = run_mode(
+            mode, f"benchin_{mode}_{os.getpid()}", sources, batches,
+            slots,
+        )
+        if payload is not None:
+            payload["extras"]["input"] = result
+            _flush(out_path, payload)
+    serial_bs = result["serial"]["batches_s"]
+    if serial_bs:
+        for mode in ("zero_copy", "pipelined"):
+            result[f"{mode}_vs_serial"] = round(
+                result[mode]["batches_s"] / serial_bs, 2
+            )
+    return result
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="input-plane micro-benchmark"
+    )
+    parser.add_argument("--batch_mb", type=int, default=64)
+    parser.add_argument("--batches", type=int, default=12)
+    parser.add_argument("--slots", type=int, default=4)
+    parser.add_argument("--out", default="")
+    args = parser.parse_args(argv)
+
+    os.environ.setdefault(
+        "DLROVER_TPU_SOCKET_DIR",
+        tempfile.mkdtemp(prefix="dlrover_benchin_socks_"),
+    )
+
+    budget = BenchBudget()
+    batch_mb, batches = args.batch_mb, args.batches
+    if budget.tight(180):
+        # keep the >= 64 MB batch (the acceptance workload) as long as
+        # possible; shed repetitions first, size only under hard
+        # pressure
+        batches = min(batches, 6)
+    if budget.tight(60):
+        batch_mb, batches = min(batch_mb, 16), min(batches, 4)
+
+    payload = {
+        "metric": "input_pipelined_batches_s",
+        "value": None,
+        "unit": "batches/s",
+        "vs_baseline": None,
+        "extras": {"bench_budget_s": budget.total},
+    }
+    result = run_all(
+        batch_mb, batches, args.slots, args.out, payload
+    )
+    payload["extras"]["input"] = result
+    payload["value"] = result["pipelined"]["batches_s"]
+    # the bar: pipelined zero-copy >= 2x the legacy serial path
+    payload["vs_baseline"] = result.get("pipelined_vs_serial")
+
+    print(json.dumps(payload), flush=True)
+    _flush(args.out, payload)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

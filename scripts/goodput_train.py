@@ -26,8 +26,11 @@ ctx = init_distributed()
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
 import optax  # noqa: E402
 
+from dlrover_tpu.common.env import input_pipeline_enabled  # noqa: E402
+from dlrover_tpu.data.prefetch import device_prefetch  # noqa: E402
 from dlrover_tpu.observability.events import get_event_logger  # noqa: E402
 from dlrover_tpu.parallel.mesh import AxisName, create_parallel_mesh  # noqa: E402
 from dlrover_tpu.trainer.checkpoint.engine import CheckpointEngine  # noqa: E402
@@ -151,10 +154,33 @@ def main() -> int:
     step_fn = compiled_step if compiled_step is not None else train_step
 
     step = int(state["step"])
-    x = jax.random.normal(jax.random.PRNGKey(ctx.rank), (16, 32))
+
+    def batch_stream(start: int):
+        """Deterministic per-step host batches: a restart resuming at
+        step k regenerates exactly the batches the dead incarnation
+        would have consumed — the pipelined and serial paths stay
+        byte-identical across restarts."""
+        i = start
+        while True:
+            rng = np.random.default_rng((ctx.rank << 20) + i)
+            yield rng.standard_normal((16, 32)).astype(np.float32)
+            i += 1
+
+    # pipelined input plane: the host fetch of batch k+1 overlaps the
+    # device staging of batch k and the compute of step k-1;
+    # DLROVER_TPU_INPUT_PIPELINE=0 falls back to inline fetch (same
+    # batch order)
+    if input_pipeline_enabled():
+        batches = iter(
+            device_prefetch(batch_stream(step), size=2, pipelined=True)
+        )
+    else:
+        batches = batch_stream(step)
+
     first_step = True
     while step < TARGET:
         step_barrier()
+        x = next(batches)
         t0_wall, t0_mono = time.time(), time.monotonic()
         if first_step:
             # this incarnation's warmup: the AOT hand-off (or the
