@@ -367,6 +367,26 @@ def _input_micro(batch_mb: int, batches: int) -> dict:
     return out
 
 
+def _control_micro(n_agents: int, wait_s: float) -> dict:
+    """Control-plane long-poll vs polling over the real gRPC master,
+    same host (``scripts/bench_control_plane.py`` owns the
+    measurement — ONE definition)."""
+    sys.path.insert(
+        0,
+        os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts"
+        ),
+    )
+    from bench_control_plane import run_all
+
+    result = run_all(n_agents, wait_s)
+    out = {"control_bench": result}
+    for key in ("control_rps", "control_rpc_reduction"):
+        if key in result:
+            out[key] = result[key]
+    return out
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -442,6 +462,19 @@ def main(argv=None) -> int:
         )
     except Exception as e:  # noqa: BLE001
         extras["input_micro_error"] = str(e)
+    flush_partial(args.out, payload)
+
+    # control-plane comparison, host-only and early for the same
+    # reason (real gRPC master + simulated agents on localhost)
+    try:
+        extras.update(
+            _control_micro(
+                n_agents=4 if budget.tight(300) else 8,
+                wait_s=2.0 if budget.tight(300) else 5.0,
+            )
+        )
+    except Exception as e:  # noqa: BLE001
+        extras["control_micro_error"] = str(e)
     flush_partial(args.out, payload)
 
     import jax

@@ -15,7 +15,44 @@ from typing import Dict, List, Optional, Tuple
 from dlrover_tpu.common import messages as msg
 from dlrover_tpu.common.comm import MasterChannel
 from dlrover_tpu.common.constants import NodeEnv, NodeType, RendezvousName
+from dlrover_tpu.common.env import (
+    control_batch_enabled,
+    control_longpoll_enabled,
+)
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.observability.events import get_event_logger
+
+#: one long-poll RPC parks on the master at most this long; waits
+#: longer than a chunk loop (each chunk is still ONE rpc, so a 5 min
+#: wait costs 10 RPCs instead of 1500 at a 0.2 s poll)
+LONGPOLL_CHUNK_S = float(
+    os.getenv("DLROVER_TPU_CONTROL_LONGPOLL_CHUNK_S", "30")
+)
+#: grpc deadline margin over the server-side wait: the RPC must not be
+#: deadline-killed while the master is still legitimately parked
+_LONGPOLL_RPC_MARGIN_S = 10.0
+#: a saturated master (parked-waiter cap hit) answers a long-poll
+#: immediately instead of parking; pace re-issues so the fallback is
+#: a 10 Hz poll, not a hot RPC spin
+_LONGPOLL_SATURATED_BACKOFF_S = 0.1
+
+
+def _pace_longpoll(chunk: float, rpc_elapsed: float):
+    """Sleep briefly when a long-poll chunk came back empty far sooner
+    than it should have (master degraded the wait to an immediate
+    answer under load)."""
+    if chunk > 0.2 and rpc_elapsed < 0.05:
+        time.sleep(_LONGPOLL_SATURATED_BACKOFF_S)
+
+
+def _longpoll_params(wait_timeout: float):
+    """ONE definition of the chunk clamp + RPC deadline: returns
+    ``(clamped_wait, rpc_timeout)`` — ``rpc_timeout`` None when not
+    long-polling (the channel's default applies)."""
+    if wait_timeout <= 0:
+        return 0.0, None
+    wait_timeout = min(wait_timeout, LONGPOLL_CHUNK_S)
+    return wait_timeout, wait_timeout + _LONGPOLL_RPC_MARGIN_S
 
 
 class MasterClient:
@@ -37,6 +74,12 @@ class MasterClient:
         self._channel = MasterChannel(
             master_addr, node_id=node_id, node_type=node_type, timeout=timeout
         )
+        # delta-protocol caches: last full response + its version, so
+        # a ``NotModified`` answer resolves locally
+        self._comm_world_cache: Dict[
+            str, Tuple[int, Tuple[int, int, Dict[int, int]]]
+        ] = {}
+        self._running_nodes_cache: Optional[Tuple[int, list]] = None
 
     # ------------------------------------------------------------ lifecycle
     @classmethod
@@ -70,6 +113,11 @@ class MasterClient:
     @property
     def node_id(self) -> int:
         return self._node_id
+
+    @property
+    def rpc_count(self) -> int:
+        """RPCs issued on the wire by this client (attempts)."""
+        return self._channel.rpc_count
 
     def close(self):
         self._channel.close()
@@ -116,18 +164,98 @@ class MasterClient:
     def get_comm_world(
         self, rdzv_name: str, node_rank: int
     ) -> Tuple[int, int, Dict[int, int]]:
-        """Returns (round, group, {node_rank: local_world_size})."""
+        """Returns (round, group, {node_rank: local_world_size}).
+
+        Delta protocol: the request carries the version of the cached
+        copy; a ``NotModified`` answer resolves from the cache without
+        the master re-shipping the world.
+        """
+        cached = self._comm_world_cache.get(rdzv_name)
+        version = cached[0] if cached else -1
         world = self._channel.get(
-            msg.CommWorldRequest(node_id=node_rank, rdzv_name=rdzv_name)
+            msg.CommWorldRequest(
+                node_id=node_rank, rdzv_name=rdzv_name, version=version
+            )
         )
-        if world is None:
+        if isinstance(world, msg.NotModified) and cached:
+            return cached[1]
+        if world is None or isinstance(world, msg.NotModified):
             return -1, 0, {}
-        return world.round, world.group, world.world or {}
+        result = (world.round, world.group, world.world or {})
+        self._comm_world_cache[rdzv_name] = (
+            getattr(world, "version", 0), result
+        )
+        return result
+
+    def wait_comm_world(
+        self,
+        rdzv_name: str,
+        node_rank: int,
+        timeout: float,
+        poll_interval: float = 0.3,
+    ) -> Tuple[int, int, Dict[int, int]]:
+        """Long-poll ``get_comm_world``: block until the master
+        declares the world complete (or ``timeout`` elapses — an empty
+        world is then returned).  Falls back to the get-every-
+        ``poll_interval`` loop under
+        ``DLROVER_TPU_CONTROL_LONGPOLL=0``."""
+        deadline = time.time() + max(timeout, 0.0)
+        longpoll = control_longpoll_enabled()
+        with get_event_logger().span(
+            "control_wait", kind="comm_world", rdzv=rdzv_name
+        ):
+            while True:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return -1, 0, {}
+                if longpoll:
+                    chunk = min(remaining, LONGPOLL_CHUNK_S)
+                    t0 = time.monotonic()
+                    world = self._channel.get(
+                        msg.CommWorldRequest(
+                            node_id=node_rank,
+                            rdzv_name=rdzv_name,
+                            wait_timeout=chunk,
+                        ),
+                        timeout=chunk + _LONGPOLL_RPC_MARGIN_S,
+                    )
+                    if world is not None and not isinstance(
+                        world, msg.NotModified
+                    ):
+                        result = (
+                            world.round, world.group, world.world or {}
+                        )
+                        if result[2]:
+                            self._comm_world_cache[rdzv_name] = (
+                                getattr(world, "version", 0), result
+                            )
+                            return result
+                    _pace_longpoll(chunk, time.monotonic() - t0)
+                else:
+                    rnd, group, world_map = self.get_comm_world(
+                        rdzv_name, node_rank
+                    )
+                    if world_map:
+                        return rnd, group, world_map
+                    time.sleep(poll_interval)
 
     def num_nodes_waiting(
-        self, rdzv_name: str = RendezvousName.ELASTIC_TRAINING
+        self,
+        rdzv_name: str = RendezvousName.ELASTIC_TRAINING,
+        wait_timeout: float = 0.0,
+        last_num: int = -1,
     ) -> int:
-        res = self._channel.get(msg.WaitingNodeNumRequest(rdzv_name=rdzv_name))
+        """Current waiting count; with ``wait_timeout`` > 0 the master
+        long-polls until the count differs from ``last_num``."""
+        wait_timeout, timeout = _longpoll_params(wait_timeout)
+        res = self._channel.get(
+            msg.WaitingNodeNumRequest(
+                rdzv_name=rdzv_name,
+                wait_timeout=wait_timeout,
+                last_num=last_num,
+            ),
+            timeout=timeout,
+        )
         return res.waiting_num if res else 0
 
     def check_fault_node(self) -> Tuple[List[int], str]:
@@ -183,15 +311,47 @@ class MasterClient:
         return res.value if res and res.value is not None else b""
 
     def kv_store_wait(
-        self, key: str, timeout: float = 300.0, interval: float = 0.2
+        self,
+        key: str,
+        timeout: float = 300.0,
+        interval: float = 0.2,
+        longpoll: Optional[bool] = None,
     ) -> bytes:
-        """Poll the master KV store until ``key`` appears."""
+        """Block until ``key`` appears in the master KV store.
+
+        Long-poll (default): each RPC parks on the master's KV
+        condition up to ``LONGPOLL_CHUNK_S`` — an idle 5 min wait costs
+        ~10 RPCs.  ``DLROVER_TPU_CONTROL_LONGPOLL=0`` (or
+        ``longpoll=False``) restores the get-every-``interval`` polling
+        loop as the bench reference.
+        """
+        if longpoll is None:
+            longpoll = control_longpoll_enabled()
         deadline = time.time() + timeout
-        while time.time() < deadline:
-            value = self.kv_store_get(key)
-            if value:
-                return value
-            time.sleep(interval)
+        with get_event_logger().span("control_wait", kind="kv", key=key):
+            while time.time() < deadline:
+                if longpoll:
+                    chunk = min(
+                        deadline - time.time(), LONGPOLL_CHUNK_S
+                    )
+                    t0 = time.monotonic()
+                    res = self._channel.get(
+                        msg.KVWaitRequest(key=key, wait_timeout=chunk),
+                        timeout=chunk + _LONGPOLL_RPC_MARGIN_S,
+                    )
+                    value = (
+                        res.value
+                        if res and res.value is not None
+                        else b""
+                    )
+                    if value:
+                        return value
+                    _pace_longpoll(chunk, time.monotonic() - t0)
+                else:
+                    value = self.kv_store_get(key)
+                    if value:
+                        return value
+                    time.sleep(interval)
         raise TimeoutError(f"key {key!r} not set within {timeout}s")
 
     # ---------------------------------------------------------- data shards
@@ -219,8 +379,18 @@ class MasterClient:
             )
         )
 
-    def get_task(self, dataset_name: str) -> msg.Task:
-        task = self._channel.get(msg.TaskRequest(dataset_name=dataset_name))
+    def get_task(
+        self, dataset_name: str, wait_timeout: float = 0.0
+    ) -> msg.Task:
+        """Next shard task; ``wait_timeout`` > 0 long-polls through
+        WAIT answers (the master parks until a task is dispatchable)."""
+        wait_timeout, timeout = _longpoll_params(wait_timeout)
+        task = self._channel.get(
+            msg.TaskRequest(
+                dataset_name=dataset_name, wait_timeout=wait_timeout
+            ),
+            timeout=timeout,
+        )
         return task if task is not None else msg.Task(task_id=-1)
 
     def report_task_result(
@@ -335,11 +505,27 @@ class MasterClient:
 
     # -------------------------------------------------------------- control
     def get_running_nodes(self) -> list:
-        res = self._channel.get(msg.RunningNodesRequest())
-        return res.nodes if res else []
+        """Running node list; versioned — an unchanged master answers
+        ``NotModified`` and the cached copy is returned."""
+        cached = self._running_nodes_cache
+        version = cached[0] if cached else -1
+        res = self._channel.get(msg.RunningNodesRequest(version=version))
+        if isinstance(res, msg.NotModified) and cached:
+            return cached[1]
+        if res is None or isinstance(res, msg.NotModified):
+            return []
+        nodes = res.nodes or []
+        self._running_nodes_cache = (getattr(res, "version", 0), nodes)
+        return nodes
 
-    def get_training_status(self) -> str:
-        res = self._channel.get(msg.TrainingStatusRequest())
+    def get_training_status(self, wait_timeout: float = 0.0) -> str:
+        """Training-loop status; ``wait_timeout`` > 0 long-polls until
+        training starts (or the timeout elapses)."""
+        wait_timeout, timeout = _longpoll_params(wait_timeout)
+        res = self._channel.get(
+            msg.TrainingStatusRequest(wait_timeout=wait_timeout),
+            timeout=timeout,
+        )
         return res.status if res else ""
 
     def get_paral_config(self) -> msg.ParallelConfig:
@@ -367,3 +553,113 @@ class MasterClient:
                 node_rank=node_rank,
             )
         )
+
+
+class ReportBuffer:
+    """Client-side coalescer for fire-and-forget reports.
+
+    Heartbeats, speed/metric samples, node events, and timeline
+    batches accumulate here and ship as ONE ``BatchedReport`` envelope
+    when either threshold trips — ``max_items`` (flushed inline by the
+    adder) or ``max_age_s`` (flushed by a daemon thread).  Item order
+    is preserved end to end: flushes are serialized, and a
+    transport-failed batch is re-queued at the FRONT so nothing is
+    reordered or lost across a master hiccup or an agent restart
+    (``flush`` runs on shutdown and before every rendezvous).
+
+    ``DLROVER_TPU_CONTROL_BATCH=0`` degenerates ``add`` to the old
+    one-RPC-per-report path.
+    """
+
+    def __init__(
+        self,
+        client: MasterClient,
+        max_items: int = 64,
+        max_age_s: float = 1.0,
+        auto_flush: bool = True,
+    ):
+        self._client = client
+        self._max_items = max_items
+        self._max_age_s = max_age_s
+        self._lock = threading.Lock()
+        #: serializes flushes: two concurrent flushes could otherwise
+        #: ship their batches out of order
+        self._flush_lock = threading.Lock()
+        self._items: List[msg.Message] = []
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if auto_flush:
+            self._thread = threading.Thread(
+                target=self._loop, name="report-buffer", daemon=True
+            )
+            self._thread.start()
+
+    @property
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def add(self, message: msg.Message) -> bool:
+        """Queue one report (or send it straight through when batching
+        is disabled).  Returns the delivery ack for the direct path;
+        for a buffered enqueue it returns True unconditionally — the
+        buffer owns delivery from here (a transport-failed inline
+        flush re-queues the batch, so the report is still owed, not
+        lost or rejected)."""
+        if not control_batch_enabled():
+            return self._client._channel.report(message)
+        with self._lock:
+            self._items.append(message)
+            full = len(self._items) >= self._max_items
+        if full:
+            self.flush()
+        return True
+
+    def flush(self) -> bool:
+        """Ship everything pending as one ``BatchedReport``.  A
+        transport failure re-queues the batch at the front (no loss,
+        no reorder); a master-side handler failure is dropped with a
+        warning — exactly what the old per-report path did with its
+        False ack."""
+        with self._flush_lock:
+            with self._lock:
+                items, self._items = self._items, []
+            if not items:
+                return True
+            try:
+                ok = self._client._channel.report(
+                    msg.BatchedReport(items=items)
+                )
+            except ConnectionError as e:
+                logger.warning(
+                    "report batch of %d undeliverable (%s); re-queued",
+                    len(items), e,
+                )
+                with self._lock:
+                    self._items[0:0] = items
+                return False
+            if not ok:
+                logger.warning(
+                    "master rejected a report batch of %d items; "
+                    "dropping it", len(items),
+                )
+            return ok
+
+    def _loop(self):
+        while not self._stopped.wait(self._max_age_s):
+            try:
+                self.flush()
+            except Exception as e:  # noqa: BLE001 - reporter must survive
+                logger.warning("report buffer flush failed: %s", e)
+
+    def close(self):
+        """Stop the age flusher and drain (agent shutdown — buffered
+        reports must survive the process)."""
+        self._stopped.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        try:
+            self.flush()
+        except Exception as e:  # noqa: BLE001
+            logger.warning("report buffer final flush failed: %s", e)

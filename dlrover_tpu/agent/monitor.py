@@ -14,7 +14,8 @@ import threading
 import time
 from typing import List, Optional
 
-from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.master_client import MasterClient, ReportBuffer
+from dlrover_tpu.common import messages as msg
 from dlrover_tpu.common.log import default_logger as logger
 
 try:
@@ -37,17 +38,34 @@ def get_used_memory_mb() -> int:
 
 class PeriodicReporter:
     """Daemon-thread loop calling ``_tick`` every ``interval`` seconds;
-    master connectivity errors are logged, never fatal."""
+    master connectivity errors are logged, never fatal.
+
+    With a shared ``ReportBuffer`` the tick's message coalesces into
+    the node's next ``BatchedReport`` envelope instead of paying its
+    own RPC — heartbeats, resource stats, step samples, and timeline
+    batches from one node ride together.
+    """
 
     name = "periodic-reporter"
 
     def __init__(
-        self, client: Optional[MasterClient] = None, interval: float = 15.0
+        self,
+        client: Optional[MasterClient] = None,
+        interval: float = 15.0,
+        buffer: Optional[ReportBuffer] = None,
     ):
         self._client = client or MasterClient.singleton_instance()
         self._interval = interval
+        self._buffer = buffer
         self._thread: Optional[threading.Thread] = None
         self._stopped = threading.Event()
+
+    def _submit(self, message: msg.Message) -> bool:
+        """One report message: buffered when a ReportBuffer is wired,
+        a direct RPC otherwise."""
+        if self._buffer is not None:
+            return self._buffer.add(message)
+        return self._client._channel.report(message)
 
     def _tick(self):
         raise NotImplementedError
@@ -82,8 +100,9 @@ class ResourceMonitor(PeriodicReporter):
         client: Optional[MasterClient] = None,
         interval: float = 15.0,
         chip_stats_file: str = "",
+        buffer: Optional[ReportBuffer] = None,
     ):
-        super().__init__(client, interval)
+        super().__init__(client, interval, buffer=buffer)
         self._chip_stats_file = chip_stats_file or os.getenv(
             "DLROVER_TPU_CHIP_STATS_FILE", ""
         )
@@ -103,10 +122,12 @@ class ResourceMonitor(PeriodicReporter):
             return []
 
     def _tick(self):
-        self._client.report_resource_stats(
-            cpu_percent=get_process_cpu_percent(),
-            memory_mb=get_used_memory_mb(),
-            tpu_stats=self._read_chip_stats(),
+        self._submit(
+            msg.ResourceStats(
+                cpu_percent=get_process_cpu_percent(),
+                memory_mb=get_used_memory_mb(),
+                tpu_stats=self._read_chip_stats(),
+            )
         )
 
 
@@ -117,7 +138,7 @@ class HeartbeatReporter(PeriodicReporter):
     name = "heartbeat"
 
     def _tick(self):
-        self._client.report_heartbeat(time.time())
+        self._submit(msg.HeartBeat(timestamp=time.time()))
 
 
 class TrainingMonitor(PeriodicReporter):
@@ -132,8 +153,9 @@ class TrainingMonitor(PeriodicReporter):
         step_file: str,
         client: Optional[MasterClient] = None,
         interval: float = 15.0,
+        buffer: Optional[ReportBuffer] = None,
     ):
-        super().__init__(client, interval)
+        super().__init__(client, interval, buffer=buffer)
         self._step_file = step_file
         self._last_step = -1
 
@@ -149,8 +171,9 @@ class TrainingMonitor(PeriodicReporter):
             return
         if step > self._last_step:
             # report first: a ConnectionError must not advance
-            # _last_step or the step would never be re-reported
-            self._client.report_global_step(step, ts)
+            # _last_step or the step would never be re-reported (the
+            # buffered path re-queues undeliverable batches instead)
+            self._submit(msg.GlobalStep(step=step, timestamp=ts))
             self._last_step = step
 
 
@@ -172,8 +195,9 @@ class TimelineReporter(PeriodicReporter):
         client: Optional[MasterClient] = None,
         interval: float = 5.0,
         max_batch: int = 1000,
+        buffer: Optional[ReportBuffer] = None,
     ):
-        super().__init__(client, interval)
+        super().__init__(client, interval, buffer=buffer)
         self._events_file = events_file
         self._offset = 0
         self._max_batch = max_batch
@@ -223,12 +247,23 @@ class TimelineReporter(PeriodicReporter):
         # the offset advances PER DELIVERED BATCH: a ConnectionError
         # mid-loop re-ships only the undelivered tail next tick (no
         # duplicates for batches the master already accepted, no loss
-        # for the ones it didn't)
+        # for the ones it didn't).  On the BUFFERED path "delivered"
+        # means handed to the ReportBuffer, which owns delivery from
+        # there (front re-queue on transport failure, drained on
+        # close) — the timeline batch then coalesces with heartbeats
+        # and metric samples into one envelope.
         for i in range(0, len(delta), self._max_batch):
             batch = delta[i:i + self._max_batch]
-            ok = self._client.report_timeline_events(
-                [rec for rec, _ in batch]
-            )
+            events = [rec for rec, _ in batch]
+            if self._buffer is not None:
+                # add() is the direct-send ack under
+                # DLROVER_TPU_CONTROL_BATCH=0 and True for a buffered
+                # enqueue — either way it IS the delivery verdict
+                ok = self._buffer.add(
+                    msg.TimelineEventsReport(events=events)
+                )
+            else:
+                ok = self._client.report_timeline_events(events)
             if not ok:
                 # master refused (no aggregator / old master): drop
                 # with a trace rather than re-shipping forever
@@ -242,5 +277,7 @@ class TimelineReporter(PeriodicReporter):
         """One synchronous drain (agent shutdown / tests)."""
         try:
             self._tick()
+            if self._buffer is not None:
+                self._buffer.flush()
         except ConnectionError as e:
             logger.warning("timeline flush failed: %s", e)

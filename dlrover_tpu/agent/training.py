@@ -28,14 +28,17 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
 from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
-from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.master_client import MasterClient, ReportBuffer
 from dlrover_tpu.common.constants import (
     NodeEnv,
     RendezvousConstant,
     RendezvousName,
     TrainingExceptionLevel,
 )
-from dlrover_tpu.common.env import get_free_port
+from dlrover_tpu.common.env import (
+    control_longpoll_enabled,
+    get_free_port,
+)
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.observability.events import get_event_logger
 
@@ -153,18 +156,23 @@ class MasterRendezvousHandler:
             self._rdzv_name,
             rdzv_round,
         )
-        deadline = time.time() + self._timeout
-        while time.time() < deadline:
-            rnd, group, world = self._client.get_comm_world(
-                self._rdzv_name, self._node_rank
-            )
-            if world:
-                if self._node_rank not in world:
-                    raise NodeExcludedError(
-                        f"node {self._node_rank} excluded from round {rnd}"
-                    )
-                return rnd, group, world
-            time.sleep(self._poll)
+        # long-poll: the RPC parks on the master's rendezvous condition
+        # and returns the moment the round completes — one RPC per
+        # ~30 s chunk instead of one every 0.3 s.  wait_comm_world
+        # falls back to the exact old get/sleep loop under
+        # DLROVER_TPU_CONTROL_LONGPOLL=0.
+        rnd, group, world = self._client.wait_comm_world(
+            self._rdzv_name,
+            self._node_rank,
+            timeout=self._timeout,
+            poll_interval=self._poll,
+        )
+        if world:
+            if self._node_rank not in world:
+                raise NodeExcludedError(
+                    f"node {self._node_rank} excluded from round {rnd}"
+                )
+            return rnd, group, world
         raise TimeoutError(
             f"rendezvous {self._rdzv_name!r} timed out after {self._timeout}s"
         )
@@ -202,9 +210,19 @@ class ElasticTrainingAgent:
         self._coordinator_port = get_free_port()
         self._stopped = False
         self._zygote = None  # ZygotePool when config.prefork
+        #: last waiting-node count seen by the monitor pacing long-poll
+        self._last_waiting = 0
+        #: shared coalescing buffer for fire-and-forget reports
+        #: (timeline batches, heartbeats, metric samples); flushed
+        #: before every rendezvous and drained on shutdown
+        self._report_buffer: Optional[ReportBuffer] = None
 
     # ------------------------------------------------------------- workers
     def _rendezvous(self):
+        if self._report_buffer is not None:
+            # nothing buffered may straddle a restart: the world (and
+            # possibly this process) changes on the other side
+            self._report_buffer.flush()
         handler = MasterRendezvousHandler(
             self._client,
             self._node_rank,
@@ -363,11 +381,37 @@ class ElasticTrainingAgent:
             result.state = WorkerState.SUCCEEDED
         return result
 
-    def _membership_changed(self) -> bool:
+    def _pace_monitor(self):
+        """One monitor-interval pause.  Under long-poll the pause IS
+        the waiting-count RPC parked on the master — the same one RPC
+        per tick as the old sleep+poll pair, but a membership change
+        wakes the loop INSTANTLY instead of at the next tick.  The
+        legacy plain sleep survives the kill-switch."""
+        interval = self._config.monitor_interval
+        if not control_longpoll_enabled():
+            time.sleep(interval)
+            return
         try:
-            waiting = self._client.num_nodes_waiting()
+            self._last_waiting = self._client.num_nodes_waiting(
+                wait_timeout=interval, last_num=self._last_waiting
+            )
         except ConnectionError:
-            return False
+            # unreachable master must read as "no membership change"
+            # (the old polling path returned False here) — a stale
+            # nonzero count would fire a restart storm every tick for
+            # the whole outage
+            self._last_waiting = 0
+            time.sleep(interval)
+
+    def _membership_changed(self) -> bool:
+        if control_longpoll_enabled():
+            # _pace_monitor just fetched it — no second RPC
+            waiting = self._last_waiting
+        else:
+            try:
+                waiting = self._client.num_nodes_waiting()
+            except ConnectionError:
+                return False
         node_unit = max(self._config.node_unit, 1)
         return waiting > 0 and waiting % node_unit == 0
 
@@ -502,12 +546,15 @@ class ElasticTrainingAgent:
         factory_queue = None
         preemption_watcher = None
         timeline_reporter = None
+        self._report_buffer = ReportBuffer(self._client)
         events = get_event_logger()
         if events.enabled:
             from dlrover_tpu.agent.monitor import TimelineReporter
 
             timeline_reporter = TimelineReporter(
-                events.path, client=self._client
+                events.path,
+                client=self._client,
+                buffer=self._report_buffer,
             )
             timeline_reporter.start()
         if self._start_ckpt_saver:
@@ -543,6 +590,11 @@ class ElasticTrainingAgent:
             if timeline_reporter is not None:
                 timeline_reporter.stop()
                 timeline_reporter.flush()  # the final partial batch
+            if self._report_buffer is not None:
+                # flush-on-shutdown: buffered heartbeats/metrics/
+                # timeline batches must survive the agent
+                self._report_buffer.close()
+                self._report_buffer = None
             if self._zygote is not None:
                 self._zygote.close()
                 self._zygote = None
@@ -565,7 +617,7 @@ class ElasticTrainingAgent:
         if not self._initialize_workers():
             return 1
         while True:
-            time.sleep(self._config.monitor_interval)
+            self._pace_monitor()
             result = self._monitor_workers()
             if result.state == WorkerState.SUCCEEDED:
                 logger.info("all workers finished successfully")

@@ -44,6 +44,26 @@ def addr_connectable(addr: str, timeout: float = 1.0) -> bool:
         return False
 
 
+def wait_channel_ready(addr: str, timeout: float = 60.0) -> bool:
+    """Block until a gRPC channel to ``addr`` is READY (or timeout).
+
+    Replaces the connect-probe polling loop (``addr_connectable`` every
+    0.5 s): grpc's own reconnect backoff drives the retries and the
+    caller just parks on the ready future — the long-poll shape for
+    "wait for the master to come up".
+    """
+    if not addr or ":" not in addr:
+        return False
+    channel = grpc.insecure_channel(addr, options=_CHANNEL_OPTIONS)
+    try:
+        grpc.channel_ready_future(channel).result(timeout=timeout)
+        return True
+    except grpc.FutureTimeoutError:
+        return False
+    finally:
+        channel.close()
+
+
 def build_master_server(
     port: int,
     report_fn: Callable[[Envelope], BoolResponse],
@@ -103,6 +123,10 @@ class MasterChannel:
         self._node_type = node_type
         self._timeout = timeout
         self._max_retry = max_retry
+        #: RPCs actually issued on the wire (attempts, not logical
+        #: calls) — what the idle-waiter RPC-bound test and the
+        #: control-plane bench count
+        self.rpc_count = 0
         self._channel = grpc.insecure_channel(addr, options=_CHANNEL_OPTIONS)
         prefix = f"/{GRPC.SERVICE_NAME}/"
         self._report = self._channel.unary_unary(
@@ -131,6 +155,7 @@ class MasterChannel:
         err: Optional[Exception] = None
         for attempt in range(self._max_retry):
             try:
+                self.rpc_count += 1
                 return rpc(payload, timeout=timeout)
             except grpc.RpcError as e:  # pragma: no cover - network flake
                 err = e

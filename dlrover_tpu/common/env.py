@@ -74,6 +74,41 @@ def input_pipeline_enabled() -> bool:
     )
 
 
+CONTROL_LONGPOLL_ENV = "DLROVER_TPU_CONTROL_LONGPOLL"
+CONTROL_BATCH_ENV = "DLROVER_TPU_CONTROL_BATCH"
+DATASTORE_SYNC_ENV = "DLROVER_TPU_DATASTORE_SYNC"
+
+
+def control_longpoll_enabled() -> bool:
+    """Kill-switch for the control-plane fast path: server-side
+    long-poll waits (KV store, comm world, shard tasks, training
+    status, master-ready).  ``DLROVER_TPU_CONTROL_LONGPOLL=0``
+    reproduces the client-side polling loops exactly (the bench
+    reference and the rollback path).  Default: enabled."""
+    return os.getenv(CONTROL_LONGPOLL_ENV, "1").lower() not in (
+        "0", "false", "off",
+    )
+
+
+def control_batch_enabled() -> bool:
+    """Kill-switch for coalesced delta reporting: with
+    ``DLROVER_TPU_CONTROL_BATCH=0`` every ``ReportBuffer.add``
+    degenerates to the old one-RPC-per-report path.  Default:
+    enabled."""
+    return os.getenv(CONTROL_BATCH_ENV, "1").lower() not in (
+        "0", "false", "off",
+    )
+
+
+def datastore_sync_enabled() -> bool:
+    """``DLROVER_TPU_DATASTORE_SYNC=1`` keeps every Brain datastore
+    write a synchronous INSERT+commit (today's behavior, byte-for-byte
+    — pinned by tests); default is the write-behind flusher."""
+    return os.getenv(DATASTORE_SYNC_ENV, "").lower() in (
+        "1", "true", "on",
+    )
+
+
 def get_free_port(host: str = "127.0.0.1") -> int:
     import socket
 

@@ -20,11 +20,21 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 
+#: Pinned wire protocol.  ``pickle.dumps`` without a protocol argument
+#: uses DEFAULT_PROTOCOL, which lags HIGHEST by a version or two on
+#: every interpreter — pinning HIGHEST keeps (de)serialization cost
+#: minimal AND makes the choice explicit so the ``BatchedReport``
+#: nesting (messages inside a message) can't silently fall back to a
+#: slower encoding.  Parity is enforced by a round-trip test over
+#: every message type in ``tests/test_control_plane.py``.
+WIRE_PICKLE_PROTOCOL = pickle.HIGHEST_PROTOCOL
+
+
 class Message:
     """Base class; every control-plane dataclass derives from it."""
 
     def serialize(self) -> bytes:
-        return pickle.dumps(self)
+        return pickle.dumps(self, protocol=WIRE_PICKLE_PROTOCOL)
 
 
 #: builtins actually needed to unpickle our dataclasses (container and
@@ -55,7 +65,7 @@ class _RestrictedUnpickler(pickle.Unpickler):
 def serialize_message(message: Optional[Message]) -> bytes:
     if message is None:
         return b""
-    return pickle.dumps(message)
+    return pickle.dumps(message, protocol=WIRE_PICKLE_PROTOCOL)
 
 
 def deserialize_message(data: bytes):
@@ -87,6 +97,10 @@ class BoolResponse(Message):
 @dataclass
 class TaskRequest(Message):
     dataset_name: str = ""
+    #: long-poll: >0 blocks the master up to this many seconds while
+    #: the dataset would only hand out WAIT tasks (0 = classic
+    #: immediate answer)
+    wait_timeout: float = 0.0
 
 
 @dataclass
@@ -129,12 +143,16 @@ class ShardCheckpoint(Message):
 
 @dataclass
 class RunningNodesRequest(Message):
-    pass
+    #: delta protocol: the version of the client's cached copy; the
+    #: master answers ``NotModified`` when nothing changed (-1 = always
+    #: send the full list)
+    version: int = -1
 
 
 @dataclass
 class RunningNodes(Message):
     nodes: List = field(default_factory=list)
+    version: int = 0
 
 
 @dataclass
@@ -155,6 +173,10 @@ class RendezvousState(Message):
 @dataclass
 class WaitingNodeNumRequest(Message):
     rdzv_name: str = ""
+    #: long-poll: >0 blocks until the waiting count differs from
+    #: ``last_num`` (or the timeout elapses); 0 = immediate answer
+    wait_timeout: float = 0.0
+    last_num: int = -1
 
 
 @dataclass
@@ -182,6 +204,13 @@ class StragglerExistRequest(Message):
 class CommWorldRequest(Message):
     node_id: int = 0
     rdzv_name: str = ""
+    #: delta protocol: rendezvous state version of the client's cached
+    #: world (-1 = no cache); when the version still matches the master
+    #: answers ``NotModified`` instead of re-shipping the world
+    version: int = -1
+    #: long-poll: >0 blocks until the world is complete AND newer than
+    #: ``version`` (or the timeout elapses); 0 = immediate answer
+    wait_timeout: float = 0.0
 
 
 @dataclass
@@ -190,12 +219,23 @@ class CommWorld(Message):
     round: int = 0
     group: int = 0
     world: Dict[int, int] = field(default_factory=dict)  # node_rank -> lws
+    version: int = 0
 
 
 @dataclass
 class KeyValuePair(Message):
     key: str = ""
     value: bytes = b""
+
+
+@dataclass
+class KVWaitRequest(Message):
+    """Long-poll ``get``: block on the master until ``key`` is set (or
+    ``wait_timeout`` elapses — the response then carries an empty
+    value).  One RPC replaces a ``timeout/interval`` polling loop."""
+
+    key: str = ""
+    wait_timeout: float = 0.0
 
 
 @dataclass
@@ -217,12 +257,22 @@ class PsNodes(Message):
 
 @dataclass
 class TrainingStatusRequest(Message):
-    pass
+    #: long-poll: >0 blocks until training has started (or the timeout
+    #: elapses); 0 = immediate answer
+    wait_timeout: float = 0.0
 
 
 @dataclass
 class TrainingStatus(Message):
     status: int = 3  # TrainingLoopStatus.PENDING
+
+
+@dataclass
+class NotModified(Message):
+    """Delta-protocol answer: the client's cached copy (at ``version``)
+    is still current — nothing to ship."""
+
+    version: int = 0
 
 
 @dataclass
@@ -282,6 +332,17 @@ class ElasticRunConfig(Message):
 # --------------------------------------------------------------------------
 # `report` messages (master/servicer report-dispatch parity)
 # --------------------------------------------------------------------------
+
+
+@dataclass
+class BatchedReport(Message):
+    """Coalesced delta reporting: one envelope carrying several report
+    messages (heartbeats, speed/metric samples, node events, timeline
+    batches) accumulated by the client-side ``ReportBuffer``.  The
+    master dispatches the items IN ORDER through the ordinary report
+    table; the ack is true only when every item succeeded."""
+
+    items: List[Message] = field(default_factory=list)
 
 
 @dataclass

@@ -6,6 +6,10 @@ import struct
 
 LEN = struct.Struct(">Q")
 
+#: peek window for ``recv_line`` — one line of the text protocols fits
+#: comfortably; longer lines just take another peek round
+_PEEK_CHUNK = 4096
+
 
 def recv_exact(conn: socket.socket, n: int) -> bytes:
     buf = b""
@@ -18,10 +22,23 @@ def recv_exact(conn: socket.socket, n: int) -> bytes:
 
 
 def recv_line(conn: socket.socket) -> str:
+    """Read one ``\\n``-terminated line.
+
+    Buffered via ``MSG_PEEK``: peek at whatever the kernel already
+    holds, find the newline, then consume exactly through it — so the
+    bytes after the line stay in the kernel buffer for the next
+    ``recv_exact`` (wire semantics identical to the old one-byte-per-
+    ``recv`` loop, at ~2 syscalls per line instead of ``len(line)``).
+    """
     buf = b""
-    while not buf.endswith(b"\n"):
-        c = conn.recv(1)
-        if not c:
+    while True:
+        peek = conn.recv(_PEEK_CHUNK, socket.MSG_PEEK)
+        if not peek:
             raise ConnectionError("peer closed mid-line")
-        buf += c
-    return buf.decode().strip()
+        idx = peek.find(b"\n")
+        # consume exactly the peeked line prefix (peeked bytes are
+        # guaranteed readable); never a byte past the newline
+        take = idx + 1 if idx >= 0 else len(peek)
+        buf += recv_exact(conn, take)
+        if idx >= 0:
+            return buf.decode().strip()

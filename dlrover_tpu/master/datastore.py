@@ -18,9 +18,21 @@ Three recorders:
 - node events            (failures, OOMs, relaunches per job)
   — the diagnosis/audit trail
 
-All writes are synchronous and tiny (control-plane rates); one lock
-serializes the shared connection (sqlite's own locking is per-process
-anyway).
+High-rate writes (speed samples, node events, timeline batches) are
+WRITE-BEHIND by default: recorders enqueue rows on a bounded
+in-memory queue and a single background flusher drains them with
+per-table ``executemany`` + ONE commit per batch — a timeline burst
+costs one fsync instead of one per event, and the report RPC path
+never blocks on sqlite.  Readers drain the queue first, so
+read-your-writes semantics are preserved exactly.  Strategy
+measurements stay synchronous: they are one row per calibration step
+and a concurrently-live neighbour master may read the shared file the
+moment the recorder returns.  ``close()`` drains
+everything and checkpoints the WAL (fsync'd durability), and
+``DLROVER_TPU_DATASTORE_SYNC=1`` restores the old synchronous
+INSERT+commit-per-write behavior byte-for-byte (pinned by tests).
+One lock serializes the shared connection (sqlite's own locking is
+per-process anyway).
 """
 
 import json
@@ -30,6 +42,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from dlrover_tpu.common.env import datastore_sync_enabled
 from dlrover_tpu.common.log import default_logger as logger
 
 _SCHEMA = """
@@ -85,11 +98,42 @@ def workload_signature(key: Tuple) -> str:
     return json.dumps(list(key), separators=(",", ":"))
 
 
+_SQL_MEASUREMENT = (
+    "INSERT INTO strategy_measurements "
+    "(workload, strategy, step_time_s, created_at, job) "
+    "VALUES (?,?,?,?,?)"
+)
+_SQL_SPEED = "INSERT INTO speed_samples VALUES (?,?,?,?)"
+_SQL_NODE_EVENT = "INSERT INTO node_events VALUES (?,?,?,?,?)"
+_SQL_TIMELINE = (
+    "INSERT INTO timeline_events VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)"
+)
+
+
 class BrainDatastore:
     """Embedded durable store for the master's learned state."""
 
-    def __init__(self, db_path: str):
+    #: write-behind linger: a burst accumulates this long before the
+    #: flusher commits it as one batch
+    FLUSH_AGE_S = 0.2
+    #: bounded queue: recorders block (briefly) past this many pending
+    #: rows instead of growing memory without bound
+    MAX_PENDING = 10_000
+
+    def __init__(self, db_path: str, sync: Optional[bool] = None):
         self.path = db_path
+        self._sync = (
+            datastore_sync_enabled() if sync is None else bool(sync)
+        )
+        # write-behind state (all guarded by _wb_cond; _enqueued /
+        # _flushed count ROWS so a drain barrier is a counter compare)
+        self._wb_cond = threading.Condition()
+        self._pending: List[Tuple[str, tuple]] = []
+        self._enqueued = 0
+        self._flushed = 0
+        self._drain_waiters = 0
+        self._closed = False
+        self._flusher: Optional[threading.Thread] = None
         parent = os.path.dirname(os.path.abspath(db_path))
         os.makedirs(parent, exist_ok=True)
         self._lock = threading.Lock()
@@ -154,6 +198,120 @@ class BrainDatastore:
                         "would delete other jobs' history)",
                         env_age,
                     )
+        if not self._sync:
+            self._flusher = threading.Thread(
+                target=self._flusher_loop,
+                name="brain-write-behind",
+                daemon=True,
+            )
+            self._flusher.start()
+
+    # ----------------------------------------------- write-behind core
+    def _submit(self, sql: str, rows: List[tuple]):
+        """Record rows: synchronous INSERT+commit under
+        ``DLROVER_TPU_DATASTORE_SYNC=1`` (the pre-write-behind
+        behavior), else enqueue for the background flusher."""
+        if not rows:
+            return
+        if self._sync:
+            with self._lock:
+                self._conn.executemany(sql, rows)
+                self._conn.commit()
+            return
+        with self._wb_cond:
+
+            def _flusher_alive():
+                return (
+                    self._flusher is not None
+                    and self._flusher.is_alive()
+                )
+
+            # bounded queue: backpressure instead of unbounded memory;
+            # the flusher drains fast enough that this only trips on a
+            # pathological burst
+            while (
+                len(self._pending) >= self.MAX_PENDING
+                and not self._closed
+                and _flusher_alive()
+            ):
+                self._wb_cond.wait(0.05)
+            if not self._closed and _flusher_alive():
+                self._pending.extend((sql, row) for row in rows)
+                self._enqueued += len(rows)
+                self._wb_cond.notify_all()
+                return
+        # post-close (or dead-flusher) writes fall back to
+        # synchronous-direct so nothing silently vanishes
+        with self._lock:
+            self._conn.executemany(sql, rows)
+            self._conn.commit()
+
+    def _flusher_loop(self):
+        while True:
+            with self._wb_cond:
+                while not self._pending and not self._closed:
+                    self._wb_cond.wait()
+                if not self._pending and self._closed:
+                    return
+                # linger so a burst coalesces into one commit — unless
+                # we're closing or a reader is parked on a drain
+                if not self._closed and not self._drain_waiters:
+                    self._wb_cond.wait(self.FLUSH_AGE_S)
+                batch, self._pending = self._pending, []
+                self._wb_cond.notify_all()  # wake backpressure waiters
+            self._write_batch(batch)
+            with self._wb_cond:
+                self._flushed += len(batch)
+                self._wb_cond.notify_all()  # wake drain waiters
+
+    def _write_batch(self, batch: List[Tuple[str, tuple]]):
+        """Per-table ``executemany`` over consecutive same-SQL runs
+        (insertion order preserved), ONE commit for the whole batch."""
+        with self._lock:
+            try:
+                i = 0
+                while i < len(batch):
+                    sql = batch[i][0]
+                    j = i
+                    while j < len(batch) and batch[j][0] == sql:
+                        j += 1
+                    self._conn.executemany(
+                        sql, [row for _, row in batch[i:j]]
+                    )
+                    i = j
+                self._conn.commit()
+            except sqlite3.Error as e:
+                logger.warning(
+                    "write-behind flush dropped %d rows: %s",
+                    len(batch), e,
+                )
+                try:
+                    self._conn.rollback()
+                except sqlite3.Error:
+                    pass
+
+    def _drain(self):
+        """Barrier: block until every row enqueued so far is
+        committed — readers call this first, preserving exact
+        read-your-writes semantics over the async queue."""
+        if self._sync:
+            return
+        if threading.current_thread() is self._flusher:
+            return  # the flusher itself must never self-deadlock
+        with self._wb_cond:
+            target = self._enqueued
+            self._drain_waiters += 1
+            self._wb_cond.notify_all()  # cut the flusher's linger short
+            try:
+                while self._flushed < target:
+                    if (
+                        self._flusher is None
+                        or not self._flusher.is_alive()
+                    ):
+                        break  # dead flusher must not hang readers
+                    self._wb_cond.wait(0.05)
+            finally:
+                self._drain_waiters -= 1
 
     # ------------------------------------------- strategy measurements
     def record_measurement(
@@ -166,20 +324,22 @@ class BrainDatastore:
         """``job`` tags provenance: measurements are keyed by
         WORKLOAD (hardware+model signature), so any job's master can
         learn from any other job's calibration through a shared db
-        file — the cluster-wide role of the reference's Brain."""
+        file — the cluster-wide role of the reference's Brain.
+
+        Deliberately SYNCHRONOUS even in write-behind mode: a
+        concurrently-live neighbour master reads this file directly,
+        so a measurement must be committed (not parked in this
+        process's queue) the moment the recorder returns — and the
+        rate is one row per calibration step, not a hot path."""
+        row = (
+            workload,
+            json.dumps(strategy, separators=(",", ":")),
+            float(step_time_s),
+            time.time(),
+            job,
+        )
         with self._lock:
-            self._conn.execute(
-                "INSERT INTO strategy_measurements "
-                "(workload, strategy, step_time_s, created_at, job) "
-                "VALUES (?,?,?,?,?)",
-                (
-                    workload,
-                    json.dumps(strategy, separators=(",", ":")),
-                    float(step_time_s),
-                    time.time(),
-                    job,
-                ),
-            )
+            self._conn.execute(_SQL_MEASUREMENT, row)
             self._conn.commit()
 
     def load_measurements(
@@ -187,6 +347,7 @@ class BrainDatastore:
     ) -> List[Tuple[Dict, float]]:
         """Newest ``limit`` measurements for a workload, oldest
         first (matches the in-memory history ordering)."""
+        self._drain()
         with self._lock:
             rows = self._conn.execute(
                 "SELECT strategy, step_time_s FROM ("
@@ -205,6 +366,7 @@ class BrainDatastore:
         return out
 
     def measured_workloads(self) -> List[str]:
+        self._drain()
         with self._lock:
             rows = self._conn.execute(
                 "SELECT DISTINCT workload FROM strategy_measurements"
@@ -215,17 +377,17 @@ class BrainDatastore:
     def record_speed(
         self, job: str, worker_count: int, records_per_sec: float
     ):
-        with self._lock:
-            self._conn.execute(
-                "INSERT INTO speed_samples VALUES (?,?,?,?)",
+        self._submit(
+            _SQL_SPEED,
+            [
                 (
                     job,
                     int(worker_count),
                     float(records_per_sec),
                     time.time(),
-                ),
-            )
-            self._conn.commit()
+                )
+            ],
+        )
 
     def speed_history(
         self, job: str, max_age_s: Optional[float] = None
@@ -241,6 +403,7 @@ class BrainDatastore:
             q += " AND created_at >= ?"
             args.append(time.time() - max_age_s)
         q += " GROUP BY worker_count"
+        self._drain()
         with self._lock:
             rows = self._conn.execute(q, args).fetchall()
         return {int(n): float(v) for n, v in rows}
@@ -249,16 +412,15 @@ class BrainDatastore:
     def record_node_event(
         self, job: str, node: str, event_type: str, detail: str = ""
     ):
-        with self._lock:
-            self._conn.execute(
-                "INSERT INTO node_events VALUES (?,?,?,?,?)",
-                (job, str(node), event_type, detail, time.time()),
-            )
-            self._conn.commit()
+        self._submit(
+            _SQL_NODE_EVENT,
+            [(job, str(node), event_type, detail, time.time())],
+        )
 
     def node_events(
         self, job: str, limit: int = 100
     ) -> List[Dict]:
+        self._drain()
         with self._lock:
             rows = self._conn.execute(
                 "SELECT node, event_type, detail, created_at "
@@ -308,21 +470,18 @@ class BrainDatastore:
                     now,
                 )
             )
-        if not rows:
-            return
-        with self._lock:
-            self._conn.executemany(
-                "INSERT INTO timeline_events VALUES "
-                "(?,?,?,?,?,?,?,?,?,?,?,?,?)",
-                rows,
-            )
-            self._conn.commit()
+        # write-behind: a node's whole timeline batch is one enqueue;
+        # the background flusher lands it (plus whatever else is
+        # pending) with one executemany + one commit — the report RPC
+        # path no longer pays sqlite latency
+        self._submit(_SQL_TIMELINE, rows)
 
     def timeline_events(
         self, job: str, limit: int = 10000
     ) -> List[Dict]:
         """Newest ``limit`` timeline records, oldest first (ready for
         ``compute_ledger`` / ``export_chrome_trace``)."""
+        self._drain()
         with self._lock:
             rows = self._conn.execute(
                 "SELECT node, rank, inc, name, ph, wall, mono, dur, "
@@ -366,6 +525,7 @@ class BrainDatastore:
         itself without touching its neighbours' history in a shared
         db)."""
         cutoff = time.time() - max_age_s
+        self._drain()
         with self._lock:
             for table in (
                 "strategy_measurements",
@@ -382,7 +542,21 @@ class BrainDatastore:
             self._conn.commit()
 
     def close(self):
+        """Drain the write-behind queue (zero rows lost — pinned by
+        tests), checkpoint the WAL so the bytes are fsync'd into the
+        main db file, then close."""
+        if not self._sync:
+            with self._wb_cond:
+                self._closed = True
+                self._wb_cond.notify_all()
+            if self._flusher is not None:
+                self._flusher.join(timeout=10.0)
         with self._lock:
+            try:
+                self._conn.commit()
+                self._conn.execute("PRAGMA wal_checkpoint(TRUNCATE)")
+            except sqlite3.Error:
+                pass  # non-WAL / read-only FS: commit already landed
             self._conn.close()
 
 

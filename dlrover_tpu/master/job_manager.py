@@ -108,6 +108,16 @@ class JobManager(metaclass=ABCMeta):
         self._error_monitor = ErrorMonitor()
         self._node_groups = NodeGroupRegistry()
         self._stop_reason: Optional[str] = None
+        #: bumped on every node-table change; the ``RunningNodes``
+        #: delta protocol's version (NotModified when it matches)
+        self._nodes_version = 0
+
+    @property
+    def nodes_version(self) -> int:
+        return self._nodes_version
+
+    def _bump_nodes_version(self):
+        self._nodes_version += 1
 
     @property
     def error_monitor(self):
@@ -190,6 +200,7 @@ class JobManager(metaclass=ABCMeta):
                     node.is_released = True
                 fire = True
             self._node_groups.route(node)
+            self._bump_nodes_version()
         if fire:
             self._fire_callbacks(node, new_status)
 
@@ -218,6 +229,12 @@ class JobManager(metaclass=ABCMeta):
             node.used_resource = NodeResource(cpu=cpu, memory=memory)
             if tpu_stats:
                 node.used_resource.tpu_chips = len(tpu_stats)
+            # deliberately NO version bump: resource ticks arrive from
+            # every node every ~15 s, so bumping here would defeat the
+            # NotModified delta protocol exactly at fleet scale.  The
+            # version tracks MEMBERSHIP (status/address/insert);
+            # resource freshness over the versioned path is
+            # best-effort until the next membership change.
 
     def update_node_address(self, node_type: str, node_id: int, addr: str):
         with self._lock:
@@ -226,6 +243,7 @@ class JobManager(metaclass=ABCMeta):
                 Node(node_type, node_id, status=NodeStatus.RUNNING),
             )
             node.host_addr = addr
+            self._bump_nodes_version()
 
     def collect_node_heartbeat(self, node_type: str, node_id: int,
                                timestamp: float):
@@ -241,6 +259,8 @@ class JobManager(metaclass=ABCMeta):
             if node.status == NodeStatus.INITIAL:
                 node.update_status(NodeStatus.RUNNING)
                 started = True
+            if started:
+                self._bump_nodes_version()
         if started:
             self._fire_callbacks(node, NodeStatus.RUNNING)
 

@@ -3,6 +3,12 @@ use to exchange small blobs (e.g. the jax coordinator address).
 
 Reference parity: ``dlrover/python/master/elastic_training/
 kv_store_service.py:18``.
+
+``wait`` blocks on a ``threading.Condition`` notified by every mutation
+(``set``/``add``/``delete``) — a waiter wakes the moment its key
+appears instead of busy-polling; this is also the primitive the
+control-plane long-poll ``get`` (``KVWaitRequest``) parks on, so an
+idle remote waiter costs one RPC and zero master CPU.
 """
 
 import threading
@@ -12,38 +18,52 @@ from typing import Dict, Optional
 
 class KVStoreService:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._cond = threading.Condition()
         self._store: Dict[str, bytes] = {}
 
+    def _mutated(self):
+        """Caller holds the condition: wake every parked waiter."""
+        self._cond.notify_all()
+
     def set(self, key: str, value: bytes):
-        with self._lock:
+        with self._cond:
             self._store[key] = value
+            self._mutated()
 
     def get(self, key: str) -> bytes:
-        with self._lock:
+        with self._cond:
             return self._store.get(key, b"")
 
     def add(self, key: str, delta: int) -> int:
         """Atomic counter (torch-Store-style add semantics)."""
-        with self._lock:
+        with self._cond:
             current = int(self._store.get(key, b"0") or b"0")
             current += delta
             self._store[key] = str(current).encode()
+            self._mutated()
             return current
 
     def wait(self, key: str, timeout: float = 30.0) -> Optional[bytes]:
-        deadline = time.time() + timeout
-        while time.time() < deadline:
-            value = self.get(key)
-            if value:
-                return value
-            time.sleep(0.05)
-        return None
+        """Block until ``key`` holds a non-empty value; None on
+        timeout.  Event-driven: sleeps on the condition, woken by the
+        mutation that publishes the key."""
+        deadline = time.monotonic() + max(timeout, 0.0)
+        with self._cond:
+            while True:
+                value = self._store.get(key, b"")
+                if value:
+                    return value
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._cond.wait(remaining)
 
     def delete(self, key: str):
-        with self._lock:
+        with self._cond:
             self._store.pop(key, None)
+            self._mutated()
 
     def clear(self):
-        with self._lock:
+        with self._cond:
             self._store.clear()
+            self._mutated()

@@ -35,8 +35,17 @@ class RendezvousParameters:
 
 
 class RendezvousManager(metaclass=ABCMeta):
+    #: long-poll wake slice: rendezvous completion is partly
+    #: TIME-driven (the waiting_timeout window rule), so a parked
+    #: waiter re-evaluates at this cadence even without a notify —
+    #: server-side CPU only, zero RPCs
+    WAIT_SLICE_S = 0.2
+
     def __init__(self):
-        self._lock = threading.Lock()
+        # a Condition IS a lock for ``with`` purposes; every mutation
+        # notifies so long-poll waiters (comm world / waiting count)
+        # wake on the event instead of the client re-polling over RPC
+        self._lock = threading.Condition()
         self._name = ""
         self._waiting_nodes: Dict[int, int] = {}  # rank -> local_world_size
         self._rdzv_nodes: Dict[int, int] = {}
@@ -50,6 +59,20 @@ class RendezvousManager(metaclass=ABCMeta):
         # node_rank -> interconnect hierarchy labels (outermost first);
         # fed by NodeTopology reports, consumed at round completion
         self._node_topology: Dict[int, tuple] = {}
+        #: bumped on every state change (join/remove/params/round
+        #: completion); the ``CommWorld`` delta protocol's version
+        self._version = 0
+
+    def _mutated(self):
+        """Caller holds the lock: version-stamp the change and wake
+        long-poll waiters."""
+        self._version += 1
+        self._lock.notify_all()
+
+    @property
+    def state_version(self) -> int:
+        with self._lock:
+            return self._version
 
     def set_node_topology(self, node_rank: int, levels: tuple):
         with self._lock:
@@ -70,6 +93,7 @@ class RendezvousManager(metaclass=ABCMeta):
             self._rdzv_params.max_nodes = max_nodes
             self._rdzv_params.waiting_timeout = waiting_timeout
             self._node_unit = max(node_unit, 1)
+            self._mutated()
             logger.info(
                 "%s rdzv params: min=%s max=%s timeout=%s unit=%s",
                 self._name, min_nodes, max_nodes, waiting_timeout, node_unit,
@@ -86,6 +110,7 @@ class RendezvousManager(metaclass=ABCMeta):
         with self._lock:
             if node_rank in self._waiting_nodes:
                 del self._waiting_nodes[node_rank]
+                self._mutated()
                 logger.info(
                     "%s: removed dead node %s from waiting list",
                     self._name, node_rank,
@@ -98,6 +123,7 @@ class RendezvousManager(metaclass=ABCMeta):
             self._waiting_nodes[node_rank] = local_world_size
             self._rdzv_nodes = {}
             self._lastcall_time = time.time()
+            self._mutated()
         return self._rdzv_round
 
     def _check_rdzv_completed(self) -> bool:
@@ -141,6 +167,7 @@ class RendezvousManager(metaclass=ABCMeta):
             self._lastcall_time = 0.0
             self._rdzv_round += 1
             self._ckpt_steps = {}  # new world: reset the ckpt barrier
+            self._mutated()
             logger.info(
                 "%s rendezvous round %s completed with %s nodes",
                 self._name, self._rdzv_round, len(self._rdzv_nodes),
@@ -155,6 +182,56 @@ class RendezvousManager(metaclass=ABCMeta):
             if self._rdzv_nodes:
                 return self._rdzv_round, 0, dict(self._rdzv_nodes)
             return self._rdzv_round, 0, {}
+
+    def get_comm_world_versioned(
+        self, node_rank: int
+    ) -> Tuple[int, int, Dict[int, int], int]:
+        """``get_comm_world`` plus the matching state version, read
+        atomically (the Condition's lock is reentrant, so the bump a
+        lazy round-completion performs inside ``get_comm_world`` is
+        visible in the version returned WITH that world)."""
+        with self._lock:
+            rnd, group, world = self.get_comm_world(node_rank)
+            return rnd, group, world, self._version
+
+    def wait_comm_world(
+        self, node_rank: int, version: int = -1, timeout: float = 0.0
+    ) -> Tuple[int, int, Dict[int, int], int]:
+        """Long-poll ``get_comm_world``: block until the world is
+        complete AND the state version moved past the caller's cached
+        ``version`` (or ``timeout`` elapses); returns
+        ``(round, group, world, version)``."""
+        deadline = time.monotonic() + max(timeout, 0.0)
+        while True:
+            rnd, group, world, current = (
+                self.get_comm_world_versioned(node_rank)
+            )
+            if world and (version < 0 or current != version):
+                return rnd, group, world, current
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return rnd, group, world, current
+            with self._lock:
+                # completion can be time-driven (the window rule), so
+                # cap the park and re-evaluate
+                self._lock.wait(min(remaining, self.WAIT_SLICE_S))
+
+    def wait_num_nodes(
+        self, last_num: int = -1, timeout: float = 0.0
+    ) -> int:
+        """Long-poll ``num_nodes_waiting``: block until the (gated)
+        waiting count differs from the caller's ``last_num`` or the
+        timeout elapses."""
+        deadline = time.monotonic() + max(timeout, 0.0)
+        while True:
+            waiting = self.num_nodes_waiting()
+            if last_num < 0 or waiting != last_num:
+                return waiting
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return waiting
+            with self._lock:
+                self._lock.wait(min(remaining, self.WAIT_SLICE_S))
 
     def num_nodes_waiting(self) -> int:
         """Nonzero once a new rendezvous is pending — the running agents
@@ -228,6 +305,7 @@ class NetworkCheckRendezvousManager(RendezvousManager):
             self._waiting_nodes[node_rank] = local_world_size
             self._rdzv_nodes = {}
             self._lastcall_time = time.time()
+            self._mutated()
         return self._rdzv_round
 
     def get_comm_world(self, node_rank: int) -> Tuple[int, int, Dict[int, int]]:

@@ -6,6 +6,7 @@ Every request type routes to the backing component (task manager,
 rendezvous managers, KV store, job manager, speed monitor, diagnosis).
 """
 
+import threading
 import time
 from typing import Optional
 
@@ -16,9 +17,17 @@ from dlrover_tpu.common.constants import (
     TrainingLoopStatus,
 )
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.observability.metrics import record_control_rpc
 
 
 class MasterServicer:
+    #: at most this many RPC workers may PARK in long-poll waits at
+    #: once (the gRPC pool has 64); past the cap a wait degrades to an
+    #: immediate answer (the client just re-issues), so join/set/report
+    #: mutations — the RPCs that WAKE parked waiters — always find a
+    #: free worker and the pool cannot deadlock on its own waiters
+    MAX_PARKED_WAITS = 32
+
     def __init__(
         self,
         task_manager=None,
@@ -39,9 +48,32 @@ class MasterServicer:
         self._sync_service = sync_service
         self._timeline_aggregator = timeline_aggregator
         self._start_training_time = 0.0
+        #: lifetime RPC tally (gets + reports, batched items counted
+        #: once per envelope) — the bench's server-side ground truth
+        self.rpc_count = 0
+        self._wait_slots = threading.BoundedSemaphore(
+            self.MAX_PARKED_WAITS
+        )
+
+    def _count_rpc(self):
+        # benign race on +=: the tally is telemetry, not a lock target
+        self.rpc_count += 1
+        record_control_rpc()
+
+    def _bounded_wait(self, wait_fn, immediate_fn):
+        """Run a blocking wait under the parked-waiter cap; saturated
+        ⇒ answer immediately (the client loop re-issues, with its own
+        backoff) instead of parking another pool thread."""
+        if not self._wait_slots.acquire(blocking=False):
+            return immediate_fn()
+        try:
+            return wait_fn()
+        finally:
+            self._wait_slots.release()
 
     # ------------------------------------------------------------------ get
     def get(self, envelope: msg.Envelope) -> Optional[msg.Message]:
+        self._count_rpc()
         request = msg.deserialize_message(envelope.data)
         node_id, node_type = envelope.node_id, envelope.node_type
         if isinstance(request, msg.TaskRequest):
@@ -51,33 +83,57 @@ class MasterServicer:
                 request.dataset_name
             )
         if isinstance(request, msg.RunningNodesRequest):
-            return msg.RunningNodes(
-                nodes=self._job_manager.get_running_nodes()
-            )
+            return self._get_running_nodes(request)
         if isinstance(request, msg.JoinRendezvousRequest):
             return self._join_rendezvous(request)
         if isinstance(request, msg.WaitingNodeNumRequest):
-            manager = self._rdzv_managers.get(
-                request.rdzv_name or RendezvousName.ELASTIC_TRAINING
-            )
-            return msg.WaitingNodeNum(
-                waiting_num=manager.num_nodes_waiting() if manager else 0
-            )
+            return self._get_waiting_num(request)
         if isinstance(request, msg.NetworkReadyRequest):
             return self._check_fault_node()
         if isinstance(request, msg.StragglerExistRequest):
             return self._check_straggler()
         if isinstance(request, msg.CommWorldRequest):
             return self._get_comm_world(request)
+        if isinstance(request, msg.KVWaitRequest):
+            # long-poll: park on the KV store's condition; an empty
+            # value means the wait timed out (the client loops)
+            value = self._bounded_wait(
+                lambda: self._kv_store.wait(
+                    request.key, timeout=request.wait_timeout
+                ),
+                lambda: self._kv_store.get(request.key),
+            )
+            return msg.KeyValuePair(key=request.key, value=value or b"")
         if isinstance(request, msg.KeyValuePair):
             return msg.KeyValuePair(
                 key=request.key, value=self._kv_store.get(request.key)
             )
         if isinstance(request, msg.TrainingStatusRequest):
-            if self._task_manager and self._task_manager.training_started():
-                status = TrainingLoopStatus.START
-            else:
-                status = TrainingLoopStatus.PENDING
+            started = bool(
+                self._task_manager
+                and self._task_manager.training_started()
+            )
+            # getattr throughout this dispatch: a pre-fast-path client
+            # pickles its dataclasses WITHOUT the new fields (unpickle
+            # restores __dict__, not defaults) and must keep working
+            # across a rolling upgrade
+            wait_timeout = getattr(request, "wait_timeout", 0.0)
+            if (
+                not started
+                and wait_timeout > 0
+                and self._task_manager is not None
+            ):
+                started = self._bounded_wait(
+                    lambda: self._task_manager.wait_training_started(
+                        wait_timeout
+                    ),
+                    lambda: False,
+                )
+            status = (
+                TrainingLoopStatus.START
+                if started
+                else TrainingLoopStatus.PENDING
+            )
             return msg.TrainingStatus(status=status)
         if isinstance(request, msg.ParallelConfigRequest):
             if self._job_manager:
@@ -156,7 +212,48 @@ class MasterServicer:
             self._start_training_time = time.time()
             if self._speed_monitor:
                 self._speed_monitor.set_start_timestamp()
+        wait_timeout = getattr(request, "wait_timeout", 0.0)
+        if wait_timeout > 0:
+            return self._bounded_wait(
+                lambda: self._task_manager.wait_task(
+                    node_id, request.dataset_name, wait_timeout
+                ),
+                lambda: self._task_manager.get_task(
+                    node_id, request.dataset_name
+                ),
+            )
         return self._task_manager.get_task(node_id, request.dataset_name)
+
+    def _get_running_nodes(self, request: msg.RunningNodesRequest):
+        if self._job_manager is None:
+            return msg.RunningNodes()
+        version = self._job_manager.nodes_version
+        req_version = getattr(request, "version", -1)
+        if req_version >= 0 and req_version == version:
+            return msg.NotModified(version=version)
+        return msg.RunningNodes(
+            nodes=self._job_manager.get_running_nodes(),
+            version=version,
+        )
+
+    def _get_waiting_num(self, request: msg.WaitingNodeNumRequest):
+        manager = self._rdzv_managers.get(
+            request.rdzv_name or RendezvousName.ELASTIC_TRAINING
+        )
+        if manager is None:
+            return msg.WaitingNodeNum(waiting_num=0)
+        wait_timeout = getattr(request, "wait_timeout", 0.0)
+        if wait_timeout > 0:
+            waiting = self._bounded_wait(
+                lambda: manager.wait_num_nodes(
+                    last_num=getattr(request, "last_num", -1),
+                    timeout=wait_timeout,
+                ),
+                manager.num_nodes_waiting,
+            )
+        else:
+            waiting = manager.num_nodes_waiting()
+        return msg.WaitingNodeNum(waiting_num=waiting)
 
     def _join_rendezvous(self, request: msg.JoinRendezvousRequest):
         manager = self._rdzv_managers.get(
@@ -183,12 +280,36 @@ class MasterServicer:
         )
         if manager is None:
             return msg.CommWorld()
-        rdzv_round, group, world = manager.get_comm_world(request.node_id)
+        wait_timeout = getattr(request, "wait_timeout", 0.0)
+        req_version = getattr(request, "version", -1)
+        if wait_timeout > 0:
+            rdzv_round, group, world, version = self._bounded_wait(
+                lambda: manager.wait_comm_world(
+                    request.node_id,
+                    version=req_version,
+                    timeout=wait_timeout,
+                ),
+                lambda: manager.get_comm_world_versioned(
+                    request.node_id
+                ),
+            )
+        else:
+            rdzv_round, group, world, version = (
+                manager.get_comm_world_versioned(request.node_id)
+            )
+        if (
+            req_version >= 0
+            and req_version == version
+            and world
+        ):
+            # the client's cached world is still this exact state
+            return msg.NotModified(version=version)
         return msg.CommWorld(
             rdzv_name=request.rdzv_name,
             round=rdzv_round,
             group=group,
             world=world,
+            version=version,
         )
 
     def _check_fault_node(self):
@@ -207,6 +328,7 @@ class MasterServicer:
 
     # --------------------------------------------------------------- report
     def report(self, envelope: msg.Envelope) -> msg.BoolResponse:
+        self._count_rpc()
         request = msg.deserialize_message(envelope.data)
         node_id, node_type = envelope.node_id, envelope.node_type
         success = False
@@ -218,6 +340,23 @@ class MasterServicer:
         return msg.BoolResponse(success=bool(success))
 
     def _dispatch_report(self, node_id, node_type, request) -> bool:
+        if isinstance(request, msg.BatchedReport):
+            # coalesced delta reporting: dispatch IN ORDER; every item
+            # runs even after a failure (dropping the tail would lose
+            # reports the client thinks are delivered), the ack is the
+            # conjunction
+            ok = True
+            for item in request.items:
+                try:
+                    ok = self._dispatch_report(
+                        node_id, node_type, item
+                    ) and ok
+                except Exception as e:  # noqa: BLE001
+                    logger.error(
+                        "batched report item %r failed: %s", item, e
+                    )
+                    ok = False
+            return ok
         if isinstance(request, msg.DatasetShardParams):
             self._task_manager.new_dataset(request)
             return True

@@ -7,6 +7,7 @@ checkpoints/restores splitter + queue state.
 """
 
 import threading
+import time
 from typing import Dict, Optional
 
 from dlrover_tpu.common.global_context import Context
@@ -15,6 +16,7 @@ from dlrover_tpu.common.messages import (
     DatasetShardParams,
     ShardCheckpoint,
     Task,
+    TaskType,
 )
 from dlrover_tpu.master.shard.dataset_manager import BatchDatasetManager
 from dlrover_tpu.master.shard.dataset_splitter import new_dataset_splitter
@@ -23,9 +25,16 @@ _ctx = Context.singleton_instance()
 
 
 class TaskManager:
+    #: long-poll wake slice: task availability is mostly event-driven
+    #: (new dataset / task ack / recovery all notify) but the timeout
+    #: watcher requeues on its own clock, so parked waiters re-check
+    WAIT_SLICE_S = 0.5
+
     def __init__(self, worker_restart_timeout: float = 0.0,
                  speed_monitor=None, check_interval: float = 30.0):
-        self._lock = threading.Lock()
+        # a Condition IS a lock for ``with`` purposes; mutations that
+        # can turn a WAIT answer into a real task notify long-pollers
+        self._lock = threading.Condition()
         self._worker_restart_timeout = worker_restart_timeout
         self._datasets: Dict[str, BatchDatasetManager] = {}
         self._speed_monitor = speed_monitor
@@ -51,6 +60,7 @@ class TaskManager:
             self._datasets[params.dataset_name] = BatchDatasetManager(
                 params.task_type, params.batch_size, splitter
             )
+            self._lock.notify_all()
             logger.info(
                 "created dataset %s: size=%s shard=%s epochs=%s",
                 params.dataset_name,
@@ -76,6 +86,10 @@ class TaskManager:
             if dataset is None:
                 return False
             ok, _ = dataset.report_task_status(task_id, success)
+            # a failure requeues the shard and an ack can roll the
+            # splitter into the next epoch — either can turn a parked
+            # WAIT long-poller's answer into a real task
+            self._lock.notify_all()
             return ok
 
     def recover_tasks(self, node_id: int):
@@ -83,6 +97,36 @@ class TaskManager:
         with self._lock:
             for dataset in self._datasets.values():
                 dataset.recover_tasks_of_node(node_id)
+            self._lock.notify_all()
+
+    def wait_task(self, node_id: int, dataset_name: str,
+                  wait_timeout: float = 0.0) -> Task:
+        """Long-poll ``get_task``: while the dataset would only hand
+        out WAIT tasks, park on the condition (woken by acks/failures/
+        recovery) up to ``wait_timeout`` — the WAIT answer then still
+        goes out, so the client's loop semantics are unchanged."""
+        deadline = time.monotonic() + max(wait_timeout, 0.0)
+        while True:
+            task = self.get_task(node_id, dataset_name)
+            if task.task_type != TaskType.WAIT:
+                return task
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return task
+            with self._lock:
+                self._lock.wait(min(remaining, self.WAIT_SLICE_S))
+
+    def wait_training_started(self, wait_timeout: float = 0.0) -> bool:
+        """Long-poll ``training_started``: block until the first
+        dataset registration flips it (or the timeout elapses)."""
+        deadline = time.monotonic() + max(wait_timeout, 0.0)
+        with self._lock:
+            while not self._datasets:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._lock.wait(min(remaining, self.WAIT_SLICE_S))
+            return True
 
     def finished(self) -> bool:
         with self._lock:
@@ -108,6 +152,7 @@ class TaskManager:
             if dataset is None:
                 return False
             dataset.restore_checkpoint(ckpt.content)
+            self._lock.notify_all()
             return True
 
     def start(self):
@@ -138,4 +183,5 @@ class TaskManager:
                                 doing.node_id,
                             )
                             dataset.recover_task(doing.task)
+                            self._lock.notify_all()
             self._stopped.wait(self._check_interval)

@@ -73,6 +73,13 @@ PHASE_CHECKPOINT_SAVE = "checkpoint_save"
 # restart critical path; the child legs above carve their shares out
 PHASE_RESTART_PATH = "restart_path"
 PHASE_RESTART = "restart"
+# client-side control-plane wait (a long-poll RPC parked on the
+# master, or the legacy polling loop it replaces).  LOWEST priority:
+# these waits are almost always nested inside rendezvous/restart
+# spans, which keep the attribution; a standalone control_wait still
+# surfaces as its own loss bucket instead of vanishing into
+# unattributed time.
+PHASE_CONTROL_WAIT = "control_wait"
 
 PHASES: Tuple[str, ...] = (
     PHASE_DATA_STALL,
@@ -88,6 +95,7 @@ PHASES: Tuple[str, ...] = (
     PHASE_CHECKPOINT_SAVE,
     PHASE_RESTART_PATH,
     PHASE_RESTART,
+    PHASE_CONTROL_WAIT,
 )
 
 #: Phases that count as useful training time in the ledger.
@@ -120,6 +128,10 @@ REQUIRED_SPAN_LABELS: Dict[str, Tuple[str, ...]] = {
     PHASE_CHECKPOINT_RESTORE: ("step", "bytes", "throughput_gbps"),
     PHASE_RESTART: ("reason",),
     PHASE_PREEMPTION_DRAIN: ("event",),
+    # which control-plane wait parked (kv | comm_world | task |
+    # status) so rendezvous-bootstrap waits and shard starvation stay
+    # distinguishable in the ledger
+    PHASE_CONTROL_WAIT: ("kind",),
 }
 
 

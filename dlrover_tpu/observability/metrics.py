@@ -186,6 +186,40 @@ def record_input_io(stage: str, nbytes: int, seconds: float):
         logger.warning("input io metric export failed: %s", e)
 
 
+#: windowed meter behind ``dlrover_tpu_control_rps``: the master's
+#: servicer calls ``record_control_rpc`` per RPC; the rate gauge is
+#: recomputed at most once per window so the metric itself cannot
+#: become control-plane load
+_CONTROL_RPS_WINDOW_S = 5.0
+_control_rpc_lock = threading.Lock()
+_control_rpc_window_start = 0.0
+_control_rpc_window_count = 0
+
+
+def record_control_rpc(n: int = 1):
+    """Count one (or ``n``) master control-plane RPCs; exports the
+    windowed rate as ``dlrover_tpu_control_rps`` and the lifetime tally
+    as ``dlrover_tpu_control_rpc_total``.  Never raises."""
+    global _control_rpc_window_start, _control_rpc_window_count
+    try:
+        reg = get_registry()
+        reg.inc_counter("dlrover_tpu_control_rpc_total", float(n))
+        now = time.monotonic()
+        with _control_rpc_lock:
+            if not _control_rpc_window_start:
+                _control_rpc_window_start = now
+            _control_rpc_window_count += n
+            elapsed = now - _control_rpc_window_start
+            if elapsed < _CONTROL_RPS_WINDOW_S:
+                return
+            rps = _control_rpc_window_count / elapsed
+            _control_rpc_window_start = now
+            _control_rpc_window_count = 0
+        reg.set_gauge("dlrover_tpu_control_rps", rps)
+    except Exception as e:  # noqa: BLE001
+        logger.warning("control rpc metric export failed: %s", e)
+
+
 class MetricsExporter:
     """Builds (once) and supervises the native exporter daemon.
 

@@ -28,9 +28,9 @@ from dlrover_tpu.agent.training import (
     ElasticLaunchConfig,
     launch_agent,
 )
-from dlrover_tpu.common.comm import addr_connectable
+from dlrover_tpu.common.comm import addr_connectable, wait_channel_ready
 from dlrover_tpu.common.constants import NodeEnv
-from dlrover_tpu.common.env import get_free_port
+from dlrover_tpu.common.env import control_longpoll_enabled, get_free_port
 from dlrover_tpu.common.log import default_logger as logger
 
 
@@ -188,6 +188,12 @@ def _launch_local_master(node_num: int) -> Tuple[subprocess.Popen, str]:
 
 
 def _wait_master(addr: str, timeout: float = 60.0) -> bool:
+    """Wait for the master's gRPC port to come up.  Default: park on
+    grpc's channel-ready future (its own reconnect backoff drives the
+    probing); ``DLROVER_TPU_CONTROL_LONGPOLL=0`` restores the 0.5 s
+    TCP-connect polling loop."""
+    if control_longpoll_enabled():
+        return wait_channel_ready(addr, timeout=timeout)
     deadline = time.time() + timeout
     while time.time() < deadline:
         if addr_connectable(addr):

@@ -14,8 +14,14 @@ from collections import deque
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Iterator, Optional
 
-from dlrover_tpu.agent.master_client import MasterClient
-from dlrover_tpu.common.env import input_pipeline_enabled
+from dlrover_tpu.agent.master_client import (
+    MasterClient,
+    _pace_longpoll,
+)
+from dlrover_tpu.common.env import (
+    control_longpoll_enabled,
+    input_pipeline_enabled,
+)
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.common.messages import DataShard, Task, TaskType
 
@@ -93,12 +99,28 @@ class ShardingClient:
 
     def fetch_shard(self, wait_interval: float = 2.0) -> Optional[DataShard]:
         """Next shard, or None when the dataset is exhausted.  Blocks
-        through WAIT tasks (dataset not fully dispatched yet)."""
+        through WAIT tasks (dataset not fully dispatched yet) — under
+        long-poll the master parks the RPC until a task is
+        dispatchable, so waiting out a starved dispatch queue costs
+        ~1 RPC instead of one every ``wait_interval``."""
+        longpoll = control_longpoll_enabled()
         while True:
             task: Task = self._next_task()
             if task.task_type == TaskType.WAIT:
-                time.sleep(wait_interval)
-                continue
+                if longpoll:
+                    t0 = time.monotonic()
+                    task = self._client.get_task(
+                        self._dataset_name, wait_timeout=30.0
+                    )
+                    if task.task_type == TaskType.WAIT:
+                        # a saturated master answers WAIT immediately
+                        # instead of parking; _pace_longpoll's shared
+                        # policy keeps the retry at the 10 Hz fallback
+                        _pace_longpoll(30.0, time.monotonic() - t0)
+                        continue
+                else:
+                    time.sleep(wait_interval)
+                    continue
             if task.is_empty:
                 return None
             with self._lock:

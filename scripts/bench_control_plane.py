@@ -1,0 +1,280 @@
+"""Micro-benchmark for the control-plane fast path.
+
+Spins the REAL gRPC master servicer on localhost (KV store,
+rendezvous managers, task manager — the same components
+``LocalJobMaster`` wires) and drives it with N simulated agents, in
+two modes:
+
+- ``poll`` — the pre-fast-path reference: every "wait for X" is a
+  client loop of ``get`` RPCs at a fixed interval
+  (``DLROVER_TPU_CONTROL_LONGPOLL=0`` behavior).
+- ``longpoll`` — one RPC parks on the master's condition and returns
+  the moment the state changes.
+
+Reported per mode:
+
+- ``idle`` — N agents wait 5 s (budget-scaled) on a key that is never
+  set: total RPC count (client AND server side) and RPC/s.  The
+  acceptance bar is >= 10x fewer RPCs under long-poll.
+- ``wakeup`` — the key is set mid-wait: per-agent latency from ``kv
+  set`` to waiter return, p50/p99.
+- ``throughput`` — N agents hammer ``kv get`` for ~1 s:
+  ``control_rps``, the sustained master RPC rate (mode-independent;
+  measured once).
+
+Usage::
+
+    python scripts/bench_control_plane.py [--agents 8] [--wait_s 5]
+                                          [--out OUT.json]
+
+Honors ``DLROVER_TPU_BENCH_BUDGET_S`` (scales the wait window and
+agent count down) and flushes the payload-so-far to ``--out`` after
+every phase.
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# ONE definition of the budget/flush semantics across all benches
+from bench import BenchBudget, flush_partial as _flush  # noqa: E402
+
+from dlrover_tpu.agent.master_client import MasterClient  # noqa: E402
+from dlrover_tpu.common.constants import RendezvousName  # noqa: E402
+from dlrover_tpu.common.env import get_free_port  # noqa: E402
+from dlrover_tpu.master.kv_store import KVStoreService  # noqa: E402
+from dlrover_tpu.master.rendezvous import (  # noqa: E402
+    ElasticTrainingRendezvousManager,
+    NetworkCheckRendezvousManager,
+)
+from dlrover_tpu.master.servicer import (  # noqa: E402
+    MasterServicer,
+    create_master_service,
+)
+from dlrover_tpu.master.shard.task_manager import TaskManager  # noqa: E402
+
+POLL_INTERVAL_S = 0.2  # the reference client loop cadence
+
+
+def start_master():
+    """The real servicer over real gRPC on a free localhost port;
+    returns (addr, servicer, server, kv_store)."""
+    kv = KVStoreService()
+    servicer = MasterServicer(
+        task_manager=TaskManager(),
+        rdzv_managers={
+            RendezvousName.ELASTIC_TRAINING:
+                ElasticTrainingRendezvousManager(),
+            RendezvousName.NETWORK_CHECK:
+                NetworkCheckRendezvousManager(),
+        },
+        kv_store=kv,
+    )
+    port = get_free_port()
+    server = create_master_service(port, servicer)
+    server.start()
+    return f"127.0.0.1:{port}", servicer, server, kv
+
+
+def _run_waiters(addr, n_agents, key, wait_s, longpoll):
+    """N agents waiting on ``key``; returns (clients, results) where
+    results[i] is the waiter's return wall time or None on timeout."""
+    clients = [
+        MasterClient(addr, node_id=i, timeout=wait_s + 15.0)
+        for i in range(n_agents)
+    ]
+    results = [None] * n_agents
+
+    def _wait(i):
+        try:
+            clients[i].kv_store_wait(
+                key,
+                timeout=wait_s,
+                interval=POLL_INTERVAL_S,
+                longpoll=longpoll,
+            )
+            results[i] = time.perf_counter()
+        except TimeoutError:
+            results[i] = None
+
+    threads = [
+        threading.Thread(target=_wait, args=(i,), daemon=True)
+        for i in range(n_agents)
+    ]
+    for t in threads:
+        t.start()
+    return clients, results, threads
+
+
+def bench_idle_wait(addr, servicer, n_agents, wait_s, longpoll) -> dict:
+    """The acceptance workload: an idle ``wait_s`` KV wait on a key
+    nobody sets.  Counts every RPC the waiters issue."""
+    server_before = servicer.rpc_count
+    key = f"bench/idle/{'lp' if longpoll else 'poll'}/{os.getpid()}"
+    clients, _results, threads = _run_waiters(
+        addr, n_agents, key, wait_s, longpoll
+    )
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    client_rpcs = sum(c.rpc_count for c in clients)
+    for c in clients:
+        c.close()
+    return {
+        "agents": n_agents,
+        "wait_s": wait_s,
+        "client_rpcs": client_rpcs,
+        "server_rpcs": servicer.rpc_count - server_before,
+        "rpcs_per_waiter": round(client_rpcs / max(n_agents, 1), 2),
+        "rps": round(client_rpcs / max(elapsed, 1e-9), 2),
+    }
+
+
+def bench_wakeup(addr, kv, n_agents, wait_s, longpoll) -> dict:
+    """Latency from ``kv set`` to waiter return, p50/p99 over the
+    agent fleet."""
+    key = f"bench/wake/{'lp' if longpoll else 'poll'}/{os.getpid()}"
+    clients, results, threads = _run_waiters(
+        addr, n_agents, key, wait_s + 10.0, longpoll
+    )
+    time.sleep(min(0.5, wait_s / 4))  # everyone parked
+    t_set = time.perf_counter()
+    kv.set(key, b"wake")
+    for t in threads:
+        t.join()
+    for c in clients:
+        c.close()
+    lat_ms = sorted(
+        (r - t_set) * 1e3 for r in results if r is not None
+    )
+    if not lat_ms:
+        return {"error": "no waiter woke"}
+    return {
+        "agents": n_agents,
+        "wakeup_p50_ms": round(statistics.median(lat_ms), 2),
+        "wakeup_p99_ms": round(
+            lat_ms[min(len(lat_ms) - 1, int(len(lat_ms) * 0.99))], 2
+        ),
+        "wakeup_max_ms": round(lat_ms[-1], 2),
+    }
+
+
+def bench_throughput(addr, kv, n_agents, duration_s: float = 1.0) -> dict:
+    """Sustained ``kv get`` RPC rate over N concurrent agents — the
+    master's control-plane ceiling on this host."""
+    kv.set("bench/throughput", b"x")
+    clients = [
+        MasterClient(addr, node_id=i) for i in range(n_agents)
+    ]
+    stop = time.perf_counter() + duration_s
+
+    def _hammer(i):
+        while time.perf_counter() < stop:
+            clients[i].kv_store_get("bench/throughput")
+
+    threads = [
+        threading.Thread(target=_hammer, args=(i,), daemon=True)
+        for i in range(n_agents)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    rpcs = sum(c.rpc_count for c in clients)
+    for c in clients:
+        c.close()
+    return {
+        "agents": n_agents,
+        "rpcs": rpcs,
+        "control_rps": round(rpcs / max(elapsed, 1e-9), 2),
+    }
+
+
+def run_all(n_agents: int = 8, wait_s: float = 5.0,
+            out_path: str = "", payload: dict = None) -> dict:
+    """All phases, poll vs long-poll; shared with ``bench.py`` extras
+    and the tier-1 smoke test."""
+    addr, servicer, server, kv = start_master()
+    result = {
+        "agents": n_agents,
+        "wait_s": wait_s,
+        "cpu_count": os.cpu_count(),
+    }
+
+    def _checkpoint():
+        if payload is not None:
+            payload["extras"]["control_plane"] = result
+            _flush(out_path, payload)
+
+    try:
+        for mode, longpoll in (("poll", False), ("longpoll", True)):
+            result[mode] = {
+                "idle": bench_idle_wait(
+                    addr, servicer, n_agents, wait_s, longpoll
+                ),
+            }
+            _checkpoint()
+            result[mode]["wakeup"] = bench_wakeup(
+                addr, kv, n_agents, wait_s, longpoll
+            )
+            _checkpoint()
+        result["throughput"] = bench_throughput(addr, kv, n_agents)
+        poll_rpcs = result["poll"]["idle"]["client_rpcs"]
+        lp_rpcs = result["longpoll"]["idle"]["client_rpcs"]
+        if lp_rpcs:
+            result["control_rpc_reduction"] = round(
+                poll_rpcs / lp_rpcs, 2
+            )
+        result["control_rps"] = result["throughput"]["control_rps"]
+        _checkpoint()
+    finally:
+        server.stop(grace=0.5)
+    return result
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="control-plane micro-benchmark"
+    )
+    parser.add_argument("--agents", type=int, default=8)
+    parser.add_argument("--wait_s", type=float, default=5.0)
+    parser.add_argument("--out", default="")
+    args = parser.parse_args(argv)
+
+    budget = BenchBudget()
+    n_agents, wait_s = args.agents, args.wait_s
+    if budget.tight(60):
+        # shed the wait window first (it dominates wall time), then
+        # the fleet size; the poll/longpoll RPC ratio survives both
+        wait_s = min(wait_s, 2.0)
+    if budget.tight(20):
+        n_agents, wait_s = min(n_agents, 2), min(wait_s, 1.0)
+
+    payload = {
+        "metric": "control_rpc_reduction",
+        "value": None,
+        "unit": "x",
+        "vs_baseline": None,
+        "extras": {"bench_budget_s": budget.total},
+    }
+    result = run_all(n_agents, wait_s, args.out, payload)
+    payload["value"] = result.get("control_rpc_reduction")
+    payload["extras"]["control_plane"] = result
+    if args.out:
+        _flush(args.out, payload)
+    print(json.dumps(payload, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,6 +38,31 @@ EMIT_METHODS = {"span", "begin", "end", "complete", "instant"}
 #: methods that OPEN a span and must carry the phase's required labels
 OPENING_METHODS = {"span", "begin", "complete"}
 
+#: The closed vocabulary of ``dlrover_tpu_``-prefixed metric names the
+#: package may emit (``set_gauge`` / ``inc_counter`` literal first
+#: args inside ``dlrover_tpu/``).  Dashboards and alerts key on these
+#: — a typo'd name would silently export an orphan series.  Names
+#: outside the prefix (tests, user metrics) are not policed.
+DECLARED_METRICS = {
+    # goodput ledger (observability/events.py TimelineAggregator)
+    "dlrover_tpu_goodput",
+    "dlrover_tpu_goodput_loss_seconds",
+    "dlrover_tpu_timeline_useful_seconds",
+    "dlrover_tpu_timeline_wall_seconds",
+    # checkpoint data plane (observability/metrics.py record_ckpt_io)
+    "dlrover_tpu_ckpt_io_gbps",
+    "dlrover_tpu_ckpt_io_bytes",
+    "dlrover_tpu_ckpt_skipped_snapshots",
+    # input data plane (record_input_io)
+    "dlrover_tpu_input_gbps",
+    "dlrover_tpu_input_bytes",
+    # control plane (record_control_rpc; master servicer RPC meter)
+    "dlrover_tpu_control_rps",
+    "dlrover_tpu_control_rpc_total",
+}
+METRIC_METHODS = {"set_gauge", "inc_counter", "observe_duration"}
+_METRIC_PREFIX = "dlrover_tpu_"
+
 
 def _default_paths():
     paths = [
@@ -98,14 +123,32 @@ def check_file(path: str):
         tree = ast.parse(open(path).read(), filename=path)
     except SyntaxError as e:
         return [f"{path}: syntax error: {e}"]
+    in_package = (
+        os.path.relpath(path, REPO).startswith("dlrover_tpu")
+    )
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
             continue
         func = node.func
-        if not (
-            isinstance(func, ast.Attribute)
-            and func.attr in EMIT_METHODS
+        if not isinstance(func, ast.Attribute):
+            continue
+        if (
+            in_package
+            and func.attr in METRIC_METHODS
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+            and node.args[0].value.startswith(_METRIC_PREFIX)
+            and node.args[0].value not in DECLARED_METRICS
         ):
+            violations.append(
+                f"{os.path.relpath(path, REPO)}:{node.lineno}: "
+                f"{func.attr}({node.args[0].value!r}) is not a "
+                "declared dlrover_tpu_ metric (add it to "
+                "DECLARED_METRICS or fix the typo)"
+            )
+            continue
+        if func.attr not in EMIT_METHODS:
             continue
         if not _is_event_receiver(func):
             continue
