@@ -275,13 +275,14 @@ def _pipeline_executor(mesh_ctx: MeshContext):
             else e
             for e in extras
         )
-        return jax.shard_map(
+        from dlrover_tpu.parallel.sharding import shard_map_compat
+
+        return shard_map_compat(
             run,
             mesh=mesh,
             in_specs=(layer_specs, rep) + extras_specs,
             out_specs=rep,
-            axis_names={AxisName.PIPELINE},
-            check_vma=False,
+            manual_axes={AxisName.PIPELINE},
         )(layers, x_in, *extras_in)
 
     return execute
@@ -300,8 +301,6 @@ def _sp_under_shard_map(mesh_ctx: MeshContext,
     the axis, ring otherwise.  Ulysses runs ``inner_attention`` (the
     Pallas flash kernel on TPU) on the gathered sequence; the ring's
     per-block kernel is flash via ``flash_attention_lse``."""
-    from jax import shard_map
-
     from dlrover_tpu.parallel.collectives import (
         ring_attention,
         ulysses_attention,
@@ -364,20 +363,24 @@ def _sp_under_shard_map(mesh_ctx: MeshContext,
         # "context mesh should match" because pipe is already Manual
         import jax as _jax
 
+        from dlrover_tpu.parallel.sharding import shard_map_compat
+
         use_mesh = mesh
-        cur = _jax.sharding.get_abstract_mesh()
+        try:
+            cur = _jax.sharding.get_abstract_mesh()
+        except AttributeError:  # older jax: no abstract-mesh API
+            cur = None
         if cur is not None and getattr(cur, "axis_names", ()):
             if any(
                 "Manual" in str(t)
                 for t in getattr(cur, "axis_types", ())
             ):
                 use_mesh = cur
-        sp = shard_map(
+        sp = shard_map_compat(
             fn,
             mesh=use_mesh,
             in_specs=(q_spec, kv_spec, kv_spec),
             out_specs=q_spec,
-            check_vma=False,
         )
         return sp(q, k, v)
 

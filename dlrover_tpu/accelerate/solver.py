@@ -89,6 +89,143 @@ class JointPlan:
         }
 
 
+@dataclass(frozen=True)
+class OffloadGroupPlan:
+    """Grouped-backward plan for the host-offload path: how many
+    backward passes per step (``n_groups``) and where the stacked
+    layer dim splits (``boundaries`` — the ``init_ngrouped_params``
+    input)."""
+
+    n_groups: int
+    boundaries: Tuple[int, ...]
+    group_params: Tuple[int, ...]
+    predicted_peak_bytes: int
+    budget_bytes: int
+
+    def describe(self) -> Dict:
+        return {
+            "n_groups": self.n_groups,
+            "boundaries": list(self.boundaries),
+            "group_params_m": [
+                round(p / 1e6, 1) for p in self.group_params
+            ],
+            "predicted_peak_gb": round(
+                self.predicted_peak_bytes / 1e9, 2
+            ),
+            "budget_gb": round(self.budget_bytes / 1e9, 2),
+        }
+
+
+def balanced_boundaries(
+    layer_params: Sequence[int],
+    n_groups: int,
+    embed_params: int = 0,
+    head_params: int = 0,
+) -> Tuple[int, ...]:
+    """Layer split points giving ``n_groups`` contiguous segments of
+    near-equal parameter weight.  ``embed_params``/``head_params``
+    are charged to the first/last layer (group 0 carries the
+    embedding, the last group the lm head — the
+    ``loss_fn_ngrouped`` contract), so a heavy head pushes the last
+    boundary earlier instead of silently unbalancing the tail
+    group.  Handles odd (non-divisible) layer counts; every group
+    keeps at least one layer."""
+    n_layers = len(layer_params)
+    if not 1 <= n_groups <= n_layers:
+        raise ValueError(
+            f"cannot split {n_layers} layers into {n_groups} groups"
+        )
+    weights = [float(w) for w in layer_params]
+    weights[0] += float(embed_params)
+    weights[-1] += float(head_params)
+    total = sum(weights)
+    cum = [0.0]
+    for w in weights:
+        cum.append(cum[-1] + w)
+    bounds: List[int] = []
+    prev = 0
+    for k in range(1, n_groups):
+        target = total * k / n_groups
+        lo = prev + 1
+        hi = n_layers - (n_groups - k)  # leave >=1 layer per group
+        best = min(
+            range(lo, hi + 1), key=lambda b: abs(cum[b] - target)
+        )
+        bounds.append(best)
+        prev = best
+    return tuple(bounds)
+
+
+def solve_offload_groups(
+    profile: ModelProfile,
+    batch_per_replica: int = 1,
+    remat: str = "full",
+    headroom: float = 0.85,
+    max_groups: int = 8,
+    hbm_bytes: Optional[int] = None,
+    layer_params: Optional[Sequence[int]] = None,
+    embed_params: int = 0,
+    head_params: int = 0,
+    transient_bytes: int = 768 << 20,
+) -> OffloadGroupPlan:
+    """Pick the grouped-backward split for the host-offload path.
+
+    The offloaded step's HBM peak is ``bf16 params + retained
+    activations + ONE group's bf16 dW tree + the chunk-stream
+    transient`` — the dW term is the only one N shrinks, so the solve
+    is: smallest N whose balanced split fits the budget (every extra
+    group costs a full extra backward pass, so more groups than
+    needed is pure slowdown).  ``layer_params`` is the per-layer
+    parameter count (uniform split of the stacked params by default);
+    ``embed_params``/``head_params`` weight the first/last groups the
+    way ``loss_fn_ngrouped`` assigns the unstacked leaves.  Raises
+    ``ValueError`` when even ``max_groups`` does not fit."""
+    if remat not in REMAT_POLICIES:
+        raise ValueError(f"unknown remat policy {remat!r}")
+    budget = float(hbm_bytes or device_memory_bytes()) * headroom
+    act_frac = REMAT_POLICIES[remat][0]
+    acts = (
+        profile.activation_bytes_per_sample
+        * batch_per_replica
+        * act_frac
+    )
+    n_layers = max(profile.num_layers, 1)
+    if layer_params is None:
+        stacked = max(
+            profile.num_params - embed_params - head_params, 0
+        )
+        layer_params = [stacked / n_layers] * n_layers
+    resident = 2.0 * profile.num_params + acts + transient_bytes
+    peak = None
+    for n in range(1, min(max_groups, len(layer_params)) + 1):
+        bounds = balanced_boundaries(
+            layer_params, n, embed_params, head_params
+        )
+        edges = [0, *bounds, len(layer_params)]
+        group_params = []
+        for lo, hi in zip(edges, edges[1:]):
+            w = sum(layer_params[lo:hi])
+            if lo == 0:
+                w += embed_params
+            if hi == len(layer_params):
+                w += head_params
+            group_params.append(int(w))
+        peak = resident + 2.0 * max(group_params)
+        if peak <= budget:
+            return OffloadGroupPlan(
+                n_groups=n,
+                boundaries=bounds,
+                group_params=tuple(group_params),
+                predicted_peak_bytes=int(peak),
+                budget_bytes=int(budget),
+            )
+    raise ValueError(
+        f"no grouped split fits: {max_groups} groups still need "
+        f"{(peak or resident) / 1e9:.2f} GB of "
+        f"{budget / 1e9:.2f} GB"
+    )
+
+
 def candidate_tiles(
     seq_len: int,
     head_dim: int = 128,

@@ -181,7 +181,10 @@ class AsyncCheckpointSaver:
         A shard whose shm snapshot is at a different step makes the
         whole save fail — persisting a mixed-step checkpoint would
         silently corrupt a later restore."""
-        t0_wall, t0_mono = time.time(), time.monotonic()
+        from dlrover_tpu.observability.events import anchored_now
+
+        t0_mono = time.monotonic()
+        t0_wall = anchored_now(t0_mono)
         root = root or self.config.checkpoint_dir
         stage = self._stage_dir(root, step)
         self._storage.safe_makedirs(stage)

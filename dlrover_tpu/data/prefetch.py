@@ -29,7 +29,10 @@ import threading
 import time
 from typing import Iterable, Iterator, Optional
 
-from dlrover_tpu.observability.events import get_event_logger
+from dlrover_tpu.observability.events import (
+    anchored_now,
+    get_event_logger,
+)
 from dlrover_tpu.observability.metrics import record_input_io
 
 #: gauge refresh window: batch rates are noisy, export ~1/s
@@ -134,7 +137,8 @@ def host_prefetch(
     thread.start()
     try:
         while True:
-            t0_wall, t0_mono = time.time(), time.monotonic()
+            t0_mono = time.monotonic()
+            t0_wall = anchored_now(t0_mono)
             item = q.get()
             wait = time.monotonic() - t0_mono
             if isinstance(item, _EndOfStream):
@@ -178,7 +182,8 @@ def device_prefetch(
     events = get_event_logger()
 
     def _put(batch):
-        t0_wall, t0_mono = time.time(), time.monotonic()
+        t0_mono = time.monotonic()
+        t0_wall = anchored_now(t0_mono)
         if sharding is not None:
             out = jax.device_put(batch, sharding)
         else:
@@ -192,7 +197,8 @@ def device_prefetch(
         """next(it) with stall accounting; raises StopIteration."""
         if not events.enabled:
             return next(it)
-        t0_wall, t0_mono = time.time(), time.monotonic()
+        t0_mono = time.monotonic()
+        t0_wall = anchored_now(t0_mono)
         batch = next(it)
         dur = time.monotonic() - t0_mono
         if dur >= stall_threshold_s:

@@ -124,6 +124,34 @@ def init_params(key, cfg: LlamaConfig) -> Dict:
     }
 
 
+def loss_fn_ngrouped(
+    parts,
+    batch: Dict,
+    cfg: LlamaConfig,
+    attention_fn=None,
+    fused_ce: Optional[bool] = None,
+) -> jnp.ndarray:
+    """``loss_fn`` over an N-group param split: group 0 carries the
+    embedding + the first layer segment, middle groups a contiguous
+    layer segment each, the last group the tail segment + final norm
+    + lm head.  ``jax.grad(..., argnums=i)`` materializes only group
+    i's dW carries — at ~3B params on a 16 GB chip the full grads
+    tree cannot coexist with the params, so the offloaded step runs
+    one backward per group
+    (``optimizers.host_offload.build_grouped_offload_step``); more
+    groups shrink the peak dW tree further."""
+    parts = tuple(parts)
+    if len(parts) == 1:
+        return loss_fn(parts[0], batch, cfg, attention_fn, fused_ce)
+    params = {
+        "embed": parts[0]["embed"],
+        "layers": tuple(p["layers"] for p in parts),
+        "final_norm": parts[-1]["final_norm"],
+        "lm_head": parts[-1]["lm_head"],
+    }
+    return loss_fn(params, batch, cfg, attention_fn, fused_ce)
+
+
 def loss_fn_grouped(
     params_a: Dict,
     params_b: Dict,
@@ -132,48 +160,58 @@ def loss_fn_grouped(
     attention_fn=None,
     fused_ce: Optional[bool] = None,
 ) -> jnp.ndarray:
-    """``loss_fn`` over a two-group param split: group A carries the
-    embedding + the first layer segment, group B the second segment +
-    final norm + lm head.  ``jax.grad(..., argnums=0 or 1)``
-    materializes only THAT group's dW carries — at ~3B params on a
-    16 GB chip the full grads tree cannot coexist with the params, so
-    the offloaded step runs one backward per group
-    (``optimizers.host_offload.build_grouped_offload_step``)."""
-    params = {
-        "embed": params_a["embed"],
-        "layers": (params_a["layers"], params_b["layers"]),
-        "final_norm": params_b["final_norm"],
-        "lm_head": params_b["lm_head"],
-    }
-    return loss_fn(params, batch, cfg, attention_fn, fused_ce)
+    """Two-group form of :func:`loss_fn_ngrouped` (kept for the
+    legacy ``build_grouped_offload_step`` calling convention)."""
+    return loss_fn_ngrouped(
+        (params_a, params_b), batch, cfg, attention_fn, fused_ce
+    )
+
+
+def init_ngrouped_params(key, cfg: LlamaConfig, boundaries):
+    """Build an N-group layer split WITHOUT materializing the full
+    stacked tree (at 3B the fp32 full tree plus its slices would not
+    fit): each group initializes from a per-segment config.
+    ``boundaries`` are the strictly-increasing layer split points
+    (``len(boundaries) + 1`` groups; ``accelerate.solver.
+    solve_offload_groups`` chooses them from the per-layer footprint).
+    Returns a list of thunks so the caller can free each group's fp32
+    source before the next materializes."""
+    import dataclasses
+
+    bounds = [0] + list(boundaries) + [cfg.n_layers]
+    for lo, hi in zip(bounds, bounds[1:]):
+        if hi <= lo:
+            raise ValueError(
+                f"boundaries {tuple(boundaries)} must be strictly "
+                f"increasing within (0, {cfg.n_layers})"
+            )
+    n_groups = len(bounds) - 1
+    keys = jax.random.split(key, n_groups)
+
+    def make(i: int):
+        seg_cfg = dataclasses.replace(
+            cfg, n_layers=bounds[i + 1] - bounds[i]
+        )
+
+        def init() -> Dict:
+            t = init_params(keys[i], seg_cfg)
+            part = {"layers": t["layers"]}
+            if i == 0:
+                part["embed"] = t["embed"]
+            if i == n_groups - 1:
+                part["final_norm"] = t["final_norm"]
+                part["lm_head"] = t["lm_head"]
+            return part
+
+        return init
+
+    return [make(i) for i in range(n_groups)]
 
 
 def init_grouped_params(key, cfg: LlamaConfig, boundary: int):
-    """Build the two-group split WITHOUT materializing the full
-    stacked tree (at 3B the fp32 full tree plus its slices would not
-    fit): each group initializes from a per-segment config.  Returns
-    ``(init_a, init_b)`` thunks so the caller can free group A's fp32
-    source before group B materializes."""
-    import dataclasses
-
-    cfg_a = dataclasses.replace(cfg, n_layers=boundary)
-    cfg_b = dataclasses.replace(
-        cfg, n_layers=cfg.n_layers - boundary
-    )
-    k_a, k_b = jax.random.split(key)
-
-    def init_a() -> Dict:
-        t = init_params(k_a, cfg_a)
-        return {"embed": t["embed"], "layers": t["layers"]}
-
-    def init_b() -> Dict:
-        t = init_params(k_b, cfg_b)
-        return {
-            "layers": t["layers"],
-            "final_norm": t["final_norm"],
-            "lm_head": t["lm_head"],
-        }
-
+    """Two-group form of :func:`init_ngrouped_params`: returns
+    ``(init_a, init_b)`` thunks splitting the stack at ``boundary``."""
+    init_a, init_b = init_ngrouped_params(key, cfg, (boundary,))
     return init_a, init_b
 
 

@@ -41,6 +41,26 @@ from dlrover_tpu.common.log import default_logger as logger
 
 EVENTS_FILE_ENV = "DLROVER_TPU_EVENTS_FILE"
 
+# One (wall, mono) anchor per process: every record's ``wall`` is
+# derived from ``mono`` against this pair, so the two clocks carry a
+# constant offset within a writer.  Sampling both clocks per event
+# would let the offset jitter by microseconds between records, and
+# span ends reconstructed as ``begin.wall + mono_delta`` could then
+# land before a nested child's end.
+_WALL_EPOCH = time.time()
+_MONO_EPOCH = time.monotonic()
+
+
+def anchored_now(mono: Optional[float] = None) -> float:
+    """Wall-clock "now" on the same ``(wall, mono)`` anchor the
+    emitted records use.  Callers that report a span after the fact
+    (``complete()``) must sample its start through this — passing the
+    ``time.monotonic()`` they already took, if any — so X-records stay
+    on one clock with B/E records even across an NTP step."""
+    if mono is None:
+        mono = time.monotonic()
+    return _WALL_EPOCH + (mono - _MONO_EPOCH)
+
 #: Span phases, HIGHEST attribution priority first.  When spans
 #: overlap, each instant of wall clock is charged to the
 #: highest-priority covering phase.  ``step`` is the only USEFUL
@@ -69,6 +89,12 @@ PHASE_AOT_COMPILE = "aot_compile"
 PHASE_RENDEZVOUS = "rendezvous"
 PHASE_RENDEZVOUS_WAIT = "rendezvous_wait"
 PHASE_CHECKPOINT_SAVE = "checkpoint_save"
+# host-offload optimizer-state chunk stream (optimizers/host_offload):
+# the D2H/H2D traffic of one streamed update.  Ranks BELOW step on
+# purpose — the stream is designed to overlap the backward, so an
+# instant covered by both charges the step (nothing was lost); a
+# standalone offload_copy (the exposed tail) surfaces as its own loss
+PHASE_OFFLOAD_COPY = "offload_copy"
 # parent span covering one whole overlapped (or fallen-back serial)
 # restart critical path; the child legs above carve their shares out
 PHASE_RESTART_PATH = "restart_path"
@@ -93,6 +119,7 @@ PHASES: Tuple[str, ...] = (
     PHASE_RENDEZVOUS,
     PHASE_RENDEZVOUS_WAIT,
     PHASE_CHECKPOINT_SAVE,
+    PHASE_OFFLOAD_COPY,
     PHASE_RESTART_PATH,
     PHASE_RESTART,
     PHASE_CONTROL_WAIT,
@@ -126,6 +153,11 @@ REQUIRED_SPAN_LABELS: Dict[str, Tuple[str, ...]] = {
     # in bench_goodput's loss breakdown, not only in wall time
     PHASE_CHECKPOINT_SAVE: ("step", "bytes", "throughput_gbps"),
     PHASE_CHECKPOINT_RESTORE: ("step", "bytes", "throughput_gbps"),
+    # host-offload chunk-stream spans carry the streamed bytes, the
+    # measured wire throughput and whether the rolling double-buffered
+    # window was active (vs the serial kill-switched stream) so DMA
+    # pipeline regressions are attributable from the timeline alone
+    PHASE_OFFLOAD_COPY: ("bytes", "throughput_gbps", "buffered"),
     PHASE_RESTART: ("reason",),
     PHASE_PREEMPTION_DRAIN: ("event",),
     # which control-plane wait parked (kv | comm_world | task |
@@ -186,11 +218,12 @@ class EventLogger:
 
     # ------------------------------------------------------------- emit
     def _record(self, name: str, ph: str, **labels) -> dict:
+        mono = time.monotonic()
         rec = {
             "name": name,
             "ph": ph,
-            "wall": time.time(),
-            "mono": time.monotonic(),
+            "wall": _WALL_EPOCH + (mono - _MONO_EPOCH),
+            "mono": mono,
             "job": self._job,
             "node": self._node,
             "rank": self._rank,

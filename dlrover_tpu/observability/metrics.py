@@ -186,6 +186,28 @@ def record_input_io(stage: str, nbytes: int, seconds: float):
         logger.warning("input io metric export failed: %s", e)
 
 
+def record_offload_io(nbytes: int, seconds: float, buffered: bool):
+    """Export one host-offload chunk-stream measurement as gauges
+    (``dlrover_tpu_offload_gbps{buffered=...}`` / ``_bytes``): the
+    optimizer-state host<->device traffic of one streamed update.
+    ``buffered`` distinguishes the rolling double-buffered DMA window
+    from the serial (kill-switched) stream so a regression in the
+    overlap shows up as a ratio between the two series.  Never raises
+    — metrics must not break a train step."""
+    try:
+        reg = get_registry()
+        gbps = nbytes / 1e9 / max(seconds, 1e-9)
+        labels = {"buffered": "1" if buffered else "0"}
+        reg.set_gauge(
+            "dlrover_tpu_offload_gbps", gbps, labels=labels
+        )
+        reg.set_gauge(
+            "dlrover_tpu_offload_bytes", float(nbytes), labels=labels
+        )
+    except Exception as e:  # noqa: BLE001
+        logger.warning("offload io metric export failed: %s", e)
+
+
 #: windowed meter behind ``dlrover_tpu_control_rps``: the master's
 #: servicer calls ``record_control_rpc`` per RPC; the rate gauge is
 #: recomputed at most once per window so the metric itself cannot

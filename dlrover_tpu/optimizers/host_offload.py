@@ -48,7 +48,7 @@ optimizer (dynamic-range rationale in ``optimizers/low_bit.py``).
 
 import functools
 import os
-from typing import Dict, NamedTuple, Optional
+from typing import Dict, NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -59,6 +59,21 @@ from dlrover_tpu.common.log import default_logger as logger
 # 64M elements = 256 MB per fp32 chunk buffer; the update transient is
 # ~6 buffers (3 in, 3 out) plus the resident bf16 params and grads
 DEFAULT_CHUNK_ELEMS = 64 * 1024 * 1024
+
+#: kill-switch: ``=0`` restores the pre-DMA-pipeline behavior exactly
+#: (one-shot first-window prefetch instead of the rolling
+#: double-buffered window)
+OFFLOAD_BUFFERED_ENV = "DLROVER_TPU_OFFLOAD_BUFFERED"
+#: kill-switch for the quantized optimizer-state TRANSFERS (fp32
+#: moments moved across the host boundary as int8+scales): ``=0``
+#: forces fp32 wire format, ``=1`` forces int8, unset = int8 only
+#: where a real PCIe boundary exists (TPU backend)
+OFFLOAD_QUANT_ENV = "DLROVER_TPU_OFFLOAD_QUANT"
+
+
+def _buffered_enabled() -> bool:
+    return os.getenv(OFFLOAD_BUFFERED_ENV, "1") != "0"
+
 
 _HOST_KIND_PROBED: Optional[bool] = None
 
@@ -147,6 +162,36 @@ def _deq_chunk(q, scales, n):
     return x.reshape(-1)[:n]
 
 
+def _np_quant_chunk(x: np.ndarray):
+    """Host-side mirror of :func:`_quant_chunk` (same block layout,
+    same absmax/127 scales) for the quantized TRANSFER path: fp32
+    moments that stay fp32 in host storage are quantized on the host
+    right before the H2D dispatch, so only int8+scales cross the
+    boundary."""
+    x = np.asarray(x, dtype=np.float32)
+    n = x.shape[0]
+    pad = (-n) % _QBLOCK
+    if pad:
+        x = np.pad(x, (0, pad))
+    blocks = x.reshape(-1, _QBLOCK)
+    scales = np.maximum(
+        np.max(np.abs(blocks), axis=1) / 127.0, 1e-12
+    ).astype(np.float32)
+    q = np.clip(
+        np.round(blocks / scales[:, None]), -127, 127
+    ).astype(np.int8)
+    return q.reshape(-1), scales
+
+
+def _np_deq_chunk(q: np.ndarray, scales: np.ndarray, n: int):
+    """Host-side mirror of :func:`_deq_chunk` for the D2H writeback."""
+    x = (
+        np.asarray(q, np.float32).reshape(-1, _QBLOCK)
+        * np.asarray(scales, np.float32)[:, None]
+    )
+    return x.reshape(-1)[:n]
+
+
 def _quant_chunk(x):
     """fp32 [n] -> (int8 [padded], per-block scales).  Plain jnp: the
     op is memory-bound and lives inside the chunk jit, so XLA fuses it
@@ -210,6 +255,68 @@ def _chunk_update_q(master, mu_q, mu_s, nu_q, nu_s, grad, bc1, bc2,
         master, mu_q, mu_s, nu_q, nu_s, grad, bc1, bc2,
         lr=lr, b1=b1, b2=b2, eps=eps, wd=wd,
     )
+
+
+class _RollingPrefetch:
+    """Double-buffered H2D stream over the chunk sequence.
+
+    The one-shot prefetch only hid the FIRST ``window`` chunks' H2D
+    under the backward; every later chunk's transfer was dispatched
+    immediately before its own compute, serializing copy against math.
+    This object keeps a rolling window: consuming chunk ``k``
+    (:meth:`get`) dispatches the H2D of chunk ``k + window``, so the
+    transfer of the next chunks always overlaps the in-flight chunks'
+    update math — the out-of-program form of the fused path's
+    barrier-windowed copy pipeline.  Per-chunk host buffers are READ
+    ONLY ahead of their own writeback (each chunk is written exactly
+    once, strictly after its own compute), so early staging can never
+    observe a torn update."""
+
+    def __init__(self, opt, leaves_m, leaves_mu, leaves_nu,
+                 quant: bool):
+        self._opt = opt
+        self._m = leaves_m
+        self._mu = leaves_mu
+        self._nu = leaves_nu
+        self._quant = quant
+        self._entries: Dict = {}
+        self._order = []
+        for li, m in enumerate(leaves_m):
+            for j, sl in enumerate(opt._chunk_slices(m.size)):
+                self._order.append((li, j, sl))
+        self._cursor = 0
+        for _ in range(opt.window):
+            self._dispatch_next()
+
+    def _dispatch_next(self):
+        if self._cursor >= len(self._order):
+            return
+        li, j, sl = self._order[self._cursor]
+        self._cursor += 1
+        self._entries[(li, j)] = self._opt._stage_chunk(
+            self._m, self._mu, self._nu, li, j, sl,
+            quant=self._quant,
+        )
+
+    def get(self, key):
+        """Consume one chunk's staged inputs and refill the window."""
+        entry = self._entries.pop(key, None)
+        self._dispatch_next()
+        return entry
+
+    def __len__(self):
+        return len(self._entries)
+
+
+class _OneShotPrefetch(dict):
+    """Legacy first-window prefetch dict.  Carries the staging-time
+    quant flag so ``_apply_numpy`` unpacks the staged tuples with the
+    arity they were built with, even if ``DLROVER_TPU_OFFLOAD_QUANT``
+    flips between ``start_prefetch`` and ``apply_gradients``."""
+
+    def __init__(self, quant: bool):
+        super().__init__()
+        self._quant = quant
 
 
 class HostOffloadAdamW:
@@ -463,43 +570,125 @@ class HostOffloadAdamW:
         )
 
     # --------------------------------------------------------- update
+    def _transfer_quant(self) -> bool:
+        """Whether fp32 moments cross the host boundary quantized
+        (int8 payload + per-block scales — ~4x less moment traffic
+        each way).  Host STORAGE stays fp32 (checkpoint format
+        unchanged); only the wire format changes, which is why this
+        is a per-step decision, not an init-time one.  Defaults on
+        only where a real transfer link exists (TPU backend);
+        ``DLROVER_TPU_OFFLOAD_QUANT=0/1`` overrides."""
+        if self.moments != "fp32" or self.backend != "numpy":
+            return False  # int8 moments already transfer quantized
+        raw = os.getenv(OFFLOAD_QUANT_ENV, "")
+        if raw == "0":
+            return False
+        if raw == "1":
+            return True
+        return jax.default_backend() == "tpu"
+
+    def _stage_chunk(self, leaves_m, leaves_mu, leaves_nu,
+                     li: int, j: int, sl: slice, quant: bool = False):
+        """Dispatch the async H2D of ONE chunk's host state; returns
+        the device-input tuple the chunk jit consumes.  With
+        ``quant`` (fp32 moments, quantized transfers) the moments are
+        blockwise-quantized host-side first — nu as sqrt(nu), the
+        same wire convention as the int8-moment storage format — so
+        the H2D carries 1 byte/elem instead of 4."""
+        flat_m = leaves_m[li].reshape(-1)
+        if self.moments == "int8":
+            mu_q, mu_s = leaves_mu[li][j]
+            nu_q, nu_s = leaves_nu[li][j]
+            return (
+                jnp.asarray(flat_m[sl]),
+                jnp.asarray(mu_q), jnp.asarray(mu_s),
+                jnp.asarray(nu_q), jnp.asarray(nu_s),
+            )
+        flat_mu = leaves_mu[li].reshape(-1)
+        flat_nu = leaves_nu[li].reshape(-1)
+        if quant:
+            mu_q, mu_s = _np_quant_chunk(flat_mu[sl])
+            nu_q, nu_s = _np_quant_chunk(np.sqrt(flat_nu[sl]))
+            return (
+                jnp.asarray(flat_m[sl]),
+                jnp.asarray(mu_q), jnp.asarray(mu_s),
+                jnp.asarray(nu_q), jnp.asarray(nu_s),
+            )
+        return (
+            jnp.asarray(flat_m[sl]),
+            jnp.asarray(flat_mu[sl]),
+            jnp.asarray(flat_nu[sl]),
+        )
+
+    @staticmethod
+    def _emit_stream_span(
+        duration_s: float, nbytes: int, buffered: bool,
+    ):
+        """One ``offload_copy`` span per chunk-streamed update: the
+        host<->device optimizer-state traffic with its measured
+        throughput, tagged ``buffered`` so the double-buffered and
+        serial pipelines stay distinguishable in the timeline (and in
+        the ``dlrover_tpu_offload_gbps`` gauge).  Must be called at
+        stream end: the span start is reconstructed as anchored "now"
+        minus ``duration_s`` so it sits on the same clock as B/E
+        records."""
+        try:
+            from dlrover_tpu.observability.events import (
+                anchored_now,
+                get_event_logger,
+            )
+            from dlrover_tpu.observability.metrics import (
+                record_offload_io,
+            )
+
+            gbps = nbytes / 1e9 / max(duration_s, 1e-9)
+            events = get_event_logger()
+            events.complete(
+                "offload_copy",
+                anchored_now() - max(duration_s, 0.0),
+                duration_s,
+                bytes=int(nbytes),
+                throughput_gbps=round(gbps, 3),
+                buffered=bool(buffered),
+            )
+            record_offload_io(nbytes, duration_s, buffered)
+        except Exception:  # noqa: BLE001 - observability only
+            pass
+
     def start_prefetch(self, state: OffloadState):
-        """Dispatch async H2D of the first ``max_in_flight`` chunk
-        window of host state (numpy backend).  Called BEFORE backward
-        so the transfers overlap the compute; the returned dict feeds
-        :meth:`apply_gradients`.  The pinned_host backend overlaps
-        via :func:`build_fused_offload_step` instead (out-of-program
-        ``device_put`` dispatch overhead makes per-chunk prefetch a
-        loss there)."""
+        """Start the H2D stream of host state (numpy backend).
+        Called BEFORE backward so the first window's transfers
+        overlap the compute; the returned object feeds
+        :meth:`apply_gradients`.
+
+        Default: a :class:`_RollingPrefetch` — the window REFILLS as
+        chunks are consumed, so every chunk's H2D (not just the first
+        window's) overlaps the previous chunks' update math.
+        ``DLROVER_TPU_OFFLOAD_BUFFERED=0`` restores the legacy
+        one-shot first-window dict exactly.  The pinned_host backend
+        overlaps via :func:`build_fused_offload_step` instead
+        (out-of-program ``device_put`` dispatch overhead makes
+        per-chunk prefetch a loss there)."""
         if self.backend != "numpy":
             return None
         leaves_m, treedef = jax.tree_util.tree_flatten(state.master)
         leaves_mu = treedef.flatten_up_to(state.mu)
         leaves_nu = treedef.flatten_up_to(state.nu)
-        prefetched = {}
+        quant = self._transfer_quant()
+        if _buffered_enabled():
+            return _RollingPrefetch(
+                self, leaves_m, leaves_mu, leaves_nu, quant
+            )
+        prefetched = _OneShotPrefetch(quant)
         budget = self.window
         for li, m in enumerate(leaves_m):
-            flat_m = m.reshape(-1)
-            if self.moments == "fp32":
-                flat_mu = leaves_mu[li].reshape(-1)
-                flat_nu = leaves_nu[li].reshape(-1)
             for j, sl in enumerate(self._chunk_slices(m.size)):
                 if budget <= 0:
                     return prefetched
-                if self.moments == "int8":
-                    mu_q, mu_s = leaves_mu[li][j]
-                    nu_q, nu_s = leaves_nu[li][j]
-                    prefetched[(li, j)] = (
-                        jnp.asarray(flat_m[sl]),
-                        jnp.asarray(mu_q), jnp.asarray(mu_s),
-                        jnp.asarray(nu_q), jnp.asarray(nu_s),
-                    )
-                else:
-                    prefetched[(li, j)] = (
-                        jnp.asarray(flat_m[sl]),
-                        jnp.asarray(flat_mu[sl]),
-                        jnp.asarray(flat_nu[sl]),
-                    )
+                prefetched[(li, j)] = self._stage_chunk(
+                    leaves_m, leaves_mu, leaves_nu, li, j, sl,
+                    quant=quant,
+                )
                 budget -= 1
         return prefetched
 
@@ -577,6 +766,8 @@ class HostOffloadAdamW:
     def _apply_numpy(
         self, state: OffloadState, grads, prefetched=None
     ) -> OffloadState:
+        import time as _time
+
         prefetched = prefetched or {}
         step = state.step + 1
         bc1 = jnp.float32(1.0 - self.b1**step)
@@ -591,10 +782,20 @@ class HostOffloadAdamW:
         in_flight = []  # (leaf_idx, chunk_slice, chunk_idx, results)
 
         int8 = self.moments == "int8"
+        # unpack staged chunks with the arity they were staged with:
+        # the prefetch window pins the quant flag at start_prefetch
+        # time, so an env flip between the two calls cannot mismatch
+        # the in-flight tuples
+        tq = getattr(prefetched, "_quant", None)
+        if tq is None:
+            tq = self._transfer_quant()
+        buffered = isinstance(prefetched, _RollingPrefetch)
         hyper = dict(
             lr=self.lr, b1=self.b1, b2=self.b2, eps=self.eps,
             wd=self.wd,
         )
+        t0 = _time.perf_counter()
+        stream_bytes = 0
 
         def drain_one():
             li, sl, j, res = in_flight.pop(0)
@@ -609,6 +810,27 @@ class HostOffloadAdamW:
                 qb, sb = leaves_nu[li][j]
                 np.copyto(qb, np.asarray(nu_q))
                 np.copyto(sb, np.asarray(nu_s))
+            elif tq:
+                # quantized wire, fp32 storage: dequantize back into
+                # the SAME fp32 host buffers (nu travels as sqrt(nu),
+                # the int8-moment wire convention)
+                m_d, mu_q, mu_s, nu_q, nu_s, p_d = res
+                np.copyto(
+                    leaves_m[li].reshape(-1)[sl], np.asarray(m_d)
+                )
+                n = sl.stop - sl.start
+                np.copyto(
+                    leaves_mu[li].reshape(-1)[sl],
+                    _np_deq_chunk(
+                        np.asarray(mu_q), np.asarray(mu_s), n
+                    ),
+                )
+                nu_root = _np_deq_chunk(
+                    np.asarray(nu_q), np.asarray(nu_s), n
+                )
+                np.copyto(
+                    leaves_nu[li].reshape(-1)[sl], nu_root * nu_root
+                )
             else:
                 m_d, mu_d, nu_d, p_d = res
                 # d2h writebacks into the SAME host buffers
@@ -629,32 +851,31 @@ class HostOffloadAdamW:
             n = flat_m.shape[0]
             for j, sl in enumerate(self._chunk_slices(n)):
                 pre = prefetched.get((li, j))
-                if int8:
-                    if pre is None:
-                        mu_q, mu_s = leaves_mu[li][j]
-                        nu_q, nu_s = leaves_nu[li][j]
-                        pre = (
-                            jnp.asarray(flat_m[sl]),
-                            jnp.asarray(mu_q),
-                            jnp.asarray(mu_s),
-                            jnp.asarray(nu_q),
-                            jnp.asarray(nu_s),
-                        )
+                if pre is None:
+                    pre = self._stage_chunk(
+                        leaves_m, leaves_mu, leaves_nu, li, j, sl,
+                        quant=tq,
+                    )
+                if int8 or tq:
                     res = _chunk_update_q(
                         *pre, flat_g[sl], bc1, bc2, **hyper
                     )
                 else:
-                    if pre is None:
-                        flat_mu = leaves_mu[li].reshape(-1)
-                        flat_nu = leaves_nu[li].reshape(-1)
-                        pre = (
-                            jnp.asarray(flat_m[sl]),
-                            jnp.asarray(flat_mu[sl]),
-                            jnp.asarray(flat_nu[sl]),
-                        )
                     res = _chunk_update(
                         *pre, flat_g[sl], bc1, bc2, **hyper
                     )
+                elems = sl.stop - sl.start
+                # master fp32 both ways + moments (fp32 or int8 +
+                # fp32 scales) both ways — the chunk-stream traffic
+                # the span reports
+                if int8 or tq:
+                    padded = self._q_padded(elems)
+                    stream_bytes += 2 * (
+                        4 * elems
+                        + 2 * (padded + 4 * (padded // _QBLOCK))
+                    )
+                else:
+                    stream_bytes += 2 * (4 * elems + 2 * 4 * elems)
                 in_flight.append((li, sl, j, res))
                 # bounded window: older chunks' HBM buffers are freed
                 # by the writeback before new ones are dispatched
@@ -662,6 +883,9 @@ class HostOffloadAdamW:
                     drain_one()
         while in_flight:
             drain_one()
+        self._emit_stream_span(
+            _time.perf_counter() - t0, stream_bytes, buffered,
+        )
 
         new_params = []
         for li, m in enumerate(leaves_m):
@@ -1164,38 +1388,68 @@ def build_offloaded_train_step(
 
 def build_grouped_offload_step(
     loss_grouped,
-    init_a_fn,
-    init_b_fn,
+    init_a_fn=None,
+    init_b_fn=None,
     optimizer_a: Optional[HostOffloadAdamW] = None,
     optimizer_b: Optional[HostOffloadAdamW] = None,
+    *,
+    init_fns: Optional[Sequence] = None,
+    optimizers: Optional[Sequence] = None,
 ):
-    """Offloaded train step with TWO param groups and one backward
+    """Offloaded train step with N param groups and one backward
     pass per group — the ceiling lever past ~2B params on a 16 GB
     chip, where a single backward's full dW tree cannot coexist with
-    the bf16 params (measured: 3.0B needs ~19 GB).
+    the bf16 params (measured: 3.0B needs ~19 GB).  More groups
+    shrink the peak further: the largest resident dW tree is one
+    group's, so N is the knob that trades backward passes for HBM
+    headroom (``accelerate.solver.solve_offload_groups`` picks the
+    smallest N that fits from the model's per-layer footprint).
 
-    Semantics are EXACT single-step AdamW: both groups' gradients are
-    evaluated at the step-start params (group A's gradients are
-    staged to host memory while group B's backward and update run,
-    then brought back) — not block-coordinate descent.
+    Semantics are EXACT single-step AdamW: every group's gradients
+    are evaluated at the step-start params (groups ``0..N-2``'s
+    gradients are staged to host memory while later backwards and
+    the last group's update run, then brought back in reverse
+    order) — not block-coordinate descent.
 
-    ``loss_grouped(params_a, params_b, batch) -> scalar``;
-    ``init_a_fn()``/``init_b_fn()`` build each group's params tree
-    lazily so group A's fp32 source frees before group B
-    materializes.  Returns ``(init_state, train_step)`` with
-    ``train_step(state, batch) -> (state, metrics)`` over a
-    ``(state_a, state_b)`` tuple, CONSUMED like the chunked step
-    (pass it as a temporary).
+    Two calling conventions:
+
+    - legacy two-group (positional, unchanged):
+      ``build_grouped_offload_step(loss, init_a, init_b, opt_a,
+      opt_b)`` with ``loss(params_a, params_b, batch)``;
+    - N-group: ``build_grouped_offload_step(loss, init_fns=[...],
+      optimizers=[...])`` with ``loss(*group_params, batch)``.
+
+    ``init_fns[i]()`` builds group i's params tree lazily so each
+    group's fp32 source frees before the next materializes.  Returns
+    ``(init_state, train_step)`` with ``train_step(state, batch) ->
+    (state, metrics)`` over a tuple of per-group states, CONSUMED
+    like the chunked step (pass it as a temporary).
     """
-    opt_a = optimizer_a or HostOffloadAdamW()
-    opt_b = optimizer_b or HostOffloadAdamW()
-    dev, host = opt_a._shardings()
+    if init_fns is None:
+        init_fns = [
+            fn for fn in (init_a_fn, init_b_fn) if fn is not None
+        ]
+        optimizers = [optimizer_a, optimizer_b][: len(init_fns)]
+    init_fns = list(init_fns)
+    n_groups = len(init_fns)
+    if n_groups < 1:
+        raise ValueError("need at least one param group")
+    if optimizers is None:
+        optimizers = [None] * n_groups
+    opts = [o or HostOffloadAdamW() for o in optimizers]
+    if len(opts) != n_groups:
+        raise ValueError(
+            f"{len(opts)} optimizers for {n_groups} groups"
+        )
+    dev, host = opts[0]._shardings()
 
-    vag_a = jax.jit(jax.value_and_grad(loss_grouped, argnums=0))
-    vag_b = jax.jit(jax.value_and_grad(loss_grouped, argnums=1))
-    # host staging round-trip for group A's grads (identity programs
-    # with host output/input layouts; on CPU test meshes host==dev
-    # and these are no-ops)
+    vags = [
+        jax.jit(jax.value_and_grad(loss_grouped, argnums=i))
+        for i in range(n_groups)
+    ]
+    # host staging round-trip for the early groups' grads (identity
+    # programs with host output/input layouts; on CPU test meshes
+    # host==dev and these are no-ops)
     stage_out = jax.jit(lambda g: g, out_shardings=host)
     stage_in = jax.jit(lambda g: g, out_shardings=dev)
     two_spaces = host is not dev
@@ -1209,18 +1463,25 @@ def build_grouped_offload_step(
     def _barrier(value):
         """Force completion of everything dispatched so far: at 3B
         the phases' OUTPUT buffers are allocated at dispatch on this
-        runtime, so letting all five phases enqueue at once demands
+        runtime, so letting every phase enqueue at once demands
         every phase's outputs simultaneously (~16 GB of outputs
         alone).  Only needed where a second memory space exists —
         the CPU test mesh runs phases eagerly anyway."""
         if two_spaces and value is not None:
             float(value)
 
+    def _last_leaf_probe(params):
+        return (
+            jax.tree_util.tree_leaves(params)[-1]
+            .reshape(-1)[-1]
+            .astype(jnp.float32)
+        )
+
     def init_state(rng=None):
         del rng  # group inits carry their own keys
-        state_a = opt_a.init(init_a_fn())
-        state_b = opt_b.init(init_b_fn())
-        return (state_a, state_b)
+        return tuple(
+            opts[i].init(init_fns[i]()) for i in range(n_groups)
+        )
 
     pending: Dict[str, object] = {}
 
@@ -1245,51 +1506,76 @@ def build_grouped_offload_step(
             )
 
     def train_step(state, batch):
-        state_a, state_b = state
+        states = list(state)
         del state
         prev = pending.pop("probe", None)
         if prev is not None:
             float(prev)  # serialize steps (HBM cannot hold two)
         _dbg("step start")
-        # pass 1: group A grads at step-start params -> host staging
-        loss, g_a = vag_a(state_a.params, state_b.params, batch)
-        _barrier(loss)
-        _dbg("vag_a done")
-        g_a = stage_out(g_a)
-        _barrier(
-            host_scalar(jax.tree_util.tree_leaves(g_a)[0])
-            if two_spaces
-            else None
-        )
-        # pass 2: group B grads at the SAME step-start params
-        loss_b, g_b = vag_b(state_a.params, state_b.params, batch)
-        _barrier(loss_b)
-        _dbg("vag_b done")
+        step_params = [s.params for s in states]
+        loss = None
+        staged = []
+        # passes 1..N-1: early groups' grads at step-start params ->
+        # host staging (one dW tree resident at a time)
+        for i in range(n_groups - 1):
+            loss_i, g = vags[i](*step_params, batch)
+            if loss is None:
+                loss = loss_i
+            _barrier(loss_i)
+            _dbg(f"vag_{i} done")
+            g = stage_out(g)
+            _barrier(
+                host_scalar(jax.tree_util.tree_leaves(g)[0])
+                if two_spaces
+                else None
+            )
+            staged.append(g)
+        # final pass: last group's grads at the SAME step-start
+        # params, updated immediately (no staging round-trip).  The
+        # rolling H2D window starts AFTER the backward barrier: at
+        # the 3B HBM edge the backward's residuals + dW leave no
+        # margin for early-staged chunks, and the chunk stream still
+        # pipelines copy against update math within the window.
+        last = n_groups - 1
+        loss_last, g_last = vags[last](*step_params, batch)
+        if loss is None:
+            loss = loss_last
+        _barrier(loss_last)
+        _dbg(f"vag_{last} done")
+        pre_last = opts[last].start_prefetch(states[last])
+        del step_params  # step-start refs live on in `states`
         # rebinding FIRST matters: inlining _release_params in the
         # call would keep the name bound to the original state (real
         # params pinned) for the whole dispatch
-        state_b = _release_params(state_b)
-        state_b = opt_b.apply_gradients(state_b, g_b)
-        # force the LAST-dispatched leaf: programs execute in
-        # dispatch order on this runtime, so its completion implies
-        # the whole stream's (the first leaf would only cover the
-        # head of the stream)
-        _barrier(
-            jax.tree_util.tree_leaves(state_b.params)[-1]
-            .reshape(-1)[-1]
-            .astype(jnp.float32)
-            if two_spaces
-            else None
+        states[last] = _release_params(states[last])
+        states[last] = opts[last].apply_gradients(
+            states[last], g_last, prefetched=pre_last
         )
-        _dbg("apply_b done")
-        g_a = stage_in(g_a)
-        state_a = _release_params(state_a)
-        state_a = opt_a.apply_gradients(state_a, g_a)
-        _dbg("apply_a dispatched")
-        last = jax.tree_util.tree_leaves(state_a.params)[-1]
-        pending["probe"] = (
-            last.reshape(-1)[-1].astype(jnp.float32)
-        )
-        return (state_a, state_b), {"loss": loss}
+        del g_last, pre_last
+        # bring the staged grads back and update in reverse order;
+        # between updates, force the LAST-dispatched leaf: programs
+        # execute in dispatch order on this runtime, so its
+        # completion implies the whole stream's (the first leaf
+        # would only cover the head of the stream)
+        for i in range(n_groups - 2, -1, -1):
+            _barrier(
+                _last_leaf_probe(states[i + 1].params)
+                if two_spaces
+                else None
+            )
+            _dbg(f"apply_{i + 1} done")
+            g = stage_in(staged[i])
+            staged[i] = None
+            # rolling window for this group's chunk stream: its H2D
+            # overlaps the previous group's still-draining update
+            pre = opts[i].start_prefetch(states[i])
+            states[i] = _release_params(states[i])
+            states[i] = opts[i].apply_gradients(
+                states[i], g, prefetched=pre
+            )
+            del g, pre
+        _dbg("apply_0 dispatched")
+        pending["probe"] = _last_leaf_probe(states[0].params)
+        return tuple(states), {"loss": loss}
 
     return init_state, train_step

@@ -29,7 +29,13 @@ def device_varying(x, axis_name):
     try:
         return lax.pcast(x, axis_name, to="varying")
     except (AttributeError, TypeError):  # older jax
+        pass
+    try:
         return lax.pvary(x, axis_name)
+    except AttributeError:
+        # pre-vma jax (<=0.4.x): replication typing does not exist,
+        # the array is already usable as a manual-region carry
+        return x
 
 
 def seq_all_to_all(

@@ -20,7 +20,10 @@ from typing import Dict, List, Optional
 
 from dlrover_tpu.common.constants import CheckpointConstant
 from dlrover_tpu.common.log import default_logger as logger
-from dlrover_tpu.observability.events import get_event_logger
+from dlrover_tpu.observability.events import (
+    anchored_now,
+    get_event_logger,
+)
 from dlrover_tpu.common.multi_process import SharedQueue
 from dlrover_tpu.common.storage import (
     get_checkpoint_storage,
@@ -210,7 +213,8 @@ class RestorePrefetch:
                 self._gate()
             except Exception:  # noqa: BLE001 - alignment is best-effort
                 pass
-        t0_wall, t0_mono = time.time(), time.monotonic()
+        t0_mono = time.monotonic()
+        t0_wall = anchored_now(t0_mono)
         eng = self._engine
         try:
             self.shm_steps = eng._shm_handler.steps_available()
@@ -581,7 +585,8 @@ class CheckpointEngine:
         target pytree was given, else {keypath: ndarray}; (-1, None)
         when nothing exists.
         """
-        t0_wall, t0_mono = time.time(), time.monotonic()
+        t0_mono = time.monotonic()
+        t0_wall = anchored_now(t0_mono)
         shm_steps = self._shm_handler.steps_available()
         storage_step, latest_dir = self._latest_storage_step(
             checkpoint_dir
@@ -677,7 +682,8 @@ class CheckpointEngine:
         the staged step, or staging error degrades to the serial
         ``_restore_agreed``/``load`` path — byte-identical result,
         never a half-applied state."""
-        t0_wall, t0_mono = time.time(), time.monotonic()
+        t0_mono = time.monotonic()
+        t0_wall = anchored_now(t0_mono)
         if (
             prefetch is None
             or not prefetch.wait_available(300)

@@ -20,7 +20,10 @@ from typing import Callable, Optional
 
 from dlrover_tpu.common.constants import NodeEnv
 from dlrover_tpu.common.log import default_logger as logger
-from dlrover_tpu.observability.events import get_event_logger
+from dlrover_tpu.observability.events import (
+    anchored_now,
+    get_event_logger,
+)
 from dlrover_tpu.trainer.elastic.context import (
     process_count,
     process_rank,
@@ -79,7 +82,8 @@ class ElasticTrainer:
         """Advance the global step; rank 0 reports progress."""
         self.global_step += steps
         if self._events.enabled:
-            now_w, now_m = time.time(), time.monotonic()
+            now_m = time.monotonic()
+            now_w = anchored_now(now_m)
             if self._step_mark is not None:
                 dur = now_m - self._step_mark[1]
                 self._events.complete(

@@ -42,7 +42,10 @@ from contextlib import contextmanager
 from typing import Callable, Optional
 
 from dlrover_tpu.common.log import default_logger as logger
-from dlrover_tpu.observability.events import get_event_logger
+from dlrover_tpu.observability.events import (
+    anchored_now,
+    get_event_logger,
+)
 
 #: kill-switch: "0"/"false"/"off" forces today's serial restart order
 OVERLAP_ENV = "DLROVER_TPU_RESTART_OVERLAP"
@@ -90,7 +93,8 @@ class _CompileLeg:
     def _run(self):
         if self._gate is not None:
             self._gate()
-        t0_wall, t0_mono = time.time(), time.monotonic()
+        t0_mono = time.monotonic()
+        t0_wall = anchored_now(t0_mono)
         try:
             self.result = self._fn()
         except Exception as e:  # noqa: BLE001 - degrade, never corrupt

@@ -56,6 +56,9 @@ DECLARED_METRICS = {
     # input data plane (record_input_io)
     "dlrover_tpu_input_gbps",
     "dlrover_tpu_input_bytes",
+    # host-offload optimizer-state chunk stream (record_offload_io)
+    "dlrover_tpu_offload_gbps",
+    "dlrover_tpu_offload_bytes",
     # control plane (record_control_rpc; master servicer RPC meter)
     "dlrover_tpu_control_rps",
     "dlrover_tpu_control_rpc_total",

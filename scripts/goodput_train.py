@@ -31,7 +31,10 @@ import optax  # noqa: E402
 
 from dlrover_tpu.common.env import input_pipeline_enabled  # noqa: E402
 from dlrover_tpu.data.prefetch import device_prefetch  # noqa: E402
-from dlrover_tpu.observability.events import get_event_logger  # noqa: E402
+from dlrover_tpu.observability.events import (  # noqa: E402
+    anchored_now,
+    get_event_logger,
+)
 from dlrover_tpu.parallel.mesh import AxisName, create_parallel_mesh  # noqa: E402
 from dlrover_tpu.trainer.checkpoint.engine import CheckpointEngine  # noqa: E402
 
@@ -181,7 +184,8 @@ def main() -> int:
     while step < TARGET:
         step_barrier()
         x = next(batches)
-        t0_wall, t0_mono = time.time(), time.monotonic()
+        t0_mono = time.monotonic()
+        t0_wall = anchored_now(t0_mono)
         if first_step:
             # this incarnation's warmup: the AOT hand-off (or the
             # fallback trace+compile / cache hit) is restart overhead

@@ -43,3 +43,21 @@ def test_lint_catches_violations(tmp_path):
     assert "not_a_phase" in proc.stdout
     assert "missing required label(s) ['step']" in proc.stdout
     assert "string literal" in proc.stdout
+
+
+def test_lint_enforces_offload_copy_labels(tmp_path):
+    """The host-offload DMA spans must carry bytes + throughput +
+    the buffered flag — a site missing any of them fails the lint."""
+    bad = tmp_path / "bad_offload.py"
+    bad.write_text(
+        "events = None\n"
+        "def f(events):\n"
+        "    events.complete('offload_copy', 0.0, 1.0,\n"
+        "                    bytes=1, throughput_gbps=2.0)\n"
+        "    events.complete('offload_copy', 0.0, 1.0, bytes=1,\n"
+        "                    throughput_gbps=2.0, buffered=True)\n"
+    )
+    proc = _run(str(bad))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "event_schema_violations=1" in proc.stdout, proc.stdout
+    assert "missing required label(s) ['buffered']" in proc.stdout

@@ -5,8 +5,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # pre-0.6 jax: experimental namespace
+    from jax.experimental.shard_map import shard_map
 
 from dlrover_tpu.models.llama import dot_product_attention
 from dlrover_tpu.models.moe import (

@@ -16,8 +16,10 @@ from dlrover_tpu.accelerate.analyser import ModelProfile
 from dlrover_tpu.accelerate.solver import (
     REMAT_POLICIES,
     attention_traffic_s,
+    balanced_boundaries,
     candidate_tiles,
     solve,
+    solve_offload_groups,
 )
 
 
@@ -214,3 +216,86 @@ class TestSolve:
         mults = [m for _, m in REMAT_POLICIES.values()]
         assert min(fracs) > 0 and max(fracs) == 1.0
         assert min(mults) == 1.0 and max(mults) <= 1.5
+
+
+class TestOffloadGroups:
+    """solve_offload_groups: smallest-N grouped-backward plan whose
+    balanced layer split fits the HBM budget (the grouped host-offload
+    path's group-count knob)."""
+
+    def _profile_3b(self):
+        # 3.0B params, 36 layers, remat=full activations
+        return ModelProfile(
+            num_params=3_000_000_000,
+            param_bytes=12_000_000_000,
+            largest_leaf=0,
+            leaf_count=12,
+            activation_bytes_per_sample=3_000_000_000,
+            num_layers=36,
+        )
+
+    def test_big_hbm_needs_one_group(self):
+        plan = solve_offload_groups(
+            self._profile_3b(), batch_per_replica=12,
+            hbm_bytes=64_000_000_000,
+        )
+        assert plan.n_groups == 1 and plan.boundaries == ()
+
+    def test_small_hbm_raises_group_count(self):
+        plan = solve_offload_groups(
+            self._profile_3b(), batch_per_replica=12,
+            hbm_bytes=16_000_000_000,
+            embed_params=82_000_000, head_params=82_000_000,
+        )
+        assert plan.n_groups >= 2
+        assert len(plan.boundaries) == plan.n_groups - 1
+        assert list(plan.boundaries) == sorted(set(plan.boundaries))
+        assert all(0 < b < 36 for b in plan.boundaries)
+        # balanced: no group more than ~2x the smallest
+        assert max(plan.group_params) < 2 * min(plan.group_params)
+        assert plan.predicted_peak_bytes <= plan.budget_bytes
+        # tighter budget -> at least as many groups
+        tighter = solve_offload_groups(
+            self._profile_3b(), batch_per_replica=12,
+            hbm_bytes=13_000_000_000,
+        )
+        assert tighter.n_groups >= plan.n_groups
+
+    def test_impossible_budget_raises(self):
+        with pytest.raises(ValueError, match="no grouped split"):
+            solve_offload_groups(
+                self._profile_3b(), batch_per_replica=12,
+                hbm_bytes=4_000_000_000, max_groups=4,
+            )
+
+    def test_describe_and_bad_remat(self):
+        plan = solve_offload_groups(
+            self._profile_3b(), hbm_bytes=64_000_000_000,
+        )
+        d = plan.describe()
+        assert d["n_groups"] == 1 and "predicted_peak_gb" in d
+        with pytest.raises(ValueError, match="remat"):
+            solve_offload_groups(
+                self._profile_3b(), remat="bogus",
+                hbm_bytes=64_000_000_000,
+            )
+
+
+class TestBalancedBoundaries:
+    def test_even_split(self):
+        assert balanced_boundaries([1] * 8, 4) == (2, 4, 6)
+
+    def test_odd_nondivisible_split(self):
+        # 5 layers into 3/4 groups: every group keeps >= 1 layer
+        assert balanced_boundaries([1] * 5, 3) == (2, 3)
+        b4 = balanced_boundaries([1] * 5, 4)
+        assert len(b4) == 3 and list(b4) == sorted(set(b4))
+
+    def test_heavy_head_shifts_last_boundary(self):
+        plain = balanced_boundaries([1] * 8, 2)
+        heavy = balanced_boundaries([1] * 8, 2, head_params=4)
+        assert heavy[0] > plain[0]
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="cannot split"):
+            balanced_boundaries([1, 1], 3)

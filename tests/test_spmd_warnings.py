@@ -1,0 +1,31 @@
+"""Tier-1 wrapper for ``scripts/check_spmd_warnings.py``: the
+flagship multi-axis train step must compile on a virtual mesh with
+ZERO involuntary-rematerialization warnings — a sharding regression
+(a constraint dropped, a gather over a sharded dim) fails fast here
+instead of surfacing as a silent throughput collapse on chip.
+
+Only the ``main`` (data x fsdp x tensor) config runs in tier-1: it is
+the program every bench candidate and the grouped-backward proofs
+build on, and the full sweep's wall clock belongs in dev runs
+(``--configs all``)."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHECK = os.path.join(REPO, "scripts", "check_spmd_warnings.py")
+
+
+def test_main_mesh_has_no_spmd_remat_warnings():
+    proc = subprocess.run(
+        [sys.executable, CHECK, "4", "--configs", "main"],
+        capture_output=True,
+        text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        timeout=300,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr[-2000:]
+    assert "spmd_remat_warnings=0" in proc.stdout, proc.stdout
+    assert "dryrun multichip ok" in proc.stdout, proc.stdout
