@@ -418,6 +418,37 @@ def _control_micro(n_agents: int, wait_s: float) -> dict:
     return out
 
 
+def _failover_bench(budget: "BenchBudget" = None) -> dict:
+    """Master-kill-storm vs fault-free goodput + per-kill master MTTR
+    (``scripts/chaos.py`` owns the orchestration — ONE definition).
+    A real master subprocess + a real 2-proc launcher job per leg."""
+    sys.path.insert(
+        0,
+        os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts"
+        ),
+    )
+    from chaos import run_plan
+
+    tightish = budget is not None and budget.tight(300)
+    steps = 20 if tightish else 40
+    out = {}
+    clean = run_plan(
+        plan="none", steps=steps, step_sleep=0.05, timeout=180.0
+    )
+    storm = run_plan(
+        plan="master-kill-storm", steps=steps, kills=2,
+        step_sleep=0.05, timeout=240.0,
+    )
+    out["failover"] = {"clean": clean, "storm": storm}
+    out["failover_mttr_mean_s"] = storm.get("mttr_mean_s")
+    if clean.get("goodput"):
+        out["failover_goodput_ratio"] = round(
+            storm["goodput"] / clean["goodput"], 3
+        )
+    return out
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -518,6 +549,14 @@ def main(argv=None) -> int:
             )
         except Exception as e:  # noqa: BLE001
             extras["control_micro_error"] = str(e)
+        flush_partial(args.out, payload)
+
+        # master-failover leg: goodput under a master-kill storm vs
+        # fault-free, plus master MTTR (scripts/chaos.py)
+        try:
+            extras.update(_failover_bench(budget))
+        except Exception as e:  # noqa: BLE001
+            extras["failover_bench_error"] = str(e)
     flush_partial(args.out, payload)
 
     import jax

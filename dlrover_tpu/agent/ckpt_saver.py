@@ -318,6 +318,12 @@ class AsyncCheckpointSaver:
         with double-buffered slots a kill that tore the shards (one at
         N+1, one at N) still flushes a complete step N instead of
         aborting on the mismatch."""
+        # chaos hook: a kill pinned here dies with the emergency flush
+        # half done — the shm snapshot (crash-survivable segment) and
+        # the storage tier's atomic rename must both tolerate it
+        from dlrover_tpu.common.fault_injection import maybe_crash
+
+        maybe_crash("mid_checkpoint_persist")
         step_sets = [set(h.steps_available()) for h in self._shm_handlers]
         if not step_sets or not all(step_sets):
             logger.info("no shm checkpoint to flush (%s)", reason)

@@ -13,14 +13,17 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from dlrover_tpu.common import messages as msg
-from dlrover_tpu.common.comm import MasterChannel
+from dlrover_tpu.common.comm import MasterChannel, wait_channel_ready
 from dlrover_tpu.common.constants import NodeEnv, NodeType, RendezvousName
 from dlrover_tpu.common.env import (
     control_batch_enabled,
     control_longpoll_enabled,
+    master_failover_enabled,
 )
+from dlrover_tpu.common.fault_injection import maybe_crash
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.observability.events import get_event_logger
+from dlrover_tpu.observability.metrics import record_dropped_reports
 
 #: one long-poll RPC parks on the master at most this long; waits
 #: longer than a chunk loop (each chunk is still ONE rpc, so a 5 min
@@ -80,6 +83,175 @@ class MasterClient:
             str, Tuple[int, Tuple[int, int, Dict[int, int]]]
         ] = {}
         self._running_nodes_cache: Optional[Tuple[int, list]] = None
+        #: this client's OWN kv writes (newest-last), re-asserted on
+        #: an incarnation change: a master ack races the write-behind
+        #: journal flush, so a crash inside the linger window loses
+        #: ACKED mutations — the agent must reattach AND re-assert,
+        #: like DLRover agents re-registering with a recreated master
+        #: pod.  Sets are last-writer-wins, so re-asserting a value
+        #: that DID survive replay is a no-op.
+        self._own_kv: Dict[str, bytes] = {}
+        #: pending rendezvous joins (rdzv_name -> (rank, local_ws)),
+        #: re-issued on reconnect while the round is still pending —
+        #: an acked-but-unflushed join otherwise parks this node on a
+        #: round the restarted master doesn't know it joined
+        self._pending_join: Dict[str, Tuple[int, int]] = {}
+        #: dataset registrations this client made, re-asserted on an
+        #: incarnation change (idempotent server-side)
+        self._own_datasets: Dict[str, msg.Message] = {}
+        #: last JOB epoch this client acted under: re-assertion is
+        #: only valid within one job generation (-1 = not learned yet)
+        self._last_job_epoch = -1
+        # epoch fencing: a StaleEpoch-triggered refresh means the job
+        # generation (or master incarnation) changed — every versioned
+        # cache is void (version counters restart with the new master)
+        self._channel.on_epoch_change = self._on_epoch_change
+
+    #: own-write re-assert cache bound: coordination keys are
+    #: per-round and small; only the newest matter after a restart
+    MAX_OWN_KV = 256
+
+    #: re-assertion RPC budget: these calls fire from inside another
+    #: call's recovery path — each opening its own full reconnect
+    #: deadline would block the outer caller minutes past its own
+    REASSERT_DEADLINE_S = 15.0
+
+    def _on_epoch_change(self, job_epoch: int, incarnation: int):
+        self._comm_world_cache.clear()
+        self._running_nodes_cache = None
+        prev_epoch, self._last_job_epoch = (
+            self._last_job_epoch, job_epoch
+        )
+        if prev_epoch not in (-1, job_epoch):
+            # the JOB generation changed (the old job was retired):
+            # this client's session state belongs to the dead
+            # generation — re-asserting it would inject the retired
+            # job's KV keys / datasets / joins into the new one,
+            # exactly what the epoch bump exists to fence off
+            self._own_kv.clear()
+            self._own_datasets.clear()
+            self._pending_join.clear()
+            logger.warning(
+                "job epoch changed %s -> %s: session state dropped, "
+                "nothing re-asserted", prev_epoch, job_epoch,
+            )
+            return
+        if prev_epoch == -1 and incarnation <= 1:
+            # first epoch learn, and the master never restarted: no
+            # linger-window state was lost, so there is nothing to
+            # re-assert — and if this client is a straggler of a
+            # RETIRED generation (it never learned the old epoch, so
+            # it can't tell), re-asserting would inject dead-job
+            # state into the new one.  Caches stay: a later restart
+            # of THIS generation's master re-asserts normally.
+            return
+        logger.info(
+            "master epoch refreshed: job_epoch=%s incarnation=%s "
+            "(delta caches dropped, %d own kv writes re-asserted)",
+            job_epoch, incarnation, len(self._own_kv),
+        )
+        with self._channel.bounded_deadline(self.REASSERT_DEADLINE_S):
+            for key, value in list(self._own_kv.items()):
+                try:
+                    self._channel.report(
+                        msg.KeyValuePair(key=key, value=value)
+                    )
+                except ConnectionError as e:
+                    logger.warning(
+                        "kv re-assert of %r failed: %s", key, e
+                    )
+                    break
+            for params in list(self._own_datasets.values()):
+                try:
+                    self._channel.report(params)
+                except ConnectionError as e:
+                    logger.warning(
+                        "dataset re-assert failed: %s", e
+                    )
+                    break
+            # a node parked between join and world-received re-asserts
+            # its membership too (conditional: _pending_join is popped
+            # the moment a world containing this node arrives, so
+            # agents that finished rendezvous can never wipe a
+            # completed world here)
+            for rdzv_name in list(self._pending_join):
+                self._ensure_rdzv_membership(rdzv_name)
+
+    def _record_own_kv(self, key: str, value: bytes):
+        self._own_kv.pop(key, None)  # re-insert newest-last
+        self._own_kv[key] = value
+        while len(self._own_kv) > self.MAX_OWN_KV:
+            self._own_kv.pop(next(iter(self._own_kv)))
+
+    def _ensure_rdzv_membership(
+        self, rdzv_name: str, node_rank: Optional[int] = None
+    ):
+        """After a master restart mid-wait: re-join the pending round
+        unless the completed world already contains this node (then
+        the re-parked wait consumes it; a blind re-join would wipe a
+        completed world and force a full re-rendezvous)."""
+        join = self._pending_join.get(rdzv_name)
+        if join is None:
+            return
+        if node_rank is None:
+            node_rank = join[0]
+        try:
+            _rnd, _grp, world = self.get_comm_world(
+                rdzv_name, node_rank
+            )
+            if world and node_rank in world:
+                return
+            self.join_rendezvous(
+                join[0], join[1], rdzv_name=rdzv_name
+            )
+            logger.info(
+                "re-joined %s rendezvous on the new master "
+                "incarnation (node %s)", rdzv_name, node_rank,
+            )
+        except ConnectionError as e:
+            logger.warning(
+                "rendezvous re-join after reconnect failed "
+                "(will retry on the next outage): %s", e,
+            )
+
+    def _survive_outage(self, deadline: float, what: str) -> bool:
+        """Failover path of a parked long-poll: the master died
+        mid-wait.  Block until the (restarted) master's channel is
+        READY again — then refresh the fencing pair so the re-issued
+        wait parks on the NEW incarnation.  False when the outage
+        outlives ``deadline`` or failover is kill-switched (the caller
+        re-raises)."""
+        if not master_failover_enabled():
+            return False
+        remaining = deadline - time.time()
+        if remaining <= 0:
+            return False
+        logger.warning(
+            "master unreachable during %s; waiting up to %.0fs for "
+            "it to come back", what, remaining,
+        )
+        with get_event_logger().span("control_wait", kind="reconnect"):
+            while remaining > 0:
+                if wait_channel_ready(
+                    self._addr, timeout=min(remaining, 10.0)
+                ):
+                    try:
+                        # bound the probe by what's left of the
+                        # caller's wait deadline — an unbounded
+                        # refresh would run its own full reconnect
+                        # deadline on top of it
+                        self._channel.refresh_epoch(
+                            deadline_s=max(
+                                deadline - time.time(), 1.0
+                            )
+                        )
+                    except ConnectionError:
+                        # it flapped; keep waiting out the deadline
+                        remaining = deadline - time.time()
+                        continue
+                    return True
+                remaining = deadline - time.time()
+        return False
 
     # ------------------------------------------------------------ lifecycle
     @classmethod
@@ -152,6 +324,7 @@ class MasterClient:
         local_world_size: int,
         rdzv_name: str = RendezvousName.ELASTIC_TRAINING,
     ) -> int:
+        self._pending_join[rdzv_name] = (node_rank, local_world_size)
         state = self._channel.get(
             msg.JoinRendezvousRequest(
                 node_rank=node_rank,
@@ -201,24 +374,49 @@ class MasterClient:
         ``DLROVER_TPU_CONTROL_LONGPOLL=0``."""
         deadline = time.time() + max(timeout, 0.0)
         longpoll = control_longpoll_enabled()
+        # a master death can be absorbed BELOW this loop (the channel
+        # retries inside its reconnect deadline and re-issues the
+        # parked wait transparently) — watch the incarnation between
+        # iterations so a lost-in-the-linger-window join is
+        # re-asserted on whichever path survived the outage
+        inc_seen = self._channel.master_incarnation
         with get_event_logger().span(
             "control_wait", kind="comm_world", rdzv=rdzv_name
         ):
             while True:
+                if self._channel.master_incarnation != inc_seen:
+                    inc_seen = self._channel.master_incarnation
+                    self._ensure_rdzv_membership(
+                        rdzv_name, node_rank
+                    )
                 remaining = deadline - time.time()
                 if remaining <= 0:
                     return -1, 0, {}
                 if longpoll:
                     chunk = min(remaining, LONGPOLL_CHUNK_S)
                     t0 = time.monotonic()
-                    world = self._channel.get(
-                        msg.CommWorldRequest(
-                            node_id=node_rank,
-                            rdzv_name=rdzv_name,
-                            wait_timeout=chunk,
-                        ),
-                        timeout=chunk + _LONGPOLL_RPC_MARGIN_S,
-                    )
+                    try:
+                        world = self._channel.get(
+                            msg.CommWorldRequest(
+                                node_id=node_rank,
+                                rdzv_name=rdzv_name,
+                                wait_timeout=chunk,
+                            ),
+                            timeout=chunk + _LONGPOLL_RPC_MARGIN_S,
+                        )
+                    except ConnectionError:
+                        # mid-wait master death: re-park on the new
+                        # incarnation.  Replay usually restored this
+                        # node's join; when the join ack died in the
+                        # write-behind linger window, re-assert it.
+                        if self._survive_outage(
+                            deadline, "comm-world wait"
+                        ):
+                            self._ensure_rdzv_membership(
+                                rdzv_name, node_rank
+                            )
+                            continue
+                        raise
                     if world is not None and not isinstance(
                         world, msg.NotModified
                     ):
@@ -226,6 +424,13 @@ class MasterClient:
                             world.round, world.group, world.world or {}
                         )
                         if result[2]:
+                            if node_rank in result[2]:
+                                # joined world delivered: the pending
+                                # join is consumed, later monitor
+                                # waits must never re-join
+                                self._pending_join.pop(
+                                    rdzv_name, None
+                                )
                             self._comm_world_cache[rdzv_name] = (
                                 getattr(world, "version", 0), result
                             )
@@ -236,6 +441,8 @@ class MasterClient:
                         rdzv_name, node_rank
                     )
                     if world_map:
+                        if node_rank in world_map:
+                            self._pending_join.pop(rdzv_name, None)
                         return rnd, group, world_map
                     time.sleep(poll_interval)
 
@@ -304,6 +511,7 @@ class MasterClient:
 
     # ------------------------------------------------------------ KV store
     def kv_store_set(self, key: str, value: bytes) -> bool:
+        self._record_own_kv(key, value)
         return self._channel.report(msg.KeyValuePair(key=key, value=value))
 
     def kv_store_get(self, key: str) -> bytes:
@@ -335,10 +543,20 @@ class MasterClient:
                         deadline - time.time(), LONGPOLL_CHUNK_S
                     )
                     t0 = time.monotonic()
-                    res = self._channel.get(
-                        msg.KVWaitRequest(key=key, wait_timeout=chunk),
-                        timeout=chunk + _LONGPOLL_RPC_MARGIN_S,
-                    )
+                    try:
+                        res = self._channel.get(
+                            msg.KVWaitRequest(
+                                key=key, wait_timeout=chunk
+                            ),
+                            timeout=chunk + _LONGPOLL_RPC_MARGIN_S,
+                        )
+                    except ConnectionError:
+                        # mid-wait master death: re-park on the new
+                        # incarnation (journal replay restored the KV
+                        # contents, so a pre-crash set still answers)
+                        if self._survive_outage(deadline, "kv wait"):
+                            continue
+                        raise
                     value = (
                         res.value
                         if res and res.value is not None
@@ -366,31 +584,48 @@ class MasterClient:
         storage_type: str = "table",
         task_type: str = msg.TaskType.TRAINING,
     ) -> bool:
-        return self._channel.report(
-            msg.DatasetShardParams(
-                dataset_name=dataset_name,
-                dataset_size=dataset_size,
-                batch_size=batch_size,
-                num_epochs=num_epochs,
-                shuffle=shuffle,
-                num_minibatches_per_shard=num_minibatches_per_shard,
-                storage_type=storage_type,
-                task_type=task_type,
-            )
+        params = msg.DatasetShardParams(
+            dataset_name=dataset_name,
+            dataset_size=dataset_size,
+            batch_size=batch_size,
+            num_epochs=num_epochs,
+            shuffle=shuffle,
+            num_minibatches_per_shard=num_minibatches_per_shard,
+            storage_type=storage_type,
+            task_type=task_type,
         )
+        # re-asserted on an incarnation change (new_dataset is a no-op
+        # when the registration survived journal replay): a dataset
+        # the restarted master doesn't know reads as "exhausted" to
+        # every fetch_shard and silently ends the epoch
+        self._own_datasets[dataset_name] = params
+        return self._channel.report(params)
 
     def get_task(
         self, dataset_name: str, wait_timeout: float = 0.0
     ) -> msg.Task:
         """Next shard task; ``wait_timeout`` > 0 long-polls through
-        WAIT answers (the master parks until a task is dispatchable)."""
+        WAIT answers (the master parks until a task is dispatchable).
+
+        A mid-wait master death re-parks on the new incarnation
+        (failover mode): an empty answer here would read as "dataset
+        exhausted" to ``fetch_shard`` and silently end the epoch."""
         wait_timeout, timeout = _longpoll_params(wait_timeout)
-        task = self._channel.get(
-            msg.TaskRequest(
-                dataset_name=dataset_name, wait_timeout=wait_timeout
-            ),
-            timeout=timeout,
-        )
+        deadline = time.time() + max(wait_timeout, 5.0)
+        while True:
+            try:
+                task = self._channel.get(
+                    msg.TaskRequest(
+                        dataset_name=dataset_name,
+                        wait_timeout=wait_timeout,
+                    ),
+                    timeout=timeout,
+                )
+                break
+            except ConnectionError:
+                if self._survive_outage(deadline, "task wait"):
+                    continue
+                raise
         return task if task is not None else msg.Task(task_id=-1)
 
     def report_task_result(
@@ -567,6 +802,12 @@ class ReportBuffer:
     reordered or lost across a master hiccup or an agent restart
     (``flush`` runs on shutdown and before every rendezvous).
 
+    The buffer is BOUNDED (``max_pending``): reports are advisory
+    telemetry, so when a master outage outlives the buffer the OLDEST
+    items are dropped (counted on
+    ``dlrover_tpu_control_dropped_reports`` + a warning) — a long
+    outage must degrade observability, never OOM the agent.
+
     ``DLROVER_TPU_CONTROL_BATCH=0`` degenerates ``add`` to the old
     one-RPC-per-report path.
     """
@@ -577,10 +818,14 @@ class ReportBuffer:
         max_items: int = 64,
         max_age_s: float = 1.0,
         auto_flush: bool = True,
+        max_pending: int = 4096,
     ):
         self._client = client
         self._max_items = max_items
         self._max_age_s = max_age_s
+        self._max_pending = max(max_pending, 1)
+        #: lifetime tally of overflow-dropped reports
+        self.dropped = 0
         self._lock = threading.Lock()
         #: serializes flushes: two concurrent flushes could otherwise
         #: ship their batches out of order
@@ -599,6 +844,22 @@ class ReportBuffer:
         with self._lock:
             return len(self._items)
 
+    def _trim_locked(self):
+        """Caller holds the lock: enforce the bound by dropping the
+        OLDEST items (the newest telemetry is the useful telemetry
+        when the master comes back)."""
+        overflow = len(self._items) - self._max_pending
+        if overflow <= 0:
+            return
+        del self._items[:overflow]
+        self.dropped += overflow
+        record_dropped_reports(overflow)
+        logger.warning(
+            "report buffer overflow: dropped %d oldest reports "
+            "(%d total dropped) — master unreachable too long?",
+            overflow, self.dropped,
+        )
+
     def add(self, message: msg.Message) -> bool:
         """Queue one report (or send it straight through when batching
         is disabled).  Returns the delivery ack for the direct path;
@@ -610,6 +871,7 @@ class ReportBuffer:
             return self._client._channel.report(message)
         with self._lock:
             self._items.append(message)
+            self._trim_locked()
             full = len(self._items) >= self._max_items
         if full:
             self.flush()
@@ -617,15 +879,18 @@ class ReportBuffer:
 
     def flush(self) -> bool:
         """Ship everything pending as one ``BatchedReport``.  A
-        transport failure re-queues the batch at the front (no loss,
-        no reorder); a master-side handler failure is dropped with a
-        warning — exactly what the old per-report path did with its
-        False ack."""
+        transport failure re-queues the batch at the front (no loss
+        below the ``max_pending`` bound, no reorder); a master-side
+        handler failure is dropped with a warning — exactly what the
+        old per-report path did with its False ack."""
         with self._flush_lock:
             with self._lock:
                 items, self._items = self._items, []
             if not items:
                 return True
+            # chaos hook: agent death between drain and send loses
+            # the batch with the process, like any crash would
+            maybe_crash("mid_report_flush")
             try:
                 ok = self._client._channel.report(
                     msg.BatchedReport(items=items)
@@ -637,6 +902,7 @@ class ReportBuffer:
                 )
                 with self._lock:
                     self._items[0:0] = items
+                    self._trim_locked()
                 return False
             if not ok:
                 logger.warning(

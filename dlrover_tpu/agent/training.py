@@ -229,6 +229,11 @@ class ElasticTrainingAgent:
             self._config.nproc_per_node,
             timeout=self._config.rdzv_timeout,
         )
+        # chaos hook: an agent SIGKILLed here has joined nothing yet —
+        # the master's window rule must simply proceed without it
+        from dlrover_tpu.common.fault_injection import maybe_crash
+
+        maybe_crash("mid_rendezvous")
         with get_event_logger().span(
             "rendezvous", inc=self._restart_count
         ):

@@ -109,6 +109,42 @@ def datastore_sync_enabled() -> bool:
     )
 
 
+MASTER_FAILOVER_ENV = "DLROVER_TPU_MASTER_FAILOVER"
+RECONNECT_DEADLINE_ENV = "DLROVER_TPU_MASTER_RECONNECT_DEADLINE_S"
+SNAPSHOT_INTERVAL_ENV = "DLROVER_TPU_CONTROL_SNAPSHOT_INTERVAL_S"
+
+
+def master_failover_enabled() -> bool:
+    """Kill-switch for the master-failover subsystem: durable
+    control-plane journaling/replay, transparent ``MasterChannel``
+    reconnection, and ``(job_epoch, master_incarnation)`` fencing.
+    ``DLROVER_TPU_MASTER_FAILOVER=0`` reproduces the fail-fast
+    behavior exactly: a dead master raises ``ConnectionError`` after
+    ``max_retry`` attempts, no epochs ride the envelope, and the
+    master journals nothing.  Default: enabled."""
+    return os.getenv(MASTER_FAILOVER_ENV, "1").lower() not in (
+        "0", "false", "off",
+    )
+
+
+def master_reconnect_deadline_s() -> float:
+    """Total time a client keeps retrying/reconnecting across a
+    master outage before giving up (failover mode only)."""
+    try:
+        return float(os.getenv(RECONNECT_DEADLINE_ENV, "120"))
+    except ValueError:
+        return 120.0
+
+
+def control_snapshot_interval_s() -> float:
+    """Cadence of the master's compacted control-plane snapshot
+    (journal entries at or below the snapshot seq are pruned)."""
+    try:
+        return float(os.getenv(SNAPSHOT_INTERVAL_ENV, "20"))
+    except ValueError:
+        return 20.0
+
+
 def get_free_port(host: str = "127.0.0.1") -> int:
     import socket
 

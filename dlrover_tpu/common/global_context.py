@@ -44,3 +44,13 @@ class Context(Singleton):
             os.getenv("DLROVER_TPU_MAX_RELAUNCH",
                       self.max_node_relaunch_times)
         )
+        # shard-lease timeout (seconds until an unacked dispatched
+        # shard is re-queued); the chaos harness shrinks it so a
+        # SIGKILLed agent's leases recover inside the test budget
+        try:
+            self.seconds_to_timeout_task = float(
+                os.getenv("DLROVER_TPU_TASK_TIMEOUT_S",
+                          self.seconds_to_timeout_task)
+            )
+        except ValueError:
+            pass

@@ -76,11 +76,21 @@ def deserialize_message(data: bytes):
 
 @dataclass
 class Envelope(Message):
-    """The on-wire unit: who sent it + the payload message."""
+    """The on-wire unit: who sent it + the payload message.
+
+    ``job_epoch`` / ``master_incarnation`` are the failover fencing
+    pair: the epoch identifies the JOB generation (stable across
+    master restarts of the same job; bumped when the job itself is
+    reborn), the incarnation identifies the serving MASTER process
+    (bumped on every master start).  ``-1`` = "not speaking the
+    fencing protocol" (old clients, or failover kill-switched) and is
+    never fenced."""
 
     node_id: int = 0
     node_type: str = ""
     data: bytes = b""
+    job_epoch: int = -1
+    master_incarnation: int = -1
 
 
 @dataclass
@@ -273,6 +283,29 @@ class NotModified(Message):
     is still current — nothing to ship."""
 
     version: int = 0
+
+
+@dataclass
+class StaleEpoch(Message):
+    """Typed fencing answer: the request's ``job_epoch`` does not
+    match the serving master's.  Carries the CURRENT pair so the
+    client can refresh its caches and re-issue instead of crashing."""
+
+    job_epoch: int = 0
+    incarnation: int = 0
+
+
+@dataclass
+class ControlEpochRequest(Message):
+    """Fetch the master's current ``(job_epoch, incarnation)`` pair —
+    the client-side refresh after a ``StaleEpoch`` answer or a
+    reconnect.  Never fenced (it IS the refresh path)."""
+
+
+@dataclass
+class ControlEpoch(Message):
+    job_epoch: int = 0
+    incarnation: int = 0
 
 
 @dataclass

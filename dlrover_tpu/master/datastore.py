@@ -89,6 +89,30 @@ CREATE TABLE IF NOT EXISTS timeline_events (
 );
 CREATE INDEX IF NOT EXISTS idx_timeline_job
     ON timeline_events (job, wall);
+CREATE TABLE IF NOT EXISTS control_journal (
+    job TEXT NOT NULL,
+    seq INTEGER NOT NULL,
+    component TEXT NOT NULL,
+    op TEXT NOT NULL,
+    args TEXT NOT NULL DEFAULT '{}',
+    created_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_journal_job
+    ON control_journal (job, seq);
+CREATE TABLE IF NOT EXISTS control_snapshots (
+    job TEXT NOT NULL,
+    seq INTEGER NOT NULL,
+    state TEXT NOT NULL,
+    created_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_snapshot_job
+    ON control_snapshots (job, seq);
+CREATE TABLE IF NOT EXISTS control_meta (
+    job TEXT PRIMARY KEY,
+    job_epoch INTEGER NOT NULL DEFAULT 1,
+    incarnation INTEGER NOT NULL DEFAULT 0,
+    updated_at REAL NOT NULL
+);
 """
 
 
@@ -108,6 +132,7 @@ _SQL_NODE_EVENT = "INSERT INTO node_events VALUES (?,?,?,?,?)"
 _SQL_TIMELINE = (
     "INSERT INTO timeline_events VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)"
 )
+_SQL_JOURNAL = "INSERT INTO control_journal VALUES (?,?,?,?,?,?)"
 
 
 class BrainDatastore:
@@ -133,6 +158,11 @@ class BrainDatastore:
         self._flushed = 0
         self._drain_waiters = 0
         self._closed = False
+        #: per-job monotonic journal sequence, initialized lazily from
+        #: MAX(seq) so a restarted master keeps appending after the
+        #: rows its predecessor landed
+        self._journal_seq: Dict[str, int] = {}
+        self._journal_seq_lock = threading.Lock()
         self._flusher: Optional[threading.Thread] = None
         parent = os.path.dirname(os.path.abspath(db_path))
         os.makedirs(parent, exist_ok=True)
@@ -267,6 +297,12 @@ class BrainDatastore:
     def _write_batch(self, batch: List[Tuple[str, tuple]]):
         """Per-table ``executemany`` over consecutive same-SQL runs
         (insertion order preserved), ONE commit for the whole batch."""
+        # chaos hook: the enqueue->flush window is exactly where a
+        # crash tears the write-behind tail; the fault plan can pin a
+        # SIGKILL here to prove journal replay tolerates it
+        from dlrover_tpu.common.fault_injection import maybe_crash
+
+        maybe_crash("mid_report_flush")
         with self._lock:
             try:
                 i = 0
@@ -517,6 +553,203 @@ class BrainDatastore:
                 rec["labels"] = parsed
             out.append(rec)
         return out
+
+    # --------------------------------------- control-plane durability
+    def _next_journal_seq(self, job: str) -> int:
+        with self._journal_seq_lock:
+            if job not in self._journal_seq:
+                with self._lock:
+                    row = self._conn.execute(
+                        "SELECT MAX(seq) FROM control_journal "
+                        "WHERE job = ?",
+                        (job,),
+                    ).fetchone()
+                self._journal_seq[job] = int(row[0] or 0)
+            self._journal_seq[job] += 1
+            return self._journal_seq[job]
+
+    def journal_append(
+        self, job: str, component: str, op: str, args: Dict
+    ) -> int:
+        """Append one control-plane mutation record (write-behind: the
+        report RPC path that triggered it never blocks on sqlite).
+        Returns the assigned sequence number."""
+        seq = self._next_journal_seq(job)
+        self._submit(
+            _SQL_JOURNAL,
+            [(
+                job,
+                seq,
+                component,
+                op,
+                json.dumps(args, separators=(",", ":"), default=str),
+                time.time(),
+            )],
+        )
+        return seq
+
+    def journal_seq(self, job: str) -> int:
+        """Highest sequence number HANDED OUT so far (enqueued, not
+        necessarily flushed) — the snapshot low-water mark."""
+        with self._journal_seq_lock:
+            if job in self._journal_seq:
+                return self._journal_seq[job]
+        self._drain()
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT MAX(seq) FROM control_journal WHERE job = ?",
+                (job,),
+            ).fetchone()
+        return int(row[0] or 0)
+
+    def journal_entries(
+        self, job: str, since_seq: int = 0
+    ) -> List[Tuple[int, str, str, Dict]]:
+        """Journal records with ``seq > since_seq``, oldest first, as
+        ``(seq, component, op, args)``.
+
+        Torn-tail tolerance: a crash can leave the NEWEST record's
+        ``args`` column unparseable; recovery truncates to the last
+        complete record (everything after the first bad row is
+        dropped with a warning) and NEVER raises — the dropped tail
+        is at most the linger window of un-fsynced mutations, exactly
+        what a crash loses anyway."""
+        self._drain()
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT seq, component, op, args FROM control_journal "
+                "WHERE job = ? AND seq > ? ORDER BY seq ASC",
+                (job, since_seq),
+            ).fetchall()
+        out: List[Tuple[int, str, str, Dict]] = []
+        for seq, component, op, args in rows:
+            try:
+                parsed = json.loads(args) if args else {}
+            except (json.JSONDecodeError, TypeError) as e:
+                logger.warning(
+                    "journal replay for %s truncated at seq %s "
+                    "(torn tail: %s); %d records replayed",
+                    job, seq, e, len(out),
+                )
+                break
+            out.append((int(seq), component, op, parsed))
+        return out
+
+    def save_control_snapshot(self, job: str, state: Dict, seq: int):
+        """Persist a compacted snapshot of the whole control-plane
+        state at journal position ``seq`` and prune journal records it
+        subsumes.  Synchronous (rare — one row per snapshot interval);
+        replay = snapshot + entries with ``seq > snapshot.seq``."""
+        payload = json.dumps(state, separators=(",", ":"), default=str)
+        # flush pending write-behind journal rows first: a row with
+        # seq <= snapshot.seq landing AFTER the prune would linger in
+        # the table forever (harmless for replay — since_seq filters
+        # it — but it defeats the compaction)
+        self._drain()
+        with self._lock:
+            self._conn.execute(
+                "DELETE FROM control_snapshots WHERE job = ?", (job,)
+            )
+            self._conn.execute(
+                "INSERT INTO control_snapshots VALUES (?,?,?,?)",
+                (job, int(seq), payload, time.time()),
+            )
+            self._conn.execute(
+                "DELETE FROM control_journal "
+                "WHERE job = ? AND seq <= ?",
+                (job, int(seq)),
+            )
+            self._conn.commit()
+
+    def load_control_snapshot(
+        self, job: str
+    ) -> Tuple[Optional[Dict], int]:
+        """Newest snapshot for ``job`` as ``(state, seq)``; ``(None,
+        0)`` when absent or unparseable (a torn snapshot falls back to
+        journal-only replay)."""
+        self._drain()
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT state, seq FROM control_snapshots "
+                "WHERE job = ? ORDER BY seq DESC LIMIT 1",
+                (job,),
+            ).fetchone()
+        if row is None:
+            return None, 0
+        try:
+            return json.loads(row[0]), int(row[1])
+        except (json.JSONDecodeError, TypeError) as e:
+            logger.warning(
+                "control snapshot for %s unreadable (%s); replaying "
+                "journal from scratch", job, e,
+            )
+            return None, 0
+
+    def bump_incarnation(self, job: str) -> Tuple[int, int]:
+        """Register a master start: increments the incarnation, keeps
+        the job epoch (a restarted master serves the SAME job).
+        Returns ``(job_epoch, incarnation)``.  Synchronous — the pair
+        fences every subsequent RPC, so it must be durable before the
+        server opens."""
+        with self._lock:
+            now = time.time()
+            self._conn.execute(
+                "INSERT INTO control_meta VALUES (?, 1, 1, ?) "
+                "ON CONFLICT(job) DO UPDATE SET "
+                "incarnation = incarnation + 1, updated_at = ?",
+                (job, now, now),
+            )
+            self._conn.commit()
+            row = self._conn.execute(
+                "SELECT job_epoch, incarnation FROM control_meta "
+                "WHERE job = ?",
+                (job,),
+            ).fetchone()
+        return int(row[0]), int(row[1])
+
+    def bump_job_epoch(self, job: str) -> int:
+        """Declare a NEW job generation on this master address: bumps
+        the epoch so clients of the previous generation are fenced
+        into a refresh, and drops the old generation's journal,
+        snapshot and per-job epoch-scoped state."""
+        # enqueued rows of the dying generation must not outlive it
+        self._drain()
+        with self._lock:
+            now = time.time()
+            self._conn.execute(
+                "INSERT INTO control_meta VALUES (?, 1, 0, ?) "
+                "ON CONFLICT(job) DO UPDATE SET "
+                "job_epoch = job_epoch + 1, incarnation = 0, "
+                "updated_at = ?",
+                (job, now, now),
+            )
+            self._conn.execute(
+                "DELETE FROM control_journal WHERE job = ?", (job,)
+            )
+            self._conn.execute(
+                "DELETE FROM control_snapshots WHERE job = ?", (job,)
+            )
+            self._conn.commit()
+            row = self._conn.execute(
+                "SELECT job_epoch FROM control_meta WHERE job = ?",
+                (job,),
+            ).fetchone()
+        with self._journal_seq_lock:
+            self._journal_seq.pop(job, None)
+        return int(row[0])
+
+    def get_control_meta(self, job: str) -> Tuple[int, int]:
+        """Current ``(job_epoch, incarnation)`` without bumping
+        (``(1, 0)`` when the job was never registered)."""
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT job_epoch, incarnation FROM control_meta "
+                "WHERE job = ?",
+                (job,),
+            ).fetchone()
+        if row is None:
+            return 1, 0
+        return int(row[0]), int(row[1])
 
     # ------------------------------------------------------- hygiene
     def prune(self, max_age_s: float, job: Optional[str] = None):

@@ -11,7 +11,7 @@ import os
 import threading
 import time
 from abc import ABCMeta, abstractmethod
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from dlrover_tpu.common.constants import (
     NodeEventType,
@@ -111,6 +111,12 @@ class JobManager(metaclass=ABCMeta):
         #: bumped on every node-table change; the ``RunningNodes``
         #: delta protocol's version (NotModified when it matches)
         self._nodes_version = 0
+        #: failover journal hook: node-table changes journal the
+        #: changed node's row (replay is per-node last-writer-wins)
+        self._journal_cb: Optional[Callable[[str, dict], None]] = None
+
+    def set_journal(self, cb: Optional[Callable[[str, dict], None]]):
+        self._journal_cb = cb
 
     @property
     def nodes_version(self) -> int:
@@ -118,6 +124,30 @@ class JobManager(metaclass=ABCMeta):
 
     def _bump_nodes_version(self):
         self._nodes_version += 1
+
+    @staticmethod
+    def _node_row(node: Node) -> dict:
+        return {
+            "type": node.type,
+            "id": node.id,
+            "rank_index": node.rank_index,
+            "status": node.status,
+            "host_addr": node.host_addr,
+            "relaunch_count": node.relaunch_count,
+            "max_relaunch_count": node.max_relaunch_count,
+            "create_time": node.create_time,
+            "heartbeat_time": node.heartbeat_time,
+            "is_released": node.is_released,
+            "exit_reason": node.exit_reason,
+        }
+
+    def _journal_node(self, node: Node):
+        if self._journal_cb is None:
+            return
+        try:
+            self._journal_cb("node", self._node_row(node))
+        except Exception as e:  # noqa: BLE001
+            logger.warning("node journal failed: %s", e)
 
     @property
     def error_monitor(self):
@@ -201,6 +231,7 @@ class JobManager(metaclass=ABCMeta):
                 fire = True
             self._node_groups.route(node)
             self._bump_nodes_version()
+            self._journal_node(node)
         if fire:
             self._fire_callbacks(node, new_status)
 
@@ -244,6 +275,7 @@ class JobManager(metaclass=ABCMeta):
             )
             node.host_addr = addr
             self._bump_nodes_version()
+            self._journal_node(node)
 
     def collect_node_heartbeat(self, node_type: str, node_id: int,
                                timestamp: float):
@@ -261,6 +293,7 @@ class JobManager(metaclass=ABCMeta):
                 started = True
             if started:
                 self._bump_nodes_version()
+                self._journal_node(node)
         if started:
             self._fire_callbacks(node, NodeStatus.RUNNING)
 
@@ -318,6 +351,7 @@ class JobManager(metaclass=ABCMeta):
                     )
             # critical-group accounting (chief semantics)
             self._node_groups.route(node)
+            self._journal_node(node)
             if self._node_groups.job_should_stop(node):
                 self._stop_reason = (
                     f"critical {node.type} node {node_id} exhausted "
@@ -360,6 +394,68 @@ class JobManager(metaclass=ABCMeta):
     def get_paral_config(self) -> ParallelConfig:
         return self._paral_config
 
+    # --------------------------------------------- failover replay
+    def export_state(self) -> dict:
+        with self._lock:
+            return {
+                "nodes": [
+                    self._node_row(n) for n in self._nodes.values()
+                ],
+                "version": self._nodes_version,
+            }
+
+    def _install_node_row(self, row: dict):
+        """Caller holds the lock: upsert one journaled node row."""
+        node_id = int(row.get("id", 0))
+        node = self._nodes.get(node_id)
+        if node is None:
+            node = Node(
+                row.get("type", NodeType.WORKER),
+                node_id,
+                rank_index=row.get("rank_index"),
+                status=row.get("status", NodeStatus.INITIAL),
+                max_relaunch_count=int(
+                    row.get("max_relaunch_count", 3)
+                ),
+            )
+            self._nodes[node_id] = node
+        else:
+            node.status = row.get("status", node.status)
+        node.host_addr = row.get("host_addr", "") or node.host_addr
+        node.relaunch_count = int(row.get("relaunch_count", 0))
+        node.create_time = row.get("create_time")
+        node.heartbeat_time = float(row.get("heartbeat_time", 0) or 0)
+        node.is_released = bool(row.get("is_released", False))
+        if row.get("exit_reason"):
+            node.set_exit_reason(row["exit_reason"])
+        self._node_groups.route(node)
+
+    def restore_state(self, state: dict):
+        """Install a snapshotted node table (replay path — not
+        re-journaled; no callbacks fire: the nodes already HAD their
+        transitions under the previous incarnation)."""
+        with self._lock:
+            cb, self._journal_cb = self._journal_cb, None
+            try:
+                for row in state.get("nodes") or []:
+                    self._install_node_row(row)
+            finally:
+                self._journal_cb = cb
+            self._nodes_version = max(
+                self._nodes_version, int(state.get("version", 0))
+            )
+            # relaunch ids must not collide with restored nodes
+            if hasattr(self, "_next_node_id") and self._nodes:
+                self._next_node_id = max(
+                    self._next_node_id, max(self._nodes) + 1
+                )
+
+    def apply_journal_op(self, op: str, args: dict):
+        if op == "node":
+            with self._lock:
+                self._install_node_row(args)
+                self._bump_nodes_version()
+
 
 class LocalJobManager(JobManager):
     """Single-host job manager used by the local master that
@@ -372,11 +468,17 @@ class LocalJobManager(JobManager):
 
     def start(self):
         for node_id in range(self._node_num):
-            self._nodes[node_id] = Node(
-                NodeType.WORKER,
+            # setdefault: a failover replay may already have restored
+            # this node's row — the fresh INITIAL placeholder must not
+            # clobber it
+            self._nodes.setdefault(
                 node_id,
-                status=NodeStatus.INITIAL,
-                max_relaunch_count=_ctx.max_node_relaunch_times,
+                Node(
+                    NodeType.WORKER,
+                    node_id,
+                    status=NodeStatus.INITIAL,
+                    max_relaunch_count=_ctx.max_node_relaunch_times,
+                ),
             )
 
     def has_job_error(self) -> bool:
@@ -406,6 +508,8 @@ class DistributedJobManager(JobManager):
 
     def start(self):
         for node_id in range(self._node_num):
+            if node_id in self._nodes:
+                continue  # restored by a failover replay
             node = Node(
                 NodeType.WORKER,
                 node_id,

@@ -47,12 +47,13 @@ class JobMaster:
         from dlrover_tpu.observability.events import TimelineAggregator
         from dlrover_tpu.observability.metrics import get_registry
 
+        self._job_name = os.getenv("DLROVER_TPU_JOB_NAME", "default")
         self.speed_monitor = SpeedMonitor()
         # unified job-event timeline: per-node streams merge here, the
         # goodput ledger is served live (get-RPC + exporter gauges) and
         # durably (sqlite datastore when configured)
         self.timeline_aggregator = TimelineAggregator(
-            job=os.getenv("DLROVER_TPU_JOB_NAME", "default"),
+            job=self._job_name,
             registry=get_registry(),
             datastore=get_default_datastore(),
         )
@@ -77,6 +78,13 @@ class JobMaster:
         self._server = None
         self._exit_reason: Optional[str] = None
         self._stopped = threading.Event()
+        #: fencing identity (durable when a Brain db is configured;
+        #: epoch 0 / incarnation 0 = no durability, fencing inert)
+        self.job_epoch = 0
+        self.incarnation = 0
+        #: durable control-plane journal (None = failover disabled or
+        #: no Brain db — today's memory-only behavior exactly)
+        self.control_journal = None
 
         self.job_manager.add_node_event_callback(
             TaskRescheduleCallback(self.task_manager)
@@ -93,7 +101,50 @@ class JobMaster:
     def addr(self) -> str:
         return f"127.0.0.1:{self._port}"
 
+    def _setup_failover(self):
+        """Durable control-plane state: registers this incarnation,
+        replays snapshot+journal into the components, then attaches
+        the journal hooks — all BEFORE the gRPC server opens, so the
+        first reconnecting agent sees the resumed state."""
+        from dlrover_tpu.common.env import master_failover_enabled
+        from dlrover_tpu.master.datastore import get_default_datastore
+
+        if not master_failover_enabled():
+            return
+        store = get_default_datastore()
+        if store is None:
+            return
+        from dlrover_tpu.master.failover import ControlPlaneJournal
+        from dlrover_tpu.observability.events import get_event_logger
+
+        self.job_epoch, self.incarnation = store.bump_incarnation(
+            self._job_name
+        )
+        self.control_journal = ControlPlaneJournal(
+            store,
+            self._job_name,
+            kv_store=self.kv_store,
+            rdzv_managers=self.rdzv_managers,
+            task_manager=self.task_manager,
+            job_manager=self.job_manager,
+        )
+        stats = self.control_journal.recover()
+        self.control_journal.attach()
+        self.control_journal.start()
+        if self.incarnation > 1:
+            get_event_logger().instant(
+                "master_restart",
+                incarnation=self.incarnation,
+                job_epoch=self.job_epoch,
+                **stats,
+            )
+            logger.info(
+                "master incarnation %s resumed job epoch %s (%s)",
+                self.incarnation, self.job_epoch, stats,
+            )
+
     def prepare(self):
+        self._setup_failover()
         servicer = MasterServicer(
             task_manager=self.task_manager,
             job_manager=self.job_manager,
@@ -102,6 +153,8 @@ class JobMaster:
             kv_store=self.kv_store,
             diagnosis_manager=self.diagnosis_manager,
             timeline_aggregator=self.timeline_aggregator,
+            job_epoch=self.job_epoch,
+            incarnation=self.incarnation,
         )
         self._server = create_master_service(self._port, servicer)
         self._server.start()
@@ -123,6 +176,16 @@ class JobMaster:
     def stop(self, reason: str = ""):
         self._exit_reason = reason or self._exit_reason
         self._stopped.set()
+        if self.control_journal is not None:
+            # a job-terminal stop (request_stop always passes a
+            # JobExitReason) RETIRES the durable state — a later run
+            # under the same Brain db + job name must not inherit this
+            # job's exhausted datasets / stale KV keys; a bare stop()
+            # (master-only shutdown) snapshots so the next incarnation
+            # resumes
+            self.control_journal.stop(
+                retire=bool(self._exit_reason)
+            )
         self.task_manager.stop()
         self.job_manager.stop()
         if self.diagnosis_manager:

@@ -17,9 +17,10 @@ import math
 import threading
 import time
 from abc import ABCMeta
-from typing import Dict, List, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from dlrover_tpu.common.constants import NetworkFailureReason
+from dlrover_tpu.common.fault_injection import maybe_crash
 from dlrover_tpu.common.log import default_logger as logger
 
 
@@ -62,12 +63,30 @@ class RendezvousManager(metaclass=ABCMeta):
         #: bumped on every state change (join/remove/params/round
         #: completion); the ``CommWorld`` delta protocol's version
         self._version = 0
+        #: failover journal hook: ``cb(op, args)``; rendezvous state is
+        #: tiny, so every mutation journals the FULL state dict —
+        #: replay is last-writer-wins and therefore idempotent, and a
+        #: restarted master resumes the same round at the same version
+        self._journal_cb: Optional[Callable[[str, dict], None]] = None
+
+    def set_journal(self, cb: Optional[Callable[[str, dict], None]]):
+        with self._lock:
+            self._journal_cb = cb
+
+    def _journal_locked(self):
+        """Caller holds the lock: journal the full current state."""
+        if self._journal_cb is not None:
+            try:
+                self._journal_cb("state", self._export_locked())
+            except Exception as e:  # noqa: BLE001
+                logger.warning("rendezvous journal failed: %s", e)
 
     def _mutated(self):
-        """Caller holds the lock: version-stamp the change and wake
-        long-poll waiters."""
+        """Caller holds the lock: version-stamp the change, wake
+        long-poll waiters, journal the new state."""
         self._version += 1
         self._lock.notify_all()
+        self._journal_locked()
 
     @property
     def state_version(self) -> int:
@@ -77,6 +96,9 @@ class RendezvousManager(metaclass=ABCMeta):
     def set_node_topology(self, node_rank: int, levels: tuple):
         with self._lock:
             self._node_topology[node_rank] = tuple(levels)
+            # journaled (ranks sort by topology after replay) but NOT
+            # version-bumped: topology is advisory, not world state
+            self._journal_locked()
 
     def _topology_order(self, ranks: List[int]) -> List[int]:
         """Caller holds the lock."""
@@ -124,6 +146,10 @@ class RendezvousManager(metaclass=ABCMeta):
             self._rdzv_nodes = {}
             self._lastcall_time = time.time()
             self._mutated()
+        # chaos hook: the join is journaled but the round is pending —
+        # a kill pinned here proves a restarted master resumes the
+        # SAME round with the already-joined members
+        maybe_crash("mid_rendezvous")
         return self._rdzv_round
 
     def _check_rdzv_completed(self) -> bool:
@@ -263,6 +289,87 @@ class RendezvousManager(metaclass=ABCMeta):
                 return False
             return len(self._ckpt_steps) == len(self._latest_rdzv_nodes)
 
+    # --------------------------------------------- failover replay
+    def _export_locked(self) -> dict:
+        """Caller holds the lock: JSON-safe full state (int dict keys
+        become strings on the wire; restore converts them back)."""
+        state = {
+            "waiting": dict(self._waiting_nodes),
+            "rdzv_nodes": dict(self._rdzv_nodes),
+            "round": self._rdzv_round,
+            "latest": list(self._latest_rdzv_nodes),
+            "ckpt_steps": dict(self._ckpt_steps),
+            "topology": {
+                str(r): list(v)
+                for r, v in self._node_topology.items()
+            },
+            "params": [
+                self._rdzv_params.min_nodes,
+                self._rdzv_params.max_nodes,
+                self._rdzv_params.waiting_timeout,
+                self._node_unit,
+            ],
+            "lastcall": self._lastcall_time,
+            "version": self._version,
+        }
+        state.update(self._export_extra_locked())
+        return state
+
+    def _export_extra_locked(self) -> dict:
+        """Subclass state rider (network-check verdicts etc.)."""
+        return {}
+
+    def _restore_extra_locked(self, state: dict):
+        pass
+
+    def export_state(self) -> dict:
+        with self._lock:
+            return self._export_locked()
+
+    def restore_state(self, state: dict):
+        """Install a journaled/snapshotted state (replay path — not
+        re-journaled).  The version is restored as-is so pre-crash
+        clients' ``NotModified`` caches stay coherent with the new
+        incarnation."""
+        with self._lock:
+            self._waiting_nodes = {
+                int(k): int(v)
+                for k, v in (state.get("waiting") or {}).items()
+            }
+            self._rdzv_nodes = {
+                int(k): int(v)
+                for k, v in (state.get("rdzv_nodes") or {}).items()
+            }
+            self._rdzv_round = int(state.get("round", 0))
+            self._latest_rdzv_nodes = [
+                int(r) for r in (state.get("latest") or [])
+            ]
+            self._ckpt_steps = {
+                int(k): int(v)
+                for k, v in (state.get("ckpt_steps") or {}).items()
+            }
+            self._node_topology = {
+                int(k): tuple(v)
+                for k, v in (state.get("topology") or {}).items()
+            }
+            params = state.get("params")
+            if params:
+                self._rdzv_params.min_nodes = int(params[0])
+                self._rdzv_params.max_nodes = int(params[1])
+                self._rdzv_params.waiting_timeout = float(params[2])
+                self._node_unit = max(int(params[3]), 1)
+            # the window rule is time-driven: restart the waiting
+            # window NOW so a pending round can't complete instantly
+            # off a stale pre-crash timestamp (members that died with
+            # the master re-join and re-arm it anyway)
+            if self._waiting_nodes and state.get("lastcall"):
+                self._lastcall_time = time.time()
+            self._restore_extra_locked(state)
+            self._version = max(
+                self._version, int(state.get("version", 0))
+            )
+            self._lock.notify_all()
+
 
 class ElasticTrainingRendezvousManager(RendezvousManager):
     def __init__(self):
@@ -349,6 +456,9 @@ class NetworkCheckRendezvousManager(RendezvousManager):
             self._node_status[node_rank] = succeeded or bool(prev)
             if succeeded:
                 self._node_times[node_rank] = elapsed
+            # journaled without a version bump: health verdicts are
+            # poll-read (check_fault_node), not delta-shipped
+            self._journal_locked()
 
     def check_fault_node(self) -> Tuple[List[int], str]:
         with self._lock:
@@ -392,3 +502,35 @@ class NetworkCheckRendezvousManager(RendezvousManager):
             self._node_status = {}
             self._node_times = {}
             self._check_round = 0
+
+    def _export_extra_locked(self) -> dict:
+        return {
+            "node_status": {
+                str(r): bool(v)
+                for r, v in self._node_status.items()
+            },
+            "node_times": {
+                str(r): float(v)
+                for r, v in self._node_times.items()
+            },
+            "check_round": self._check_round,
+            "node_groups": [
+                {str(r): int(v) for r, v in g.items()}
+                for g in self._node_groups
+            ],
+        }
+
+    def _restore_extra_locked(self, state: dict):
+        self._node_status = {
+            int(k): bool(v)
+            for k, v in (state.get("node_status") or {}).items()
+        }
+        self._node_times = {
+            int(k): float(v)
+            for k, v in (state.get("node_times") or {}).items()
+        }
+        self._check_round = int(state.get("check_round", 0))
+        self._node_groups = [
+            {int(k): int(v) for k, v in g.items()}
+            for g in (state.get("node_groups") or [])
+        ]

@@ -16,6 +16,8 @@ from dlrover_tpu.common.constants import (
     RendezvousName,
     TrainingLoopStatus,
 )
+from dlrover_tpu.common.env import master_failover_enabled
+from dlrover_tpu.common.fault_injection import maybe_crash
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.observability.metrics import record_control_rpc
 
@@ -38,7 +40,16 @@ class MasterServicer:
         diagnosis_manager=None,
         sync_service=None,
         timeline_aggregator=None,
+        job_epoch: int = 0,
+        incarnation: int = 0,
     ):
+        #: fencing identity: requests carrying a DIFFERENT job_epoch
+        #: get a typed ``StaleEpoch`` answer (client refreshes and
+        #: re-issues) instead of being dispatched against the wrong
+        #: job generation.  incarnation is informational — it tells
+        #: reconnecting clients the master restarted.
+        self.job_epoch = job_epoch
+        self.incarnation = incarnation
         self._task_manager = task_manager
         self._job_manager = job_manager
         self._speed_monitor = speed_monitor
@@ -67,15 +78,42 @@ class MasterServicer:
         if not self._wait_slots.acquire(blocking=False):
             return immediate_fn()
         try:
+            # chaos hook: a kill pinned here dies with RPCs parked
+            # mid-long-poll — the waiters must re-park on the next
+            # incarnation, not crash
+            maybe_crash("mid_long_poll")
             return wait_fn()
         finally:
             self._wait_slots.release()
+
+    def _fenced(self, envelope: msg.Envelope) -> Optional[msg.StaleEpoch]:
+        """Typed fencing answer when the request's job_epoch doesn't
+        match this master's.  ``-1`` (old clients / kill-switched
+        failover) is never fenced."""
+        if not master_failover_enabled():
+            return None
+        epoch = getattr(envelope, "job_epoch", -1)
+        if epoch is None or epoch < 0 or epoch == self.job_epoch:
+            return None
+        return msg.StaleEpoch(
+            job_epoch=self.job_epoch, incarnation=self.incarnation
+        )
 
     # ------------------------------------------------------------------ get
     def get(self, envelope: msg.Envelope) -> Optional[msg.Message]:
         self._count_rpc()
         request = msg.deserialize_message(envelope.data)
         node_id, node_type = envelope.node_id, envelope.node_type
+        if isinstance(request, msg.ControlEpochRequest):
+            # the refresh path — answered even to stale clients (it is
+            # HOW they stop being stale)
+            return msg.ControlEpoch(
+                job_epoch=self.job_epoch,
+                incarnation=self.incarnation,
+            )
+        stale = self._fenced(envelope)
+        if stale is not None:
+            return stale
         if isinstance(request, msg.TaskRequest):
             return self._get_task(node_id, request)
         if isinstance(request, msg.ShardCheckpointRequest):
@@ -327,8 +365,11 @@ class MasterServicer:
         return msg.NetworkCheckResult(nodes=nodes, reason=reason)
 
     # --------------------------------------------------------------- report
-    def report(self, envelope: msg.Envelope) -> msg.BoolResponse:
+    def report(self, envelope: msg.Envelope):
         self._count_rpc()
+        stale = self._fenced(envelope)
+        if stale is not None:
+            return stale
         request = msg.deserialize_message(envelope.data)
         node_id, node_type = envelope.node_id, envelope.node_type
         success = False

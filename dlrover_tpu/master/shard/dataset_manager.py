@@ -50,6 +50,10 @@ class BatchDatasetManager(DatasetManager):
         self._task_id = 0
         self._completed_step = 0
         self._max_task_completed_time = 0.0
+        #: bumped whenever the splitter produced a new todo batch —
+        #: the failover journal records full state exactly then (the
+        #: splitter position moved), and O(1) deltas otherwise
+        self.refill_count = 0
 
     def get_task(self, node_id: int) -> Task:
         """Pop the next todo task; WAIT if dispatching is exhausted but
@@ -67,6 +71,7 @@ class BatchDatasetManager(DatasetManager):
         return Task()
 
     def _create_tasks(self):
+        self.refill_count += 1
         self._splitter.create_shards()
         for shard in self._splitter.get_shards():
             task = Task(
@@ -174,6 +179,28 @@ class BatchDatasetManager(DatasetManager):
                 )
             )
             self._task_id += 1
+
+    def apply_done_for_replay(
+        self, shard_key, epoch: int, completed_step: int
+    ):
+        """Replay one journaled successful ack: remove the todo task
+        whose shard matches ``shard_key`` (``[name, start, end]`` —
+        stable across the task-id renumbering ``restore_checkpoint``
+        performs) and adopt the recorded progress.  ``epoch`` guards
+        the snapshot race: ranges recur every epoch, so a stale delta
+        racing a newer-epoch snapshot must not eat the new epoch's
+        shard.  Idempotent — a delta the snapshot already folded in
+        finds no match and ``max`` keeps the newer step."""
+        name, lo, hi = shard_key
+        if epoch == self._splitter.epoch:
+            for i, task in enumerate(self.todo):
+                s = task.shard
+                if (s.name, s.start, s.end) == (name, lo, hi):
+                    del self.todo[i]
+                    break
+        self._completed_step = max(
+            self._completed_step, int(completed_step)
+        )
 
     @property
     def completed_step(self) -> int:

@@ -134,9 +134,30 @@ USEFUL_PHASES = frozenset({PHASE_STEP})
 UNATTRIBUTED = "unattributed"
 
 #: Point events (``ph: "i"``) — markers, not ledger input.
+#: ``fault_injected`` marks a chaos-harness fault (a plan-driven
+#: SIGKILL or an RPC drop/delay/dup at the channel boundary) so an
+#: injected fault and the recovery it provokes share one trace;
+#: ``master_restart`` marks a master incarnation replaying its
+#: journal+snapshot back to serving state.
 INSTANT_EVENTS = frozenset(
-    {"preemption_signal", "job_start", "job_end", "worker_kill"}
+    {
+        "preemption_signal",
+        "job_start",
+        "job_end",
+        "worker_kill",
+        "fault_injected",
+        "master_restart",
+    }
 )
+
+#: Labels an ``instant()`` emit site must pass explicitly; enforced by
+#: ``scripts/check_event_schema.py`` like ``REQUIRED_SPAN_LABELS``.
+#: ``fault_injected`` without kind+target would be an unattributable
+#: blip in a chaos trace — exactly the record that must be precise.
+REQUIRED_INSTANT_LABELS: Dict[str, Tuple[str, ...]] = {
+    "fault_injected": ("kind", "target"),
+    "master_restart": ("incarnation",),
+}
 
 #: Labels an emit SITE must pass explicitly (beyond the automatic
 #: job/node/rank/inc/pid identity labels); enforced by

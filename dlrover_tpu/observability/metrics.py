@@ -208,6 +208,21 @@ def record_offload_io(nbytes: int, seconds: float, buffered: bool):
         logger.warning("offload io metric export failed: %s", e)
 
 
+def record_dropped_reports(n: int = 1):
+    """Count fire-and-forget reports dropped by the client-side
+    ``ReportBuffer`` overflow cap during a master outage
+    (``dlrover_tpu_control_dropped_reports``).  A nonzero rate means
+    the outage outlived the buffer — telemetry from that window is
+    gone (training state is unaffected; reports are advisory).  Never
+    raises."""
+    try:
+        get_registry().inc_counter(
+            "dlrover_tpu_control_dropped_reports", float(n)
+        )
+    except Exception as e:  # noqa: BLE001
+        logger.warning("dropped-report metric export failed: %s", e)
+
+
 #: windowed meter behind ``dlrover_tpu_control_rps``: the master's
 #: servicer calls ``record_control_rpc`` per RPC; the rate gauge is
 #: recomputed at most once per window so the metric itself cannot

@@ -1,0 +1,498 @@
+"""Chaos-injection harness: kill the control plane, measure recovery.
+
+Plays the role of DLRover's ElasticJob controller for a local job: it
+owns the master subprocess (``python -m dlrover_tpu.master.main`` with
+a durable ``--brain_db``), launches the training job against it
+(``python -m dlrover_tpu.run --master_addr=...`` running the goodput
+worker), and SUPERVISES the master — whenever the master process dies,
+the harness restarts it on the same port with the same Brain db, the
+way the controller recreates a failed master pod and agents simply
+reattach (PAPER.md §1).  Master recovery (journal+snapshot replay,
+incarnation bump, agents re-parking their long-polls) is the product
+under test; this script only measures it.
+
+Fault plans (``--plan``):
+
+- ``none``                  — no faults; the goodput baseline leg.
+- ``master-kill-storm``     — ``--kills`` timer-driven SIGKILLs of the
+  master, evenly spaced across the step budget.
+- ``master-kill-rendezvous``/``master-kill-longpoll``/
+  ``master-kill-flush`` — a SEEDED one-kill fault plan pinned to the
+  named phase hook (``DLROVER_TPU_FAULT_PLAN`` +
+  ``DLROVER_TPU_FAULT_ROLE=master``): the master SIGKILLs itself at
+  ``mid_rendezvous`` / ``mid_long_poll`` / ``mid_report_flush``, which
+  reproduces "the master dies mid-X" deterministically instead of by
+  racing a timer against the serve loop.  The plan rides only the
+  FIRST incarnation — a restarted master is a fresh pod; the
+  controller does not re-inject the chaos.
+- ``agent-kill``            — SIGKILL the rank-1 worker once mid-run
+  (the PR-3 worker-restart path, for storm mixes).
+- ``rpc-chaos``             — seeded drop/delay/duplicate of agent
+  RPCs at the ``MasterChannel`` boundary; no kills.  The job must
+  complete anyway (retries + idempotent masters absorb it).
+
+Reported per run (JSON ``--out`` artifact, wired into ``bench.py``
+``extras.failover``):
+
+- ``master_kills`` / ``master_restarts`` and per-kill ``mttr_s`` —
+  wall time from master death to the NEW incarnation answering a
+  ``ControlEpochRequest`` (replay is complete before the server
+  opens, so "answers the epoch probe" == "serving the resumed job").
+- ``goodput`` — final step x steady-state step time / wall clock, the
+  same definition ``bench_goodput`` uses.
+- ``stall_max_s`` — the longest gap between consecutive completed
+  steps; under master failover a master kill should barely dent this
+  (steps don't go through the master at steady state).
+- ``job_survived`` — with ``--no-failover`` the same storm is
+  fail-fast by design: the first master death crashes the job.
+
+Honors ``DLROVER_TPU_BENCH_BUDGET_S`` (scales the step budget down).
+
+Usage::
+
+    python scripts/chaos.py --plan master-kill-storm [--kills 2]
+                            [--steps 60] [--seed 7] [--out OUT.json]
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import BenchBudget, flush_partial as _flush  # noqa: E402
+
+from dlrover_tpu.common.comm import (  # noqa: E402
+    MasterChannel,
+    wait_channel_ready,
+)
+from dlrover_tpu.common.env import get_free_port  # noqa: E402
+
+PLANS = (
+    "none",
+    "master-kill-storm",
+    "master-kill-rendezvous",
+    "master-kill-longpoll",
+    "master-kill-flush",
+    "agent-kill",
+    "rpc-chaos",
+)
+
+#: phase hook each plan pins its master kill to
+_PHASE_FOR_PLAN = {
+    "master-kill-rendezvous": "mid_rendezvous",
+    "master-kill-longpoll": "mid_long_poll",
+    "master-kill-flush": "mid_report_flush",
+}
+
+
+def build_fault_plan(plan: str, seed: int) -> str:
+    """The ``DLROVER_TPU_FAULT_PLAN`` JSON for plan-driven faults
+    ("" = the plan is timer-driven or fault-free)."""
+    phase = _PHASE_FOR_PLAN.get(plan)
+    if phase is not None:
+        return json.dumps({
+            "seed": seed,
+            "faults": [{
+                "kind": "kill", "target": "master",
+                "phase": phase, "count": 1,
+            }],
+        })
+    if plan == "rpc-chaos":
+        return json.dumps({
+            "seed": seed,
+            "faults": [
+                {"kind": "rpc", "op": "drop", "prob": 0.05,
+                 "count": -1},
+                {"kind": "rpc", "op": "delay", "prob": 0.05,
+                 "delay_s": 0.05, "count": -1},
+                {"kind": "rpc", "op": "dup", "prob": 0.05,
+                 "count": -1},
+            ],
+        })
+    return ""
+
+
+def _read_progress(path):
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    return out
+
+
+class MasterSupervisor:
+    """Owns the master subprocess: spawn, death detection, restart on
+    the same port + Brain db, per-restart MTTR."""
+
+    def __init__(self, workdir: str, fault_plan: str = "",
+                 job_name: str = "chaos"):
+        self.port = get_free_port()
+        self.addr = f"127.0.0.1:{self.port}"
+        self._workdir = workdir
+        self._brain_db = os.path.join(workdir, "brain.db")
+        self._log_path = os.path.join(workdir, "master.log")
+        self._fault_plan = fault_plan
+        self._job_name = job_name
+        self._proc = None
+        self.incarnations = 0
+        self.mttr_s = []
+
+    def _spawn(self, with_plan: bool):
+        env = dict(
+            os.environ,
+            PYTHONPATH=REPO,
+            DLROVER_TPU_BRAIN_DB=self._brain_db,
+            DLROVER_TPU_EVENTS_FILE=os.path.join(
+                self._workdir, "events.jsonl"
+            ),
+            # compact often: a chaos run is short, and the recovery
+            # cost bound (snapshot + linger of journal) is the point
+            DLROVER_TPU_CONTROL_SNAPSHOT_INTERVAL_S="5",
+            DLROVER_TPU_FAULT_ROLE="master",
+        )
+        if with_plan and self._fault_plan:
+            env["DLROVER_TPU_FAULT_PLAN"] = self._fault_plan
+        else:
+            env.pop("DLROVER_TPU_FAULT_PLAN", None)
+        log = open(self._log_path, "a")
+        self._proc = subprocess.Popen(  # noqa: S603
+            [
+                sys.executable, "-m", "dlrover_tpu.master.main",
+                "--platform", "local",
+                "--port", str(self.port),
+                "--node_num", "1",
+                "--job_name", self._job_name,
+            ],
+            stdout=log, stderr=subprocess.STDOUT, env=env,
+            cwd=self._workdir,
+        )
+        log.close()
+        self.incarnations += 1
+
+    def _probe_ready(self, timeout: float) -> bool:
+        """Serving == the NEW incarnation answers an epoch probe
+        (recovery replays before the gRPC server opens, so this is
+        also 'the resumed job state is installed')."""
+        if not wait_channel_ready(self.addr, timeout=timeout):
+            return False
+        chan = MasterChannel(self.addr, max_retry=3)
+        try:
+            chan.refresh_epoch(timeout=5.0, deadline_s=5.0)
+            return True
+        except ConnectionError:
+            return False
+        finally:
+            chan.close()
+
+    def start(self, timeout: float = 30.0) -> bool:
+        self._spawn(with_plan=True)
+        return self._probe_ready(timeout)
+
+    def alive(self) -> bool:
+        return self._proc is not None and self._proc.poll() is None
+
+    def kill(self):
+        if self.alive():
+            try:
+                os.kill(self._proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+
+    def restart(self, timeout: float = 30.0) -> bool:
+        """Controller behavior: recreate the dead master pod.  The
+        fault plan is NOT re-injected.  Records MTTR from the moment
+        the death was observed."""
+        t_dead = time.perf_counter()
+        if self._proc is not None:
+            self._proc.wait()
+        self._spawn(with_plan=False)
+        ok = self._probe_ready(timeout)
+        if ok:
+            self.mttr_s.append(
+                round(time.perf_counter() - t_dead, 3)
+            )
+        return ok
+
+    def stop(self):
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+                self._proc.wait()
+
+    def log_tail(self, n: int = 800) -> str:
+        try:
+            return open(self._log_path).read()[-n:]
+        except OSError:
+            return ""
+
+
+def run_plan(
+    plan: str = "master-kill-storm",
+    steps: int = 60,
+    kills: int = 2,
+    seed: int = 7,
+    step_sleep: float = 0.08,
+    timeout: float = 300.0,
+    failover: bool = True,
+    nproc: int = 2,
+) -> dict:
+    """One chaos run; returns the metrics dict.  Raises RuntimeError
+    only on harness failure — a job death under ``failover=False`` is
+    a RESULT (``job_survived=False``), not an error."""
+    if plan not in PLANS:
+        raise ValueError(f"unknown plan {plan!r} (have: {PLANS})")
+    workdir = tempfile.mkdtemp(prefix="dlrover_chaos_")
+    progress = os.path.join(workdir, "progress.jsonl")
+    fault_plan = build_fault_plan(plan, seed)
+    master_plan = fault_plan if plan.startswith("master-") else ""
+    agent_plan = fault_plan if plan == "rpc-chaos" else ""
+
+    supervisor = MasterSupervisor(workdir, fault_plan=master_plan)
+    if not supervisor.start():
+        raise RuntimeError(
+            "master never came up: " + supervisor.log_tail()
+        )
+
+    env = dict(
+        os.environ,
+        GOODPUT_TARGET_STEPS=str(steps),
+        GOODPUT_STEP_SLEEP=str(step_sleep),
+        GOODPUT_PROGRESS_FILE=progress,
+        GOODPUT_CKPT_DIR=os.path.join(workdir, "ckpt"),
+        DLROVER_TPU_SOCKET_DIR=os.path.join(workdir, "socks"),
+        DLROVER_TPU_EVENTS_FILE=os.path.join(
+            workdir, "events.jsonl"
+        ),
+        DLROVER_TPU_MASTER_FAILOVER="1" if failover else "0",
+        JAX_PLATFORMS="cpu",
+        JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="0",
+        PYTHONPATH=REPO,
+        XLA_FLAGS="",
+    )
+    if agent_plan:
+        env["DLROVER_TPU_FAULT_PLAN"] = agent_plan
+        env["DLROVER_TPU_FAULT_ROLE"] = "agent"
+    else:
+        env.pop("DLROVER_TPU_FAULT_PLAN", None)
+    log_path = os.path.join(workdir, "launcher.log")
+    t_start = time.perf_counter()
+    with open(log_path, "w") as log:
+        launcher = subprocess.Popen(  # noqa: S603
+            [
+                sys.executable, "-m", "dlrover_tpu.run",
+                "--nnodes=1", f"--nproc_per_node={nproc}",
+                f"--master_addr={supervisor.addr}",
+                "--monitor_interval=0.3",
+                "--stop_timeout=2",
+                "--max_restarts=4",
+                "--failure_stop_timeout=0.5",
+                "--compile_cache_dir="
+                + os.path.join(workdir, "xla_cache"),
+                os.path.join(REPO, "scripts", "goodput_train.py"),
+            ],
+            stdout=log, stderr=subprocess.STDOUT, env=env,
+            cwd=workdir,
+        )
+
+    # timer-driven kill thresholds, evenly spaced inside the run
+    storm = []
+    if plan == "master-kill-storm":
+        storm = [
+            max(1, int(steps * (i + 1) / (kills + 1)))
+            for i in range(kills)
+        ]
+    agent_kill_at = max(2, steps // 3) if plan == "agent-kill" else None
+
+    master_kills = 0
+    deadline = time.time() + timeout
+    job_survived = True
+    try:
+        while launcher.poll() is None:
+            if time.time() > deadline:
+                raise RuntimeError(
+                    "chaos run timed out; launcher log tail:\n"
+                    + open(log_path).read()[-800:]
+                )
+            lines = _read_progress(progress)
+            max_step = (
+                max(e["step"] for e in lines) if lines else 0
+            )
+            if storm and max_step >= storm[0] and supervisor.alive():
+                storm.pop(0)
+                supervisor.kill()
+                master_kills += 1
+            if (
+                agent_kill_at is not None
+                and max_step >= agent_kill_at
+            ):
+                agent_kill_at = None
+                rank1 = [e for e in lines if e["rank"] == 1]
+                victim = (rank1 or lines)[-1]["pid"]
+                try:
+                    os.kill(victim, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+            if not supervisor.alive():
+                # plan-driven suicides are kills the timer didn't do
+                if not storm and plan in _PHASE_FOR_PLAN and (
+                    master_kills == 0
+                ):
+                    master_kills += 1
+                if failover:
+                    if not supervisor.restart():
+                        raise RuntimeError(
+                            "restarted master never became ready: "
+                            + supervisor.log_tail()
+                        )
+                # fail-fast mode: no restart — the next
+                # master-dependent operation crashes the job (steady
+                # -state steps may still finish: they never touch the
+                # master, and reports were always advisory)
+            time.sleep(0.05)
+    finally:
+        supervisor.stop()
+        if launcher.poll() is None:
+            launcher.kill()
+            launcher.wait()
+    wall_s = time.perf_counter() - t_start
+
+    lines = _read_progress(progress)
+    final_step = max((e["step"] for e in lines), default=0)
+    if launcher.returncode != 0 or final_step < steps:
+        job_survived = False
+    if job_survived is False and failover and plan != "none":
+        # under failover the job MUST survive the storm — this is the
+        # acceptance bar, so a dead job is a harness-level failure
+        raise RuntimeError(
+            f"job did not survive plan {plan!r} "
+            f"(rc={launcher.returncode}, step {final_step}/{steps}); "
+            "launcher log tail:\n" + open(log_path).read()[-1200:]
+        )
+
+    # goodput: final step x steady step time / wall (bench_goodput's
+    # definition); steady time = median inter-step delta on rank 0
+    rank0 = sorted(
+        (e for e in lines if e["rank"] == 0),
+        key=lambda e: e["step"],
+    )
+    deltas = sorted(
+        b["t"] - a["t"]
+        for a, b in zip(rank0, rank0[1:])
+        if b["step"] == a["step"] + 1 and b["t"] > a["t"]
+    )
+    steady_s = deltas[len(deltas) // 2] if deltas else step_sleep
+    # the stall is the longest TIME gap between ANY two consecutive
+    # progress entries — a restart replays from the checkpoint, so
+    # the step counter repeats/regresses across exactly the gap we
+    # must not exclude (the steady median above keeps the
+    # step-continuity filter: it wants true inter-step deltas)
+    rank0_by_t = sorted(
+        (e for e in lines if e["rank"] == 0), key=lambda e: e["t"]
+    )
+    stall_max_s = max(
+        (
+            b["t"] - a["t"]
+            for a, b in zip(rank0_by_t, rank0_by_t[1:])
+        ),
+        default=0.0,
+    )
+    goodput = (
+        min(1.0, final_step * steady_s / wall_s) if wall_s else 0.0
+    )
+    return {
+        "plan": plan,
+        "seed": seed,
+        "failover": failover,
+        "steps": final_step,
+        "target_steps": steps,
+        "wall_s": round(wall_s, 2),
+        "goodput": round(goodput, 4),
+        "steady_step_s": round(steady_s, 4),
+        "stall_max_s": round(stall_max_s, 3),
+        "master_kills": master_kills,
+        "master_restarts": supervisor.incarnations - 1,
+        "mttr_s": supervisor.mttr_s,
+        "mttr_mean_s": round(
+            sum(supervisor.mttr_s) / len(supervisor.mttr_s), 3
+        ) if supervisor.mttr_s else None,
+        "mttr_max_s": max(supervisor.mttr_s, default=None),
+        "job_survived": job_survived,
+        "launcher_rc": launcher.returncode,
+        "workdir": workdir,
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="chaos-injection harness"
+    )
+    parser.add_argument("--plan", default="master-kill-storm",
+                        choices=PLANS)
+    parser.add_argument("--steps", type=int, default=60)
+    parser.add_argument("--kills", type=int, default=2)
+    parser.add_argument("--seed", type=int, default=7)
+    parser.add_argument("--step_sleep", type=float, default=0.08)
+    parser.add_argument("--timeout", type=float, default=300.0)
+    parser.add_argument("--no-failover", action="store_true",
+                        help="DLROVER_TPU_MASTER_FAILOVER=0 on the "
+                        "job: pin today's fail-fast behavior")
+    parser.add_argument("--out", default="")
+    args = parser.parse_args(argv)
+
+    budget = BenchBudget()
+    steps = args.steps
+    if budget.tight(120):
+        steps = min(steps, 30)
+    if budget.tight(45):
+        steps = min(steps, 12)
+
+    payload = {
+        "metric": "chaos_mttr_mean_s",
+        "value": None,
+        "unit": "s",
+        "vs_baseline": None,
+        "extras": {"bench_budget_s": budget.total},
+    }
+    try:
+        result = run_plan(
+            plan=args.plan,
+            steps=steps,
+            kills=args.kills,
+            seed=args.seed,
+            step_sleep=args.step_sleep,
+            timeout=budget.cap_timeout(args.timeout),
+            failover=not args.no_failover,
+        )
+    except RuntimeError as e:
+        payload["extras"]["error"] = str(e)
+        if args.out:
+            _flush(args.out, payload)
+        print(json.dumps(payload, indent=2))
+        return 1
+    payload["value"] = result.get("mttr_mean_s")
+    payload["extras"]["chaos"] = result
+    if args.out:
+        _flush(args.out, payload)
+    print(json.dumps(payload, indent=2))
+    return 0 if result["job_survived"] or args.no_failover else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

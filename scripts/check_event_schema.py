@@ -31,6 +31,7 @@ sys.path.insert(0, REPO)
 from dlrover_tpu.observability.events import (  # noqa: E402
     INSTANT_EVENTS,
     PHASES,
+    REQUIRED_INSTANT_LABELS,
     REQUIRED_SPAN_LABELS,
 )
 
@@ -62,6 +63,9 @@ DECLARED_METRICS = {
     # control plane (record_control_rpc; master servicer RPC meter)
     "dlrover_tpu_control_rps",
     "dlrover_tpu_control_rpc_total",
+    # client-side ReportBuffer overflow drops during a master outage
+    # (record_dropped_reports)
+    "dlrover_tpu_control_dropped_reports",
 }
 METRIC_METHODS = {"set_gauge", "inc_counter", "observe_duration"}
 _METRIC_PREFIX = "dlrover_tpu_"
@@ -179,6 +183,21 @@ def check_file(path: str):
                 f" (declared: {sorted(declared)})"
             )
             continue
+        if method == "instant":
+            kwargs = {kw.arg for kw in node.keywords if kw.arg}
+            has_splat = any(
+                kw.arg is None for kw in node.keywords
+            )
+            missing = [
+                lab
+                for lab in REQUIRED_INSTANT_LABELS.get(phase, ())
+                if lab not in kwargs
+            ]
+            if missing and not has_splat:
+                violations.append(
+                    f"{where}: instant({phase!r}) missing required "
+                    f"label(s) {missing}"
+                )
         if method in OPENING_METHODS:
             kwargs = {kw.arg for kw in node.keywords if kw.arg}
             has_splat = any(
@@ -194,6 +213,30 @@ def check_file(path: str):
                     f"{where}: {method}({phase!r}) missing required "
                     f"label(s) {missing}"
                 )
+            # retry-storm visibility: a control_wait span opened as a
+            # retry pause must carry the attempt ordinal, or storms
+            # collapse into indistinguishable blips on the timeline
+            if (
+                phase == "control_wait"
+                and not has_splat
+                and "retries" not in kwargs
+            ):
+                kind_kw = next(
+                    (
+                        kw.value
+                        for kw in node.keywords
+                        if kw.arg == "kind"
+                    ),
+                    None,
+                )
+                if (
+                    isinstance(kind_kw, ast.Constant)
+                    and kind_kw.value == "retry"
+                ):
+                    violations.append(
+                        f"{where}: {method}('control_wait') with "
+                        "kind='retry' missing the 'retries' label"
+                    )
     return violations
 
 

@@ -1,0 +1,75 @@
+"""Tier-1 chaos smoke: a tiny local job under a seeded one-master-kill
+fault plan.
+
+The full acceptance run is ``scripts/chaos.py --plan
+master-kill-storm``; this smoke keeps the same orchestration (real
+master subprocess with a durable Brain db, real ``dlrover_tpu.run``
+launcher, supervisor restart) but pins ONE plan-driven kill at
+``mid_long_poll`` — the master SIGKILLs itself while agent long-polls
+are parked on it, the harness restarts it, journal+snapshot replay
+resumes the job, and the agents' re-parked waits complete.  A passing
+run asserts the whole failover stack end to end inside the tier-1
+budget.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from scripts.chaos import build_fault_plan, run_plan  # noqa: E402
+
+
+def test_fault_plan_shapes():
+    """Named plans compile to valid DLROVER_TPU_FAULT_PLAN JSON."""
+    import json
+
+    from dlrover_tpu.common.fault_injection import FaultPlan
+
+    for name in (
+        "master-kill-rendezvous",
+        "master-kill-longpoll",
+        "master-kill-flush",
+        "rpc-chaos",
+    ):
+        raw = build_fault_plan(name, seed=3)
+        plan = FaultPlan.from_json(raw)
+        assert plan.seed == 3
+        assert plan.faults
+    assert build_fault_plan("none", 0) == ""
+    assert build_fault_plan("master-kill-storm", 0) == ""
+    data = json.loads(build_fault_plan("master-kill-longpoll", 1))
+    assert data["faults"][0]["phase"] == "mid_long_poll"
+    assert data["faults"][0]["target"] == "master"
+
+
+@pytest.mark.timeout(300)
+def test_one_master_kill_job_completes():
+    try:
+        result = run_plan(
+            plan="master-kill-longpoll",
+            steps=12,
+            step_sleep=0.05,
+            seed=11,
+            timeout=200.0,
+        )
+    except RuntimeError:
+        # one retry: a saturated single-core CI can stretch the
+        # restart window past the deadline without any product fault
+        result = run_plan(
+            plan="master-kill-longpoll",
+            steps=12,
+            step_sleep=0.05,
+            seed=11,
+            timeout=200.0,
+        )
+    assert result["job_survived"], result
+    assert result["steps"] >= 12
+    # exactly one plan-driven master suicide, one supervisor restart
+    assert result["master_kills"] == 1
+    assert result["master_restarts"] == 1
+    assert result["mttr_s"] and all(s > 0 for s in result["mttr_s"])

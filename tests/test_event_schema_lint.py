@@ -61,3 +61,42 @@ def test_lint_enforces_offload_copy_labels(tmp_path):
     assert proc.returncode == 1, proc.stdout + proc.stderr
     assert "event_schema_violations=1" in proc.stdout, proc.stdout
     assert "missing required label(s) ['buffered']" in proc.stdout
+
+
+def test_lint_enforces_fault_injected_labels(tmp_path):
+    """Chaos markers must be attributable: ``fault_injected`` without
+    kind+target is an anonymous blip in exactly the trace that needs
+    precision."""
+    bad = tmp_path / "bad_fault.py"
+    bad.write_text(
+        "events = None\n"
+        "def f(events):\n"
+        "    events.instant('fault_injected', kind='kill')\n"
+        "    events.instant('fault_injected',\n"
+        "                   kind='kill', target='master')\n"
+        "    events.instant('master_restart')\n"
+        "    events.instant('master_restart', incarnation=2)\n"
+    )
+    proc = _run(str(bad))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "event_schema_violations=2" in proc.stdout, proc.stdout
+    assert "missing required label(s) ['target']" in proc.stdout
+    assert "missing required label(s) ['incarnation']" in proc.stdout
+
+
+def test_lint_enforces_control_wait_retry_label(tmp_path):
+    """A ``control_wait`` span opened as a retry pause must carry the
+    attempt ordinal so retry storms are countable on the timeline."""
+    bad = tmp_path / "bad_retry.py"
+    bad.write_text(
+        "events = None\n"
+        "def f(events):\n"
+        "    events.complete('control_wait', 0.0, 1.0, kind='retry')\n"
+        "    events.complete('control_wait', 0.0, 1.0,\n"
+        "                    kind='retry', retries=3)\n"
+        "    events.span('control_wait', kind='reconnect')\n"
+    )
+    proc = _run(str(bad))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "event_schema_violations=1" in proc.stdout, proc.stdout
+    assert "missing the 'retries' label" in proc.stdout

@@ -116,3 +116,240 @@ class TestNumericChecker:
             "drift", a, {"w": jnp.ones((4,)) * 1.1}
         )
         assert checker.records[-1]["max_rel_err"] > 0.05
+
+
+# --------------------------------------------------------------------------
+# master failover integration: kill+restart the master mid-rendezvous
+# and mid-kv_store_wait; the same two-agent coordinated run must
+# complete with byte-identical final state vs the no-fault run
+# --------------------------------------------------------------------------
+
+import threading
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.constants import RendezvousName
+from dlrover_tpu.common.env import get_free_port
+from dlrover_tpu.master.master import LocalJobMaster
+
+STEPS = 4
+
+
+def _toy_train(addr, rank, gates=None, done=None):
+    """Deterministic 2-rank 'training': per step each rank publishes a
+    gradient to the master KV store and waits (long-poll) for the
+    peer's, then both apply the identical mean update.  The ONLY
+    nondeterminism possible is a lost/duplicated coordination message
+    — exactly what master failover must never cause."""
+    client = MasterClient(addr, node_id=rank)
+    try:
+        if rank == 0:
+            client.report_rdzv_params(2, 2, 60, 1)
+        if gates and ("join", rank) in gates:
+            gates[("join", rank)].wait(timeout=60)
+        client.join_rendezvous(rank, 1)
+        _rnd, _grp, world = client.wait_comm_world(
+            RendezvousName.ELASTIC_TRAINING, rank, timeout=60.0
+        )
+        assert rank in world and len(world) == 2, world
+        state = np.full(8, 0.125, np.float64)
+        for s in range(STEPS):
+            grad = np.sin(state * (s + 1) * (rank + 1))
+            if gates and ("set", s, rank) in gates:
+                gates[("set", s, rank)].wait(timeout=60)
+            client.kv_store_set(f"g/{s}/{rank}", grad.tobytes())
+            other = client.kv_store_wait(
+                f"g/{s}/{1 - rank}", timeout=60.0
+            )
+            peer = np.frombuffer(other, np.float64)
+            state = state + 0.5 * (grad + peer)
+        if done is not None:
+            done[rank] = state.tobytes()
+    finally:
+        client.close()
+
+
+class TestMasterKillMidJob:
+    @pytest.fixture()
+    def brain_env(self, tmp_path, monkeypatch):
+        import dlrover_tpu.master.datastore as ds_mod
+
+        monkeypatch.setenv(
+            "DLROVER_TPU_BRAIN_DB", str(tmp_path / "brain.db")
+        )
+        monkeypatch.setattr(ds_mod, "_default_store", None)
+        yield
+        store = ds_mod._default_store
+        if store is not None:
+            store.close()
+        ds_mod._default_store = None
+
+    @staticmethod
+    def _crash(master):
+        """Simulate a crash: the gRPC server vanishes NOW — no final
+        snapshot, no graceful drain (``stop()`` would compact the
+        journal, which a SIGKILL never does)."""
+        if master.control_journal is not None:
+            master.control_journal.detach()
+            master.control_journal._stopped.set()
+        master._server.stop(grace=0)
+
+    def _run_job(self, port, fault=None):
+        """Run the 2-agent job; ``fault(master) -> master`` is invoked
+        mid-run to kill/replace the master.  Returns both ranks' final
+        state bytes."""
+        master = LocalJobMaster(port, node_num=2)
+        master.prepare()
+        addr = f"127.0.0.1:{port}"
+        gates = fault.gates if fault else {}
+        done = {}
+        threads = [
+            threading.Thread(
+                target=_toy_train,
+                args=(addr, rank, gates, done),
+                daemon=True,
+            )
+            for rank in (0, 1)
+        ]
+        try:
+            for t in threads:
+                t.start()
+            if fault:
+                master = fault.run(master)
+            for t in threads:
+                t.join(timeout=120.0)
+            assert not any(t.is_alive() for t in threads), (
+                "agents wedged (reconnect/re-park failed)"
+            )
+        finally:
+            master.stop()
+        assert set(done) == {0, 1}
+        return done
+
+    def test_kill_master_mid_rendezvous_byte_identical(
+        self, brain_env, tmp_path, monkeypatch
+    ):
+        """Rank 0 joins and parks; the master dies before rank 1 ever
+        joins; the restarted master must resume the SAME round (or the
+        re-asserted join must heal it) and the run's final state must
+        match the no-fault run bit for bit."""
+        reference = self._run_job(get_free_port())
+
+        test = self
+
+        class Fault:
+            def __init__(self):
+                # rank 1 joins only after the replacement master is up
+                self.gates = {("join", 1): threading.Event()}
+
+            def run(self, master):
+                port = master._port
+                # rank 0 has joined once its node is in the waiting set
+                from dlrover_tpu.common.constants import (
+                    RendezvousName as RN,
+                )
+
+                rdzv = master.rdzv_managers[RN.ELASTIC_TRAINING]
+                deadline = time.time() + 30
+                while time.time() < deadline:
+                    if rdzv._waiting_nodes:
+                        break
+                    time.sleep(0.02)
+                assert rdzv._waiting_nodes, "rank 0 never joined"
+                test._crash(master)
+                m2 = LocalJobMaster(port, node_num=2)
+                m2.prepare()
+                assert m2.incarnation == 2
+                self.gates[("join", 1)].set()
+                return m2
+
+        # fresh Brain for the fault run (the fixture db already holds
+        # the reference run's journal under the same job name)
+        import dlrover_tpu.master.datastore as ds_mod
+
+        store = ds_mod._default_store
+        if store is not None:
+            store.close()
+        ds_mod._default_store = None
+        monkeypatch.setenv(
+            "DLROVER_TPU_BRAIN_DB", str(tmp_path / "brain2.db")
+        )
+
+        faulted = self._run_job(get_free_port(), Fault())
+        assert faulted[0] == reference[0]
+        assert faulted[1] == reference[1]
+
+    def test_kill_master_mid_kv_wait_byte_identical(
+        self, brain_env, tmp_path, monkeypatch
+    ):
+        """Rank 0 publishes its step-2 gradient and parks waiting for
+        rank 1's; the master dies mid-wait; rank 1 publishes only to
+        the NEW incarnation.  Both sides must heal (replay or client
+        re-assert) and the final state must be byte-identical."""
+        reference = self._run_job(get_free_port())
+
+        test = self
+
+        class Fault:
+            def __init__(self):
+                self.gates = {("set", 2, 1): threading.Event()}
+
+            def run(self, master):
+                port = master._port
+                # rank 0 parked: its step-2 key is set, rank 1's isn't
+                deadline = time.time() + 30
+                while time.time() < deadline:
+                    if master.kv_store.get("g/2/0"):
+                        break
+                    time.sleep(0.02)
+                assert master.kv_store.get("g/2/0"), (
+                    "rank 0 never reached step 2"
+                )
+                time.sleep(0.3)  # let its kv wait park
+                test._crash(master)
+                m2 = LocalJobMaster(port, node_num=2)
+                m2.prepare()
+                assert m2.incarnation == 2
+                self.gates[("set", 2, 1)].set()
+                return m2
+
+        import dlrover_tpu.master.datastore as ds_mod
+
+        store = ds_mod._default_store
+        if store is not None:
+            store.close()
+        ds_mod._default_store = None
+        monkeypatch.setenv(
+            "DLROVER_TPU_BRAIN_DB", str(tmp_path / "brain2.db")
+        )
+
+        faulted = self._run_job(get_free_port(), Fault())
+        assert faulted[0] == reference[0]
+        assert faulted[1] == reference[1]
+
+    def test_kill_switch_fail_fast_mid_kv_wait(self, monkeypatch):
+        """DLROVER_TPU_MASTER_FAILOVER=0 restores today's behavior
+        exactly: a master death mid-wait raises ConnectionError after
+        max_retry attempts instead of reconnecting."""
+        monkeypatch.setenv("DLROVER_TPU_MASTER_FAILOVER", "0")
+        port = get_free_port()
+        master = LocalJobMaster(port, node_num=1)
+        master.prepare()
+        client = MasterClient(f"127.0.0.1:{port}", node_id=0)
+        errs = []
+
+        def _wait():
+            try:
+                client.kv_store_wait("never/set", timeout=60.0)
+            except (ConnectionError, TimeoutError) as e:
+                errs.append(e)
+
+        t = threading.Thread(target=_wait, daemon=True)
+        t.start()
+        time.sleep(0.4)  # parked on the live master
+        try:
+            master._server.stop(grace=0)
+            t.join(timeout=30.0)
+            assert errs and isinstance(errs[0], ConnectionError)
+        finally:
+            client.close()
+            master.stop()
