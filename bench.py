@@ -557,6 +557,28 @@ def main(argv=None) -> int:
             extras.update(_failover_bench(budget))
         except Exception as e:  # noqa: BLE001
             extras["failover_bench_error"] = str(e)
+        flush_partial(args.out, payload)
+
+        # observatory leg: injected straggler + hang must be named
+        # within the interval bound (scripts/bench_observatory.py
+        # owns the scenario — ONE definition)
+        try:
+            sys.path.insert(
+                0,
+                os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "scripts",
+                ),
+            )
+            from bench_observatory import run_scenario
+
+            scenario = run_scenario(interval=0.4, timeout_s=45.0)
+            extras["observatory"] = scenario
+            extras["observatory_hang_detect_intervals"] = (
+                scenario.get("hang_intervals")
+            )
+        except Exception as e:  # noqa: BLE001
+            extras["observatory_bench_error"] = str(e)
     flush_partial(args.out, payload)
 
     import jax

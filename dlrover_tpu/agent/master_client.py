@@ -726,6 +726,20 @@ class MasterClient:
             msg.TimelineEventsReport(events=list(events))
         )
 
+    def get_job_status(
+        self, job: str = "", conclusions: int = 16
+    ) -> Optional[Dict]:
+        """Fetch the master observatory's derived snapshot (per-node
+        health, goodput ledger, newest diagnosis conclusions); None
+        when the observatory is off (``DLROVER_TPU_OBSERVATORY=0``)
+        or the master predates it."""
+        res = self._channel.get(
+            msg.JobStatusRequest(job=job, conclusions=conclusions)
+        )
+        if res is None or not getattr(res, "available", False):
+            return None
+        return res.status
+
     def get_goodput_ledger(
         self, job: str = "", limit: int = 0
     ) -> Optional[Tuple[Dict, list]]:

@@ -200,17 +200,38 @@ class TimelineReporter(PeriodicReporter):
         super().__init__(client, interval, buffer=buffer)
         self._events_file = events_file
         self._offset = 0
+        #: inode of the file instance ``_offset`` was measured in —
+        #: how a size-based rotation is told apart from ordinary
+        #: growth (the recreated file can regrow PAST the old offset
+        #: between ticks, so size alone cannot detect it)
+        self._ino: Optional[int] = None
         self._max_batch = max_batch
 
     def _read_delta(self):
         """New complete JSONL records past the shipped offset, each
         paired with the file offset consuming it advances to."""
         try:
-            size = os.path.getsize(self._events_file)
+            st = os.stat(self._events_file)
         except OSError:
             return []
-        if size < self._offset:
-            self._offset = 0  # truncated/recreated file
+        size = st.st_size
+        if self._ino is None:
+            self._ino = st.st_ino
+        elif st.st_ino != self._ino:
+            # the path points at a NEW file: a size rotation
+            # (EventLogger moved ours to `.1`) or a fresh run
+            # recreating the path.  On rotation the unshipped tail
+            # lives in the backup — drain it first or up to one
+            # reporter interval of spans (including E records the
+            # master's open-span bookkeeping needs) silently
+            # vanishes from the ledger.
+            tail = self._read_rotated_tail(expect_ino=self._ino)
+            self._ino = st.st_ino
+            self._offset = 0
+            if tail:
+                return tail
+        elif size < self._offset:
+            self._offset = 0  # truncated in place
         if size == self._offset:
             return []
         try:
@@ -240,6 +261,38 @@ class TimelineReporter(PeriodicReporter):
             out[-1] = (out[-1][0], self._offset + cut + 1)
         else:
             self._offset += cut + 1
+        return out
+
+    def _read_rotated_tail(self, expect_ino: int):
+        """Whole-line records past the shipped offset in the rotated
+        backup (``<events_file>.1``), with end offsets pinned to 0 so
+        delivering them leaves the offset at the START of the new
+        live file.  The backup must BE the file instance the offset
+        was measured in (``expect_ino``) — a stale backup from an
+        older run, or the middle file of a double rotation, would
+        ship garbage from a misaligned offset.  Empty when absent,
+        foreign, or fully shipped already."""
+        backup = self._events_file + ".1"
+        try:
+            st = os.stat(backup)
+            if st.st_ino != expect_ino or st.st_size <= self._offset:
+                return []
+            with open(backup, "rb") as f:
+                f.seek(self._offset)
+                chunk = f.read(st.st_size - self._offset)
+        except OSError:
+            return []
+        out = []
+        for line in chunk.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "name" in rec:
+                out.append((rec, 0))
         return out
 
     def _tick(self):

@@ -109,6 +109,55 @@ def datastore_sync_enabled() -> bool:
     )
 
 
+OBSERVATORY_ENV = "DLROVER_TPU_OBSERVATORY"
+EVENTS_MAX_MB_ENV = "DLROVER_TPU_EVENTS_MAX_MB"
+TIMELINE_MAX_AGE_ENV = "DLROVER_TPU_TIMELINE_MAX_AGE_S"
+TIMELINE_MAX_ROWS_ENV = "DLROVER_TPU_TIMELINE_MAX_ROWS"
+
+
+def observatory_enabled() -> bool:
+    """Kill-switch for the master-side observatory: the streaming
+    health-derivation engine (``observability/health.py``), the
+    derived-signal diagnosis operators (straggler / data-stall / hang
+    watchdog), the ``JobStatusRequest`` RPC, the ``--status_port``
+    HTTP endpoints, and the timeline growth bounds (agent JSONL
+    rotation + Brain retention sweep).  ``DLROVER_TPU_OBSERVATORY=0``
+    reproduces today's paths exactly: the private
+    ``DiagnosisDataStore`` chain alone, SpeedMonitor-only hang
+    detection, unbounded timeline growth.  Default: enabled."""
+    return os.getenv(OBSERVATORY_ENV, "1").lower() not in (
+        "0", "false", "off",
+    )
+
+
+def env_float(name: str, default: float) -> float:
+    """Float env knob with a default (malformed values fall back) —
+    the one parser behind every tunable threshold."""
+    try:
+        return float(os.getenv(name, "") or default)
+    except ValueError:
+        return default
+
+
+def events_max_bytes() -> int:
+    """Size-based rotation threshold for the agent-side JSONL events
+    file (0 = never rotate).  Generous default: a week-long job at
+    control-plane event rates stays far below it."""
+    return int(env_float(EVENTS_MAX_MB_ENV, 256.0) * 1024 * 1024)
+
+
+def timeline_max_age_s() -> float:
+    """Brain ``timeline_events`` retention age (rows older than this
+    are swept; 0 = age-unbounded)."""
+    return env_float(TIMELINE_MAX_AGE_ENV, 7 * 24 * 3600.0)
+
+
+def timeline_max_rows() -> int:
+    """Brain ``timeline_events`` per-job row cap (newest rows win;
+    0 = row-unbounded)."""
+    return int(env_float(TIMELINE_MAX_ROWS_ENV, 500_000))
+
+
 MASTER_FAILOVER_ENV = "DLROVER_TPU_MASTER_FAILOVER"
 RECONNECT_DEADLINE_ENV = "DLROVER_TPU_MASTER_RECONNECT_DEADLINE_S"
 SNAPSHOT_INTERVAL_ENV = "DLROVER_TPU_CONTROL_SNAPSHOT_INTERVAL_S"

@@ -549,6 +549,26 @@ class TimelineQueryResponse(Message):
 
 
 @dataclass
+class JobStatusRequest(Message):
+    """Fetch the master observatory's full derived snapshot: per-node
+    health (step-rate/step-time EWMAs, stall shares, straggler scores,
+    hang verdicts), the live goodput ledger, and the newest diagnosis
+    conclusions.  ``scripts/top.py`` and the chaos scenario read this."""
+
+    job: str = ""
+    #: include the newest N diagnosis conclusions (0 = none)
+    conclusions: int = 16
+
+
+@dataclass
+class JobStatusResponse(Message):
+    #: {"health": HealthEngine.snapshot(), "ledger": ...,
+    #:  "conclusions": [...], "speed": {...}, "epoch": {...}}
+    status: Dict = field(default_factory=dict)
+    available: bool = False  # False = observatory off / absent
+
+
+@dataclass
 class BrainQueryRequest(Message):
     """Query the master's durable Brain datastore (speed history /
     node events / measured workloads) — the TPU analog of the Go

@@ -751,6 +751,50 @@ class BrainDatastore:
             return 1, 0
         return int(row[0]), int(row[1])
 
+    def sweep_timeline(
+        self,
+        job: str,
+        max_age_s: Optional[float] = None,
+        max_rows: Optional[int] = None,
+    ):
+        """Retention sweep for ONE job's ``timeline_events`` rows:
+        drop rows older than ``max_age_s`` AND cap the job to the
+        newest ``max_rows`` (0 disables either bound).  Defaults come
+        from ``DLROVER_TPU_TIMELINE_MAX_AGE_S`` /
+        ``DLROVER_TPU_TIMELINE_MAX_ROWS`` (generous: 7 days / 500k
+        rows).  Job-scoped on purpose — a shared multi-job Brain must
+        never lose a neighbour's history to this job's sweep."""
+        from dlrover_tpu.common.env import (
+            timeline_max_age_s,
+            timeline_max_rows,
+        )
+
+        if max_age_s is None:
+            max_age_s = timeline_max_age_s()
+        if max_rows is None:
+            max_rows = timeline_max_rows()
+        self._drain()
+        with self._lock:
+            if max_age_s and max_age_s > 0:
+                self._conn.execute(
+                    "DELETE FROM timeline_events "
+                    "WHERE job = ? AND created_at < ?",
+                    (job, time.time() - max_age_s),
+                )
+            if max_rows and max_rows > 0:
+                # newest rows win: delete everything below the
+                # max_rows-th newest (created_at, wall) position
+                self._conn.execute(
+                    "DELETE FROM timeline_events WHERE job = ? "
+                    "AND rowid NOT IN ("
+                    "  SELECT rowid FROM timeline_events "
+                    "  WHERE job = ? "
+                    "  ORDER BY created_at DESC, wall DESC LIMIT ?"
+                    ")",
+                    (job, job, int(max_rows)),
+                )
+            self._conn.commit()
+
     # ------------------------------------------------------- hygiene
     def prune(self, max_age_s: float, job: Optional[str] = None):
         """Drop rows older than ``max_age_s``; with ``job`` given,

@@ -11,12 +11,16 @@ chip unhealthy (libtpu error strings), preemption notice.
 """
 
 import json
+import os
 import re
 import threading
 import time
 from abc import ABCMeta, abstractmethod
-from dataclasses import dataclass, field
+from collections import deque
+from dataclasses import asdict, dataclass, field
 from typing import Dict, List, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
 
 
 class DiagnosisDataType:
@@ -50,22 +54,37 @@ class InferenceOperator(metaclass=ABCMeta):
 
 
 class DiagnosisDataStore:
-    def __init__(self, window_secs: float = 1800.0):
-        self._data: Dict[str, List[DiagnosisData]] = {}
+    """Windowed diagnosis evidence, bucketed by data type.
+
+    Buckets are ``deque``s bounded BOTH ways: by age (``window_secs``,
+    evicted on every add) and by length (``max_per_type`` via the
+    deque's own ``maxlen``) — high-rate CHIP_METRICS used to pay an
+    O(n) ``list.pop(0)`` per eviction AND could grow without bound
+    inside the window."""
+
+    def __init__(
+        self, window_secs: float = 1800.0, max_per_type: int = 2048
+    ):
+        self._data: Dict[str, "deque[DiagnosisData]"] = {}
         self._window = window_secs
+        self._max_per_type = max(int(max_per_type), 1)
         self._lock = threading.Lock()
 
     def add(self, data: DiagnosisData):
         with self._lock:
-            bucket = self._data.setdefault(data.data_type, [])
+            bucket = self._data.get(data.data_type)
+            if bucket is None:
+                bucket = self._data[data.data_type] = deque(
+                    maxlen=self._max_per_type
+                )
             bucket.append(data)
             horizon = time.time() - self._window
             while bucket and bucket[0].timestamp < horizon:
-                bucket.pop(0)
+                bucket.popleft()
 
     def get(self, data_type: str) -> List[DiagnosisData]:
         with self._lock:
-            return list(self._data.get(data_type, []))
+            return list(self._data.get(data_type, ()))
 
 
 class OomOperator(InferenceOperator):
@@ -230,6 +249,97 @@ class GemmRegressionOperator(InferenceOperator):
         return results
 
 
+class StragglerOperator(InferenceOperator):
+    """Relative straggler verdicts from the observatory's streaming
+    step-time EWMAs (``observability/health.py``): a node whose EWMA
+    exceeds the across-node median by the engine's ratio is concluded
+    a straggler.  Replaces nothing — per-STEP timing at the master was
+    simply never derived before; the network-check manager only sees
+    the pre-flight rounds."""
+
+    def __init__(self, health_engine):
+        self._health = health_engine
+
+    def infer(self, store: "DiagnosisDataStore") -> List[Inference]:
+        del store  # derived from the timeline, not the evidence store
+        return [
+            Inference(
+                problem="straggler",
+                cause=(
+                    f"step time x{score:.2f} vs across-node median "
+                    f"(ratio {self._health.straggler_ratio:.2f})"
+                ),
+                action="none",
+                node_rank=node,
+            )
+            for node, score in self._health.stragglers()
+        ]
+
+
+class DataStallOperator(InferenceOperator):
+    """Chronic input starvation from the goodput ledger's
+    ``data_stall`` spans: when a node's windowed stall share (by
+    stage) passes ``share_threshold``, conclude the stage that
+    stalls.  The ledger already proved the share is pure loss —
+    this operator just names the node and the stage."""
+
+    def __init__(self, health_engine, share_threshold: float = 0.3):
+        self._health = health_engine
+        self._threshold = share_threshold
+
+    def infer(self, store: "DiagnosisDataStore") -> List[Inference]:
+        del store
+        results = []
+        for node, shares in self._health.stall_shares().items():
+            stage, share = max(
+                shares.items(), key=lambda kv: kv[1]
+            )
+            if share < self._threshold:
+                continue
+            results.append(
+                Inference(
+                    problem="data_stall",
+                    cause=(
+                        f"{stage} stall share {share:.0%} of the "
+                        f"window (threshold "
+                        f"{self._threshold:.0%})"
+                    ),
+                    action="none",
+                    node_rank=node,
+                )
+            )
+        return results
+
+
+class HangWatchdogOperator(InferenceOperator):
+    """Per-node hang via the observatory's span-heartbeat watchdog:
+    a node whose agent still heartbeats but whose processes emitted
+    no timeline event for the watchdog window is concluded hung.
+    Unlike :class:`HangOperator` this needs no ``GlobalStep``
+    reports, and it NAMES the wedged node — the global step keeps
+    advancing while one rank hangs in a collective, which is exactly
+    the case the SpeedMonitor cannot see."""
+
+    def __init__(self, health_engine):
+        self._health = health_engine
+
+    def infer(self, store: "DiagnosisDataStore") -> List[Inference]:
+        del store
+        return [
+            Inference(
+                problem="hang",
+                cause=(
+                    f"no timeline event for {silence:.0f}s "
+                    f"(watchdog {self._health.hang_watchdog_s:.0f}s)"
+                    " while the node is otherwise alive"
+                ),
+                action="restart_process",
+                node_rank=node,
+            )
+            for node, silence in self._health.hang_suspects()
+        ]
+
+
 class InferenceChain:
     def __init__(self, operators: List[InferenceOperator]):
         self._operators = operators
@@ -241,17 +351,37 @@ class InferenceChain:
         return conclusions
 
 
+#: cadence of the background diagnose loop (env-overridable so the
+#: chaos scenario and tests can run many intervals in seconds)
+DIAGNOSIS_INTERVAL_ENV = "DLROVER_TPU_DIAGNOSIS_INTERVAL_S"
+
+
 class DiagnosisManager:
     def __init__(
         self,
         speed_monitor=None,
         operators: Optional[List[InferenceOperator]] = None,
-        interval: float = 60.0,
+        interval: Optional[float] = None,
         conclusion_cooldown: float = 600.0,
+        health_engine=None,
+        datastore=None,
+        job: str = "",
     ):
+        """With a ``health_engine`` (the observatory is on) the chain
+        sits ON TOP of the streaming derivations: straggler /
+        data-stall / per-node hang operators join the log-pattern
+        operators, and the SpeedMonitor hang rule is subsumed by the
+        span-heartbeat watchdog.  Conclusions are then recorded as
+        ``diagnosis`` instants on the timeline and persisted to the
+        Brain ``node_events`` table (``datastore``) so they survive
+        master failover.  Without an engine the manager is exactly
+        the pre-observatory one."""
         self.store = DiagnosisDataStore()
         self._cooldown = conclusion_cooldown
         self._emitted: Dict = {}
+        self._health = health_engine
+        self._datastore = datastore
+        self._job = job or os.getenv("DLROVER_TPU_JOB_NAME", "default")
         if operators is None:
             operators = [
                 OomOperator(),
@@ -259,17 +389,71 @@ class DiagnosisManager:
                 PreemptionOperator(),
                 GemmRegressionOperator(),
             ]
+            if health_engine is not None:
+                operators.extend(
+                    [
+                        StragglerOperator(health_engine),
+                        DataStallOperator(health_engine),
+                        HangWatchdogOperator(health_engine),
+                    ]
+                )
             if speed_monitor is not None:
+                # the whole-job stagnation rule stays EVEN WITH the
+                # watchdog: the two see different failure shapes (the
+                # watchdog names a silent node; this one catches a
+                # job whose every node idles inside open spans), and
+                # their conclusion keys differ so the cooldown dedupe
+                # keeps them from stacking restarts
                 operators.append(HangOperator(speed_monitor))
         self.chain = InferenceChain(operators)
+        if interval is None:
+            from dlrover_tpu.common.env import env_float
+
+            interval = env_float(DIAGNOSIS_INTERVAL_ENV, 60.0)
         self._interval = interval
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._conclusions: List[Inference] = []
+        #: newest conclusions kept for the status snapshot (NOT
+        #: consumed by take_conclusions, which feeds the node manager)
+        self._recent: "deque[dict]" = deque(maxlen=64)
         self._lock = threading.Lock()
 
     def collect_data(self, data: DiagnosisData):
         self.store.add(data)
+
+    def _record_conclusion(self, c: Inference, now: float):
+        """One fresh conclusion onto the timeline (``diagnosis``
+        instant) and into the Brain sqlite — the observatory's audit
+        trail survives master failover.  Best-effort: recording must
+        never block or break the diagnose loop."""
+        if self._health is None:
+            return  # observatory off: today's (unrecorded) behavior
+        from dlrover_tpu.observability.events import get_event_logger
+
+        try:
+            get_event_logger().instant(
+                "diagnosis",
+                problem=c.problem,
+                action=c.action,
+                node_rank=c.node_rank,
+                cause=c.cause,
+            )
+        except Exception as e:  # noqa: BLE001
+            logger.warning("diagnosis instant emit failed: %s", e)
+        if self._datastore is not None:
+            try:
+                self._datastore.record_node_event(
+                    self._job,
+                    str(c.node_rank),
+                    "diagnosis",
+                    json.dumps(
+                        {**asdict(c), "t": now},
+                        separators=(",", ":"),
+                    ),
+                )
+            except Exception as e:  # noqa: BLE001
+                logger.warning("diagnosis persist failed: %s", e)
 
     def diagnose(self) -> List[Inference]:
         """Run the chain, de-duplicating conclusions: the same
@@ -287,8 +471,19 @@ class DiagnosisManager:
                     continue
                 self._emitted[key] = now
                 fresh.append(c)
+                self._recent.append({**asdict(c), "t": now})
             self._conclusions.extend(fresh)
+        for c in fresh:
+            self._record_conclusion(c, now)
         return fresh
+
+    def recent_conclusions(self, limit: int = 16) -> List[dict]:
+        """Newest de-duplicated conclusions (not consumed — the
+        status snapshot's view; ``take_conclusions`` still owns the
+        apply-exactly-once contract)."""
+        with self._lock:
+            out = list(self._recent)
+        return out[-limit:] if limit else out
 
     def take_conclusions(self) -> List[Inference]:
         """Consume pending conclusions (applied exactly once)."""

@@ -26,6 +26,12 @@ def parse_master_args(argv=None):
         "history, strategy calibration, node events survive master "
         "restarts); also via $DLROVER_TPU_BRAIN_DB",
     )
+    parser.add_argument(
+        "--status_port", type=int, default=None,
+        help="serve plain-HTTP /metrics (Prometheus text) + /status "
+        "(observatory JSON snapshot) on this port (0 = pick a free "
+        "one; omit = off).  Also via $DLROVER_TPU_STATUS_PORT.",
+    )
     return parser.parse_args(argv)
 
 
@@ -40,6 +46,8 @@ def run(args) -> int:
 
     if args.brain_db:
         os.environ["DLROVER_TPU_BRAIN_DB"] = args.brain_db
+    if args.status_port is not None:
+        os.environ["DLROVER_TPU_STATUS_PORT"] = str(args.status_port)
     os.environ.setdefault("DLROVER_TPU_JOB_NAME", args.job_name)
 
     port = args.port or get_free_port()
@@ -66,6 +74,12 @@ def run(args) -> int:
     logger.info("job %s master listening on %s", args.job_name,
                 master.addr)
     print(f"DLROVER_TPU_MASTER_ADDR={master.addr}", flush=True)
+    if master.status_server is not None:
+        # the BOUND port (a requested 0 resolves here)
+        print(
+            f"DLROVER_TPU_STATUS_PORT={master.status_server.port}",
+            flush=True,
+        )
     return master.run()
 
 

@@ -43,19 +43,34 @@ class JobMaster:
                  job_manager=None, diagnosis_manager=None):
         import os
 
+        from dlrover_tpu.common.env import observatory_enabled
         from dlrover_tpu.master.datastore import get_default_datastore
         from dlrover_tpu.observability.events import TimelineAggregator
         from dlrover_tpu.observability.metrics import get_registry
 
         self._job_name = os.getenv("DLROVER_TPU_JOB_NAME", "default")
         self.speed_monitor = SpeedMonitor()
+        # the observatory: streaming per-node health derivations over
+        # the incoming timeline batches + agent reports.  None under
+        # the DLROVER_TPU_OBSERVATORY=0 kill-switch — every consumer
+        # (diagnosis operators, JobStatusRequest, status server,
+        # gauges) degrades to the pre-observatory behavior exactly.
+        self.health_engine = None
+        if observatory_enabled():
+            from dlrover_tpu.observability.health import HealthEngine
+
+            self.health_engine = HealthEngine(
+                job=self._job_name, registry=get_registry()
+            )
         # unified job-event timeline: per-node streams merge here, the
         # goodput ledger is served live (get-RPC + exporter gauges) and
-        # durably (sqlite datastore when configured)
+        # durably (sqlite datastore when configured); the health
+        # engine taps every accepted batch
         self.timeline_aggregator = TimelineAggregator(
             job=self._job_name,
             registry=get_registry(),
             datastore=get_default_datastore(),
+            health=self.health_engine,
         )
         self.task_manager = TaskManager(speed_monitor=self.speed_monitor)
         self.rdzv_managers = {
@@ -68,10 +83,19 @@ class JobMaster:
         if diagnosis_manager is None:
             from dlrover_tpu.master.diagnosis import DiagnosisManager
 
+            # with the observatory on, the chain sits on top of the
+            # streaming derivations (straggler / data-stall / hang
+            # watchdog operators) and records conclusions to the
+            # timeline + Brain; off, it is exactly the old manager
             diagnosis_manager = DiagnosisManager(
-                speed_monitor=self.speed_monitor
+                speed_monitor=self.speed_monitor,
+                health_engine=self.health_engine,
+                datastore=get_default_datastore(),
+                job=self._job_name,
             )
         self.diagnosis_manager = diagnosis_manager
+        #: plain-HTTP /metrics + /status (off unless --status_port)
+        self.status_server = None
         self.speed_monitor.set_target_worker_num(node_num)
         self._node_num = node_num
         self._port = port
@@ -153,16 +177,68 @@ class JobMaster:
             kv_store=self.kv_store,
             diagnosis_manager=self.diagnosis_manager,
             timeline_aggregator=self.timeline_aggregator,
+            health_engine=self.health_engine,
             job_epoch=self.job_epoch,
             incarnation=self.incarnation,
         )
+        self._servicer = servicer
         self._server = create_master_service(self._port, servicer)
         self._server.start()
         self.task_manager.start()
         self.job_manager.start()
         if self.diagnosis_manager:
             self.diagnosis_manager.start()
+        self._start_status_server(servicer)
         logger.info("master serving on port %s", self._port)
+
+    def _start_status_server(self, servicer):
+        """Plain-HTTP ``/metrics`` (Prometheus text) + ``/status``
+        (the JobStatusRequest snapshot as JSON).  Off by default:
+        needs ``--status_port`` (``DLROVER_TPU_STATUS_PORT``) AND the
+        observatory on."""
+        import os
+
+        raw = os.getenv("DLROVER_TPU_STATUS_PORT", "")
+        if not raw:
+            return
+        try:
+            port = int(raw)
+        except ValueError:
+            logger.warning(
+                "ignoring malformed DLROVER_TPU_STATUS_PORT=%r", raw
+            )
+            return
+        if port < 0:
+            return
+        if self.health_engine is None:
+            logger.info(
+                "status port requested but observatory is off "
+                "(DLROVER_TPU_OBSERVATORY=0); not serving"
+            )
+            return
+        from dlrover_tpu.common import messages as msg
+        from dlrover_tpu.observability.metrics import get_registry
+        from dlrover_tpu.observability.status_server import (
+            StatusServer,
+        )
+
+        def _snapshot():
+            res = servicer._job_status(msg.JobStatusRequest())
+            return res.status if res.available else {}
+
+        self.status_server = StatusServer(
+            port,
+            registry=get_registry(),
+            snapshot_fn=_snapshot,
+            health_engine=self.health_engine,
+        )
+        try:
+            self.status_server.start()
+        except OSError as e:
+            logger.warning(
+                "status server failed to bind :%d: %s", port, e
+            )
+            self.status_server = None
 
     def process_diagnosis(self):
         """Feed inference-chain conclusions to the job manager (run
@@ -190,6 +266,9 @@ class JobMaster:
         self.job_manager.stop()
         if self.diagnosis_manager:
             self.diagnosis_manager.stop()
+        if self.status_server is not None:
+            self.status_server.stop()
+            self.status_server = None
         if self._server:
             self._server.stop(grace=0.5)
 

@@ -40,6 +40,7 @@ class MasterServicer:
         diagnosis_manager=None,
         sync_service=None,
         timeline_aggregator=None,
+        health_engine=None,
         job_epoch: int = 0,
         incarnation: int = 0,
     ):
@@ -58,6 +59,10 @@ class MasterServicer:
         self._diagnosis_manager = diagnosis_manager
         self._sync_service = sync_service
         self._timeline_aggregator = timeline_aggregator
+        #: the observatory's streaming derivation engine (None =
+        #: DLROVER_TPU_OBSERVATORY=0 or a pre-observatory master);
+        #: heartbeats / steps / failures / resource reports tap it
+        self._health_engine = health_engine
         self._start_training_time = 0.0
         #: lifetime RPC tally (gets + reports, batched items counted
         #: once per envelope) — the bench's server-side ground truth
@@ -194,8 +199,44 @@ class MasterServicer:
             return self._brain_query(request)
         if isinstance(request, msg.TimelineQueryRequest):
             return self._timeline_query(request)
+        if isinstance(request, msg.JobStatusRequest):
+            return self._job_status(request)
         logger.warning("unhandled get request: %r", request)
         return None
+
+    def _job_status(
+        self, request: msg.JobStatusRequest
+    ) -> msg.JobStatusResponse:
+        """The observatory snapshot: streaming health derivations +
+        the live goodput ledger + the newest diagnosis conclusions.
+        ``available=False`` when the observatory is off (kill-switch)
+        — the pre-observatory master had no such surface."""
+        if self._health_engine is None:
+            return msg.JobStatusResponse(available=False)
+        status = {"health": self._health_engine.snapshot()}
+        if self._timeline_aggregator is not None:
+            try:
+                status["ledger"] = self._timeline_aggregator.ledger()
+            except Exception as e:  # noqa: BLE001 - partial status beats none
+                logger.warning("status ledger failed: %s", e)
+        if self._diagnosis_manager is not None and hasattr(
+            self._diagnosis_manager, "recent_conclusions"
+        ):
+            status["conclusions"] = (
+                self._diagnosis_manager.recent_conclusions(
+                    getattr(request, "conclusions", 16)
+                )
+            )
+        if self._speed_monitor is not None:
+            status["speed"] = {
+                "global_step": self._speed_monitor.completed_global_step,
+                "records_per_sec": self._speed_monitor.running_speed(),
+            }
+        status["epoch"] = {
+            "job_epoch": self.job_epoch,
+            "incarnation": self.incarnation,
+        }
+        return msg.JobStatusResponse(status=status, available=True)
 
     def _timeline_query(
         self, request: msg.TimelineQueryRequest
@@ -420,11 +461,21 @@ class MasterServicer:
                     request.memory_mb,
                     request.tpu_stats,
                 )
+            if self._health_engine is not None:
+                self._health_engine.observe_resource(
+                    node_id, request.cpu_percent, request.memory_mb
+                )
             return True
         if isinstance(request, msg.GlobalStep):
             if self._speed_monitor:
                 self._speed_monitor.collect_global_step(
                     request.step, request.timestamp or time.time()
+                )
+            if self._health_engine is not None:
+                self._health_engine.observe_step(
+                    node_id,
+                    request.step,
+                    request.timestamp or time.time(),
                 )
             return True
         if isinstance(request, msg.NodeAddress):
@@ -466,6 +517,10 @@ class MasterServicer:
                     request.error_data,
                     request.level,
                 )
+            if self._health_engine is not None:
+                self._health_engine.observe_fault(
+                    node_id, request.level
+                )
             return True
         if isinstance(request, msg.RendezvousParams):
             for manager in self._rdzv_managers.values():
@@ -487,6 +542,10 @@ class MasterServicer:
             if self._job_manager:
                 self._job_manager.collect_node_heartbeat(
                     node_type, node_id, request.timestamp or time.time()
+                )
+            if self._health_engine is not None:
+                self._health_engine.observe_heartbeat(
+                    node_id, request.timestamp or time.time()
                 )
             return True
         if isinstance(request, msg.NodeCheckpointState):

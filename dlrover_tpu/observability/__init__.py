@@ -10,7 +10,11 @@ from dlrover_tpu.observability.metrics import (  # noqa: F401
     MetricsExporter,
     MetricsRegistry,
 )
+from dlrover_tpu.observability.health import HealthEngine  # noqa: F401
 from dlrover_tpu.observability.profiler import AProfiler  # noqa: F401
+from dlrover_tpu.observability.status_server import (  # noqa: F401
+    StatusServer,
+)
 from dlrover_tpu.observability.hlo_census import (  # noqa: F401
     census_report,
     gemm_census,

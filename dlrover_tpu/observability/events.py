@@ -139,6 +139,10 @@ UNATTRIBUTED = "unattributed"
 #: injected fault and the recovery it provokes share one trace;
 #: ``master_restart`` marks a master incarnation replaying its
 #: journal+snapshot back to serving state.
+#: ``diagnosis`` marks one fresh inference-chain conclusion (the
+#: observatory's DiagnosisManager): the problem, the recovery action
+#: and the node it names — the trace shows the verdict next to the
+#: evidence that produced it.
 INSTANT_EVENTS = frozenset(
     {
         "preemption_signal",
@@ -147,6 +151,7 @@ INSTANT_EVENTS = frozenset(
         "worker_kill",
         "fault_injected",
         "master_restart",
+        "diagnosis",
     }
 )
 
@@ -157,6 +162,9 @@ INSTANT_EVENTS = frozenset(
 REQUIRED_INSTANT_LABELS: Dict[str, Tuple[str, ...]] = {
     "fault_injected": ("kind", "target"),
     "master_restart": ("incarnation",),
+    # an anonymous conclusion is useless to the operator reading the
+    # trace AND to scripts/top.py's conclusions pane
+    "diagnosis": ("problem", "action", "node_rank"),
 }
 
 #: Labels an emit SITE must pass explicitly (beyond the automatic
@@ -228,6 +236,53 @@ class EventLogger:
         self._sid = 0
         # per-(thread, phase) open-span stack for begin/end pairing
         self._open: Dict[Tuple[int, str], List[dict]] = {}
+        #: emits since the last rotation check (the size stat is not
+        #: paid per line)
+        self._emits_since_check = 0
+
+    #: how many emitted lines between size checks for rotation
+    ROTATE_CHECK_EVERY = 128
+
+    def _maybe_rotate_locked(self):
+        """Size-based rotation of the JSONL file (caller holds the
+        lock, fd is open).  One ``.1`` backup is kept; the agent's
+        ``TimelineReporter`` treats the recreated (smaller) file as a
+        truncation and restarts its tail offset at 0.  Multi-writer
+        safe: a writer whose fd no longer matches the path (someone
+        else already rotated) just follows to the new file instead of
+        rotating the fresh file away."""
+        from dlrover_tpu.common.env import (
+            events_max_bytes,
+            observatory_enabled,
+        )
+
+        if not observatory_enabled():
+            return  # kill-switch: unbounded growth, exactly as before
+        max_bytes = events_max_bytes()
+        if max_bytes <= 0:
+            return
+        try:
+            st_fd = os.fstat(self._fd)
+            try:
+                st_path = os.stat(self._path)
+            except FileNotFoundError:
+                st_path = None
+            if st_path is None or st_path.st_ino != st_fd.st_ino:
+                # rotated (or unlinked) under us: reopen on next emit
+                os.close(self._fd)
+                self._fd = None
+                return
+            if st_path.st_size < max_bytes:
+                return
+            os.close(self._fd)
+            self._fd = None
+            os.replace(self._path, self._path + ".1")
+            logger.info(
+                "rotated events file %s (%d bytes > %d)",
+                self._path, st_path.st_size, max_bytes,
+            )
+        except OSError as e:
+            logger.warning("events rotation failed: %s", e)
 
     @property
     def enabled(self) -> bool:
@@ -279,6 +334,13 @@ class EventLogger:
                         0o644,
                     )
                 os.write(self._fd, line.encode())
+                self._emits_since_check += 1
+                if (
+                    self._emits_since_check
+                    >= self.ROTATE_CHECK_EVERY
+                ):
+                    self._emits_since_check = 0
+                    self._maybe_rotate_locked()
             except OSError as e:
                 logger.warning("event emit failed: %s", e)
 
@@ -688,16 +750,28 @@ class TimelineAggregator:
     #: gauge refresh cadence: the ledger sweep is O(ring log ring),
     #: so it must not run on every node's report RPC
     GAUGE_REFRESH_S = 5.0
+    #: Brain timeline_events retention sweep cadence (age/row-cap;
+    #: the sweep itself lives in the datastore)
+    RETENTION_SWEEP_S = 300.0
 
-    def __init__(self, job: str = "", registry=None, datastore=None):
+    def __init__(
+        self, job: str = "", registry=None, datastore=None,
+        health=None,
+    ):
+        """``health``: an ``observability.health.HealthEngine`` — the
+        observatory's streaming tap; every accepted batch is forwarded
+        so per-node derivations update at report rate (None = no
+        observatory, today's behavior)."""
         self._job = job or os.getenv(
             "DLROVER_TPU_JOB_NAME", "default"
         )
         self._registry = registry
         self._datastore = datastore
+        self._health = health
         self._events: List[dict] = []
         self._lock = threading.Lock()
         self._last_gauge_refresh = 0.0
+        self._last_retention_sweep = time.monotonic()
 
     @property
     def job(self) -> str:
@@ -723,6 +797,12 @@ class TimelineAggregator:
                 )
             except Exception as e:  # noqa: BLE001 - durability is best-effort
                 logger.warning("timeline persist failed: %s", e)
+            self._maybe_sweep_retention()
+        if self._health is not None and accepted:
+            try:
+                self._health.observe_events(node_id, accepted)
+            except Exception as e:  # noqa: BLE001 - derivations are best-effort
+                logger.warning("health derivation failed: %s", e)
         if accepted:
             now = time.monotonic()
             if (
@@ -732,6 +812,24 @@ class TimelineAggregator:
                 self._last_gauge_refresh = now
                 self._refresh_gauges()
         return len(accepted)
+
+    def _maybe_sweep_retention(self):
+        """Throttled Brain ``timeline_events`` retention sweep — the
+        durable timeline must not grow without bound on a week-long
+        job (behind the observatory kill-switch like the rest of the
+        growth bounds)."""
+        from dlrover_tpu.common.env import observatory_enabled
+
+        if not observatory_enabled():
+            return
+        now = time.monotonic()
+        if now - self._last_retention_sweep < self.RETENTION_SWEEP_S:
+            return
+        self._last_retention_sweep = now
+        try:
+            self._datastore.sweep_timeline(self._job)
+        except Exception as e:  # noqa: BLE001 - hygiene is best-effort
+            logger.warning("timeline retention sweep failed: %s", e)
 
     def events(self, limit: int = 0) -> List[dict]:
         with self._lock:

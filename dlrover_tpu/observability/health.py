@@ -1,0 +1,587 @@
+"""The job observatory: streaming health derivation from the timeline.
+
+PR-1 gave every process a structured event timeline and PR-5 batched
+the agent->master reporting path — but nothing consumed them *live*:
+the only way to see a running job was to export a Perfetto trace after
+the fact, and ``master/diagnosis.py`` ran on its own isolated
+``DiagnosisDataStore`` that almost nobody fed.  This module is the
+missing consumer (the role the reference splits between
+``DiagnosisManager``/``InferenceChain`` and xpu_timer's live kernel
+watch): the master streams incoming timeline batches and agent
+reports through a :class:`HealthEngine` that maintains rolling
+per-node derivations —
+
+- **step-rate and step-time EWMAs** from ``step`` spans (per node, on
+  the span's own ``dur``, so a slow rank is visible even while the
+  *global* step — the max over ranks the SpeedMonitor sees — still
+  advances);
+- **data-stall share by stage** (``host_fetch`` / ``h2d``) over a
+  rolling window, from the same ``data_stall`` spans the goodput
+  ledger charges;
+- **restart / fault counts** from ``restart`` spans and
+  ``fault_injected`` instants plus the servicer's ``NodeFailure``
+  reports;
+- a **relative straggler score**: each node's step-time EWMA over the
+  across-node median, flagged past ``DLROVER_TPU_STRAGGLER_RATIO``
+  (the xpu_timer "one chip is slow" signal, derived from spans
+  instead of kernel interposition);
+- a **span-heartbeat hang watchdog**: a node whose agent still
+  heartbeats but whose processes have emitted *no timeline event* for
+  ``DLROVER_TPU_HANG_WATCHDOG_S`` is flagged hung.  This works when
+  the SpeedMonitor sees no steps at all (it needs ``GlobalStep``
+  reports, and the global step keeps moving while one rank wedges in
+  a collective); a node attributably busy inside an *open* non-step
+  span (a long compile or restore emitted its ``B`` record) is NOT
+  flagged — the ledger already charges that time.
+
+``DiagnosisManager`` sits on top of these derivations through the
+``StragglerOperator`` / ``DataStallOperator`` / ``HangWatchdogOperator``
+in ``master/diagnosis.py``; the full derived snapshot is served by the
+``JobStatusRequest`` RPC, the ``--status_port`` HTTP endpoints
+(``observability/status_server.py``) and ``scripts/top.py``.  Gauges
+``dlrover_tpu_node_health{node}`` / ``dlrover_tpu_straggler_score{node}``
+mirror the snapshot for Prometheus.  Everything here is behind the
+``DLROVER_TPU_OBSERVATORY=0`` kill-switch (the master simply never
+constructs an engine).
+"""
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+from dlrover_tpu.common.env import env_float
+from dlrover_tpu.common.log import default_logger as logger
+
+#: a node whose step-time EWMA exceeds the across-node median by this
+#: ratio is a straggler (reference: the network-check manager's 2x
+#: round-time rule; xpu_timer flags slow kernels the same way)
+STRAGGLER_RATIO_ENV = "DLROVER_TPU_STRAGGLER_RATIO"
+#: span-heartbeat watchdog: seconds of total timeline silence from a
+#: still-heartbeating node before it is flagged hung
+HANG_WATCHDOG_ENV = "DLROVER_TPU_HANG_WATCHDOG_S"
+#: rolling derivation window (stall shares, step rates)
+HEALTH_WINDOW_ENV = "DLROVER_TPU_HEALTH_WINDOW_S"
+
+#: health gauge encoding (dlrover_tpu_node_health{node=...})
+HEALTH_OK = 1.0
+HEALTH_STRAGGLER = 0.5
+HEALTH_STALLED = 0.4
+HEALTH_HUNG = 0.0
+
+#: snapshot status strings, worst wins
+STATUS_OK = "healthy"
+STATUS_STRAGGLER = "straggler"
+STATUS_STALLED = "data_stalled"
+STATUS_HUNG = "hung"
+
+
+class _NodeState:
+    """Mutable per-node rolling state (guarded by the engine lock)."""
+
+    __slots__ = (
+        "node",
+        "step_time_ewma",
+        "step_rate_ewma",
+        "steps_seen",
+        "last_step",
+        "last_step_wall",
+        "step_walls",
+        "stall_windows",
+        "restarts",
+        "faults",
+        "incarnation",
+        "last_event_wall",
+        "last_event_seen",
+        "last_heartbeat",
+        "open_spans",
+        "rss_mb",
+        "cpu_percent",
+    )
+
+    def __init__(self, node: int):
+        self.node = node
+        self.step_time_ewma = 0.0
+        self.step_rate_ewma = 0.0
+        self.steps_seen = 0
+        self.last_step = -1
+        self.last_step_wall = 0.0
+        #: recent step-end walls for windowed rate
+        self.step_walls: Deque[float] = deque(maxlen=256)
+        #: stage -> deque[(end_wall, dur)] for windowed stall share
+        self.stall_windows: Dict[str, Deque[Tuple[float, float]]] = {}
+        self.restarts = 0
+        self.faults = 0
+        self.incarnation = 0
+        #: newest event wall clock from this node (the span heartbeat)
+        self.last_event_wall = 0.0
+        #: master-local monotonic time the newest event ARRIVED — the
+        #: watchdog compares against this, not the event's own wall,
+        #: so a node-side clock skew cannot fake (or mask) a hang
+        self.last_event_seen = 0.0
+        self.last_heartbeat = 0.0
+        #: (pid, name) -> (open B count, mono of the newest B) —
+        #: suppresses the watchdog while the node is attributably
+        #: busy in a long non-step phase that only emits B now and E
+        #: much later.  The mono bounds the suppression: a B whose E
+        #: never arrives (crashed writer, dropped batch) must not
+        #: disarm hang detection forever.
+        self.open_spans: Dict[Tuple[int, str], Tuple[int, float]] = {}
+        self.rss_mb = 0.0
+        self.cpu_percent = 0.0
+
+
+class HealthEngine:
+    """Streaming per-node/per-phase derivations over the live job.
+
+    Fed by the master's report dispatch: ``observe_events`` taps the
+    ``TimelineAggregator`` (every ``TimelineEventsReport`` batch, so
+    the PR-5 ``BatchedReport`` path feeds it for free),
+    ``observe_heartbeat`` / ``observe_step`` / ``observe_fault`` /
+    ``observe_resource`` tap the corresponding report messages in the
+    servicer.  All methods are thread-safe and O(batch) — the report
+    RPC path pays a dict update, never a sweep; the sweeps happen in
+    ``snapshot()`` / the throttled gauge refresh.
+    """
+
+    #: EWMA smoothing for step time/rate (per new step span)
+    EWMA_ALPHA = 0.3
+    #: a node must complete this many steps before its EWMA can brand
+    #: it a straggler — one cold first step is not a verdict
+    MIN_STEPS_FOR_STRAGGLER = 3
+    #: gauge refresh throttle (the sweep is O(nodes))
+    GAUGE_REFRESH_S = 5.0
+    #: a heartbeat older than this no longer proves the node alive
+    #: (the job manager's dead-node monitor owns that case)
+    HEARTBEAT_FRESH_S = 90.0
+
+    def __init__(
+        self,
+        job: str = "",
+        registry=None,
+        straggler_ratio: Optional[float] = None,
+        hang_watchdog_s: Optional[float] = None,
+        window_s: Optional[float] = None,
+    ):
+        self._job = job or os.getenv("DLROVER_TPU_JOB_NAME", "default")
+        self._registry = registry
+        self.straggler_ratio = (
+            straggler_ratio
+            if straggler_ratio is not None
+            else env_float(STRAGGLER_RATIO_ENV, 1.5)
+        )
+        self.hang_watchdog_s = (
+            hang_watchdog_s
+            if hang_watchdog_s is not None
+            else env_float(HANG_WATCHDOG_ENV, 60.0)
+        )
+        self.window_s = (
+            window_s
+            if window_s is not None
+            else env_float(HEALTH_WINDOW_ENV, 600.0)
+        )
+        self._nodes: Dict[int, _NodeState] = {}
+        self._lock = threading.Lock()
+        self._last_gauge_refresh = 0.0
+        #: monotonic instant the engine started observing — a node is
+        #: only hang-eligible after it produced at least one event
+        self._t0 = time.monotonic()
+
+    @property
+    def job(self) -> str:
+        return self._job
+
+    # ----------------------------------------------------------- ingest
+    def _state(self, node: int) -> _NodeState:
+        state = self._nodes.get(node)
+        if state is None:
+            state = self._nodes[node] = _NodeState(node)
+        return state
+
+    def observe_events(self, node_id: int, events: List[dict]):
+        """Tap for one node's timeline batch (call with the SAME
+        accepted list the ``TimelineAggregator`` merged)."""
+        now_mono = time.monotonic()
+        with self._lock:
+            for e in events:
+                if not isinstance(e, dict):
+                    continue
+                node = int(e.get("node", node_id) or 0)
+                state = self._state(node)
+                wall = float(e.get("wall", 0.0) or 0.0)
+                if wall > state.last_event_wall:
+                    state.last_event_wall = wall
+                state.last_event_seen = now_mono
+                inc = int(e.get("inc", 0) or 0)
+                if inc > state.incarnation:
+                    state.incarnation = inc
+                    # the restart replaced this node's processes: any
+                    # B the dead incarnation never closed must not
+                    # keep suppressing the watchdog
+                    state.open_spans.clear()
+                name = e.get("name", "")
+                ph = e.get("ph", "")
+                if ph == "B":
+                    key = (int(e.get("pid", 0) or 0), name)
+                    count, _opened = state.open_spans.get(
+                        key, (0, now_mono)
+                    )
+                    state.open_spans[key] = (count + 1, now_mono)
+                elif ph == "E":
+                    key = (int(e.get("pid", 0) or 0), name)
+                    count, opened = state.open_spans.get(
+                        key, (0, now_mono)
+                    )
+                    if count > 1:
+                        state.open_spans[key] = (count - 1, opened)
+                    else:
+                        state.open_spans.pop(key, None)
+                if name == "step":
+                    self._observe_step_span(state, e, wall)
+                elif name == "data_stall":
+                    self._observe_stall_span(state, e, wall)
+                elif name == "restart" and ph in ("B", "X"):
+                    state.restarts += 1
+                elif name == "fault_injected" and ph == "i":
+                    state.faults += 1
+        self._maybe_refresh_gauges()
+
+    def _observe_step_span(self, state: _NodeState, e: dict, wall: float):
+        """One ``step`` span: the X record carries ``dur``; B/E pairs
+        are folded at the E (ends are what mark progress)."""
+        ph = e.get("ph")
+        dur = e.get("dur")
+        if ph == "X" and dur is not None:
+            dur = max(float(dur), 0.0)
+            end = wall + dur
+        elif ph == "E":
+            dur = None
+            end = wall
+        else:
+            return  # a B alone is not a completed step
+        state.steps_seen += 1
+        state.step_walls.append(end)
+        if end > state.last_step_wall:
+            state.last_step_wall = end
+        labels = e.get("labels") or {}
+        try:
+            step = int(labels.get("step", -1))
+        except (TypeError, ValueError):
+            step = -1
+        if step > state.last_step:
+            state.last_step = step
+        if dur is not None and dur > 0:
+            a = self.EWMA_ALPHA
+            if state.step_time_ewma <= 0:
+                state.step_time_ewma = dur
+            else:
+                state.step_time_ewma = (
+                    a * dur + (1 - a) * state.step_time_ewma
+                )
+            rate = 1.0 / dur
+            if state.step_rate_ewma <= 0:
+                state.step_rate_ewma = rate
+            else:
+                state.step_rate_ewma = (
+                    a * rate + (1 - a) * state.step_rate_ewma
+                )
+
+    def _observe_stall_span(self, state: _NodeState, e: dict, wall: float):
+        if e.get("ph") != "X" or e.get("dur") is None:
+            return  # stalls are emitted as X records (data/prefetch.py)
+        dur = max(float(e["dur"]), 0.0)
+        stage = str((e.get("labels") or {}).get("stage", "") or "?")
+        window = state.stall_windows.setdefault(
+            stage, deque(maxlen=1024)
+        )
+        window.append((wall + dur, dur))
+
+    def observe_heartbeat(self, node_id: int, timestamp: float):
+        """Agent heartbeat tap.  Freshness is judged on the master's
+        monotonic clock at ARRIVAL, not the agent's ``timestamp`` —
+        a skewed agent clock must not fake liveness."""
+        del timestamp
+        with self._lock:
+            state = self._state(int(node_id))
+            state.last_heartbeat = max(
+                state.last_heartbeat, time.monotonic()
+            )
+
+    def observe_step(self, node_id: int, step: int, timestamp: float):
+        """``GlobalStep`` report tap — progress evidence even from
+        jobs that never emit timeline spans."""
+        with self._lock:
+            state = self._state(int(node_id))
+            if step > state.last_step:
+                state.last_step = step
+            if timestamp > state.last_step_wall:
+                state.last_step_wall = timestamp
+            state.last_event_seen = max(
+                state.last_event_seen, time.monotonic()
+            )
+
+    def observe_fault(self, node_id: int, kind: str = ""):
+        del kind  # counted, not classified (the error monitor does that)
+        with self._lock:
+            self._state(int(node_id)).faults += 1
+
+    def observe_resource(
+        self, node_id: int, cpu_percent: float, memory_mb: float
+    ):
+        with self._lock:
+            state = self._state(int(node_id))
+            state.cpu_percent = float(cpu_percent)
+            state.rss_mb = float(memory_mb)
+
+    # ------------------------------------------------------ derivations
+    def _evict_locked(self, state: _NodeState, now_wall: float):
+        horizon = now_wall - self.window_s
+        for window in state.stall_windows.values():
+            while window and window[0][0] < horizon:
+                window.popleft()
+        while state.step_walls and state.step_walls[0] < horizon:
+            state.step_walls.popleft()
+
+    def _median_step_time_locked(self) -> float:
+        ewmas = sorted(
+            s.step_time_ewma
+            for s in self._nodes.values()
+            if s.step_time_ewma > 0
+            and s.steps_seen >= self.MIN_STEPS_FOR_STRAGGLER
+        )
+        if not ewmas:
+            return 0.0
+        return ewmas[len(ewmas) // 2]
+
+    #: open-span suppression expires after this many watchdog windows
+    #: — a B whose E never arrives (crashed writer, batch lost to a
+    #: master outage or a file rotation) must not disarm the watchdog
+    #: for the rest of the job
+    OPEN_SPAN_GRACE_WINDOWS = 10.0
+
+    def _hang_suspect_locked(
+        self, state: _NodeState, now_mono: float
+    ) -> bool:
+        """The span-heartbeat watchdog verdict for one node."""
+        if state.last_event_seen <= 0:
+            return False  # never produced an event: not armed yet
+        if now_mono - state.last_event_seen < self.hang_watchdog_s:
+            return False
+        # attributably busy: an open non-step span (compile, restore,
+        # rendezvous...) emitted its B and will emit E when done —
+        # the ledger charges that time, the watchdog stays quiet.
+        # The suppression is BOUNDED (and stale entries purged): an
+        # orphaned B only buys its phase a grace window, not immunity.
+        grace = self.hang_watchdog_s * self.OPEN_SPAN_GRACE_WINDOWS
+        for key in [
+            k
+            for k, (_n, opened) in state.open_spans.items()
+            if now_mono - opened > grace
+        ]:
+            state.open_spans.pop(key)
+        if any(name != "step" for _pid, name in state.open_spans):
+            return False
+        # dead vs hung: no fresh heartbeat means the agent is gone too
+        # (the job manager's heartbeat monitor owns dead nodes); hung
+        # means the agent answers while the workers emit nothing
+        if state.last_heartbeat > 0 and (
+            now_mono - state.last_heartbeat > self.HEARTBEAT_FRESH_S
+        ):
+            return False
+        return True
+
+    def _stall_share_locked(
+        self, state: _NodeState, now_wall: float
+    ) -> Dict[str, float]:
+        """Windowed stall share by stage (caller holds the lock and
+        has evicted): stalled seconds over the stretch of the window
+        the oldest retained stall actually covers — ONE definition,
+        consumed by both the snapshot and the DataStallOperator."""
+        shares = {}
+        for stage, window in state.stall_windows.items():
+            if not window:
+                continue
+            span = max(
+                now_wall - max(window[0][0] - window[0][1],
+                               now_wall - self.window_s),
+                1e-9,
+            )
+            shares[stage] = min(
+                sum(d for _t, d in window) / span, 1.0
+            )
+        return shares
+
+    def node_snapshot_locked(
+        self, state: _NodeState, median: float, now_wall: float,
+        now_mono: float,
+    ) -> dict:
+        self._evict_locked(state, now_wall)
+        stall_share = {
+            stage: round(share, 4)
+            for stage, share in self._stall_share_locked(
+                state, now_wall
+            ).items()
+        }
+        score = 0.0
+        if (
+            median > 0
+            and state.step_time_ewma > 0
+            and state.steps_seen >= self.MIN_STEPS_FOR_STRAGGLER
+        ):
+            score = state.step_time_ewma / median
+        straggler = bool(score >= self.straggler_ratio)
+        hung = self._hang_suspect_locked(state, now_mono)
+        stalled = any(
+            share >= 0.5 for share in stall_share.values()
+        )
+        if hung:
+            status, health = STATUS_HUNG, HEALTH_HUNG
+        elif straggler:
+            status, health = STATUS_STRAGGLER, HEALTH_STRAGGLER
+        elif stalled:
+            status, health = STATUS_STALLED, HEALTH_STALLED
+        else:
+            status, health = STATUS_OK, HEALTH_OK
+        # windowed rate: completed steps per second over the window
+        rate = 0.0
+        if len(state.step_walls) >= 2:
+            span = state.step_walls[-1] - state.step_walls[0]
+            if span > 0:
+                rate = (len(state.step_walls) - 1) / span
+        return {
+            "node": state.node,
+            "status": status,
+            "health": health,
+            "step": state.last_step,
+            "steps_seen": state.steps_seen,
+            "step_time_s": round(state.step_time_ewma, 6),
+            "step_rate": round(rate or state.step_rate_ewma, 6),
+            "straggler_score": round(score, 4),
+            "straggler": straggler,
+            "hung": hung,
+            "stall_share": stall_share,
+            "restarts": state.restarts,
+            "faults": state.faults,
+            "inc": state.incarnation,
+            "cpu_percent": state.cpu_percent,
+            "rss_mb": state.rss_mb,
+            "last_event_age_s": round(
+                now_mono - state.last_event_seen, 3
+            ) if state.last_event_seen > 0 else None,
+            "last_step_wall": state.last_step_wall or None,
+        }
+
+    def snapshot(self) -> dict:
+        """The full derived state — what ``JobStatusRequest``,
+        ``/status`` and ``scripts/top.py`` serve."""
+        now_wall = time.time()
+        now_mono = time.monotonic()
+        with self._lock:
+            median = self._median_step_time_locked()
+            nodes = [
+                self.node_snapshot_locked(
+                    state, median, now_wall, now_mono
+                )
+                for state in sorted(
+                    self._nodes.values(), key=lambda s: s.node
+                )
+            ]
+        return {
+            "job": self._job,
+            "t": now_wall,
+            "median_step_time_s": round(median, 6),
+            "straggler_ratio": self.straggler_ratio,
+            "hang_watchdog_s": self.hang_watchdog_s,
+            "window_s": self.window_s,
+            "nodes": nodes,
+            "stragglers": [
+                n["node"] for n in nodes if n["straggler"]
+            ],
+            "hangs": [n["node"] for n in nodes if n["hung"]],
+        }
+
+    # ------------------------------------------------- operator queries
+    def stragglers(self) -> List[Tuple[int, float]]:
+        """``[(node, score)]`` for nodes past the ratio (the
+        ``StragglerOperator``'s input)."""
+        with self._lock:
+            median = self._median_step_time_locked()
+            if median <= 0:
+                return []
+            out = []
+            for state in self._nodes.values():
+                if (
+                    state.step_time_ewma > 0
+                    and state.steps_seen
+                    >= self.MIN_STEPS_FOR_STRAGGLER
+                ):
+                    score = state.step_time_ewma / median
+                    if score >= self.straggler_ratio:
+                        out.append((state.node, round(score, 4)))
+            return sorted(out, key=lambda t: -t[1])
+
+    def hang_suspects(self) -> List[Tuple[int, float]]:
+        """``[(node, silence_s)]`` flagged by the span-heartbeat
+        watchdog (the ``HangWatchdogOperator``'s input)."""
+        now_mono = time.monotonic()
+        with self._lock:
+            return [
+                (
+                    state.node,
+                    round(now_mono - state.last_event_seen, 3),
+                )
+                for state in self._nodes.values()
+                if self._hang_suspect_locked(state, now_mono)
+            ]
+
+    def stall_shares(self) -> Dict[int, Dict[str, float]]:
+        """Per-node windowed data-stall share by stage (the
+        ``DataStallOperator``'s input)."""
+        now_wall = time.time()
+        out: Dict[int, Dict[str, float]] = {}
+        with self._lock:
+            for state in self._nodes.values():
+                self._evict_locked(state, now_wall)
+                shares = self._stall_share_locked(state, now_wall)
+                if shares:
+                    out[state.node] = shares
+        return out
+
+    # ------------------------------------------------------------ gauges
+    def _maybe_refresh_gauges(self):
+        if self._registry is None:
+            return
+        now = time.monotonic()
+        if now - self._last_gauge_refresh < self.GAUGE_REFRESH_S:
+            return
+        self._last_gauge_refresh = now
+        self.refresh_gauges()
+
+    def refresh_gauges(self):
+        """Export the per-node health + straggler-score gauges (also
+        callable directly — the status server refreshes before
+        rendering ``/metrics``)."""
+        if self._registry is None:
+            return
+        try:
+            snap = self.snapshot()
+            for n in snap["nodes"]:
+                labels = {"node": n["node"]}
+                self._registry.set_gauge(
+                    "dlrover_tpu_node_health",
+                    n["health"],
+                    labels=labels,
+                )
+                self._registry.set_gauge(
+                    "dlrover_tpu_straggler_score",
+                    n["straggler_score"],
+                    labels=labels,
+                )
+        except Exception as e:  # noqa: BLE001 - gauges must not break reports
+            logger.warning("health gauge refresh failed: %s", e)
+
+    # ------------------------------------------------------------- misc
+    def to_json(self) -> str:
+        return json.dumps(self.snapshot(), separators=(",", ":"))

@@ -96,6 +96,22 @@ class MetricsRegistry:
         self.inc_counter(name + "_seconds_sum", seconds, labels)
         self.inc_counter(name + "_count", 1.0, labels)
 
+    def render_text(self) -> str:
+        """The current metrics as Prometheus exposition text for the
+        master's plain-HTTP ``/metrics`` endpoint.  NO trailing
+        timestamp: the classic text format demands int64
+        *milliseconds* there, and the seconds-float stamp ``flush``
+        writes (which the C++ exporter strips before serving, using
+        it only for staleness eviction) would make a real Prometheus
+        scrape land every sample at ~epoch — served samples must
+        carry the scrape time instead."""
+        with self._lock:
+            lines = [
+                f"{k} {v:.9g}"
+                for k, v in sorted(self._metrics.items())
+            ]
+        return "\n".join(lines) + "\n"
+
     def _maybe_flush(self):
         now = time.time()
         if now - self._last_flush >= self._flush_interval:

@@ -9,7 +9,9 @@ interposition needed (SURVEY.md §5.1 TPU equivalent).
 """
 
 import contextlib
+import threading
 import time
+from collections import deque
 from typing import Callable, Dict, Optional
 
 import jax
@@ -18,11 +20,28 @@ from dlrover_tpu.common.log import default_logger as logger
 
 
 class AProfiler:
-    """FLOPs/memory census of a jitted function + step timing."""
+    """FLOPs/memory census of a jitted function + step timing.
+
+    ``registry`` must expose ``observe_duration`` (the
+    ``MetricsRegistry`` contract).  A registry without it is rejected
+    at CONSTRUCTION — ``step()`` used to discover the mismatch only
+    when it tried to record, which silently lost every sample until
+    then."""
+
+    #: step-time window (ring — the old list paid O(n) ``pop(0)``)
+    STEP_WINDOW = 1024
 
     def __init__(self, registry=None):
+        if registry is not None and not callable(
+            getattr(registry, "observe_duration", None)
+        ):
+            raise TypeError(
+                "AProfiler registry must provide observe_duration() "
+                f"(got {type(registry).__name__}); pass a "
+                "MetricsRegistry or None"
+            )
         self._registry = registry
-        self._step_times = []
+        self._step_times = deque(maxlen=self.STEP_WINDOW)
 
     def cost_analysis(self, fn: Callable, *args, **kwargs) -> Dict:
         """Exact compiled-cost census (replaces the reference's
@@ -55,13 +74,15 @@ class AProfiler:
     @contextlib.contextmanager
     def step(self, name: str = "train_step"):
         start = time.perf_counter()
-        yield
-        elapsed = time.perf_counter() - start
-        self._step_times.append(elapsed)
-        if len(self._step_times) > 1024:
-            self._step_times.pop(0)
-        if self._registry is not None:
-            self._registry.observe_duration(name, elapsed)
+        try:
+            yield
+        finally:
+            # a raising step still took its time — drop the sample
+            # and the window under-reports exactly the bad steps
+            elapsed = time.perf_counter() - start
+            self._step_times.append(elapsed)
+            if self._registry is not None:
+                self._registry.observe_duration(name, elapsed)
 
     def mean_step_time(self) -> float:
         if not self._step_times:
@@ -89,10 +110,46 @@ def trace(log_dir: str):
         logger.info("trace written to %s", log_dir)
 
 
+#: the live trace server (jax keeps it alive only while a reference
+#: exists — the old API returned it to callers who all dropped it on
+#: the floor, so "nothing ever stops it" was really "anything GCing
+#: it stops it at an arbitrary moment")
+_profiler_server = None
+_profiler_server_lock = threading.Lock()
+
+
 def start_profiler_server(port: int = 9999) -> Optional[object]:
-    """On-demand profiling endpoint (``jax.profiler`` trace server)."""
+    """On-demand profiling endpoint (``jax.profiler`` trace server).
+
+    Idempotent: a second call returns the already-running server.
+    The module holds the reference (jax stops the server when the
+    object is collected), so the lifetime is explicit —
+    :func:`stop_profiler_server` ends it."""
+    global _profiler_server
+    with _profiler_server_lock:
+        if _profiler_server is not None:
+            return _profiler_server
+        try:
+            _profiler_server = jax.profiler.start_server(port)
+        except Exception as e:  # noqa: BLE001
+            logger.warning("profiler server failed: %s", e)
+            return None
+        return _profiler_server
+
+
+def stop_profiler_server():
+    """Stop the trace server started by :func:`start_profiler_server`
+    (no-op when none is running)."""
+    global _profiler_server
+    with _profiler_server_lock:
+        server, _profiler_server = _profiler_server, None
+    if server is None:
+        return
+    stop = getattr(server, "stop", None)
     try:
-        return jax.profiler.start_server(port)
+        if callable(stop):
+            stop()
+        # else: dropping the last reference stops it (jax contract)
     except Exception as e:  # noqa: BLE001
-        logger.warning("profiler server failed: %s", e)
-        return None
+        logger.warning("profiler server stop failed: %s", e)
+    logger.info("profiler server stopped")

@@ -1,0 +1,317 @@
+"""Observatory closed-loop scenario: inject one straggler + one hang,
+assert the master names both — node and problem — within a bounded
+number of reporting intervals.
+
+This is the acceptance harness for the job observatory
+(``observability/health.py`` + the derived-signal diagnosis
+operators): a real ``LocalJobMaster`` serves over real gRPC, and N
+simulated nodes run the REAL agent reporting path — each node's
+worker loop sleeps its per-step duration and emits ``step`` spans
+through a real ``EventLogger``, a real ``TimelineReporter`` tails the
+JSONL and ships deltas, a real ``HeartbeatReporter`` keeps the agent
+heartbeat up.  Faults:
+
+- the **straggler** node's step sleep is multiplied by
+  ``straggler_factor`` (the sleep-fault form of a degraded chip /
+  ``rpc delay`` slowdown) — its spans keep flowing, just slower;
+- the **hung** node stops emitting spans entirely after
+  ``hang_after`` steps while its heartbeats continue — the
+  wedged-in-a-collective posture the SpeedMonitor cannot attribute
+  (the global step keeps advancing on the healthy ranks).
+
+The harness polls the ``JobStatusRequest`` snapshot and records, in
+units of the reporting interval, how long each verdict took:
+``straggler_intervals`` (from scenario start) and ``hang_intervals``
+(from the hang onset).  It also asserts the diagnosis conclusions
+(``DiagnosisManager`` on top of the engine) name the same nodes with
+the right problems.  JSON ``--out`` artifact; honors
+``DLROVER_TPU_BENCH_BUDGET_S``.
+
+Usage::
+
+    python scripts/bench_observatory.py [--nodes 4] [--interval 0.5]
+        [--detect-within 3] [--out OUT.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import BenchBudget, flush_partial as _flush  # noqa: E402
+
+
+def run_scenario(
+    nodes: int = 4,
+    straggler_node: int = 2,
+    hung_node: int = 3,
+    step_s: float = 0.04,
+    straggler_factor: float = 3.0,
+    interval: float = 0.5,
+    hang_after: int = 6,
+    detect_within: int = 3,
+    timeout_s: float = 60.0,
+    probe=None,
+) -> dict:
+    """One closed-loop run; returns the metrics dict.  ``probe``,
+    when given, is called with the live master's address after
+    detection (the tier-1 smoke drives ``scripts/top.py`` through
+    it).  Raises RuntimeError only on harness failure — a missed
+    detection is a RESULT (``detected=False``)."""
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.agent.monitor import (
+        HeartbeatReporter,
+        TimelineReporter,
+    )
+    from dlrover_tpu.common.env import get_free_port
+    from dlrover_tpu.observability.events import (
+        EventLogger,
+        anchored_now,
+    )
+
+    workdir = tempfile.mkdtemp(prefix="dlrover_observatory_")
+    job = "observatory-bench"
+    # scenario-scale knobs, applied only around master construction:
+    # watchdog 2 intervals of total span silence, diagnosis sweep
+    # every half interval so a verdict never waits a full minute
+    overrides = {
+        "DLROVER_TPU_JOB_NAME": job,
+        "DLROVER_TPU_OBSERVATORY": "1",
+        "DLROVER_TPU_HANG_WATCHDOG_S": str(2.0 * interval),
+        "DLROVER_TPU_DIAGNOSIS_INTERVAL_S": str(interval / 2.0),
+        "DLROVER_TPU_STRAGGLER_RATIO": "1.5",
+    }
+    saved = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    try:
+        from dlrover_tpu.master.master import LocalJobMaster
+
+        master = LocalJobMaster(get_free_port(), node_num=nodes)
+        master.prepare()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    stop = threading.Event()
+    hang_onset = [0.0]
+    clients, reporters, threads = [], [], []
+
+    def node_worker(n: int, events: EventLogger):
+        step = 0
+        while not stop.is_set():
+            if n == hung_node and step >= hang_after:
+                if not hang_onset[0]:
+                    hang_onset[0] = time.monotonic()
+                time.sleep(0.02)  # wedged: alive, emitting nothing
+                continue
+            dur = step_s * (
+                straggler_factor if n == straggler_node else 1.0
+            )
+            t0_mono = time.monotonic()
+            t0_wall = anchored_now(t0_mono)
+            time.sleep(dur)  # the simulated device work (sleep fault)
+            step += 1
+            events.complete(
+                "step",
+                t0_wall,
+                time.monotonic() - t0_mono,
+                step=step,
+            )
+
+    try:
+        for n in range(nodes):
+            client = MasterClient(master.addr, node_id=n)
+            clients.append(client)
+            path = os.path.join(workdir, f"events_{n}.jsonl")
+            events = EventLogger(
+                path=path, job=job, node=n, rank=0, incarnation=0
+            )
+            # ship at half the reporting interval: the detection
+            # bound is watchdog (2 intervals) + ship delay + poll —
+            # a full-interval ship cadence would eat the whole margin
+            shipper = TimelineReporter(
+                path, client=client, interval=interval / 2.0
+            )
+            heart = HeartbeatReporter(
+                client=client, interval=interval / 2.0
+            )
+            shipper.start()
+            heart.start()
+            reporters.extend([shipper, heart])
+            t = threading.Thread(
+                target=node_worker,
+                args=(n, events),
+                name=f"sim-node-{n}",
+                daemon=True,
+            )
+            t.start()
+            threads.append(t)
+
+        poller = MasterClient(master.addr, node_id=nodes)
+        clients.append(poller)
+        t_start = time.monotonic()
+        deadline = t_start + timeout_s
+        straggler_detected_at = 0.0
+        hang_detected_at = 0.0
+        conclusion_hits = {}
+        snapshot = {}
+        while time.monotonic() < deadline:
+            status = poller.get_job_status() or {}
+            snapshot = status
+            health = status.get("health") or {}
+            now = time.monotonic()
+            if (
+                not straggler_detected_at
+                and straggler_node in (health.get("stragglers") or [])
+            ):
+                straggler_detected_at = now
+            if (
+                not hang_detected_at
+                and hung_node in (health.get("hangs") or [])
+            ):
+                hang_detected_at = now
+            for c in status.get("conclusions") or []:
+                conclusion_hits.setdefault(
+                    (c.get("problem"), c.get("node_rank")), c
+                )
+            if (
+                straggler_detected_at
+                and hang_detected_at
+                and ("straggler", straggler_node) in conclusion_hits
+                and ("hang", hung_node) in conclusion_hits
+            ):
+                break
+            time.sleep(interval / 4.0)
+
+        if probe is not None:
+            probe(master.addr)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=2.0)
+        for r in reporters:
+            r.stop()
+        for c in clients:
+            c.close()
+        master.stop()
+
+    nodes_snap = {
+        n.get("node"): n
+        for n in (snapshot.get("health") or {}).get("nodes") or []
+    }
+    straggler_intervals = (
+        (straggler_detected_at - t_start) / interval
+        if straggler_detected_at
+        else None
+    )
+    hang_intervals = (
+        (hang_detected_at - hang_onset[0]) / interval
+        if hang_detected_at and hang_onset[0]
+        else None
+    )
+    detected = bool(
+        straggler_intervals is not None
+        and hang_intervals is not None
+        and ("straggler", straggler_node) in conclusion_hits
+        and ("hang", hung_node) in conclusion_hits
+    )
+    # false-positive audit: which OTHER nodes ended up flagged
+    false_stragglers = [
+        n
+        for n in (snapshot.get("health") or {}).get("stragglers", [])
+        if n != straggler_node
+    ]
+    return {
+        "nodes": nodes,
+        "straggler_node": straggler_node,
+        "hung_node": hung_node,
+        "interval_s": interval,
+        "detect_within": detect_within,
+        "detected": detected,
+        "straggler_intervals": (
+            round(straggler_intervals, 2)
+            if straggler_intervals is not None
+            else None
+        ),
+        "hang_intervals": (
+            round(hang_intervals, 2)
+            if hang_intervals is not None
+            else None
+        ),
+        "within_bound": bool(
+            detected
+            and hang_intervals is not None
+            and hang_intervals <= detect_within
+        ),
+        "false_stragglers": false_stragglers,
+        "straggler_score": (
+            nodes_snap.get(straggler_node, {}).get("straggler_score")
+        ),
+        "conclusions": sorted(
+            f"{p}@{r}" for p, r in conclusion_hits
+        ),
+        "node_statuses": {
+            n: s.get("status") for n, s in nodes_snap.items()
+        },
+        "workdir": workdir,
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="observatory straggler+hang detection scenario"
+    )
+    parser.add_argument("--nodes", type=int, default=4)
+    parser.add_argument("--interval", type=float, default=0.5)
+    parser.add_argument("--step_s", type=float, default=0.04)
+    parser.add_argument("--straggler_factor", type=float, default=3.0)
+    parser.add_argument("--detect-within", type=int, default=3,
+                        dest="detect_within")
+    parser.add_argument("--timeout", type=float, default=60.0)
+    parser.add_argument("--out", default="")
+    args = parser.parse_args(argv)
+
+    budget = BenchBudget()
+    timeout = budget.cap_timeout(args.timeout, reserve_s=10.0)
+
+    payload = {
+        "metric": "observatory_hang_detect_intervals",
+        "value": None,
+        "unit": "reporting intervals",
+        "vs_baseline": None,
+        "extras": {"bench_budget_s": budget.total},
+    }
+    try:
+        result = run_scenario(
+            nodes=args.nodes,
+            interval=args.interval,
+            step_s=args.step_s,
+            straggler_factor=args.straggler_factor,
+            detect_within=args.detect_within,
+            timeout_s=timeout,
+        )
+    except RuntimeError as e:
+        payload["extras"]["error"] = str(e)
+        if args.out:
+            _flush(args.out, payload)
+        print(json.dumps(payload, indent=2))
+        return 1
+    payload["value"] = result.get("hang_intervals")
+    payload["extras"]["scenario"] = result
+    if args.out:
+        _flush(args.out, payload)
+    print(json.dumps(payload, indent=2))
+    return 0 if result["detected"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

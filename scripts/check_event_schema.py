@@ -66,6 +66,11 @@ DECLARED_METRICS = {
     # client-side ReportBuffer overflow drops during a master outage
     # (record_dropped_reports)
     "dlrover_tpu_control_dropped_reports",
+    # the observatory's per-node derivations (observability/health.py
+    # HealthEngine.refresh_gauges): health code 1/0.5/0.4/0 and the
+    # step-time-over-median straggler score
+    "dlrover_tpu_node_health",
+    "dlrover_tpu_straggler_score",
 }
 METRIC_METHODS = {"set_gauge", "inc_counter", "observe_duration"}
 _METRIC_PREFIX = "dlrover_tpu_"

@@ -1,0 +1,216 @@
+"""``top`` for a running job: a refreshing terminal dashboard over the
+master observatory.
+
+Reads the ``JobStatusRequest`` snapshot (gRPC, ``--master_addr`` /
+``$DLROVER_TPU_MASTER_ADDR``) or the plain-HTTP ``/status`` endpoint
+(``--status_url`` when the master was started with ``--status_port``)
+and renders per-node health — step counter, step-time and rate EWMAs,
+data-stall share, straggler score, restarts/faults, the hang-watchdog
+verdict — plus the live goodput ledger and the newest diagnosis
+conclusions.  Refreshes every ``--interval`` seconds until ^C.
+
+``--snapshot`` fetches ONCE and prints the raw JSON (written to
+``--out`` too when given) — the CI/scripting mode; the tier-1 smoke
+test asserts this JSON names the same nodes the RPC snapshot does.
+
+Usage::
+
+    python scripts/top.py --master_addr 127.0.0.1:50051
+    python scripts/top.py --status_url http://master:8081/status
+    python scripts/top.py --master_addr ... --snapshot --out status.json
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+_STATUS_GLYPH = {
+    "healthy": "ok",
+    "straggler": "SLOW",
+    "data_stalled": "STALL",
+    "hung": "HUNG",
+}
+
+
+def fetch_status(master_addr: str = "", status_url: str = "",
+                 conclusions: int = 16):
+    """One snapshot dict (or None when the observatory is off)."""
+    if status_url:
+        import urllib.request
+
+        with urllib.request.urlopen(status_url, timeout=10) as resp:
+            data = json.loads(resp.read().decode())
+        return data or None
+    from dlrover_tpu.common import messages as msg
+    from dlrover_tpu.common.comm import MasterChannel
+
+    chan = MasterChannel(master_addr, timeout=10.0)
+    try:
+        res = chan.get(
+            msg.JobStatusRequest(conclusions=conclusions)
+        )
+    finally:
+        chan.close()
+    if res is None or not getattr(res, "available", False):
+        return None
+    return res.status
+
+
+def _fmt_share(shares: dict) -> str:
+    if not shares:
+        return "-"
+    return ",".join(
+        f"{stage}:{share:.0%}" for stage, share in sorted(
+            shares.items(), key=lambda kv: -kv[1]
+        )
+    )
+
+
+def render(status: dict) -> str:
+    """The dashboard frame as a string (separated from the fetch loop
+    so tests can assert on it without a tty)."""
+    health = status.get("health") or {}
+    ledger = status.get("ledger") or {}
+    speed = status.get("speed") or {}
+    lines = []
+    lines.append(
+        f"job {health.get('job', '?')}"
+        f" · goodput {ledger.get('goodput', 0.0):.3f}"
+        f" (useful {ledger.get('useful_s', 0.0):.1f}s"
+        f" / wall {ledger.get('wall_s', 0.0):.1f}s)"
+        f" · global step {speed.get('global_step', '-')}"
+        f" · median step {health.get('median_step_time_s', 0.0):.3f}s"
+    )
+    loss = ledger.get("loss_breakdown") or {}
+    if loss:
+        top_loss = sorted(
+            loss.items(), key=lambda kv: -kv[1]
+        )[:4]
+        lines.append(
+            "loss: " + "  ".join(
+                f"{phase}={sec:.1f}s" for phase, sec in top_loss
+            )
+        )
+    lines.append("")
+    header = (
+        f"{'node':>4} {'state':>6} {'step':>8} {'t/step':>8} "
+        f"{'rate':>7} {'straggle':>8} {'stall':>14} "
+        f"{'rst':>3} {'flt':>3} {'inc':>3} {'silent':>7}"
+    )
+    lines.append(header)
+    lines.append("-" * len(header))
+    for n in health.get("nodes") or []:
+        age = n.get("last_event_age_s")
+        lines.append(
+            f"{n.get('node', '?'):>4} "
+            f"{_STATUS_GLYPH.get(n.get('status'), '?'):>6} "
+            f"{n.get('step', -1):>8} "
+            f"{n.get('step_time_s', 0.0):>8.3f} "
+            f"{n.get('step_rate', 0.0):>7.2f} "
+            f"{n.get('straggler_score', 0.0):>7.2f}x "
+            f"{_fmt_share(n.get('stall_share') or {}):>14} "
+            f"{n.get('restarts', 0):>3} "
+            f"{n.get('faults', 0):>3} "
+            f"{n.get('inc', 0):>3} "
+            f"{(f'{age:.0f}s' if age is not None else '-'):>7}"
+        )
+    conclusions = status.get("conclusions") or []
+    if conclusions:
+        lines.append("")
+        lines.append("recent diagnosis conclusions (newest last):")
+        for c in conclusions[-8:]:
+            t = time.strftime(
+                "%H:%M:%S", time.localtime(c.get("t", 0))
+            )
+            lines.append(
+                f"  {t} node {c.get('node_rank', -1):>3} "
+                f"{c.get('problem', '?'):<12} -> "
+                f"{c.get('action', 'none'):<16} {c.get('cause', '')}"
+            )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="live observatory dashboard for a running job"
+    )
+    parser.add_argument(
+        "--master_addr",
+        default=os.getenv("DLROVER_TPU_MASTER_ADDR", ""),
+        help="master gRPC address (host:port); default "
+        "$DLROVER_TPU_MASTER_ADDR",
+    )
+    parser.add_argument(
+        "--status_url", default="",
+        help="plain-HTTP /status URL (alternative to --master_addr "
+        "when the master runs with --status_port)",
+    )
+    parser.add_argument("--interval", type=float, default=2.0)
+    parser.add_argument(
+        "--conclusions", type=int, default=16,
+        help="how many recent diagnosis conclusions to fetch",
+    )
+    parser.add_argument(
+        "--snapshot", action="store_true",
+        help="fetch once, print the raw JSON, exit (CI mode)",
+    )
+    parser.add_argument(
+        "--out", default="",
+        help="also write the snapshot JSON here (with --snapshot)",
+    )
+    args = parser.parse_args(argv)
+    if not args.master_addr and not args.status_url:
+        parser.error(
+            "need --master_addr (or $DLROVER_TPU_MASTER_ADDR) "
+            "or --status_url"
+        )
+
+    if args.snapshot:
+        status = fetch_status(
+            args.master_addr, args.status_url, args.conclusions
+        )
+        payload = status if status is not None else {
+            "available": False
+        }
+        text = json.dumps(payload, indent=2, default=str)
+        if args.out:
+            tmp = args.out + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(text + "\n")
+            os.replace(tmp, args.out)
+        print(text)
+        return 0 if status is not None else 1
+
+    try:
+        while True:
+            try:
+                status = fetch_status(
+                    args.master_addr,
+                    args.status_url,
+                    args.conclusions,
+                )
+            except (ConnectionError, OSError) as e:
+                frame = f"(master unreachable: {e})"
+            else:
+                if status is None:
+                    frame = (
+                        "(observatory unavailable — master runs with "
+                        "DLROVER_TPU_OBSERVATORY=0 or predates it)"
+                    )
+                else:
+                    frame = render(status)
+            # ANSI clear + home: a refreshing frame, not a scroll
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(max(args.interval, 0.2))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

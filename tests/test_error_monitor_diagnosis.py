@@ -1,0 +1,196 @@
+"""Interplay of ``master/error_monitor.py`` + ``agent/node_check.py``
+with the diagnosis conclusions: a failure classified for node
+replacement — or a ``relaunch_node`` conclusion from the inference
+chain — must reach the node manager's restart verdict EXACTLY once
+per cooldown, and the agent's CheckHardwareResetRequest poll must
+consume it exactly once."""
+
+import os
+import time
+
+import pytest
+
+from dlrover_tpu.common.constants import (
+    NodeStatus,
+    NodeType,
+    TrainingExceptionLevel,
+)
+from dlrover_tpu.master.diagnosis import (
+    DiagnosisManager,
+    Inference,
+    InferenceOperator,
+)
+from dlrover_tpu.master.error_monitor import (
+    ErrorKind,
+    ErrorMonitor,
+    RecoveryAction,
+    classify_error,
+)
+from dlrover_tpu.master.job_manager import LocalJobManager
+
+
+class TestClassification:
+    @pytest.mark.parametrize(
+        "excerpt,kind",
+        [
+            ("RESOURCE_EXHAUSTED: while allocating", ErrorKind.OOM),
+            ("maintenance event TERMINATED_BY_SYSTEM",
+             ErrorKind.PREEMPTION),
+            ("libtpu abort: chip failure", ErrorKind.HARDWARE),
+            ("connection refused by coordinator", ErrorKind.NETWORK),
+            ("Traceback (most recent call last):",
+             ErrorKind.USER_CODE),
+            ("some novel nonsense", ErrorKind.UNKNOWN),
+        ],
+    )
+    def test_classify(self, excerpt, kind):
+        assert classify_error(excerpt) == kind
+
+    def test_hardware_recommends_relaunch(self):
+        monitor = ErrorMonitor()
+        action = monitor.report(3, NodeType.WORKER,
+                                "device lost: uncorrectable")
+        assert action == RecoveryAction.RELAUNCH_NODE
+
+
+class TestNodeCheckFailurePath:
+    def test_mock_error_fails_before_touching_jax(self, monkeypatch,
+                                                  tmp_path):
+        """The injected node-check fault raises before the payload
+        imports jax, and ``main`` reports rc=1 with no result file —
+        the agent then reports the node unhealthy to the master."""
+        from dlrover_tpu.agent import node_check
+
+        monkeypatch.setenv("DLROVER_TPU_MOCK_NODE_ERROR", "1")
+        result_file = tmp_path / "check.txt"
+        monkeypatch.setenv(
+            "DLROVER_TPU_NODE_CHECK_RESULT_FILE", str(result_file)
+        )
+        with pytest.raises(RuntimeError, match="injected"):
+            node_check.run_health_check()
+        assert node_check.main() == 1
+        assert not result_file.exists()
+
+    def test_reported_failure_sets_restart_verdict_once(self):
+        """agent node-check failure -> NodeFailure(NODE_ERROR) ->
+        job manager hardware verdict, consumed exactly once by the
+        CheckHardwareResetRequest poll."""
+        manager = LocalJobManager(node_num=2)
+        manager.start()
+        manager.collect_node_heartbeat(
+            NodeType.WORKER, 1, time.time()
+        )
+        manager.handle_training_failure(
+            NodeType.WORKER, 1, restart_count=0,
+            error_data="node 1 failed the health check",
+            level=TrainingExceptionLevel.NODE_ERROR,
+        )
+        node = manager.get_node(1)
+        assert node.exit_reason  # hardware error recorded
+        assert manager.should_restart_node(NodeType.WORKER, 1)
+        # the verdict is a one-shot: the next poll is clean
+        assert not manager.should_restart_node(NodeType.WORKER, 1)
+
+
+class _AlwaysConclude(InferenceOperator):
+    """An operator that concludes relaunch_node for node 1 on every
+    sweep — the cooldown must make the VERDICT fire once per window."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def infer(self, store):
+        self.calls += 1
+        return [
+            Inference(
+                problem="chip_error",
+                cause="synthetic",
+                action="relaunch_node",
+                node_rank=1,
+            )
+        ]
+
+
+class TestConclusionReachesNodeManagerOncePerCooldown:
+    def _drive(self, mgr, manager):
+        """One master supervision tick: diagnose + apply (what
+        JobMaster.process_diagnosis does)."""
+        mgr.diagnose()
+        conclusions = mgr.take_conclusions()
+        if conclusions:
+            manager.apply_diagnosis_conclusions(conclusions)
+        return conclusions
+
+    def test_exactly_once_per_cooldown(self):
+        operator = _AlwaysConclude()
+        mgr = DiagnosisManager(
+            operators=[operator], conclusion_cooldown=0.4
+        )
+        manager = LocalJobManager(node_num=2)
+        manager.start()
+        manager.collect_node_heartbeat(
+            NodeType.WORKER, 1, time.time()
+        )
+
+        # sweep 1: the conclusion fires and the verdict is set
+        assert len(self._drive(mgr, manager)) == 1
+        assert manager.should_restart_node(NodeType.WORKER, 1)
+        node = manager.get_node(1)
+        assert node.exit_reason  # relaunch_node marks hardware exit
+
+        # sweeps 2..4 inside the cooldown: the operator keeps
+        # concluding but NOTHING reaches the node manager — the
+        # verdict is not re-armed
+        for _ in range(3):
+            assert self._drive(mgr, manager) == []
+        assert operator.calls == 4
+        assert not manager.should_restart_node(NodeType.WORKER, 1)
+
+        # past the cooldown the verdict re-arms exactly once more
+        time.sleep(0.45)
+        assert len(self._drive(mgr, manager)) == 1
+        assert manager.should_restart_node(NodeType.WORKER, 1)
+        assert not manager.should_restart_node(NodeType.WORKER, 1)
+
+    def test_restart_process_conclusion_does_not_mark_hardware(self):
+        """restart_process restarts in place: the node must NOT be
+        branded a hardware failure (that escalates to relaunch)."""
+        mgr = DiagnosisManager(
+            operators=[],
+        )
+        manager = LocalJobManager(node_num=1)
+        manager.start()
+        manager.collect_node_heartbeat(
+            NodeType.WORKER, 0, time.time()
+        )
+        manager.apply_diagnosis_conclusions(
+            [
+                Inference(
+                    problem="hang",
+                    action="restart_process",
+                    node_rank=0,
+                )
+            ]
+        )
+        assert manager.should_restart_node(NodeType.WORKER, 0)
+        node = manager.get_node(0)
+        assert not node.exit_reason
+        del mgr
+
+    def test_user_code_failures_stop_job_not_relaunch(self):
+        """Repeated deterministic user-code failures on one node
+        flip the job to stop instead of burning the relaunch
+        budget (error-monitor threshold)."""
+        manager = LocalJobManager(node_num=1)
+        manager.start()
+        manager.collect_node_heartbeat(
+            NodeType.WORKER, 0, time.time()
+        )
+        for _ in range(3):
+            manager.handle_training_failure(
+                NodeType.WORKER, 0, restart_count=0,
+                error_data="Traceback (most recent call last): "
+                "ValueError: bad user code",
+                level=TrainingExceptionLevel.PROCESS_ERROR,
+            )
+        assert manager.should_stop_job()

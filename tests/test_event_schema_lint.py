@@ -84,6 +84,58 @@ def test_lint_enforces_fault_injected_labels(tmp_path):
     assert "missing required label(s) ['incarnation']" in proc.stdout
 
 
+def test_lint_enforces_diagnosis_labels(tmp_path):
+    """The observatory's conclusion markers must name the problem,
+    the action and the node — an anonymous ``diagnosis`` instant is
+    useless to the operator reading the trace."""
+    bad = tmp_path / "bad_diagnosis.py"
+    bad.write_text(
+        "events = None\n"
+        "def f(events):\n"
+        "    events.instant('diagnosis', problem='hang')\n"
+        "    events.instant('diagnosis', problem='hang',\n"
+        "                   action='restart_process', node_rank=3)\n"
+        "    events.instant('diagnosis', problem='straggler',\n"
+        "                   action='none', node_rank=2,\n"
+        "                   cause='x2.4 vs median')\n"
+    )
+    proc = _run(str(bad))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "event_schema_violations=1" in proc.stdout, proc.stdout
+    assert "missing required label(s) ['action', 'node_rank']" in (
+        proc.stdout
+    )
+
+
+def test_lint_catches_undeclared_metric_names():
+    """A ``dlrover_tpu_``-prefixed gauge the package never declared
+    (a typo'd dashboard series) must fail the lint; the observatory
+    gauges themselves are declared.  The probe file must live INSIDE
+    the package tree — metric policing is package-scoped."""
+    probe = os.path.join(
+        REPO, "dlrover_tpu", "_lint_probe_delete_me.py"
+    )
+    with open(probe, "w") as f:
+        f.write(
+            "def f(reg):\n"
+            "    reg.set_gauge('dlrover_tpu_node_health', 1.0)\n"
+            "    reg.set_gauge('dlrover_tpu_straggler_score', 1.0)\n"
+            "    reg.set_gauge('dlrover_tpu_not_a_real_metric', 1)\n"
+        )
+    try:
+        proc = _run(probe)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "event_schema_violations=1" in proc.stdout, proc.stdout
+        assert "dlrover_tpu_not_a_real_metric" in proc.stdout
+        assert "dlrover_tpu_node_health" not in "".join(
+            line
+            for line in proc.stdout.splitlines()
+            if "not a" in line and "declared" in line
+        )
+    finally:
+        os.unlink(probe)
+
+
 def test_lint_enforces_control_wait_retry_label(tmp_path):
     """A ``control_wait`` span opened as a retry pause must carry the
     attempt ordinal so retry storms are countable on the timeline."""

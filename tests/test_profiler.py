@@ -1,0 +1,143 @@
+"""First direct unit tests for ``observability/profiler.py``:
+cost-analysis dict shape, MFU math, the bounded step-time window, the
+registry contract, and the trace-server lifecycle."""
+
+import pytest
+
+from dlrover_tpu.observability import profiler as prof
+from dlrover_tpu.observability.metrics import MetricsRegistry
+from dlrover_tpu.observability.profiler import (
+    AProfiler,
+    start_profiler_server,
+    stop_profiler_server,
+)
+
+
+class TestCostAnalysis:
+    def test_dict_shape_and_flops(self):
+        import jax.numpy as jnp
+
+        def fn(a, b):
+            return a @ b
+
+        a = jnp.ones((32, 64), jnp.float32)
+        b = jnp.ones((64, 16), jnp.float32)
+        result = AProfiler().cost_analysis(fn, a, b)
+        assert set(result) >= {"flops", "bytes_accessed"}
+        assert isinstance(result["flops"], float)
+        assert isinstance(result["bytes_accessed"], float)
+        # a 32x64 @ 64x16 matmul is 2*32*64*16 FLOPs analytically;
+        # XLA may fuse/round but cannot report zero
+        assert result["flops"] > 0
+
+    def test_model_flops_per_token(self):
+        assert AProfiler().model_flops_per_token(7_000_000_000) == (
+            pytest.approx(42e9)
+        )
+
+
+class TestStepTiming:
+    def test_mean_and_mfu_math(self):
+        profiler = AProfiler()
+        assert profiler.mean_step_time() == 0.0
+        assert profiler.mfu(1e12) == 0.0  # no samples: 0, not a crash
+        profiler._step_times.extend([0.5, 1.5])
+        assert profiler.mean_step_time() == pytest.approx(1.0)
+        # flops_per_step / mean_t / peak
+        assert profiler.mfu(2.0, peak_flops=4.0) == pytest.approx(0.5)
+
+    def test_step_window_is_bounded(self):
+        profiler = AProfiler()
+        for _ in range(AProfiler.STEP_WINDOW + 100):
+            with profiler.step():
+                pass
+        assert len(profiler._step_times) == AProfiler.STEP_WINDOW
+
+    def test_step_records_to_registry(self):
+        registry = MetricsRegistry(flush_interval=1e9)
+        profiler = AProfiler(registry=registry)
+        with profiler.step("train_step"):
+            pass
+        text = registry.render_text()
+        assert "train_step_seconds_sum" in text
+        assert "train_step_count 1" in text
+
+    def test_step_records_even_when_body_raises(self):
+        profiler = AProfiler()
+        with pytest.raises(ValueError):
+            with profiler.step():
+                raise ValueError("boom")
+        assert len(profiler._step_times) == 1
+
+    def test_registry_without_observe_duration_rejected(self):
+        """The old code discovered a bad registry only at record
+        time, silently losing every sample before it; now the
+        contract is checked at construction."""
+
+        class Bad:
+            def set_gauge(self, *a, **k):
+                ...
+
+        with pytest.raises(TypeError, match="observe_duration"):
+            AProfiler(registry=Bad())
+
+
+class TestProfilerServer:
+    def test_lifecycle_idempotent_start_and_stop(self, monkeypatch):
+        stopped = []
+
+        class FakeServer:
+            def stop(self):
+                stopped.append(True)
+
+        calls = []
+
+        def fake_start(port):
+            calls.append(port)
+            return FakeServer()
+
+        import jax
+
+        monkeypatch.setattr(jax.profiler, "start_server", fake_start)
+        stop_profiler_server()  # clean slate
+        s1 = start_profiler_server(9911)
+        s2 = start_profiler_server(9911)
+        assert s1 is s2  # second start returns the running server
+        assert calls == [9911]
+        stop_profiler_server()
+        assert stopped == [True]
+        stop_profiler_server()  # no-op, no double stop
+        assert stopped == [True]
+        # a fresh start after stop builds a new server
+        s3 = start_profiler_server(9912)
+        assert s3 is not None and s3 is not s1
+        stop_profiler_server()
+
+    def test_start_failure_returns_none(self, monkeypatch):
+        import jax
+
+        def boom(port):
+            raise RuntimeError("no profiler here")
+
+        monkeypatch.setattr(jax.profiler, "start_server", boom)
+        stop_profiler_server()
+        assert start_profiler_server(9913) is None
+        stop_profiler_server()
+
+    def test_module_holds_the_reference(self, monkeypatch):
+        """The server object must be owned by the module, not the
+        caller — jax stops the server when the object is collected,
+        so a dropped return value used to stop it at GC whim."""
+        import jax
+
+        class FakeServer:
+            pass
+
+        monkeypatch.setattr(
+            jax.profiler, "start_server", lambda port: FakeServer()
+        )
+        stop_profiler_server()
+        start_profiler_server(9914)
+        assert prof._profiler_server is not None
+        stop_profiler_server()
+        assert prof._profiler_server is None
