@@ -113,6 +113,29 @@ def auto_accelerate(
     planner = None
     if load_strategy is not None:
         strategy = load_strategy
+        # elastic re-mesh: a pinned strategy sized for the PREVIOUS
+        # world is structurally illegal after a membership change
+        # (its mesh product no longer matches the device count) —
+        # re-solve the factorization for the new world instead of
+        # failing at mesh creation.  The agent exports
+        # DLROVER_TPU_PREV_WORLD across restarts; a same-size restart
+        # keeps the pinned strategy untouched.
+        from dlrover_tpu.accelerate.solver import (
+            resolve_for_world,
+            strategy_device_count,
+        )
+
+        if strategy_device_count(strategy) != len(devices):
+            plan = resolve_for_world(
+                profile,
+                len(devices),
+                batch_per_replica,
+                seq_len,
+                prior=strategy,
+                long_context=long_context,
+                global_batch=global_batch,
+            )
+            strategy = plan.strategy
     else:
         candidates = generate_candidates(
             profile,

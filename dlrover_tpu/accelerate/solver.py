@@ -226,6 +226,68 @@ def solve_offload_groups(
     )
 
 
+def strategy_device_count(strategy: Strategy) -> int:
+    """Devices a strategy's mesh factorization consumes."""
+    return (
+        strategy.data
+        * strategy.fsdp
+        * strategy.tensor
+        * strategy.seq
+        * strategy.expert
+        * strategy.pipe
+    )
+
+
+def resolve_for_world(
+    profile: ModelProfile,
+    n_devices: int,
+    batch_per_replica: int,
+    seq_len: int,
+    prior: Optional[Strategy] = None,
+    **solve_kwargs,
+) -> JointPlan:
+    """Re-solve the parallelism strategy for a CHANGED device count
+    (the elastic re-mesh: a preempted host shrank the world, or a
+    replacement grew it back).
+
+    The prior strategy's tunables are preserved where still legal —
+    the calibration that picked them came from measurements of this
+    very workload — but the mesh factorization is re-solved from
+    scratch: a strategy sized for 8 hosts is structurally illegal on
+    4 (its mesh product no longer matches), and even legal survivors
+    may be far from optimal at the new scale.  Returns the best
+    :class:`JointPlan` for the new world; raises ``ValueError`` when
+    nothing fits (the job genuinely cannot run at this size — better
+    a loud scheduling failure than an OOM loop)."""
+    if prior is not None:
+        solve_kwargs.setdefault(
+            "pipe_microbatches", prior.pipe_microbatches
+        )
+        if prior.expert > 1:
+            solve_kwargs.setdefault("moe", True)
+    plans = solve(
+        profile,
+        n_devices,
+        batch_per_replica,
+        seq_len,
+        top_k=1,
+        **solve_kwargs,
+    )
+    best = plans[0]
+    if prior is not None:
+        from dlrover_tpu.common.log import default_logger
+
+        default_logger.info(
+            "world change re-solve: %s devices -> mesh %s (was "
+            "data=%s fsdp=%s tensor=%s seq=%s expert=%s pipe=%s "
+            "for %s devices)",
+            n_devices, best.describe()["mesh"], prior.data,
+            prior.fsdp, prior.tensor, prior.seq, prior.expert,
+            prior.pipe, strategy_device_count(prior),
+        )
+    return best
+
+
 def candidate_tiles(
     seq_len: int,
     head_dim: int = 128,

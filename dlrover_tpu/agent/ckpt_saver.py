@@ -175,12 +175,18 @@ class AsyncCheckpointSaver:
             root, f"{CheckpointConstant.CKPT_DIR_PREFIX}{step}"
         )
 
-    def save_step_checkpoint(self, step: int, root: Optional[str] = None):
+    def save_step_checkpoint(self, step: int, root: Optional[str] = None,
+                             commit_timeout: Optional[float] = None):
         """Persist all local shm shards of ``step`` and commit.
 
         A shard whose shm snapshot is at a different step makes the
         whole save fail — persisting a mixed-step checkpoint would
-        silently corrupt a later restore."""
+        silently corrupt a later restore.  ``commit_timeout`` bounds
+        the node-0 done-file wait (None = SAVE_TIMEOUT): emergency
+        flushes pass a small bound because under preemption the PEER
+        node may never write its done file — a 600 s poll there would
+        wedge the survivor's restart path behind a commit that cannot
+        happen."""
         from dlrover_tpu.observability.events import anchored_now
 
         t0_mono = time.monotonic()
@@ -257,7 +263,14 @@ class AsyncCheckpointSaver:
         record_ckpt_io("persist", persisted_bytes, io_seconds)
         self._write_done_file(stage)
         if self.config.node_rank == 0:
-            committed = self.commit_checkpoint(step, root)
+            committed = self.commit_checkpoint(
+                step, root,
+                timeout=(
+                    commit_timeout
+                    if commit_timeout is not None
+                    else CheckpointConstant.SAVE_TIMEOUT
+                ),
+            )
             if committed:
                 self._latest_persisted_step = step
             return committed
@@ -310,6 +323,19 @@ class AsyncCheckpointSaver:
         logger.error("commit of step %s timed out", step)
         return False
 
+    def max_common_step(self) -> int:
+        """Newest step present in EVERY local shard's shm (what an
+        emergency flush would persist), or -1.  The agent's graceful
+        drain polls this to learn when the workers' drain-mode
+        snapshots have landed."""
+        step_sets = [
+            set(h.steps_available()) for h in self._shm_handlers
+        ]
+        if not step_sets or not all(step_sets):
+            return -1
+        common = set.intersection(*step_sets)
+        return max(common) if common else -1
+
     def save_shm_to_storage(self, reason: str = ""):
         """Emergency flush: persist whatever valid snapshot sits in shm
         (called on SIGTERM / worker failure; reference ``:473-495``).
@@ -345,7 +371,21 @@ class AsyncCheckpointSaver:
             "emergency-flushing shm checkpoint step %s (%s)",
             step, reason,
         )
-        return self.save_step_checkpoint(step)
+        from dlrover_tpu.common.env import env_float
+
+        # bounded commit: under preemption the peer node may never
+        # write its done file; the shards themselves are persisted
+        # either way, and a restart must not stall behind the poll
+        return self.save_step_checkpoint(
+            step,
+            commit_timeout=env_float(
+                "DLROVER_TPU_EMERGENCY_COMMIT_TIMEOUT_S", 20.0
+            ),
+        )
+
+    #: whether the atexit fallback flush is armed (non-main-thread
+    #: embedders that could not install the SIGTERM hook)
+    _atexit_registered = False
 
     @classmethod
     def register_signal_handlers(cls):
@@ -362,6 +402,42 @@ class AsyncCheckpointSaver:
 
         signal.signal(signal.SIGTERM, _on_term)
 
+    @classmethod
+    def _atexit_flush(cls):
+        """Fallback crash-snapshot flush for embedders that could not
+        install the SIGTERM hook: runs at interpreter shutdown, so a
+        clean SystemExit (including the one a SIGTERM's default
+        handler does NOT produce, but an embedder's catch-and-exit
+        does) still lands the last shm snapshot in storage."""
+        saver = cls._instance
+        if saver is not None and not saver._stopped:
+            try:
+                saver.save_shm_to_storage(reason="atexit fallback")
+            except Exception as e:  # noqa: BLE001 - shutdown path
+                logger.warning("atexit ckpt flush failed: %s", e)
+
+    @classmethod
+    def register_atexit_fallback(cls):
+        """Arm the atexit fallback flush + warning metric.  Called
+        when ``register_signal_handlers`` failed (not on the main
+        thread): embedded/test callers still get the crash snapshot
+        on any orderly interpreter exit, and the metric flags that
+        TRUE kill-signal coverage is missing."""
+        import atexit
+
+        if cls._atexit_registered:
+            return
+        cls._atexit_registered = True
+        atexit.register(cls._atexit_flush)
+        try:
+            from dlrover_tpu.observability.metrics import get_registry
+
+            get_registry().inc_counter(
+                "dlrover_tpu_ckpt_sigterm_fallback"
+            )
+        except Exception:  # noqa: BLE001 - metrics never break startup
+            pass
+
     # -- factory (class-level) ---------------------------------------------
     @classmethod
     def start_async_saving_ckpt(cls, install_signal_handlers: bool = True):
@@ -372,9 +448,15 @@ class AsyncCheckpointSaver:
             try:
                 cls.register_signal_handlers()
             except ValueError:
+                # embedded/test caller off the main thread: a SIGTERM
+                # will not flush, but an orderly interpreter exit
+                # still can — arm the atexit fallback instead of
+                # silently dropping crash-snapshot coverage
                 logger.warning(
-                    "not on main thread: SIGTERM flush hook not installed"
+                    "not on main thread: SIGTERM flush hook not "
+                    "installed; registering atexit fallback flush"
                 )
+                cls.register_atexit_fallback()
         factory_queue = SharedQueue(FACTORY_QUEUE, create=True)
 
         def _factory_loop():

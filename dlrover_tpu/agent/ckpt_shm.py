@@ -125,8 +125,16 @@ class SharedMemoryHandler:
     NUM_SLOTS = 2  # double-buffer: previous snapshot survives a crash
     _ALIGN = 4096
 
-    def save_state(self, step: int, tree) -> int:
+    def save_state(self, step: int, tree, layouts=None) -> int:
         """Snapshot a pytree into shm; returns total bytes written.
+
+        ``layouts`` ({keypath: LeafLayout dict}, see
+        ``trainer/checkpoint/reshard.py``) is the per-leaf
+        global-layout header: the leaf's global shape plus this
+        shard's index slice.  It rides the slot meta and every
+        persisted ``.drckpt`` header, making the shard readable by
+        ANY world size (resharded restore).  None keeps the legacy
+        world-locked format.
 
         Single-pass drain: specs are computed from leaf metadata (no
         transfer), then each leaf is materialized and copied into its
@@ -183,16 +191,19 @@ class SharedMemoryHandler:
         other = slots.get(str((slot + 1) % self.NUM_SLOTS))
         header = {"slots": slots, "stride": stride, "last_slot": last}
         if other and other.get("valid"):
-            self.meta.update(
-                dict(
-                    header,
-                    step=other["step"],
-                    specs=other["specs"],
-                    total_bytes=other["total_bytes"],
-                    base=other["base"],
-                    valid=True,
-                )
+            repoint = dict(
+                header,
+                step=other["step"],
+                specs=other["specs"],
+                total_bytes=other["total_bytes"],
+                base=other["base"],
+                valid=True,
             )
+            # explicit None beats key-absence: SharedDict.update
+            # merges, so a stale top-level layouts entry from an
+            # earlier save would otherwise describe the wrong specs
+            repoint["layouts"] = other.get("layouts")
+            self.meta.update(repoint)
         else:
             self.meta.update(dict(header, valid=False))
 
@@ -206,6 +217,7 @@ class SharedMemoryHandler:
             "base": base,
             "valid": True,
         }
+        slot_meta["layouts"] = dict(layouts) if layouts else None
         slots[str(slot)] = slot_meta
         self.meta.update(
             dict(
@@ -378,6 +390,26 @@ class SharedMemoryHandler:
             return -1
         return meta.get("step", -1)
 
+    def slot_layouts(self, step: Optional[int] = None):
+        """The global-layout header of the slot holding ``step``
+        (None = newest valid), or None when the slot predates layout
+        headers / does not exist."""
+        slot = self._resolve_slot(self.meta.get_all(), step)
+        if slot is None:
+            return None
+        return slot.get("layouts") or None
+
+    def slot_shapes(self, step: Optional[int] = None):
+        """{keypath: local shape} of the slot holding ``step``, read
+        from the meta specs alone — no shm attach, no leaf views."""
+        slot = self._resolve_slot(self.meta.get_all(), step)
+        if slot is None:
+            return None
+        return {
+            key: tuple(int(d) for d in shape)
+            for key, _dt, shape, _off, _nb in slot["specs"]
+        }
+
     def load_state(
         self, copy: bool = True, step: Optional[int] = None
     ) -> Tuple[int, Dict[str, np.ndarray]]:
@@ -444,9 +476,13 @@ class SharedMemoryHandler:
         if not self.attach(min_size=base + total):
             logger.warning("shm segment missing for rank %s", self._rank)
             return None
-        header = pickle.dumps(
-            {"step": slot["step"], "specs": slot["specs"]}
-        )
+        file_meta = {"step": slot["step"], "specs": slot["specs"]}
+        if slot.get("layouts"):
+            # the device-count-agnostic header: with per-leaf global
+            # layouts in the file, ANY world size can reassemble any
+            # leaf from whichever shards cover its new slices
+            file_meta["layouts"] = slot["layouts"]
+        header = pickle.dumps(file_meta)
         # stream header + BOUNDED zero-copy slices of the shm buffer:
         # the agent never materializes a second shard-sized object,
         # and backends that buffer per-chunk (multipart uploads) see
@@ -497,9 +533,10 @@ class TruncatedShardError(ValueError):
 def stream_shard_leaves(path: str, storage=None):
     """Generator over a persisted ``*.drckpt`` shard, leaf by leaf.
 
-    Yields ``("meta", step, specs)`` first, then ``("leaf", key,
-    ndarray)`` for each leaf THE MOMENT its bytes land, in file
-    (offset) order.  All leaf views share ONE preallocated private
+    Yields ``("meta", step, specs, layouts)`` first (``layouts`` is
+    the per-leaf global-layout header dict, or None for old-format
+    files), then ``("leaf", key, ndarray)`` for each leaf THE MOMENT
+    its bytes land, in file (offset) order.  All leaf views share ONE preallocated private
     buffer (the ``read_shard_file`` memory discipline) — peak memory
     is the shard size.  The leaf-granular stream is what lets a
     restore consumer pipeline ``device_put`` against the tail of the
@@ -524,7 +561,7 @@ def stream_shard_leaves(path: str, storage=None):
             (int(off) + int(nbytes) for _k, _d, _s, off, nbytes in specs),
             default=0,
         )
-        yield "meta", meta.get("step", -1), specs
+        yield "meta", meta.get("step", -1), specs, meta.get("layouts")
         raw = np.empty(total, dtype=np.uint8)
         mv = memoryview(raw)
         filled = 0

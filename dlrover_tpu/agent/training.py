@@ -30,6 +30,7 @@ from typing import Dict, List, Optional, Sequence
 from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
 from dlrover_tpu.agent.master_client import MasterClient, ReportBuffer
 from dlrover_tpu.common.constants import (
+    AgentExitCode,
     NodeEnv,
     RendezvousConstant,
     RendezvousName,
@@ -38,6 +39,8 @@ from dlrover_tpu.common.constants import (
 from dlrover_tpu.common.env import (
     control_longpoll_enabled,
     get_free_port,
+    preempt_drain_grace_s,
+    reshard_enabled,
 )
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.observability.events import get_event_logger
@@ -51,6 +54,13 @@ class ElasticLaunchConfig:
     max_nodes: int = 1
     nproc_per_node: int = 1
     rdzv_timeout: int = RendezvousConstant.MAX_WAIT_SECS
+    # master-side window rule: how long after the last join an
+    # under-max round waits before completing with what it has.
+    # <0 = rdzv_timeout (the historical coupling).  The preemption
+    # harness shortens THIS without shrinking the join wait: a lone
+    # survivor must re-mesh in seconds, while a joining node may
+    # legitimately wait minutes for peers.
+    rdzv_waiting_timeout: float = -1.0
     node_unit: int = 1
     network_check: bool = False
     comm_perf_test: bool = False
@@ -210,6 +220,16 @@ class ElasticTrainingAgent:
         self._coordinator_port = get_free_port()
         self._stopped = False
         self._zygote = None  # ZygotePool when config.prefork
+        #: the node received a preemption notice / SIGTERM: it must
+        #: drain + flush, NOT restart into the next rendezvous (the
+        #: hardware is going away; the master has fenced it)
+        self._preempted = False
+        #: the master excluded this node from the comm world
+        self._excluded = False
+        #: world size of the previous completed round (exported to
+        #: workers as DLROVER_TPU_PREV_WORLD so the trainer can
+        #: re-solve its parallelism strategy on a world change)
+        self._last_world_size = 0
         #: last waiting-node count seen by the monitor pacing long-poll
         self._last_waiting = 0
         #: shared coalescing buffer for fire-and-forget reports
@@ -302,6 +322,11 @@ class ElasticTrainingAgent:
                 NodeEnv.COORDINATOR_ADDR: coordinator,
                 "DLROVER_TPU_RDZV_ROUND": str(rdzv_round),
                 "DLROVER_TPU_RESTART_COUNT": str(self._restart_count),
+                # the previous round's world size: a relaunched
+                # trainer compares it against the new world to decide
+                # whether its pinned parallelism strategy must be
+                # re-solved (accelerate/solver.resolve_for_world)
+                "DLROVER_TPU_PREV_WORLD": str(self._last_world_size),
             }
         )
         if self._config.compile_cache_dir:
@@ -320,7 +345,14 @@ class ElasticTrainingAgent:
         try:
             rdzv_round, world = self._rendezvous()
         except NodeExcludedError as e:
+            # a scheduling verdict, not a crash: surface it as its
+            # own failure level + a distinct agent exit code so the
+            # controller does not reschedule the node into this job
             logger.error("%s", e)
+            self._excluded = True
+            self._try_report_failure(
+                str(e), TrainingExceptionLevel.NODE_EXCLUDED
+            )
             return False
         except (TimeoutError, ConnectionError) as e:
             logger.error("rendezvous failed: %s", e)
@@ -364,6 +396,7 @@ class ElasticTrainingAgent:
                     self._entrypoint, env=env
                 )
             self._procs.append(proc)
+        self._last_world_size = world_size
         return True
 
     # ------------------------------------------------------------- monitor
@@ -446,6 +479,58 @@ class ElasticTrainingAgent:
             except Exception as e:  # noqa: BLE001
                 logger.warning("breakpoint ckpt flush failed: %s", e)
 
+    def _drain_worker_snapshots(self, reason: str):
+        """Graceful drain: ask every live worker (SIGUSR1 →
+        ``trainer/drain.py``) to snapshot at each step boundary, then
+        wait — bounded by ``DLROVER_TPU_PREEMPT_DRAIN_GRACE_S`` — for
+        a FRESH common step to land in shm, so the flush that follows
+        persists the step the world just completed instead of the
+        last periodic snapshot.  Workers wedged in a collective
+        simply cannot advance; the grace expires and the flush uses
+        the newest complete snapshot, exactly today's behavior.
+        No-op under ``DLROVER_TPU_RESHARD=0``."""
+        if not reshard_enabled():
+            return
+        live = [p for p in self._procs if p.poll() is None]
+        if not live:
+            return
+        from dlrover_tpu.trainer.drain import DRAIN_SIGNAL
+
+        saver = AsyncCheckpointSaver.get_ckpt_saver()
+        before = saver.max_common_step() if saver is not None else -1
+        for proc in live:
+            try:
+                proc.send_signal(DRAIN_SIGNAL)
+            except (ProcessLookupError, OSError):
+                pass
+        grace = preempt_drain_grace_s()
+        logger.info(
+            "drain requested of %d workers (%s); waiting up to "
+            "%.1fs for a fresh snapshot (current common step %s)",
+            len(live), reason, grace, before,
+        )
+        if saver is None:
+            # no agent-side saver (tests / exotic embeddings): give
+            # the workers one bounded beat to run their drain saves
+            time.sleep(min(grace, 1.0))
+            return
+        deadline = time.time() + grace
+        while time.time() < deadline:
+            common = saver.max_common_step()
+            if common > before >= 0 or (before < 0 <= common):
+                logger.info(
+                    "drain snapshot landed at step %s", common
+                )
+                return
+            if all(p.poll() is not None for p in live):
+                return  # nothing left to wait on
+            time.sleep(0.1)
+        logger.warning(
+            "drain grace expired (%.1fs); flushing the newest "
+            "complete snapshot (step %s)", grace,
+            saver.max_common_step(),
+        )
+
     def _restart_workers(
         self, reason: str, consume_budget: bool = True
     ) -> bool:
@@ -471,6 +556,13 @@ class ElasticTrainingAgent:
         with get_event_logger().span(
             "restart", reason=reason, inc=self._restart_count
         ):
+            if not consume_budget:
+                # elastic re-mesh: the workers are still coupled and
+                # stepping — drain them so the flush below persists a
+                # FRESH step for the new world to reshard from (a
+                # failure restart skips this: the group is broken and
+                # nothing can advance)
+                self._drain_worker_snapshots(reason)
             self._save_ckpt_to_storage(reason)
             # failure restarts: the group is broken and the shm
             # snapshot is already flushed — survivors wedged in
@@ -564,6 +656,19 @@ class ElasticTrainingAgent:
             timeline_reporter.start()
         if self._start_ckpt_saver:
             factory_queue = AsyncCheckpointSaver.start_async_saving_ckpt()
+        if reshard_enabled():
+            # graceful-drain SIGTERM: supersede the bare ckpt_saver
+            # flush hook with drain → flush → fence → exit, so a pod
+            # kill leaves survivors a FRESH reshardable checkpoint
+            # and an already-fenced master.  DLROVER_TPU_RESHARD=0
+            # keeps today's flush-only hook exactly.
+            try:
+                signal.signal(signal.SIGTERM, self._on_sigterm)
+            except ValueError:
+                logger.warning(
+                    "not on main thread: graceful SIGTERM drain not "
+                    "installed"
+                )
         if self._config.watch_preemption:
             from dlrover_tpu.agent.preemption import PreemptionWatcher
 
@@ -608,19 +713,46 @@ class ElasticTrainingAgent:
                 AsyncCheckpointSaver.reset()
 
     def _on_preemption(self, event: str):
-        """Maintenance event: flush the newest shm snapshot to storage
-        and fence this node at the master BEFORE the hardware goes
-        away (the SIGTERM path may never run)."""
+        """Maintenance event: drain the workers to a fresh snapshot,
+        flush it to storage, and fence this node at the master BEFORE
+        the hardware goes away (the SIGTERM path may never run).  The
+        ``node_preempted`` report makes the master fence the node out
+        of the next round immediately, so survivors observe the
+        membership change within one monitor interval instead of
+        waiting for this node's heartbeat to go stale."""
+        self._preempted = True
         with get_event_logger().span("preemption_drain", event=event):
+            self._drain_worker_snapshots(f"preemption:{event}")
             self._save_ckpt_to_storage(f"preemption:{event}")
             self._try_report_failure(
                 f"maintenance event {event}",
-                TrainingExceptionLevel.NODE_ERROR,
+                TrainingExceptionLevel.NODE_PREEMPTED
+                if reshard_enabled()
+                else TrainingExceptionLevel.NODE_ERROR,
             )
+
+    def _on_sigterm(self, signum, frame):  # pragma: no cover - signal
+        """Pod kill: drain → flush → fence, then die with the
+        preemption exit code.  Runs on the main thread (signal
+        contract); every step is bounded so the pod's termination
+        grace is respected."""
+        logger.warning("SIGTERM: graceful drain before exit")
+        self._on_preemption(f"SIGTERM:{signum}")
+        self._stop_workers(
+            timeout=self._config.failure_stop_timeout
+        )
+        raise SystemExit(AgentExitCode.NODE_PREEMPTED)
+
+    def _exit_code(self, default: int = AgentExitCode.ERROR) -> int:
+        if self._excluded:
+            return AgentExitCode.NODE_EXCLUDED
+        if self._preempted:
+            return AgentExitCode.NODE_PREEMPTED
+        return default
 
     def _invoke_run(self) -> int:
         if not self._initialize_workers():
-            return 1
+            return self._exit_code()
         while True:
             self._pace_monitor()
             result = self._monitor_workers()
@@ -632,6 +764,16 @@ class ElasticTrainingAgent:
                     pass
                 return 0
             if result.state == WorkerState.FAILED:
+                if self._preempted:
+                    # the hardware is going away and the drain +
+                    # flush + fence already happened — restarting
+                    # into a rendezvous the master fenced us out of
+                    # would only delay the pod's death
+                    logger.info(
+                        "workers gone after preemption drain; "
+                        "exiting without restart"
+                    )
+                    return AgentExitCode.NODE_PREEMPTED
                 logger.error(
                     "worker failure: local ranks %s codes %s",
                     result.failed_ranks,
@@ -639,14 +781,14 @@ class ElasticTrainingAgent:
                 )
                 self._report_failure(result)
                 if not self._restart_workers("worker failure"):
-                    return 1
+                    return self._exit_code()
                 continue
             # HEALTHY: elastic re-mesh when new nodes wait at the master
             if self._membership_changed():
                 if not self._restart_workers(
                     "membership change", consume_budget=False
                 ):
-                    return 1
+                    return self._exit_code()
 
 
 def launch_agent(
@@ -658,10 +800,15 @@ def launch_agent(
     ``training.py:776``)."""
     config.auto_configure_params()
     client = MasterClient.singleton_instance(master_addr)
+    waiting_timeout = (
+        config.rdzv_waiting_timeout
+        if config.rdzv_waiting_timeout >= 0
+        else config.rdzv_timeout
+    )
     client.report_rdzv_params(
         config.min_nodes,
         config.max_nodes,
-        config.rdzv_timeout,
+        waiting_timeout,
         config.node_unit,
     )
     agent = ElasticTrainingAgent(config, entrypoint, client=client)

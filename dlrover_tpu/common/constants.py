@@ -102,8 +102,30 @@ class TrainingExceptionLevel:
     PROCESS_ERROR = "process_error"
     NODE_ERROR = "node_error"
     RDZV_ERROR = "rdzv_error"
+    # the node received a preemption notice / SIGTERM and has DRAINED
+    # (fresh shm snapshot flushed): the master should fence it out of
+    # the next rendezvous immediately so survivors reshard without
+    # waiting for its heartbeat to go stale
+    NODE_PREEMPTED = "node_preempted"
+    # the master left this node out of the completed comm world
+    # (fault / straggler verdict): a scheduling decision, not a crash
+    NODE_EXCLUDED = "node_excluded"
     WARNING = "warning"
     INFO = "info"
+
+
+class AgentExitCode:
+    """Distinct agent process exit codes: the supervising controller
+    (and the chaos harness) keys recovery policy on WHY the agent
+    exited — an excluded node must not be rescheduled into the same
+    job the way a generic failure is."""
+
+    SUCCESS = 0
+    ERROR = 1
+    #: the master excluded this node from the comm world
+    NODE_EXCLUDED = 3
+    #: the node was preempted and exited after a graceful drain
+    NODE_PREEMPTED = 43
 
 
 class TrainingLoopStatus:

@@ -158,6 +158,43 @@ def timeline_max_rows() -> int:
     return int(env_float(TIMELINE_MAX_ROWS_ENV, 500_000))
 
 
+RESHARD_ENV = "DLROVER_TPU_RESHARD"
+CKPT_CLOSE_TIMEOUT_ENV = "DLROVER_TPU_CKPT_CLOSE_TIMEOUT_S"
+PREEMPT_DRAIN_GRACE_ENV = "DLROVER_TPU_PREEMPT_DRAIN_GRACE_S"
+
+
+def reshard_enabled() -> bool:
+    """Kill-switch for the elastic-reshard subsystem: device-count-
+    agnostic layout headers on checkpoint shards, the overlap-range
+    resharded restore leg in ``CheckpointEngine``, the agent's
+    graceful worker drain (SIGUSR1 snapshot-every-step + SIGTERM
+    drain-then-flush) and the ``node_preempted`` master fencing.
+    ``DLROVER_TPU_RESHARD=0`` reproduces today's behavior exactly: a
+    world-size change restores per-rank shard files or fails, the
+    SIGTERM path is the bare ckpt_saver flush, and preemption reports
+    stay ``node_error``.  Default: enabled."""
+    return os.getenv(RESHARD_ENV, "1").lower() not in (
+        "0", "false", "off",
+    )
+
+
+def ckpt_close_timeout_s() -> float:
+    """How long ``CheckpointEngine.close()`` waits for an in-flight
+    snapshot drain before deliberately LEAKING the shm/lock/queue
+    handles (closing under a live drain would corrupt the persist —
+    the leak is the safe outcome, now observable via the
+    ``dlrover_tpu_ckpt_drain_stuck`` counter)."""
+    return env_float(CKPT_CLOSE_TIMEOUT_ENV, 300.0)
+
+
+def preempt_drain_grace_s() -> float:
+    """How long the agent waits, after asking workers to drain
+    (SIGUSR1 -> snapshot-every-step), for a fresh common step to land
+    in shm before flushing to storage.  Bounded by the preemption
+    notice lead (~60 s on GCE) and the pod's SIGTERM grace."""
+    return env_float(PREEMPT_DRAIN_GRACE_ENV, 5.0)
+
+
 MASTER_FAILOVER_ENV = "DLROVER_TPU_MASTER_FAILOVER"
 RECONNECT_DEADLINE_ENV = "DLROVER_TPU_MASTER_RECONNECT_DEADLINE_S"
 SNAPSHOT_INTERVAL_ENV = "DLROVER_TPU_CONTROL_SNAPSHOT_INTERVAL_S"

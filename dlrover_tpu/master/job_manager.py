@@ -329,9 +329,20 @@ class JobManager(metaclass=ABCMeta):
             node = self._nodes.get(node_id)
             if node is None:
                 return
-            if level == TrainingExceptionLevel.NODE_ERROR:
+            if level in (
+                TrainingExceptionLevel.NODE_ERROR,
+                TrainingExceptionLevel.NODE_PREEMPTED,
+            ):
+                # a preempted node is hardware-gone like a failed one
+                # (relaunch verdict set so the controller replaces
+                # it); the rendezvous fencing rides the servicer path
                 node.set_exit_reason(NodeExitReason.HARDWARE_ERROR)
                 self._restart_verdicts[node_id] = True
+            elif level == TrainingExceptionLevel.NODE_EXCLUDED:
+                # a scheduling verdict the master itself issued: audit
+                # trail only — no relaunch verdict, no error-monitor
+                # escalation (the node is healthy, just unwanted)
+                pass
             elif action is not None:
                 from dlrover_tpu.master.error_monitor import (
                     RecoveryAction,

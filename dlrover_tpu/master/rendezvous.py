@@ -63,6 +63,17 @@ class RendezvousManager(metaclass=ABCMeta):
         #: bumped on every state change (join/remove/params/round
         #: completion); the ``CommWorld`` delta protocol's version
         self._version = 0
+        #: preemption-fenced nodes: rank -> fence expiry (epoch s).
+        #: A fenced node is excluded from round completion (its
+        #: hardware is going away); the fence expires so a re-created
+        #: pod with the same rank can rejoin later.
+        self._fenced: Dict[int, float] = {}
+        #: a member of the live world was fenced: survivors must
+        #: re-mesh even though nobody is WAITING yet — this makes
+        #: ``num_nodes_waiting`` signal the membership change within
+        #: one monitor interval of the preemption notice instead of
+        #: after the dead node's heartbeat goes stale
+        self._pending_remesh = False
         #: failover journal hook: ``cb(op, args)``; rendezvous state is
         #: tiny, so every mutation journals the FULL state dict —
         #: replay is last-writer-wins and therefore idempotent, and a
@@ -138,10 +149,43 @@ class RendezvousManager(metaclass=ABCMeta):
                     self._name, node_rank,
                 )
 
+    def _live_fenced_locked(self) -> Dict[int, float]:
+        """Caller holds the lock: prune expired fences, return live."""
+        now = time.time()
+        expired = [r for r, t in self._fenced.items() if t <= now]
+        for r in expired:
+            del self._fenced[r]
+        return self._fenced
+
+    def fence_node(self, node_rank: int,
+                   ttl_s: Optional[float] = None):
+        """Preemption fencing: the node reported it is about to die
+        (graceful drain done).  Drop it from the pending round,
+        exclude it from completions until the fence expires, and —
+        when it was part of the live world — raise the pending-remesh
+        flag so survivors' waiting-count long-polls wake NOW."""
+        if ttl_s is None:
+            from dlrover_tpu.common.env import env_float
+
+            ttl_s = env_float("DLROVER_TPU_FENCE_TTL_S", 30.0)
+        with self._lock:
+            self._fenced[node_rank] = time.time() + max(ttl_s, 0.0)
+            self._waiting_nodes.pop(node_rank, None)
+            if node_rank in self._latest_rdzv_nodes:
+                self._pending_remesh = True
+            self._mutated()
+            logger.info(
+                "%s: fenced node %s for %.0fs (pending_remesh=%s)",
+                self._name, node_rank, ttl_s, self._pending_remesh,
+            )
+
     def join_rendezvous(self, node_rank: int, local_world_size: int) -> int:
         with self._lock:
             if not self._waiting_nodes:
                 self._start_rdzv_time = time.time()
+            # a join IS liveness: a re-created pod re-announcing
+            # itself clears its own fence
+            self._fenced.pop(node_rank, None)
             self._waiting_nodes[node_rank] = local_world_size
             self._rdzv_nodes = {}
             self._lastcall_time = time.time()
@@ -156,7 +200,13 @@ class RendezvousManager(metaclass=ABCMeta):
         """Caller holds the lock.  The window rule (reference ``:135``):
         complete immediately at max_nodes; after waiting_timeout complete
         with the largest multiple of node_unit >= min_nodes."""
-        waiting = len(self._waiting_nodes)
+        fenced = self._live_fenced_locked()
+        eligible = {
+            r: v
+            for r, v in self._waiting_nodes.items()
+            if r not in fenced
+        }
+        waiting = len(eligible)
         params = self._rdzv_params
         if waiting == params.max_nodes:
             completed = True
@@ -175,10 +225,9 @@ class RendezvousManager(metaclass=ABCMeta):
             # round down to a node_unit multiple; excess nodes STAY in
             # the waiting list so they keep signalling a pending
             # re-rendezvous instead of being stranded
-            waiting = len(self._waiting_nodes)
             usable = (waiting // self._node_unit) * self._node_unit
             usable = min(usable, self._rdzv_params.max_nodes)
-            ranks = sorted(self._waiting_nodes.keys())[:usable]
+            ranks = sorted(eligible.keys())[:usable]
             # topology-aware ordering: neighbors on the interconnect
             # get adjacent global ranks (the world dict's insertion
             # order IS the rank order the agents apply); numeric order
@@ -193,6 +242,8 @@ class RendezvousManager(metaclass=ABCMeta):
             self._lastcall_time = 0.0
             self._rdzv_round += 1
             self._ckpt_steps = {}  # new world: reset the ckpt barrier
+            # the re-mesh the fence demanded has happened
+            self._pending_remesh = False
             self._mutated()
             logger.info(
                 "%s rendezvous round %s completed with %s nodes",
@@ -267,16 +318,26 @@ class RendezvousManager(metaclass=ABCMeta):
         alone must NOT signal a restart (they cannot change the world),
         or every completed round with a remainder would trigger an
         infinite restart storm.  A re-joining member of the latest world
-        always signals (its training process died)."""
+        always signals (its training process died).  A PENDING REMESH
+        (a live-world member was preemption-fenced) signals even with
+        an empty waiting list: the survivors must re-rendezvous away
+        from the dying node, and they learn it from this count."""
         with self._lock:
             if not self._waiting_nodes:
-                return 0
+                return self._node_unit if self._pending_remesh else 0
             rejoined = any(
                 r in self._latest_rdzv_nodes
                 for r in self._waiting_nodes
             )
-            if rejoined or len(self._waiting_nodes) >= self._node_unit:
-                return len(self._waiting_nodes)
+            if (
+                rejoined
+                or self._pending_remesh
+                or len(self._waiting_nodes) >= self._node_unit
+            ):
+                return max(
+                    len(self._waiting_nodes),
+                    self._node_unit if self._pending_remesh else 0,
+                )
             return 0
 
     def sync_ckpt_nodes(self, node_id: int, step: int) -> bool:
@@ -311,6 +372,10 @@ class RendezvousManager(metaclass=ABCMeta):
             ],
             "lastcall": self._lastcall_time,
             "version": self._version,
+            "fenced": {
+                str(r): float(t) for r, t in self._fenced.items()
+            },
+            "pending_remesh": self._pending_remesh,
         }
         state.update(self._export_extra_locked())
         return state
@@ -364,6 +429,13 @@ class RendezvousManager(metaclass=ABCMeta):
             # the master re-join and re-arm it anyway)
             if self._waiting_nodes and state.get("lastcall"):
                 self._lastcall_time = time.time()
+            self._fenced = {
+                int(k): float(v)
+                for k, v in (state.get("fenced") or {}).items()
+            }
+            self._pending_remesh = bool(
+                state.get("pending_remesh", False)
+            )
             self._restore_extra_locked(state)
             self._version = max(
                 self._version, int(state.get("version", 0))

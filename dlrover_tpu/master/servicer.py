@@ -14,6 +14,7 @@ from dlrover_tpu.common import messages as msg
 from dlrover_tpu.common.comm import build_master_server
 from dlrover_tpu.common.constants import (
     RendezvousName,
+    TrainingExceptionLevel,
     TrainingLoopStatus,
 )
 from dlrover_tpu.common.env import master_failover_enabled
@@ -517,6 +518,16 @@ class MasterServicer:
                     request.error_data,
                     request.level,
                 )
+            if request.level == TrainingExceptionLevel.NODE_PREEMPTED:
+                # graceful drain done on the node: fence it out of the
+                # next round NOW so survivors' waiting-count long-polls
+                # wake within one monitor interval (waiting for its
+                # heartbeat to go stale would eat the preemption lead)
+                training = self._rdzv_managers.get(
+                    RendezvousName.ELASTIC_TRAINING
+                )
+                if training is not None:
+                    training.fence_node(node_id)
             if self._health_engine is not None:
                 self._health_engine.observe_fault(
                     node_id, request.level

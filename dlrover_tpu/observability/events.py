@@ -83,6 +83,13 @@ PHASE_CHECKPOINT_RESTORE = "checkpoint_restore"
 # compile so a serial-path span that covers the same instant keeps
 # its attribution.
 PHASE_RESTORE_PREFETCH = "restore_prefetch"
+# elastic-reshard data leg (trainer/checkpoint/reshard.py): the
+# overlap-range reads that reassemble this rank's NEW slices from a
+# checkpoint written by a DIFFERENT world size.  Ranks with the other
+# restore legs: below checkpoint_restore (a covering serial-restore
+# span keeps its attribution) and beside restore_prefetch (the leg it
+# replaces when the world changed).
+PHASE_RESHARD = "reshard"
 PHASE_FINISH_RESTORE = "finish_restore"
 PHASE_COMPILE = "compile"
 PHASE_AOT_COMPILE = "aot_compile"
@@ -113,6 +120,7 @@ PHASES: Tuple[str, ...] = (
     PHASE_PREEMPTION_DRAIN,
     PHASE_CHECKPOINT_RESTORE,
     PHASE_RESTORE_PREFETCH,
+    PHASE_RESHARD,
     PHASE_FINISH_RESTORE,
     PHASE_COMPILE,
     PHASE_AOT_COMPILE,
@@ -187,6 +195,11 @@ REQUIRED_SPAN_LABELS: Dict[str, Tuple[str, ...]] = {
     # window was active (vs the serial kill-switched stream) so DMA
     # pipeline regressions are attributable from the timeline alone
     PHASE_OFFLOAD_COPY: ("bytes", "throughput_gbps", "buffered"),
+    # a reshard span without the world transition and the moved bytes
+    # is uninterpretable: "8→4, 3.1 GB at 1.2 GB/s" is the whole story
+    # of an elastic restore, and MTTR regressions key on it
+    PHASE_RESHARD: ("from_world", "to_world", "bytes",
+                    "throughput_gbps"),
     PHASE_RESTART: ("reason",),
     PHASE_PREEMPTION_DRAIN: ("event",),
     # which control-plane wait parked (kv | comm_world | task |

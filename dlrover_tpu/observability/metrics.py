@@ -224,6 +224,33 @@ def record_offload_io(nbytes: int, seconds: float, buffered: bool):
         logger.warning("offload io metric export failed: %s", e)
 
 
+def record_reshard_io(from_world: int, to_world: int, nbytes: int,
+                      seconds: float):
+    """Export one elastic-reshard restore measurement as gauges
+    (``dlrover_tpu_reshard_gbps`` / ``_bytes``, labeled with the world
+    transition) plus a ``dlrover_tpu_reshard_total`` counter: the
+    overlap-range bytes that reassembled this rank's new slices from a
+    different-world checkpoint.  Never raises — metrics must not break
+    a restore."""
+    try:
+        reg = get_registry()
+        labels = {
+            "from_world": str(int(from_world)),
+            "to_world": str(int(to_world)),
+        }
+        reg.set_gauge(
+            "dlrover_tpu_reshard_gbps",
+            nbytes / 1e9 / max(seconds, 1e-9),
+            labels=labels,
+        )
+        reg.set_gauge(
+            "dlrover_tpu_reshard_bytes", float(nbytes), labels=labels
+        )
+        reg.inc_counter("dlrover_tpu_reshard_total")
+    except Exception as e:  # noqa: BLE001
+        logger.warning("reshard metric export failed: %s", e)
+
+
 def record_dropped_reports(n: int = 1):
     """Count fire-and-forget reports dropped by the client-side
     ``ReportBuffer`` overflow cap during a master outage

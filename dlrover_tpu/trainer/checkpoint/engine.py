@@ -44,6 +44,11 @@ from dlrover_tpu.agent.ckpt_shm import (
     shard_lock,
     stream_shard_leaves,
 )
+from dlrover_tpu.common.env import (
+    ckpt_close_timeout_s,
+    reshard_enabled,
+)
+from dlrover_tpu.trainer.checkpoint import reshard as _reshard
 
 
 def _newest_common_step(pairs) -> int:
@@ -169,10 +174,13 @@ class RestorePrefetch:
 
     def __init__(self, engine: "CheckpointEngine",
                  checkpoint_dir: Optional[str] = None,
-                 start_gate=None):
+                 start_gate=None, layouts=None):
         self._engine = engine
         self._dir = checkpoint_dir
         self._gate = start_gate
+        #: requested per-leaf global layouts for THIS rank's new
+        #: slices (reshard-aware restore); None = legacy same-world
+        self._layouts = layouts
         self.error: Optional[BaseException] = None
         self.shm_steps: List[int] = []
         self.storage_step = -1
@@ -217,7 +225,7 @@ class RestorePrefetch:
         t0_wall = anchored_now(t0_mono)
         eng = self._engine
         try:
-            self.shm_steps = eng._shm_handler.steps_available()
+            self.shm_steps = eng._usable_shm_steps(self._layouts)
             self.storage_step, self.storage_dir = (
                 eng._latest_storage_step(self._dir)
             )
@@ -290,12 +298,11 @@ class RestorePrefetch:
 
     def _stage_storage(self, step: int, ckpt_dir: str,
                        cand: _StagedCandidate):
-        path = os.path.join(
-            ckpt_dir, f"shard_{self._engine._rank}.drckpt"
-        )
+        eng = self._engine
         try:
+            stream = eng._storage_leaf_stream(ckpt_dir, self._layouts)
             got = -1
-            for item in stream_shard_leaves(path, self._engine._storage):
+            for item in stream:
                 if item[0] == "meta":
                     got = item[1]
                 else:
@@ -306,7 +313,7 @@ class RestorePrefetch:
             cand.finish(failed=True)
             logger.warning(
                 "rank %s: storage prefetch of step %s failed: %s",
-                self._engine._rank, step, e,
+                eng._rank, step, e,
             )
 
 
@@ -430,7 +437,7 @@ class CheckpointEngine:
 
     # -- save --------------------------------------------------------------
     def save_to_memory(self, step: int, state,
-                       blocking: bool = True) -> bool:
+                       blocking: bool = True, layouts=None) -> bool:
         """Snapshot ``state`` into shm.
 
         ``blocking=True`` waits for the device->host copy (safe with
@@ -441,12 +448,21 @@ class CheckpointEngine:
         blocked only for the dispatch (~ms); the caller must keep
         ``state`` alive and un-donated until the drain finishes
         (``wait_for_snapshot``).
+
+        ``layouts`` ({keypath: global-layout dict}, see
+        ``trainer/checkpoint/reshard.py``) stamps the snapshot — and
+        every shard file persisted from it — with each leaf's global
+        shape and this shard's index slice, making the checkpoint
+        restorable by ANY world size.  None = legacy world-locked
+        format.
         """
         if not self._snapshot_slot_free(step):
             return False
+        if not reshard_enabled():
+            layouts = None  # kill-switch: today's format, byte for byte
         if blocking:
-            return self._drain_snapshot(step, state, None)
-        return self._launch_async_snapshot(step, state, None)
+            return self._drain_snapshot(step, state, None, layouts)
+        return self._launch_async_snapshot(step, state, None, layouts)
 
     def _snapshot_slot_free(self, step: int) -> bool:
         if self._snapshot_thread is not None:
@@ -473,7 +489,8 @@ class CheckpointEngine:
             pass
 
     def _launch_async_snapshot(self, step: int, state,
-                               persist_dir: Optional[str]) -> bool:
+                               persist_dir: Optional[str],
+                               layouts=None) -> bool:
         # launch every transfer before returning so D2H overlaps with
         # whatever the training loop does next
         import threading
@@ -485,7 +502,7 @@ class CheckpointEngine:
                 leaf.copy_to_host_async()
         self._snapshot_thread = threading.Thread(
             target=self._drain_snapshot,
-            args=(step, state, persist_dir),
+            args=(step, state, persist_dir, layouts),
             name=f"ckpt-snapshot-{step}",
             daemon=True,
         )
@@ -493,7 +510,8 @@ class CheckpointEngine:
         return True
 
     def _drain_snapshot(self, step: int, state,
-                        persist_dir: Optional[str]) -> bool:
+                        persist_dir: Optional[str],
+                        layouts=None) -> bool:
         start = time.time()
         start_mono = time.monotonic()
         self._last_drain_ok = False
@@ -505,7 +523,9 @@ class CheckpointEngine:
             )
             return False
         try:
-            nbytes = self._shm_handler.save_state(step, state)
+            nbytes = self._shm_handler.save_state(
+                step, state, layouts=layouts
+            )
         finally:
             self._lock.release()
         from dlrover_tpu.common.parallel_io import throughput_gbps
@@ -550,10 +570,10 @@ class CheckpointEngine:
 
     def save_to_storage(self, step: int, state,
                         checkpoint_dir: Optional[str] = None,
-                        blocking: bool = True) -> bool:
+                        blocking: bool = True, layouts=None) -> bool:
         target_dir = checkpoint_dir or self.checkpoint_dir
         if blocking:
-            if not self.save_to_memory(step, state):
+            if not self.save_to_memory(step, state, layouts=layouts):
                 return False
             self._event_queue.put(
                 CheckpointEvent(
@@ -566,10 +586,15 @@ class CheckpointEngine:
         # drain thread enqueues it
         if not self._snapshot_slot_free(step):
             return False
-        return self._launch_async_snapshot(step, state, target_dir)
+        if not reshard_enabled():
+            layouts = None  # kill-switch: today's format, byte for byte
+        return self._launch_async_snapshot(
+            step, state, target_dir, layouts
+        )
 
     # -- load --------------------------------------------------------------
-    def load(self, target=None, checkpoint_dir: Optional[str] = None):
+    def load(self, target=None, checkpoint_dir: Optional[str] = None,
+             layouts=None):
         """Restore the newest globally-agreed state: shm first
         (zero-copy views fed straight to device), storage next.
 
@@ -581,13 +606,19 @@ class CheckpointEngine:
         step available on ALL ranks (each rank's set = its two shm
         slots + its latest committed storage step).
 
+        ``layouts`` describes the per-leaf global slices THIS rank
+        wants on the (possibly new) world; when the stored shards'
+        placement differs, the restore reassembles each leaf from
+        whichever shards cover its new slices (reshard leg, gated by
+        ``DLROVER_TPU_RESHARD``).
+
         Returns (step, state) where state is ``target``-shaped if a
         target pytree was given, else {keypath: ndarray}; (-1, None)
         when nothing exists.
         """
         t0_mono = time.monotonic()
         t0_wall = anchored_now(t0_mono)
-        shm_steps = self._shm_handler.steps_available()
+        shm_steps = self._usable_shm_steps(layouts)
         storage_step, latest_dir = self._latest_storage_step(
             checkpoint_dir
         )
@@ -596,12 +627,12 @@ class CheckpointEngine:
             return -1, None
         return self._restore_agreed(
             agreed, target, checkpoint_dir, shm_steps, storage_step,
-            latest_dir, t0_wall, t0_mono,
+            latest_dir, t0_wall, t0_mono, layouts=layouts,
         )
 
     def _restore_agreed(self, agreed, target, checkpoint_dir,
                         shm_steps, storage_step, latest_dir,
-                        t0_wall, t0_mono):
+                        t0_wall, t0_mono, layouts=None):
         """Fetch + apply an already-agreed restore step (the serial
         data path, shared by ``load`` and ``finish_restore``'s
         fallback)."""
@@ -620,10 +651,14 @@ class CheckpointEngine:
             # shm miss (or invalidated between get_step and load_state):
             # storage holds the agreed step too
             zero_copy = False
-            step, arrays = self._read_storage_shard(latest_dir)
+            step, arrays = self._read_storage_step_dir(
+                latest_dir, layouts
+            )
         if step != agreed:
             zero_copy = False
-            step, arrays = self._load_storage_step(agreed, checkpoint_dir)
+            step, arrays = self._load_storage_step(
+                agreed, checkpoint_dir, layouts
+            )
         if step != agreed or not arrays:
             # peers WILL resume from `agreed`; silently starting fresh
             # here would be exactly the mixed-step divergence the
@@ -657,20 +692,24 @@ class CheckpointEngine:
         return step, arrays
 
     def start_prefetch(self, checkpoint_dir: Optional[str] = None,
-                       start_gate=None) -> RestorePrefetch:
+                       start_gate=None, layouts=None) -> RestorePrefetch:
         """Begin streaming restore bytes into host RAM on a background
         thread — the first leg of the overlapped restart critical path
         (see ``trainer/restart_path.py``).  Callable before the mesh
         or ``jax.distributed`` exist: it touches only shm and storage.
+        ``layouts`` makes the staging reshard-aware: the byte stream
+        reads whichever shard files cover this rank's NEW slices.
         Pair with :meth:`finish_restore`; ``load`` stays the serial
         equivalent."""
         return RestorePrefetch(
-            self, checkpoint_dir=checkpoint_dir, start_gate=start_gate
+            self, checkpoint_dir=checkpoint_dir,
+            start_gate=start_gate, layouts=layouts,
         )
 
     def finish_restore(self, prefetch: Optional[RestorePrefetch],
                        target=None,
-                       checkpoint_dir: Optional[str] = None):
+                       checkpoint_dir: Optional[str] = None,
+                       layouts=None):
         """Complete an overlapped restore started by
         :meth:`start_prefetch`.
 
@@ -681,9 +720,19 @@ class CheckpointEngine:
         still-streaming tail.  Any prefetch failure, consensus miss on
         the staged step, or staging error degrades to the serial
         ``_restore_agreed``/``load`` path — byte-identical result,
-        never a half-applied state."""
+        never a half-applied state.
+
+        ``layouts`` supersedes the prefetch's (a caller may only learn
+        its target slices AFTER the blind prefetch launched — e.g. the
+        Trainer derives them from the freshly-initialized state): the
+        consensus row is re-filtered through the layout gate and every
+        fallback is layout-aware, so a blind prefetch that staged the
+        wrong world's shard degrades into the reshard leg instead of
+        a mis-sharded (or failed) restore."""
         t0_mono = time.monotonic()
         t0_wall = anchored_now(t0_mono)
+        if layouts is None and prefetch is not None:
+            layouts = prefetch._layouts
         if (
             prefetch is None
             or not prefetch.wait_available(300)
@@ -691,9 +740,18 @@ class CheckpointEngine:
         ):
             if prefetch is not None:
                 prefetch.join()
-            return self.load(target=target, checkpoint_dir=checkpoint_dir)
+            return self.load(
+                target=target, checkpoint_dir=checkpoint_dir,
+                layouts=layouts,
+            )
+        shm_steps = prefetch.shm_steps
+        if layouts is not None and layouts is not prefetch._layouts:
+            # stricter than what the prefetch staged: drop shm steps
+            # whose placement does not serve the requested slices
+            usable = set(self._usable_shm_steps(layouts))
+            shm_steps = [s for s in shm_steps if s in usable]
         agreed = self._sync_restore_step(
-            prefetch.shm_steps, prefetch.storage_step
+            shm_steps, prefetch.storage_step
         )
         if agreed < 0:
             prefetch.join()
@@ -702,12 +760,21 @@ class CheckpointEngine:
         def _serial():
             prefetch.join()
             return self._restore_agreed(
-                agreed, target, checkpoint_dir, prefetch.shm_steps,
+                agreed, target, checkpoint_dir, shm_steps,
                 prefetch.storage_step, prefetch.storage_dir,
-                t0_wall, t0_mono,
+                t0_wall, t0_mono, layouts=layouts,
             )
 
         cand = prefetch.candidate(agreed)
+        if (
+            cand is not None
+            and cand.source == "shm"
+            and agreed not in shm_steps
+        ):
+            # the blind prefetch staged this step from a shm slot the
+            # override's layout gate rejected (valid bytes, wrong
+            # placement) — the step is only restorable via storage
+            cand = None
         if cand is None:
             return _serial()
         try:
@@ -908,7 +975,8 @@ class CheckpointEngine:
         return read_shard_file(path, self._storage)
 
     def _load_storage_step(self, step: int,
-                           checkpoint_dir: Optional[str] = None):
+                           checkpoint_dir: Optional[str] = None,
+                           layouts=None):
         """Read a specific committed step (an older step may be the
         globally-agreed one when this rank's storage is ahead)."""
         root = checkpoint_dir or self.checkpoint_dir
@@ -917,7 +985,151 @@ class CheckpointEngine:
         )
         if not self._storage.exists(path):
             return -1, {}
-        return self._read_storage_shard(path)
+        return self._read_storage_step_dir(path, layouts)
+
+    # -- reshard ------------------------------------------------------------
+    def _reshard_active(self, layouts) -> bool:
+        return bool(layouts) and reshard_enabled()
+
+    def _usable_shm_steps(self, layouts=None):
+        """Steps restorable from THIS rank's shm segment under the
+        requested layouts.  After a world change the segment may hold
+        a snapshot of the OLD world's slices — its bytes are valid but
+        placed wrong, and using them would silently resume a
+        mis-sharded state.  A slot is usable when its layout header
+        matches the request, or (headerless legacy slot) when every
+        spec's local shape matches the requested local shape.  Without
+        requested layouts (or with the reshard kill-switch off) this
+        is exactly ``steps_available()`` — today's behavior."""
+        steps = self._shm_handler.steps_available()
+        if not self._reshard_active(layouts):
+            return steps
+        usable = []
+        for step in steps:
+            slot_layouts = self._shm_handler.slot_layouts(step)
+            if slot_layouts is not None:
+                if _reshard.layouts_equal(slot_layouts, layouts):
+                    usable.append(step)
+                continue
+            # legacy slot: shape-compare against the request straight
+            # off the meta specs (no shm attach, no leaf views)
+            shapes = self._shm_handler.slot_shapes(step)
+            if shapes is None:
+                continue
+            ok = True
+            for key, raw in layouts.items():
+                want_shape = tuple(
+                    int(d) for d in (
+                        raw["shape"] if isinstance(raw, dict)
+                        else raw.shape
+                    )
+                )
+                if shapes.get(key) != want_shape:
+                    ok = False
+                    break
+            if ok:
+                usable.append(step)
+        return usable
+
+    def _read_storage_step_dir(self, ckpt_path: Optional[str],
+                               layouts=None):
+        """Read one committed checkpoint dir onto this rank: the
+        direct per-rank shard when its placement matches the request,
+        the resharded overlap-range read otherwise."""
+        if ckpt_path is None:
+            return -1, {}
+        if not self._reshard_active(layouts):
+            return self._read_storage_shard(ckpt_path)
+        step, arrays = -1, {}
+        try:
+            for item in self._storage_leaf_stream(ckpt_path, layouts):
+                if item[0] == "meta":
+                    step = item[1]
+                else:
+                    arrays[item[1]] = item[2]
+        except Exception as e:  # noqa: BLE001 - degrade, never corrupt
+            logger.warning(
+                "rank %s: storage read of %s failed: %s",
+                self._rank, ckpt_path, e,
+            )
+            return -1, {}
+        return step, arrays
+
+    def _direct_shard_compatible(self, ckpt_dir: str, layouts) -> bool:
+        """Whether ``shard_{rank}`` in ``ckpt_dir`` already holds
+        exactly the requested slices (same-world restart): header-only
+        check, KBs against GB shards."""
+        path = os.path.join(ckpt_dir, f"shard_{self._rank}.drckpt")
+        if not self._storage.exists(path):
+            return False
+        try:
+            info = _reshard.read_shard_header(path, self._storage)
+        except Exception:  # noqa: BLE001 - unreadable header
+            return False
+        if info.layouts is not None:
+            want = {
+                k: (v if isinstance(v, dict) else v.as_dict())
+                for k, v in layouts.items()
+            }
+            have = {k: v.as_dict() for k, v in info.layouts.items()}
+            return _reshard.layouts_equal(have, want)
+        # legacy file: usable iff every requested local shape matches
+        for key, raw in layouts.items():
+            shape = tuple(
+                raw["shape"] if isinstance(raw, dict) else raw.shape
+            )
+            spec = info.specs.get(key)
+            if spec is None or tuple(spec[1]) != shape:
+                return False
+        return True
+
+    def _storage_leaf_stream(self, ckpt_dir: str, layouts=None):
+        """Leaf stream over one committed checkpoint dir: the direct
+        per-rank shard file when it already matches the requested
+        layouts (or none were requested), else the resharded
+        overlap-range read across whichever shards cover this rank's
+        new slices.  The reshard leg emits a ``reshard`` span with
+        the world transition and the moved bytes."""
+        direct = os.path.join(
+            ckpt_dir, f"shard_{self._rank}.drckpt"
+        )
+        if not self._reshard_active(layouts) or (
+            self._direct_shard_compatible(ckpt_dir, layouts)
+        ):
+            yield from stream_shard_leaves(direct, self._storage)
+            return
+        t0_mono = time.monotonic()
+        t0_wall = anchored_now(t0_mono)
+        shards = _reshard.scan_checkpoint_shards(
+            ckpt_dir, self._storage
+        )
+        from_world = _reshard.checkpoint_world_size(shards)
+        moved = 0
+        for item in _reshard.stream_resharded_leaves(
+            ckpt_dir, layouts, storage=self._storage, shards=shards
+        ):
+            if item[0] == "leaf":
+                moved += int(item[2].nbytes)
+            yield item
+        from dlrover_tpu.common.parallel_io import throughput_gbps
+        from dlrover_tpu.observability.metrics import record_reshard_io
+
+        dur = time.monotonic() - t0_mono
+        get_event_logger().complete(
+            "reshard",
+            t0_wall,
+            dur,
+            from_world=from_world,
+            to_world=self._world,
+            bytes=moved,
+            throughput_gbps=throughput_gbps(moved, dur),
+        )
+        record_reshard_io(from_world, self._world, moved, dur)
+        logger.info(
+            "rank %s: resharded restore %s -> %s ranks (%.1f MB in "
+            "%.3fs)", self._rank, from_world, self._world,
+            moved / 1e6, dur,
+        )
 
     def latest_persisted_step(self) -> int:
         tracker = os.path.join(
@@ -944,17 +1156,34 @@ class CheckpointEngine:
         return self.latest_persisted_step() >= step
 
     def close(self):
-        self.wait_for_snapshot(timeout=300)
+        budget = ckpt_close_timeout_s()
+        self.wait_for_snapshot(timeout=budget)
         t = self._snapshot_thread
         if t is not None and t.is_alive():
             # the drain thread still holds live views over the shm
             # buffer and will touch the lock and event queue when it
             # finishes — closing ANY of them now would make the drain
             # fail on a closed handle (persist event lost) or raise
-            # BufferError; leak all three and let process exit reclaim
+            # BufferError; leak all three and let process exit reclaim.
+            # The leak is deliberate but must be OBSERVABLE: a fleet
+            # where closes keep timing out is leaking multi-GB shm
+            # segments (dlrover_tpu_ckpt_drain_stuck alerts on it),
+            # and DLROVER_TPU_CKPT_CLOSE_TIMEOUT_S tunes the budget
+            # (tests use a tiny one to pin this path).
+            try:
+                from dlrover_tpu.observability.metrics import (
+                    get_registry,
+                )
+
+                get_registry().inc_counter(
+                    "dlrover_tpu_ckpt_drain_stuck"
+                )
+            except Exception:  # noqa: BLE001 - metrics never break close
+                pass
             logger.error(
-                "rank %s: snapshot drain still running after 300s; "
+                "rank %s: snapshot drain still running after %.0fs; "
                 "leaving shm/lock/queue handles open", self._rank,
+                budget,
             )
             return  # saver side must stay up too: drain uses its
             # locks/queue service and the shm segments it would unlink

@@ -59,6 +59,13 @@ def parse_args(argv=None):
     parser.add_argument("--max_restarts", type=int, default=3)
     parser.add_argument("--node_unit", type=int, default=1)
     parser.add_argument("--rdzv_timeout", type=int, default=600)
+    parser.add_argument(
+        "--rdzv_waiting_timeout", type=float, default=-1.0,
+        help="master window rule: seconds after the last join before "
+        "an under-max round completes with what it has (<0 = "
+        "rdzv_timeout); shorten for fast elastic re-mesh after a "
+        "preemption without shrinking the join wait",
+    )
     parser.add_argument("--monitor_interval", type=float, default=3.0)
     parser.add_argument(
         "--stop_timeout", type=float, default=15.0,
@@ -251,6 +258,7 @@ def run(args) -> int:
         max_nodes=max_nodes,
         nproc_per_node=args.nproc_per_node,
         rdzv_timeout=args.rdzv_timeout,
+        rdzv_waiting_timeout=args.rdzv_waiting_timeout,
         node_unit=args.node_unit,
         network_check=args.network_check,
         max_restarts=args.max_restarts,

@@ -149,11 +149,19 @@ class RestartCoordinator:
 
     # ------------------------------------------------------------ legs
     def start(self, compile_fn: Optional[Callable] = None,
-              checkpoint_dir: Optional[str] = None
-              ) -> "RestartCoordinator":
+              checkpoint_dir: Optional[str] = None,
+              layouts=None) -> "RestartCoordinator":
         """Launch the overlappable legs.  Safe to call once; a second
         ``start`` only adds a compile leg if none ran yet (the worker
-        may start the prefetch pre-mesh and the compile post-mesh)."""
+        may start the prefetch pre-mesh and the compile post-mesh).
+
+        ``layouts`` ({keypath: global-layout dict},
+        ``trainer/checkpoint/reshard.py``) makes the restore byte
+        prefetch reshard-aware: after a world change it streams
+        whichever shard files cover this rank's NEW slices — the
+        reshard-copy leg then rides the same overlap window as the
+        AOT compile and the rendezvous, so elastic MTTR stays
+        ≈ max(reshard, compile)."""
         if not self.overlap:
             return self
         legs = []
@@ -175,6 +183,7 @@ class RestartCoordinator:
                 self._prefetch = self._engine.start_prefetch(
                     checkpoint_dir=checkpoint_dir,
                     start_gate=_gate_for(barrier),
+                    layouts=layouts,
                 )
             if "compile" in legs:
                 self._pending.add("compile")
@@ -199,20 +208,26 @@ class RestartCoordinator:
 
     # --------------------------------------------------------- resolve
     def finish_restore(self, target=None,
-                       checkpoint_dir: Optional[str] = None):
+                       checkpoint_dir: Optional[str] = None,
+                       layouts=None):
         """Consensus + staged-bytes application; serial ``load`` when
         overlap is off, was never started, or any leg failed.  Returns
-        ``(step, state)`` like ``CheckpointEngine.load``."""
+        ``(step, state)`` like ``CheckpointEngine.load``.  ``layouts``
+        supersedes what ``start`` passed — a caller that only learns
+        its target slices after the prefetch launched (the Trainer
+        derives them from the initialized state) still gets the
+        layout-aware reshard fallback."""
         try:
             if self._engine is None:
                 return -1, None
             if not self.overlap or self._prefetch is None:
                 return self._engine.load(
-                    target=target, checkpoint_dir=checkpoint_dir
+                    target=target, checkpoint_dir=checkpoint_dir,
+                    layouts=layouts,
                 )
             return self._engine.finish_restore(
                 self._prefetch, target=target,
-                checkpoint_dir=checkpoint_dir,
+                checkpoint_dir=checkpoint_dir, layouts=layouts,
             )
         finally:
             self._resolved("restore")

@@ -124,6 +124,10 @@ class Trainer:
         )
         self._engine = None
         self._restart_coord = None
+        self._world_changed = False
+        #: per-leaf global layouts of this rank's state slices
+        #: (derived from the live shardings after init_state)
+        self._layouts = None
         if args.checkpoint_dir:
             from dlrover_tpu.trainer.checkpoint.engine import (
                 CheckpointEngine,
@@ -144,9 +148,26 @@ class Trainer:
             # restart critical path: kick the restore byte prefetch
             # NOW, so it streams while init_state traces+compiles in
             # _init_or_restore_state; DLROVER_TPU_RESTART_OVERLAP=0
-            # (or any prefetch failure) reproduces the serial load
-            self._restart_coord = RestartCoordinator(self._engine)
-            self._restart_coord.start()
+            # (or any prefetch failure) reproduces the serial load.
+            # After a WORLD CHANGE the target layouts are unknowable
+            # until init_state shards the new state — the blind
+            # prefetch would stage the OLD world's shard, so the
+            # restore runs the serial reshard-aware load instead.
+            prev_world = int(
+                os.getenv("DLROVER_TPU_PREV_WORLD", "0") or 0
+            )
+            self._world_changed = (
+                prev_world > 0
+                and prev_world != self._ctx.world_size
+            )
+            if not self._world_changed:
+                self._restart_coord = RestartCoordinator(self._engine)
+                self._restart_coord.start()
+            # graceful-drain protocol: the agent's SIGUSR1 flips
+            # snapshot-every-step mode (trainer/drain.py)
+            from dlrover_tpu.trainer.drain import install_drain_handler
+
+            install_drain_handler()
         self._sparse_mgr = None
         if args.sparse_tables and args.checkpoint_dir:
             from dlrover_tpu.sparse.checkpoint import (
@@ -214,20 +235,33 @@ class Trainer:
         )
         start_step = 0
         if self._engine is not None:
+            from dlrover_tpu.trainer.checkpoint.reshard import (
+                derive_layouts,
+            )
+
+            self._layouts = derive_layouts(self.state)
             # restore straight onto the initialized state's shardings;
             # the coordinator consumes the bytes the __init__-time
             # prefetch staged while init_state compiled (falls back to
             # the serial engine.load on any overlap failure)
             if self._restart_coord is not None:
+                # the derived layouts supersede the blind prefetch's:
+                # if what it staged turns out to be another world's
+                # placement, the finish falls into the reshard leg
                 step, restored = self._restart_coord.finish_restore(
-                    target=self.state
+                    target=self.state, layouts=self._layouts
                 )
                 # one restart, one prefetch: a later re-init must read
                 # FRESH availability (training may have snapshotted
                 # past the staged step), i.e. the serial load below
                 self._restart_coord = None
             else:
-                step, restored = self._engine.load(target=self.state)
+                # serial, layout-aware: after a world change this is
+                # the reshard leg — each leaf reassembled from
+                # whichever old-world shards cover its new slices
+                step, restored = self._engine.load(
+                    target=self.state, layouts=self._layouts
+                )
             if step >= 0 and restored is not None:
                 self.state = restored
                 start_step = step
@@ -304,8 +338,18 @@ class Trainer:
     def _maybe_checkpoint(self, step: int):
         if self._engine is None:
             return
+        from dlrover_tpu.trainer.drain import drain_requested
+
+        draining = drain_requested()
         to_storage = step % self._args.save_storage_interval == 0
-        to_memory = step % self._args.save_memory_interval == 0
+        to_memory = (
+            step % self._args.save_memory_interval == 0
+            # drain mode (agent SIGUSR1: the node — or a peer — is
+            # about to die): snapshot EVERY step so the agent's flush
+            # persists the last step the whole world completed, not
+            # the last periodic snapshot
+            or draining
+        )
         if not (to_storage or to_memory):
             return
         if self._snapshot_mode is None:
@@ -325,7 +369,9 @@ class Trainer:
                 )
             snap = self._snap_fn(self.state)
         if to_storage:
-            self._engine.save_to_storage(step, snap, blocking=False)
+            self._engine.save_to_storage(
+                step, snap, blocking=False, layouts=self._layouts
+            )
             if self._sparse_mgr is not None:
                 # export inline (version cut), write in background —
                 # the step blocks only for the touched-row memcpy
@@ -333,7 +379,12 @@ class Trainer:
                     step, self._args.sparse_tables, blocking=False
                 )
         else:
-            self._engine.save_to_memory(step, snap, blocking=False)
+            # drain mode blocks: the agent is about to flush shm, and
+            # an un-drained async snapshot would hand it a torn buffer
+            self._engine.save_to_memory(
+                step, snap, blocking=draining,
+                layouts=self._layouts,
+            )
         self._callbacks.on_save(step, storage=to_storage)
 
     def _consume_metrics(self, step: int, metrics, batch) -> float:

@@ -99,6 +99,136 @@ def build_workload(state_mb: int, depth: int = 4):
     return init_state, make_step, batch_shape, hidden
 
 
+def measure_reshard(root_dir: str, state_mb: int = 64,
+                    old_world: int = 8, new_world: int = 4,
+                    lost_steps: int = 50, step_probe: int = 3) -> dict:
+    """Elastic-MTTR comparison on simulated hosts.
+
+    Commits one ``old_world``-way axis-0-sharded checkpoint (layout
+    headers on every shard), then measures two recoveries to the same
+    training progress:
+
+    - **reshard**: every ``new_world`` rank reassembles its NEW slice
+      from the old shards' overlapping byte ranges
+      (``CheckpointEngine.load(layouts=...)``); MTTR = the slowest
+      rank (ranks run concurrently in production — measuring each
+      serially and taking the max is the conservative bound).
+    - **full restart**: the pre-reshard reality — the checkpoint is
+      unreadable on the new world, so recovery = re-running the
+      ``lost_steps`` of training it held, at the workload's measured
+      steady step time.
+    """
+    import tempfile as _tf
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dlrover_tpu.trainer.checkpoint.engine import CheckpointEngine
+    from dlrover_tpu.trainer.checkpoint.reshard import axis0_layouts
+
+    ckpt_dir = _tf.mkdtemp(prefix="dlrover_benchrs_reshard_")
+    rows = max(old_world * 64, 256)
+    cols = max(
+        int(state_mb * 1024 * 1024 / 4 / rows), 64
+    )
+    global_w = np.random.default_rng(0).standard_normal(
+        (rows, cols)
+    ).astype(np.float32)
+    per = rows // old_world
+    step = 7
+
+    # ---- commit the old-world checkpoint (8 engines, one saver)
+    engines = []
+    for r in range(old_world):
+        engines.append(
+            CheckpointEngine(
+                checkpoint_dir=ckpt_dir, process_rank=r,
+                process_count=old_world, local_shard_num=old_world,
+                name="brs_old",
+            )
+        )
+    t0 = time.perf_counter()
+    for r, eng in enumerate(engines):
+        local = {"w": global_w[r * per : (r + 1) * per]}
+        lay = axis0_layouts(local, r, old_world)
+        if r == 0:
+            continue  # rank 0 persists last so every shard is in shm
+        assert eng.save_to_memory(step, local, layouts=lay)
+    local0 = {"w": global_w[:per]}
+    assert engines[0].save_to_storage(
+        step, local0, layouts=axis0_layouts(local0, 0, old_world)
+    )
+    assert engines[0].wait_for_persist(step, timeout=300)
+    commit_s = time.perf_counter() - t0
+    for eng in engines:
+        eng.close()
+
+    # ---- reshard restore onto the new world
+    new_per = rows // new_world
+    sync = lambda avail: max(avail)  # noqa: E731 - simulated hosts
+    restore_times = []
+    new_engines = []
+    for r in range(new_world):
+        new_engines.append(
+            CheckpointEngine(
+                checkpoint_dir=ckpt_dir, process_rank=r,
+                process_count=new_world, local_shard_num=new_world,
+                name="brs_new", step_sync_fn=sync,
+            )
+        )
+    moved_bytes = 0
+    for r, eng in enumerate(new_engines):
+        target = {
+            "w": np.zeros((new_per, cols), np.float32)
+        }
+        lay = axis0_layouts(target, r, new_world)
+        t0 = time.perf_counter()
+        got, restored = eng.load(target=target, layouts=lay)
+        restore_times.append(time.perf_counter() - t0)
+        assert got == step, got
+        np.testing.assert_array_equal(
+            np.asarray(restored["w"]),
+            global_w[r * new_per : (r + 1) * new_per],
+        )
+        moved_bytes += restored["w"].nbytes
+    for eng in new_engines:
+        eng.close()
+
+    # ---- the restart-from-scratch comparator: re-run the lost steps
+    init_state, make_step, batch_shape, _hidden = build_workload(
+        max(state_mb // 2, 16), depth=2
+    )
+    wstate = init_state(jax.random.PRNGKey(1))
+    step_fn = make_step()
+    batch = jnp.ones(batch_shape, jnp.float32)
+    wstate, _ = step_fn(wstate, batch)  # compile outside the probe
+    jax.block_until_ready(wstate)
+    t0 = time.perf_counter()
+    for _ in range(step_probe):
+        wstate, _ = step_fn(wstate, batch)
+    jax.block_until_ready(wstate)
+    step_s = (time.perf_counter() - t0) / step_probe
+
+    reshard_mttr = max(restore_times)
+    full_restart_mttr = lost_steps * step_s
+    return {
+        "old_world": old_world,
+        "new_world": new_world,
+        "state_mb": round(global_w.nbytes / 1e6, 1),
+        "commit_s": round(commit_s, 4),
+        "restore_s_per_rank": [round(t, 4) for t in restore_times],
+        "reshard_mttr_s": round(reshard_mttr, 4),
+        "lost_steps": lost_steps,
+        "steady_step_s": round(step_s, 5),
+        "full_restart_mttr_s": round(full_restart_mttr, 4),
+        "reshard_bytes": moved_bytes,
+        "speedup_vs_full_restart": round(
+            full_restart_mttr / max(reshard_mttr, 1e-9), 2
+        ),
+    }
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="serial vs overlapped restart MTTR"
@@ -254,6 +384,23 @@ def main(argv=None) -> int:
                 dict(payload, serial_runs=serial,
                      overlap_runs=overlapped),
             )
+
+    # ---- reshard leg: elastic world change vs restart-from-scratch
+    if not budget.tight(45):
+        try:
+            payload["reshard"] = measure_reshard(
+                ckpt_dir, state_mb=max(state_mb // 2, 32),
+                lost_steps=50, step_probe=3,
+            )
+            payload["reshard_mttr_s"] = payload["reshard"][
+                "reshard_mttr_s"
+            ]
+            payload["full_restart_mttr_s"] = payload["reshard"][
+                "full_restart_mttr_s"
+            ]
+        except Exception as e:  # noqa: BLE001 - leg must not kill bench
+            payload["reshard"] = {"error": str(e)}
+        _flush(args.out, payload)
 
     if serial and overlapped:
         payload["restart_serial_s"] = round(min(serial), 4)

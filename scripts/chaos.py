@@ -82,6 +82,14 @@ PLANS = (
     "master-kill-flush",
     "agent-kill",
     "rpc-chaos",
+    # SIGTERM-with-grace waves against one of two agent pods (unlike
+    # the SIGKILL plans): the dying agent drains its workers to a
+    # fresh snapshot, flushes, fences itself at the master, and the
+    # SURVIVOR re-meshes onto the shrunken world without a restart-
+    # from-scratch; the pod is re-created after a delay and the world
+    # grows back.  Run twice (DLROVER_TPU_RESHARD on/off) by main()
+    # to produce the reshard-vs-restart goodput/MTTR artifact.
+    "preempt-storm",
 )
 
 #: phase hook each plan pins its master kill to
@@ -241,6 +249,286 @@ class MasterSupervisor:
             return open(self._log_path).read()[-n:]
         except OSError:
             return ""
+
+
+class NodePod:
+    """One simulated elastic pod: a ``dlrover_tpu.run`` launcher (the
+    per-node agent) pinned to a node_rank against a shared master."""
+
+    def __init__(self, workdir: str, node_rank: int, master_addr: str,
+                 env: dict, max_nodes: int = 2):
+        self.node_rank = node_rank
+        self._workdir = workdir
+        self._addr = master_addr
+        self._env = dict(env)
+        self._max_nodes = max_nodes
+        self._log_path = os.path.join(
+            workdir, f"pod{node_rank}.log"
+        )
+        self.proc = None
+        self.launches = 0
+
+    def launch(self):
+        log = open(self._log_path, "a")
+        env = dict(self._env, DLROVER_TPU_NODE_RANK=str(self.node_rank))
+        # per-pod socket namespace: on a real cluster every node has
+        # its own /tmp — two simulated pods sharing one socket dir
+        # would collide on the agent's ckpt factory queue
+        env["DLROVER_TPU_SOCKET_DIR"] = os.path.join(
+            self._workdir, f"socks{self.node_rank}"
+        )
+        self.proc = subprocess.Popen(  # noqa: S603
+            [
+                sys.executable, "-m", "dlrover_tpu.run",
+                f"--nnodes=1:{self._max_nodes}",
+                "--nproc_per_node=1",
+                f"--node_rank={self.node_rank}",
+                f"--master_addr={self._addr}",
+                "--monitor_interval=0.3",
+                "--stop_timeout=2",
+                "--failure_stop_timeout=0.5",
+                "--max_restarts=6",
+                "--rdzv_timeout=60",
+                # a lone survivor must complete its shrunken round in
+                # seconds; joiners still get the full 60 s above
+                "--rdzv_waiting_timeout=1.5",
+                "--compile_cache_dir="
+                + os.path.join(self._workdir, "xla_cache"),
+                os.path.join(REPO, "scripts", "goodput_train.py"),
+            ],
+            stdout=log, stderr=subprocess.STDOUT, env=env,
+            cwd=self._workdir,
+        )
+        log.close()
+        self.launches += 1
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def sigterm(self):
+        if self.alive():
+            try:
+                self.proc.terminate()
+            except ProcessLookupError:
+                pass
+
+    def wait_dead(self, grace: float) -> bool:
+        """SIGTERM grace, then SIGKILL — the kubelet's contract."""
+        try:
+            self.proc.wait(timeout=grace)
+            return True
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait()
+            return False
+
+    def stop(self):
+        if self.alive():
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+
+    def log_tail(self, n: int = 1200) -> str:
+        try:
+            return open(self._log_path).read()[-n:]
+        except OSError:
+            return ""
+
+
+def run_preempt_storm(
+    steps: int = 60,
+    waves: int = 2,
+    step_sleep: float = 0.08,
+    save_every: int = 5,
+    term_grace: float = 10.0,
+    relaunch_delay: float = 12.0,
+    timeout: float = 300.0,
+    reshard: bool = True,
+) -> dict:
+    """SIGTERM-with-grace preemption waves against pod 1 of a 2-pod
+    job.  With the reshard loop ON the dying pod drains + fences and
+    the survivor re-meshes within a monitor interval — training
+    continues on the shrunken world THROUGH the ``relaunch_delay``
+    outage (the realistic gap before the scheduler re-creates the
+    pod).  OFF reproduces today's behavior: bare flush, no fencing,
+    the survivor stalls wedged in its collective until the re-created
+    pod rejoins, then replays back to the last periodic snapshot.
+    Per-wave MTTR = SIGTERM → first step BEYOND the pre-death
+    watermark, logged AFTER the pod actually died."""
+    workdir = tempfile.mkdtemp(prefix="dlrover_preempt_")
+    progress = os.path.join(workdir, "progress.jsonl")
+    supervisor = MasterSupervisor(
+        workdir, fault_plan="", job_name="preempt"
+    )
+    if not supervisor.start():
+        raise RuntimeError(
+            "master never came up: " + supervisor.log_tail()
+        )
+    env = dict(
+        os.environ,
+        GOODPUT_TARGET_STEPS=str(steps),
+        GOODPUT_STEP_SLEEP=str(step_sleep),
+        GOODPUT_SAVE_EVERY=str(save_every),
+        GOODPUT_PROGRESS_FILE=progress,
+        GOODPUT_CKPT_DIR=os.path.join(workdir, "ckpt"),
+        DLROVER_TPU_SOCKET_DIR=os.path.join(workdir, "socks"),
+        DLROVER_TPU_EVENTS_FILE=os.path.join(
+            workdir, "events.jsonl"
+        ),
+        DLROVER_TPU_RESHARD="1" if reshard else "0",
+        DLROVER_TPU_PREEMPT_DRAIN_GRACE_S="2.0",
+        DLROVER_TPU_EMERGENCY_COMMIT_TIMEOUT_S="3.0",
+        DLROVER_TPU_FENCE_TTL_S="8.0",
+        JAX_PLATFORMS="cpu",
+        JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="0",
+        PYTHONPATH=REPO,
+        XLA_FLAGS="",
+    )
+    pods = [
+        NodePod(workdir, 0, supervisor.addr, env),
+        NodePod(workdir, 1, supervisor.addr, env),
+    ]
+    t_start = time.perf_counter()
+    t_start_wall = time.time()
+    for pod in pods:
+        pod.launch()
+
+    # +2 keeps the marks OFF the save_every cadence: a wave landing
+    # exactly on a periodic snapshot step would hide the replay cost
+    # the graceful drain exists to remove
+    wave_marks = [
+        max(3, int(steps * (i + 1) / (waves + 1)) + 2)
+        for i in range(waves)
+    ]
+    recoveries = []  # per wave: seconds from SIGTERM to NEW progress
+    replayed = []  # per wave: steps re-run after the restore
+    wave = None  # in-flight wave state
+    deadline = time.time() + timeout
+    try:
+        while any(p.alive() for p in pods):
+            if time.time() > deadline:
+                raise RuntimeError(
+                    "preempt storm timed out; pod0 tail:\n"
+                    + pods[0].log_tail() + "\npod1 tail:\n"
+                    + pods[1].log_tail()
+                )
+            lines = _read_progress(progress)
+            max_step = max((e["step"] for e in lines), default=0)
+            now = time.perf_counter()
+            if (
+                wave is None
+                and wave_marks
+                and max_step >= wave_marks[0]
+                and pods[1].alive()
+            ):
+                wave_marks.pop(0)
+                pods[1].sigterm()
+                wave = {
+                    "t0": now,
+                    "t0_wall": time.time(),
+                    "before": max_step,
+                    "relaunch_at": None,
+                    "recovered": False,
+                }
+            if wave is not None:
+                if wave["relaunch_at"] is None and (
+                    not pods[1].alive()
+                    or now - wave["t0"] > term_grace
+                ):
+                    pods[1].wait_dead(grace=1.0)
+                    wave["relaunch_at"] = now + relaunch_delay
+                    # the interruption point: the watermark when the
+                    # pod actually died (the drained pod keeps
+                    # stepping through its grace — those steps are
+                    # training, not recovery)
+                    wave["t_dead_wall"] = time.time()
+                    wave["before"] = max(
+                        (e["step"] for e in lines), default=0
+                    )
+                if (
+                    wave["relaunch_at"] is not None
+                    and wave["relaunch_at"] > 0
+                    and now >= wave["relaunch_at"]
+                ):
+                    if max_step < steps:
+                        pods[1].launch()  # the re-created pod
+                    wave["relaunch_at"] = -1.0
+                if not wave["recovered"] and (
+                    wave.get("t_dead_wall") is not None
+                ):
+                    post = [
+                        e["step"]
+                        for e in lines
+                        if e["t"] > wave["t_dead_wall"]
+                    ]
+                    if post and max(post) > wave["before"]:
+                        # the job stepped PAST the preemption point:
+                        # recovery complete; replay depth = how far
+                        # below the preemption step the resumed
+                        # counter dipped
+                        wave["recovered"] = True
+                        recoveries.append(
+                            round(now - wave["t0"], 3)
+                        )
+                        replayed.append(
+                            max(wave["before"] - min(post), 0)
+                        )
+                if wave["recovered"] and wave["relaunch_at"] == -1.0:
+                    wave = None
+            time.sleep(0.05)
+    finally:
+        for pod in pods:
+            pod.stop()
+        supervisor.stop()
+    wall_s = time.perf_counter() - t_start
+
+    lines = _read_progress(progress)
+    final_step = max((e["step"] for e in lines), default=0)
+    rank0 = sorted(
+        (e for e in lines if e["rank"] == 0),
+        key=lambda e: e["step"],
+    )
+    deltas = sorted(
+        b["t"] - a["t"]
+        for a, b in zip(rank0, rank0[1:])
+        if b["step"] == a["step"] + 1 and b["t"] > a["t"]
+    )
+    steady_s = deltas[len(deltas) // 2] if deltas else step_sleep
+    # goodput measures TRAINING: launch → the target step landing.
+    # The re-created pod's post-completion rejoin (it comes back,
+    # restores, finds the job already done, exits) is scheduler
+    # housekeeping, not training wall time.
+    done_t = [e["t"] for e in lines if e["step"] >= steps]
+    train_wall_s = (
+        min(done_t) - t_start_wall if done_t else wall_s
+    )
+    goodput = (
+        min(1.0, final_step * steady_s / train_wall_s)
+        if train_wall_s
+        else 0.0
+    )
+    return {
+        "plan": "preempt-storm",
+        "reshard": reshard,
+        "steps": final_step,
+        "target_steps": steps,
+        "save_every": save_every,
+        "waves": waves - len(wave_marks),
+        "wall_s": round(wall_s, 2),
+        "train_wall_s": round(train_wall_s, 2),
+        "goodput": round(goodput, 4),
+        "steady_step_s": round(steady_s, 4),
+        "recovery_s": recoveries,
+        "recovery_mean_s": round(
+            sum(recoveries) / len(recoveries), 3
+        ) if recoveries else None,
+        "steps_replayed": replayed,
+        "job_survived": final_step >= steps,
+        "workdir": workdir,
+    }
 
 
 def run_plan(
@@ -453,6 +741,18 @@ def main(argv=None) -> int:
     parser.add_argument("--no-failover", action="store_true",
                         help="DLROVER_TPU_MASTER_FAILOVER=0 on the "
                         "job: pin today's fail-fast behavior")
+    parser.add_argument("--waves", type=int, default=2,
+                        help="preempt-storm: SIGTERM waves")
+    parser.add_argument("--save_every", type=int, default=5,
+                        help="preempt-storm: shm snapshot cadence "
+                        "(steps) — the periodic-RPO the graceful "
+                        "drain beats")
+    parser.add_argument("--no-reshard", action="store_true",
+                        help="preempt-storm: run ONLY the "
+                        "DLROVER_TPU_RESHARD=0 leg (default runs "
+                        "both and reports the comparison)")
+    parser.add_argument("--reshard-only", action="store_true",
+                        help="preempt-storm: run only the reshard leg")
     parser.add_argument("--out", default="")
     args = parser.parse_args(argv)
 
@@ -470,6 +770,62 @@ def main(argv=None) -> int:
         "vs_baseline": None,
         "extras": {"bench_budget_s": budget.total},
     }
+
+    if args.plan == "preempt-storm":
+        payload["metric"] = "preempt_recovery_mean_s"
+        legs = (
+            [False] if args.no_reshard
+            else [True] if args.reshard_only
+            else [True, False]
+        )
+        timeout = budget.cap_timeout(args.timeout)
+        # a storm needs steps SLOWER than pod teardown, or the job
+        # races to completion between the SIGTERM and the first
+        # missed collective and the wave measures nothing
+        storm_sleep = max(args.step_sleep, 0.25)
+        try:
+            for reshard in legs:
+                leg = run_preempt_storm(
+                    steps=steps,
+                    waves=args.waves,
+                    step_sleep=storm_sleep,
+                    save_every=args.save_every,
+                    timeout=timeout,
+                    reshard=reshard,
+                )
+                key = "reshard" if reshard else "restart"
+                payload["extras"][key] = leg
+                if args.out:
+                    _flush(args.out, payload)
+        except RuntimeError as e:
+            payload["extras"]["error"] = str(e)
+            if args.out:
+                _flush(args.out, payload)
+            print(json.dumps(payload, indent=2))
+            return 1
+        re_leg = payload["extras"].get("reshard")
+        rs_leg = payload["extras"].get("restart")
+        if re_leg:
+            payload["value"] = re_leg["recovery_mean_s"]
+        if re_leg and rs_leg:
+            payload["extras"]["goodput_gain"] = round(
+                re_leg["goodput"] - rs_leg["goodput"], 4
+            )
+            payload["extras"]["mttr_ratio"] = round(
+                (re_leg["recovery_mean_s"] or 0.0)
+                / max(rs_leg["recovery_mean_s"] or 1e-9, 1e-9),
+                3,
+            )
+        if args.out:
+            _flush(args.out, payload)
+        print(json.dumps(payload, indent=2))
+        survived = all(
+            payload["extras"].get(k, {}).get("job_survived", False)
+            for k in ("reshard", "restart")
+            if k in payload["extras"]
+        )
+        return 0 if survived else 1
+
     try:
         result = run_plan(
             plan=args.plan,

@@ -54,6 +54,19 @@ DECLARED_METRICS = {
     "dlrover_tpu_ckpt_io_gbps",
     "dlrover_tpu_ckpt_io_bytes",
     "dlrover_tpu_ckpt_skipped_snapshots",
+    # a CheckpointEngine.close() that gave up waiting for a stuck
+    # snapshot drain and deliberately leaked its shm/lock/queue
+    # handles (engine.close; DLROVER_TPU_CKPT_CLOSE_TIMEOUT_S)
+    "dlrover_tpu_ckpt_drain_stuck",
+    # SIGTERM flush hook could not be installed (non-main-thread
+    # embedder); the atexit fallback flush is active instead
+    "dlrover_tpu_ckpt_sigterm_fallback",
+    # elastic-reshard restore data plane (record_reshard_io): the
+    # overlap-range bytes reassembling a rank's new slices from a
+    # different-world checkpoint
+    "dlrover_tpu_reshard_gbps",
+    "dlrover_tpu_reshard_bytes",
+    "dlrover_tpu_reshard_total",
     # input data plane (record_input_io)
     "dlrover_tpu_input_gbps",
     "dlrover_tpu_input_bytes",

@@ -160,6 +160,44 @@ class TestElasticAgent:
         agent = self._agent(client, script, nproc=1, max_restarts=2)
         assert agent.run() == 0
 
+    def test_node_excluded_distinct_exit_code(
+        self, client, tmp_path, monkeypatch
+    ):
+        """A master exclusion verdict surfaces as its own exit code
+        and a node_excluded report — not a generic failure."""
+        from dlrover_tpu.agent.training import NodeExcludedError
+        from dlrover_tpu.common.constants import (
+            AgentExitCode,
+            TrainingExceptionLevel,
+        )
+
+        script = _write_script(tmp_path, "raise SystemExit(0)\n")
+        agent = self._agent(client, script, nproc=1)
+
+        def excluded(self):
+            raise NodeExcludedError("node 0 excluded from round 1")
+
+        monkeypatch.setattr(
+            MasterRendezvousHandler, "next_rendezvous", excluded
+        )
+        reports = []
+        real_report = client.report_failure
+
+        def spy(error_data="", restart_count=0, level=""):
+            reports.append((error_data, level))
+            return real_report(
+                error_data=error_data,
+                restart_count=restart_count,
+                level=level,
+            )
+
+        monkeypatch.setattr(client, "report_failure", spy)
+        assert agent.run() == AgentExitCode.NODE_EXCLUDED
+        assert reports and reports[0][1] == (
+            TrainingExceptionLevel.NODE_EXCLUDED
+        )
+        assert "excluded" in reports[0][0]
+
 
 class TestElasticRunCLI:
     def test_parse_nnodes(self):

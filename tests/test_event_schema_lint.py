@@ -107,6 +107,57 @@ def test_lint_enforces_diagnosis_labels(tmp_path):
     )
 
 
+def test_lint_enforces_reshard_labels(tmp_path):
+    """An elastic-reshard span without the world transition + moved
+    bytes + throughput is uninterpretable — every label is REQUIRED,
+    and a site missing any one of them fails the lint."""
+    bad = tmp_path / "bad_reshard.py"
+    bad.write_text(
+        "events = None\n"
+        "def f(events):\n"
+        "    events.complete('reshard', 0.0, 1.0,\n"
+        "                    from_world=8, to_world=4, bytes=1)\n"
+        "    events.complete('reshard', 0.0, 1.0, to_world=4,\n"
+        "                    bytes=1, throughput_gbps=2.0)\n"
+        "    events.complete('reshard', 0.0, 1.0, from_world=8,\n"
+        "                    to_world=4, bytes=1,\n"
+        "                    throughput_gbps=2.0)\n"
+    )
+    proc = _run(str(bad))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "event_schema_violations=2" in proc.stdout, proc.stdout
+    assert "missing required label(s) ['throughput_gbps']" in (
+        proc.stdout
+    )
+    assert "missing required label(s) ['from_world']" in proc.stdout
+
+
+def test_lint_knows_reshard_and_drain_metrics():
+    """The reshard gauges/counters and the ckpt drain/fallback
+    counters are declared; a near-miss typo is not."""
+    probe = os.path.join(
+        REPO, "dlrover_tpu", "_lint_probe2_delete_me.py"
+    )
+    with open(probe, "w") as f:
+        f.write(
+            "def f(reg):\n"
+            "    reg.set_gauge('dlrover_tpu_reshard_gbps', 1.0)\n"
+            "    reg.set_gauge('dlrover_tpu_reshard_bytes', 1.0)\n"
+            "    reg.inc_counter('dlrover_tpu_reshard_total')\n"
+            "    reg.inc_counter('dlrover_tpu_ckpt_drain_stuck')\n"
+            "    reg.inc_counter("
+            "'dlrover_tpu_ckpt_sigterm_fallback')\n"
+            "    reg.inc_counter('dlrover_tpu_reshard_totals')\n"
+        )
+    try:
+        proc = _run(probe)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "event_schema_violations=1" in proc.stdout, proc.stdout
+        assert "dlrover_tpu_reshard_totals" in proc.stdout
+    finally:
+        os.unlink(probe)
+
+
 def test_lint_catches_undeclared_metric_names():
     """A ``dlrover_tpu_``-prefixed gauge the package never declared
     (a typo'd dashboard series) must fail the lint; the observatory

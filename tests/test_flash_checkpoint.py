@@ -424,6 +424,115 @@ class TestCrashSurvival:
             AsyncCheckpointSaver._instance = None
 
 
+class TestCloseLeakBudget:
+    def test_stuck_drain_leaks_handles_on_purpose(
+        self, tmp_ckpt_dir, monkeypatch
+    ):
+        """A drain stuck past DLROVER_TPU_CKPT_CLOSE_TIMEOUT_S makes
+        close() return WITHOUT touching the shm/lock/queue handles
+        (closing under a live drain corrupts the persist) and bumps
+        the dlrover_tpu_ckpt_drain_stuck counter so the deliberate
+        leak is observable."""
+        import threading as _threading
+
+        from dlrover_tpu.observability.metrics import get_registry
+        from dlrover_tpu.trainer.checkpoint.engine import (
+            CheckpointEngine,
+        )
+
+        monkeypatch.setenv("DLROVER_TPU_CKPT_CLOSE_TIMEOUT_S", "0.2")
+        engine = CheckpointEngine(
+            tmp_ckpt_dir, process_rank=0, process_count=1,
+            local_shard_num=1, name="leak1",
+        )
+        engine.save_to_memory(1, {"x": np.ones(4)})
+        release = _threading.Event()
+        stuck = _threading.Thread(target=release.wait, daemon=True)
+        stuck.start()
+        engine._snapshot_thread = stuck
+        before = get_registry()._metrics.get(
+            "dlrover_tpu_ckpt_drain_stuck", 0.0
+        )
+        t0 = time.time()
+        engine.close()
+        assert time.time() - t0 < 5.0  # bounded by the env budget
+        # handles deliberately left open: shm still readable
+        step, arrays = engine._shm_handler.load_state()
+        assert step == 1 and arrays
+        after = get_registry()._metrics.get(
+            "dlrover_tpu_ckpt_drain_stuck", 0.0
+        )
+        assert after == before + 1
+        # unstick and REALLY close (pytest hygiene)
+        release.set()
+        stuck.join(5)
+        engine._snapshot_thread = None
+        engine.close()
+
+
+class TestSigtermFallback:
+    def test_non_main_thread_registers_atexit_flush(
+        self, tmp_ckpt_dir, monkeypatch
+    ):
+        """start_async_saving_ckpt off the main thread cannot install
+        the SIGTERM hook: it must arm the atexit fallback flush (+
+        warning metric) so embedded callers still get the crash
+        snapshot."""
+        import threading as _threading
+
+        from dlrover_tpu.observability.metrics import get_registry
+
+        monkeypatch.setattr(
+            AsyncCheckpointSaver, "_atexit_registered", False
+        )
+        registered = []
+        import atexit as _atexit
+
+        monkeypatch.setattr(
+            _atexit, "register", lambda fn: registered.append(fn)
+        )
+        before = get_registry()._metrics.get(
+            "dlrover_tpu_ckpt_sigterm_fallback", 0.0
+        )
+        holder = {}
+
+        def run():
+            holder["q"] = (
+                AsyncCheckpointSaver.start_async_saving_ckpt()
+            )
+
+        t = _threading.Thread(target=run)
+        t.start()
+        t.join(10)
+        try:
+            assert registered, "atexit fallback was not registered"
+            assert get_registry()._metrics.get(
+                "dlrover_tpu_ckpt_sigterm_fallback", 0.0
+            ) == before + 1
+            # the fallback flushes through the live saver instance
+            flushed = []
+            stub = type(
+                "S",
+                (),
+                {
+                    "_stopped": False,
+                    "save_shm_to_storage":
+                        lambda self, reason="": flushed.append(
+                            reason
+                        ),
+                },
+            )()
+            monkeypatch.setattr(
+                AsyncCheckpointSaver, "_instance", stub
+            )
+            registered[0]()
+            assert flushed == ["atexit fallback"]
+        finally:
+            if holder.get("q") is not None:
+                holder["q"].close()
+            AsyncCheckpointSaver._factory_thread = None
+
+
 class TestMultiShardCommit:
     def test_two_node_commit_waits_for_done_files(self, tmp_ckpt_dir):
         """Node 1 persists its shard first; node 0 commits only after

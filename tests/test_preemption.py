@@ -1,5 +1,9 @@
 """TPU-VM preemption watcher: event edge detection, idle resets,
-metadata-unavailable quiescence, agent callback wiring."""
+metadata-unavailable quiescence, agent callback wiring, and the
+end-to-end graceful drain (notice → flush → master fencing →
+survivor wake-up)."""
+
+import time
 
 from dlrover_tpu.agent.preemption import PreemptionWatcher
 
@@ -52,20 +56,129 @@ class TestPreemptionWatcher:
         assert hits == ["TRUE", "TRUE"]
 
 
-def test_agent_preemption_flushes_and_reports(monkeypatch, tmp_path):
-    """The agent's _on_preemption callback flushes the shm checkpoint
-    and reports a NODE_ERROR to the master."""
+def _bare_agent(calls):
     from dlrover_tpu.agent import training as tr
 
-    calls = {"flush": [], "report": []}
-
     agent = tr.ElasticTrainingAgent.__new__(tr.ElasticTrainingAgent)
+    agent._procs = []
+    agent._preempted = False
     agent._save_ckpt_to_storage = lambda reason: calls["flush"].append(
         reason
     )
     agent._try_report_failure = (
         lambda msg, level: calls["report"].append((msg, level))
     )
+    return agent
+
+
+def test_agent_preemption_drains_flushes_and_fences():
+    """The agent's _on_preemption callback drains the workers,
+    flushes the shm checkpoint, and reports node_preempted so the
+    master fences the node immediately."""
+    calls = {"flush": [], "report": []}
+    agent = _bare_agent(calls)
     agent._on_preemption("TERMINATE_ON_HOST_MAINTENANCE")
     assert calls["flush"] == ["preemption:TERMINATE_ON_HOST_MAINTENANCE"]
+    assert calls["report"][0][1] == "node_preempted"
+    assert agent._preempted
+
+
+def test_agent_preemption_kill_switch_reports_node_error(monkeypatch):
+    """DLROVER_TPU_RESHARD=0 reproduces today's behavior: the report
+    stays a generic node_error (no fencing)."""
+    monkeypatch.setenv("DLROVER_TPU_RESHARD", "0")
+    calls = {"flush": [], "report": []}
+    agent = _bare_agent(calls)
+    agent._on_preemption("TRUE")
+    assert calls["flush"] == ["preemption:TRUE"]
     assert calls["report"][0][1] == "node_error"
+
+
+class _StubSaver:
+    """Stands in for the agent-side AsyncCheckpointSaver: records the
+    emergency flush and answers the drain's common-step poll."""
+
+    def __init__(self):
+        self.flushes = []
+        self._step = 11
+
+    def max_common_step(self):
+        return self._step
+
+    def save_shm_to_storage(self, reason=""):
+        self.flushes.append(reason)
+        return True
+
+
+def test_preemption_drain_end_to_end(monkeypatch):
+    """Notice → shm flush → master notified → the SURVIVING agent
+    observes the membership change within one monitor interval, and
+    the next round completes WITHOUT the fenced node."""
+    from dlrover_tpu.agent import training as tr
+    from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.common.env import get_free_port
+    from dlrover_tpu.master.master import LocalJobMaster
+
+    monkeypatch.setenv("DLROVER_TPU_FENCE_TTL_S", "30")
+    port = get_free_port()
+    master = LocalJobMaster(port, node_num=2)
+    master.prepare()
+    survivor = MasterClient(master.addr, node_id=0)
+    dying = MasterClient(master.addr, node_id=1)
+    try:
+        # both nodes form the live world (round completes instantly
+        # at max_nodes); a short window so the post-fence shrink
+        # round also completes inside the test
+        survivor.report_rdzv_params(1, 2, 0.4, 1)
+        survivor.join_rendezvous(0, 1)
+        dying.join_rendezvous(1, 1)
+        _rnd, _g, world = survivor.wait_comm_world(
+            "elastic-training", 0, timeout=10
+        )
+        assert set(world) == {0, 1}
+        assert survivor.num_nodes_waiting() == 0
+
+        # the preemption notice fires the REAL agent callback chain
+        calls = {"flush": [], "report": []}
+        agent = tr.ElasticTrainingAgent.__new__(
+            tr.ElasticTrainingAgent
+        )
+        agent._procs = []
+        agent._preempted = False
+        agent._client = dying
+        agent._restart_count = 0
+        stub = _StubSaver()
+        monkeypatch.setattr(AsyncCheckpointSaver, "_instance", stub)
+        watcher = PreemptionWatcher(
+            fetcher=lambda: "TERMINATE_ON_HOST_MAINTENANCE"
+        )
+        watcher.on_preemption(agent._on_preemption)
+        t0 = time.monotonic()
+        assert watcher.check_once() == "TERMINATE_ON_HOST_MAINTENANCE"
+        # shm flushed before the pod dies
+        assert stub.flushes and "preemption" in stub.flushes[0]
+        # the survivor's waiting-count poll signals the membership
+        # change immediately (pending-remesh fencing) — well within
+        # one monitor interval of the notice
+        waiting = survivor.num_nodes_waiting()
+        assert waiting > 0
+        assert time.monotonic() - t0 < 5.0  # one monitor interval
+
+        # the survivor re-joins; the shrunken round completes without
+        # the fenced node once the waiting window lapses
+        survivor.join_rendezvous(0, 1)
+        deadline = time.time() + 10
+        world = {}
+        while time.time() < deadline:
+            _rnd, _g, world = survivor.get_comm_world(
+                "elastic-training", 0
+            )
+            if world:
+                break
+            time.sleep(0.1)
+        assert set(world) == {0}
+    finally:
+        survivor.close()
+        dying.close()
+        master.stop()

@@ -18,8 +18,10 @@ from dlrover_tpu.accelerate.solver import (
     attention_traffic_s,
     balanced_boundaries,
     candidate_tiles,
+    resolve_for_world,
     solve,
     solve_offload_groups,
+    strategy_device_count,
 )
 
 
@@ -299,3 +301,58 @@ class TestBalancedBoundaries:
     def test_validation(self):
         with pytest.raises(ValueError, match="cannot split"):
             balanced_boundaries([1, 1], 3)
+
+
+class TestResolveForWorld:
+    def test_remesh_fits_new_device_count(self):
+        """The elastic re-solve: a strategy sized for 8 devices is
+        replaced by one whose mesh product matches the new world,
+        both shrinking and growing."""
+        profile = bench_profile()
+        plan8 = resolve_for_world(profile, 8, 8, 2048)
+        assert strategy_device_count(plan8.strategy) == 8
+        plan4 = resolve_for_world(
+            profile, 4, 8, 2048, prior=plan8.strategy
+        )
+        assert strategy_device_count(plan4.strategy) == 4
+        plan8b = resolve_for_world(
+            profile, 8, 8, 2048, prior=plan4.strategy
+        )
+        assert strategy_device_count(plan8b.strategy) == 8
+
+    def test_auto_accelerate_resolves_pinned_strategy(self, monkeypatch):
+        """A pinned (load_strategy) plan whose mesh no longer matches
+        the device count is re-solved instead of failing at mesh
+        creation — the restart-after-world-change path."""
+        import jax
+
+        import dlrover_tpu.accelerate.api as api
+
+        devices = jax.devices()[:1]
+        from dlrover_tpu.accelerate.strategy import Strategy
+
+        stale = Strategy(data=8)  # sized for a world of 8
+        captured = {}
+
+        def fake_build(strategy, *a, **k):
+            captured["strategy"] = strategy
+            raise RuntimeError("stop after strategy resolution")
+
+        monkeypatch.setattr(api, "_build_for_strategy", fake_build)
+
+        def tiny_params(rng):
+            return {"w": np.zeros((128, 64), np.float32)}
+
+        with pytest.raises(RuntimeError, match="stop after"):
+            api.auto_accelerate(
+                loss_fn=lambda p, b: 0.0,
+                optimizer=None,
+                init_params_fn=tiny_params,
+                param_axes={},
+                devices=devices,
+                load_strategy=stale,
+                batch_per_replica=1,
+                seq_len=128,
+            )
+        got = captured["strategy"]
+        assert strategy_device_count(got) == 1
