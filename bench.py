@@ -418,6 +418,67 @@ def _control_micro(n_agents: int, wait_s: float) -> dict:
     return out
 
 
+def _brain_loop_bench(budget: "BenchBudget" = None) -> dict:
+    """The closed autonomy loop's acceptance artifact: Brain-on vs
+    Brain-off goodput under the slow-node sleep fault, plus — when
+    the budget allows — the preempt-storm comparison (full autonomy
+    stack vs the static seed job).  ``scripts/chaos.py`` owns both
+    scenarios — ONE definition."""
+    sys.path.insert(
+        0,
+        os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts"
+        ),
+    )
+    from chaos import run_preempt_storm, run_slow_node
+
+    tightish = budget is not None and budget.tight(300)
+    steps = 20 if tightish else 30
+    on = run_slow_node(steps=steps, brain=True, timeout=240.0)
+    off = run_slow_node(steps=steps, brain=False, timeout=240.0)
+    brain_loop = {
+        "slow_node": {
+            "brain": on,
+            "static": off,
+            "goodput_gain": round(
+                on["goodput"] - off["goodput"], 4
+            ),
+        }
+    }
+    out = {
+        "brain_loop": brain_loop,
+        "brain_slow_node_goodput_gain": brain_loop["slow_node"][
+            "goodput_gain"
+        ],
+    }
+    # the storm legs are the most expensive chaos in the suite; only
+    # a roomy budget runs them here (chaos.py --plan preempt-storm
+    # produces the same artifact standalone)
+    if budget is None or not budget.tight(700):
+        # storm steps must be SLOWER than pod teardown (chaos.py
+        # main() applies the same floor) or the job races to the
+        # target between the SIGTERM and the first missed collective
+        p_on = run_preempt_storm(
+            steps=30, step_sleep=0.25, reshard=True, brain=True,
+            timeout=240.0,
+        )
+        p_off = run_preempt_storm(
+            steps=30, step_sleep=0.25, reshard=False, brain=False,
+            timeout=240.0,
+        )
+        brain_loop["preempt_storm"] = {
+            "brain": p_on,
+            "static": p_off,
+            "goodput_gain": round(
+                p_on["goodput"] - p_off["goodput"], 4
+            ),
+        }
+        out["brain_preempt_goodput_gain"] = brain_loop[
+            "preempt_storm"
+        ]["goodput_gain"]
+    return out
+
+
 def _failover_bench(budget: "BenchBudget" = None) -> dict:
     """Master-kill-storm vs fault-free goodput + per-kill master MTTR
     (``scripts/chaos.py`` owns the orchestration — ONE definition).
@@ -579,6 +640,15 @@ def main(argv=None) -> int:
             )
         except Exception as e:  # noqa: BLE001
             extras["observatory_bench_error"] = str(e)
+        flush_partial(args.out, payload)
+
+        # autonomy-loop leg: the Brain job must beat the static job
+        # on goodput under the slow-node fault (scripts/chaos.py
+        # owns the scenario)
+        try:
+            extras.update(_brain_loop_bench(budget))
+        except Exception as e:  # noqa: BLE001
+            extras["brain_loop_bench_error"] = str(e)
     flush_partial(args.out, payload)
 
     import jax

@@ -102,6 +102,10 @@ class MasterClient:
         #: last JOB epoch this client acted under: re-assertion is
         #: only valid within one job generation (-1 = not learned yet)
         self._last_job_epoch = -1
+        #: Brain node directive delivered on the last WaitingNodeNum
+        #: response (action, reason, decision_id); consumed by the
+        #: agent via :meth:`take_node_action`
+        self._node_action: Optional[Tuple[str, str, int]] = None
         # epoch fencing: a StaleEpoch-triggered refresh means the job
         # generation (or master incarnation) changed — every versioned
         # cache is void (version counters restart with the new master)
@@ -463,7 +467,24 @@ class MasterClient:
             ),
             timeout=timeout,
         )
-        return res.waiting_num if res else 0
+        if res is None:
+            return 0
+        # Brain directive piggyback (getattr: an old master's pickle
+        # has no such fields); stashed for the agent's monitor loop
+        action = getattr(res, "action", "")
+        if action:
+            self._node_action = (
+                action,
+                getattr(res, "action_reason", ""),
+                int(getattr(res, "action_id", 0) or 0),
+            )
+        return res.waiting_num
+
+    def take_node_action(self) -> Optional[Tuple[str, str, int]]:
+        """Consume the Brain directive the last waiting-num poll
+        delivered (``(action, reason, decision_id)`` or None)."""
+        action, self._node_action = self._node_action, None
+        return action
 
     def check_fault_node(self) -> Tuple[List[int], str]:
         res = self._channel.get(msg.NetworkReadyRequest())

@@ -38,6 +38,7 @@ from dlrover_tpu.common.constants import (
 )
 from dlrover_tpu.common.env import (
     control_longpoll_enabled,
+    env_float,
     get_free_port,
     preempt_drain_grace_s,
     reshard_enabled,
@@ -652,6 +653,12 @@ class ElasticTrainingAgent:
                 events.path,
                 client=self._client,
                 buffer=self._report_buffer,
+                # ship cadence bounds how fast the master's health
+                # derivations (and therefore the Brain) can see a
+                # signal; chaos/bench harnesses tighten it
+                interval=env_float(
+                    "DLROVER_TPU_TIMELINE_REPORT_S", 5.0
+                ),
             )
             timeline_reporter.start()
         if self._start_ckpt_saver:
@@ -750,19 +757,62 @@ class ElasticTrainingAgent:
             return AgentExitCode.NODE_PREEMPTED
         return default
 
+    def _take_brain_directive(self):
+        """A Brain planned action delivered on the monitor-pacing
+        poll.  Ignored (and logged) when the reshard/drain machinery
+        is kill-switched — the master's execution deadline then falls
+        back to fencing this node without our cooperation."""
+        directive = self._client.take_node_action()
+        if directive is None:
+            return None
+        action, reason, decision_id = directive
+        if action != "drain":
+            logger.warning(
+                "ignoring unknown brain directive %r (decision %s)",
+                action, decision_id,
+            )
+            return None
+        if not reshard_enabled():
+            logger.warning(
+                "brain drain directive ignored: DLROVER_TPU_RESHARD=0"
+            )
+            return None
+        return directive
+
+    def _execute_brain_drain(self, reason: str, decision_id: int) -> int:
+        """The cooperative half of a Brain drain_replace/shrink: the
+        PR-9 graceful-drain protocol (snapshot-every-step → flush →
+        ``node_preempted`` report, which fences this node at the
+        master) and exit with the preemption code so the controller
+        reschedules the pod instead of counting a crash."""
+        logger.warning(
+            "brain directive: graceful drain and exit "
+            "(decision %s: %s)", decision_id, reason,
+        )
+        self._on_preemption(f"brain:{reason}")
+        self._stop_workers(timeout=self._config.failure_stop_timeout)
+        return AgentExitCode.NODE_PREEMPTED
+
     def _invoke_run(self) -> int:
         if not self._initialize_workers():
             return self._exit_code()
         while True:
             self._pace_monitor()
+            directive = self._take_brain_directive()
             result = self._monitor_workers()
             if result.state == WorkerState.SUCCEEDED:
+                # a completed job outranks a drain directive: there is
+                # nothing left to drain and the success must be
+                # reported as one
                 logger.info("all workers finished successfully")
                 try:
                     self._client.report_succeeded()
                 except ConnectionError:
                     pass
                 return 0
+            if directive is not None:
+                _action, reason, decision_id = directive
+                return self._execute_brain_drain(reason, decision_id)
             if result.state == WorkerState.FAILED:
                 if self._preempted:
                     # the hardware is going away and the drain +

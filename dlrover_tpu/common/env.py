@@ -195,6 +195,47 @@ def preempt_drain_grace_s() -> float:
     return env_float(PREEMPT_DRAIN_GRACE_ENV, 5.0)
 
 
+BRAIN_ENV = "DLROVER_TPU_BRAIN"
+BRAIN_INTERVAL_ENV = "DLROVER_TPU_BRAIN_INTERVAL_S"
+BRAIN_COOLDOWN_ENV = "DLROVER_TPU_BRAIN_COOLDOWN_S"
+BRAIN_SUSTAIN_ENV = "DLROVER_TPU_BRAIN_SUSTAIN"
+
+
+def brain_enabled() -> bool:
+    """Kill-switch for the autonomy loop: the observatory-fed Brain
+    (``master/resource_optimizer.ObservatoryBrainOptimizer`` +
+    ``master/auto_scaler.BrainAutoScaler`` + the planned-action
+    executor in ``master/brain.py``), its node directives riding the
+    ``WaitingNodeNum`` response, its journal component, and the
+    ``scale_decision``/``scale_execute`` telemetry.
+    ``DLROVER_TPU_BRAIN=0`` reproduces the seed auto-scaler exactly:
+    ``AllreduceAutoScaler`` polling the ``SpeedMonitor`` with
+    ``Scaler.scale(plan)`` as its only actuator, no directives on the
+    wire, nothing journaled.  Default: enabled."""
+    return os.getenv(BRAIN_ENV, "1").lower() not in (
+        "0", "false", "off",
+    )
+
+
+def brain_interval_s() -> float:
+    """Cadence of the Brain decision cycle."""
+    return env_float(BRAIN_INTERVAL_ENV, 30.0)
+
+
+def brain_cooldown_s() -> float:
+    """Minimum quiet time after an executed decision before the next
+    same-direction decision; opposite-direction decisions wait twice
+    this (hysteresis)."""
+    return env_float(BRAIN_COOLDOWN_ENV, 120.0)
+
+
+def brain_sustain_cycles() -> int:
+    """Consecutive decision cycles a signal (straggler verdict, hang
+    verdict, chronic stall share) must persist before the Brain acts
+    on it — one noisy snapshot is not a verdict."""
+    return max(int(env_float(BRAIN_SUSTAIN_ENV, 2.0)), 1)
+
+
 MASTER_FAILOVER_ENV = "DLROVER_TPU_MASTER_FAILOVER"
 RECONNECT_DEADLINE_ENV = "DLROVER_TPU_MASTER_RECONNECT_DEADLINE_S"
 SNAPSHOT_INTERVAL_ENV = "DLROVER_TPU_CONTROL_SNAPSHOT_INTERVAL_S"

@@ -192,6 +192,14 @@ class WaitingNodeNumRequest(Message):
 @dataclass
 class WaitingNodeNum(Message):
     waiting_num: int = 0
+    #: Brain node directive piggybacked on the monitor-pacing poll
+    #: (zero extra RPCs): "" = nothing for this node; ``drain`` = run
+    #: the graceful-drain protocol (snapshot → flush → report
+    #: preempted → exit) — the Brain planned this node out of the
+    #: world.  Consumed on delivery; old masters simply never set it.
+    action: str = ""
+    action_reason: str = ""
+    action_id: int = 0
 
 
 @dataclass

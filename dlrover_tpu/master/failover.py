@@ -41,6 +41,11 @@ KV = "kv"
 RDZV_PREFIX = "rdzv/"
 TASKS = "tasks"
 NODES = "nodes"
+#: the Brain auto-scaler's hysteresis/cooldown/in-flight state — a
+#: failed-over master must not forget a just-issued shrink and
+#: immediately re-grow (flip-flop), and an in-flight planned action
+#: must resume or be safely abandoned, never silently dropped
+BRAIN = "brain"
 
 
 class ControlPlaneJournal:
@@ -55,6 +60,7 @@ class ControlPlaneJournal:
         rdzv_managers: Optional[Dict[str, object]] = None,
         task_manager=None,
         job_manager=None,
+        brain=None,
         snapshot_interval_s: Optional[float] = None,
     ):
         self._store = store
@@ -63,6 +69,7 @@ class ControlPlaneJournal:
         self._rdzv = dict(rdzv_managers or {})
         self._tasks = task_manager
         self._nodes = job_manager
+        self._brain = brain
         self._interval = (
             control_snapshot_interval_s()
             if snapshot_interval_s is None
@@ -100,6 +107,8 @@ class ControlPlaneJournal:
             self._tasks.set_journal(self._cb(TASKS))
         if self._nodes is not None:
             self._nodes.set_journal(self._cb(NODES))
+        if self._brain is not None:
+            self._brain.set_journal(self._cb(BRAIN))
 
     def detach(self):
         if self._kv is not None:
@@ -110,6 +119,8 @@ class ControlPlaneJournal:
             self._tasks.set_journal(None)
         if self._nodes is not None:
             self._nodes.set_journal(None)
+        if self._brain is not None:
+            self._brain.set_journal(None)
 
     # ------------------------------------------------------- recovery
     def recover(self) -> dict:
@@ -169,6 +180,8 @@ class ControlPlaneJournal:
             return self._tasks
         if key == NODES:
             return self._nodes
+        if key == BRAIN:
+            return self._brain
         if key.startswith(RDZV_PREFIX):
             return self._rdzv.get(key[len(RDZV_PREFIX):])
         return None
@@ -207,6 +220,8 @@ class ControlPlaneJournal:
                 components[TASKS] = self._tasks.export_state()
             if self._nodes is not None:
                 components[NODES] = self._nodes.export_state()
+            if self._brain is not None:
+                components[BRAIN] = self._brain.export_state()
             self._store.save_control_snapshot(
                 self._job, {"components": components}, seq
             )

@@ -43,7 +43,10 @@ class JobMaster:
                  job_manager=None, diagnosis_manager=None):
         import os
 
-        from dlrover_tpu.common.env import observatory_enabled
+        from dlrover_tpu.common.env import (
+            brain_enabled,
+            observatory_enabled,
+        )
         from dlrover_tpu.master.datastore import get_default_datastore
         from dlrover_tpu.observability.events import TimelineAggregator
         from dlrover_tpu.observability.metrics import get_registry
@@ -94,6 +97,37 @@ class JobMaster:
                 job=self._job_name,
             )
         self.diagnosis_manager = diagnosis_manager
+        # the autonomy loop (ROADMAP item 1): observatory signals ->
+        # hysteresis-guarded BrainDecision -> ONE planned action
+        # (cooperative drain directive + fence + reshard re-mesh, or
+        # a scaler plan).  None under DLROVER_TPU_BRAIN=0 or with the
+        # observatory off — the seed AllreduceAutoScaler (distributed
+        # masters with a scaler) is then the only scaling loop,
+        # exactly as before.
+        self.brain = None
+        if brain_enabled() and self.health_engine is not None:
+            from dlrover_tpu.master.auto_scaler import BrainAutoScaler
+            from dlrover_tpu.master.brain import (
+                BrainExecutor,
+                NodeDirectives,
+            )
+            from dlrover_tpu.master.resource_optimizer import (
+                ObservatoryBrainOptimizer,
+            )
+
+            self.brain = BrainAutoScaler(
+                ObservatoryBrainOptimizer(),
+                BrainExecutor(
+                    rdzv_manager=self.rdzv_managers[
+                        RendezvousName.ELASTIC_TRAINING
+                    ],
+                    directives=NodeDirectives(),
+                    job_manager=self.job_manager,
+                ),
+                health_engine=self.health_engine,
+                timeline_aggregator=self.timeline_aggregator,
+                job=self._job_name,
+            )
         #: plain-HTTP /metrics + /status (off unless --status_port)
         self.status_server = None
         self.speed_monitor.set_target_worker_num(node_num)
@@ -151,6 +185,7 @@ class JobMaster:
             rdzv_managers=self.rdzv_managers,
             task_manager=self.task_manager,
             job_manager=self.job_manager,
+            brain=self.brain,
         )
         stats = self.control_journal.recover()
         self.control_journal.attach()
@@ -178,6 +213,7 @@ class JobMaster:
             diagnosis_manager=self.diagnosis_manager,
             timeline_aggregator=self.timeline_aggregator,
             health_engine=self.health_engine,
+            brain=self.brain,
             job_epoch=self.job_epoch,
             incarnation=self.incarnation,
         )
@@ -188,6 +224,8 @@ class JobMaster:
         self.job_manager.start()
         if self.diagnosis_manager:
             self.diagnosis_manager.start()
+        if self.brain is not None:
+            self.brain.start()
         self._start_status_server(servicer)
         logger.info("master serving on port %s", self._port)
 
@@ -266,6 +304,8 @@ class JobMaster:
         self.job_manager.stop()
         if self.diagnosis_manager:
             self.diagnosis_manager.stop()
+        if self.brain is not None:
+            self.brain.stop()
         if self.status_server is not None:
             self.status_server.stop()
             self.status_server = None
@@ -316,12 +356,24 @@ class DistributedJobMaster(JobMaster):
             ),
             diagnosis_manager=diagnosis_manager,
         )
-        # periodic optimize -> ScalePlan cycle (reference
-        # job_auto_scaler.py:271); the plan executes through the SAME
-        # scaler the job manager relaunches with, so a no-op scaler
-        # (local runs) makes this a cheap observer
+        if not autoscale and self.brain is not None:
+            # autoscaling explicitly disabled: the Brain must not run
+            # either (dropped before prepare() wires the journal /
+            # servicer, so nothing references it)
+            self.brain = None
+        if self.brain is not None and scaler is not None:
+            # the Brain gains launch capacity: grow decisions and
+            # drain REPLACEMENTS execute through the same scaler the
+            # job manager relaunches with
+            self.brain.set_scaler(scaler)
+        # seed periodic optimize -> ScalePlan cycle (reference
+        # job_auto_scaler.py:271); with the Brain on it is replaced
+        # wholesale — DLROVER_TPU_BRAIN=0 reproduces it exactly.  The
+        # plan executes through the SAME scaler the job manager
+        # relaunches with, so a no-op scaler (local runs) makes this
+        # a cheap observer.
         self.auto_scaler = None
-        if autoscale and scaler is not None:
+        if autoscale and scaler is not None and self.brain is None:
             import os
 
             from dlrover_tpu.master.auto_scaler import (

@@ -104,6 +104,32 @@ class RendezvousManager(metaclass=ABCMeta):
         with self._lock:
             return self._version
 
+    @property
+    def rdzv_params(self) -> RendezvousParameters:
+        """The live window parameters (the agents' ``--nnodes`` min/max
+        land here via ``report_rdzv_params``) — the Brain's world
+        clamps read them instead of guessing from ``--node_num``."""
+        with self._lock:
+            params = self._rdzv_params
+            return RendezvousParameters(
+                min_nodes=params.min_nodes,
+                max_nodes=params.max_nodes,
+                waiting_timeout=params.waiting_timeout,
+                node_unit=self._node_unit,
+            )
+
+    def current_world_ranks(self) -> List[int]:
+        """Node ranks of the latest COMPLETED round — the world the
+        Brain plans against (insertion order = rank order)."""
+        with self._lock:
+            return list(self._latest_rdzv_nodes)
+
+    def fenced_ranks(self) -> List[int]:
+        """Live (unexpired) preemption fences — the Brain must not
+        re-plan a node that is already on its way out."""
+        with self._lock:
+            return sorted(self._live_fenced_locked().keys())
+
     def set_node_topology(self, node_rank: int, levels: tuple):
         with self._lock:
             self._node_topology[node_rank] = tuple(levels)

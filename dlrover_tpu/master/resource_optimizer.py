@@ -22,6 +22,8 @@ TPU form: the unit of scaling is a whole TPU-VM worker (chips come in
 fixed slices), so plans adjust *worker count* within [min, max].
 """
 
+import threading
+import time
 from abc import ABCMeta, abstractmethod
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -428,3 +430,476 @@ class LocalAllreduceOptimizer(ResourceOptimizer):
         plan = WorkerOomResource(self._oom_factor).optimize(meta)
         self._oom_nodes = {}
         return plan
+
+
+# ---------------------------------------------------------------------------
+# the observatory-fed Brain (DLROVER_TPU_BRAIN; ROADMAP item 1)
+# ---------------------------------------------------------------------------
+#
+# The seed optimizer above reads one scalar (records/sec from the
+# SpeedMonitor) and its only actuator is a pod-count plan.  The Brain
+# variant below consumes the PR-8 observatory derivations and the
+# goodput ledger, and every verdict is a single explicit
+# :class:`BrainDecision` the executor (``master/brain.py``) turns into
+# ONE planned action — fence + cooperative drain + re-solve +
+# resharded restore — instead of an emergent restart.  Rules:
+#
+# - confirmed straggler / hang-watchdog conclusion -> drain_replace
+# - chronic data-stall share                        -> shrink
+# - near-linear step-time scaling + spare capacity  -> grow
+#
+# Everything is hysteresis/cooldown-guarded, and the whole mutable
+# rule state (streaks, last decision, the in-flight action) exports /
+# restores through the PR-7 ``ControlPlaneJournal`` so a master
+# failover mid-action resumes or abandons it instead of flip-flopping.
+
+#: BrainDecision.action vocabulary
+ACTION_GROW = "grow"
+ACTION_SHRINK = "shrink"
+ACTION_DRAIN_REPLACE = "drain_replace"
+
+#: capacity direction per action (hysteresis keys on it: an opposite-
+#: direction decision needs twice the cooldown)
+_DIRECTION = {
+    ACTION_GROW: "up",
+    ACTION_SHRINK: "down",
+    ACTION_DRAIN_REPLACE: "down",
+}
+
+#: execution outcomes (scale_execute labels / journal records)
+OUTCOME_DONE = "done"
+OUTCOME_FENCED_FALLBACK = "fenced_fallback"
+OUTCOME_ABANDONED = "abandoned"
+
+
+@dataclass
+class ObservatorySignals:
+    """One decision cycle's inputs, assembled by the auto-scaler from
+    the health engine + rendezvous manager + ledger (kept a plain
+    dataclass so the rule tests feed it directly)."""
+
+    #: node ranks of the latest completed world (rank order)
+    world: List[int] = field(default_factory=list)
+    min_nodes: int = 1
+    max_nodes: int = 1
+    #: (node, score) past the straggler ratio (HealthEngine.stragglers)
+    stragglers: List[tuple] = field(default_factory=list)
+    #: (node, silence_s) hang-watchdog verdicts
+    hangs: List[tuple] = field(default_factory=list)
+    #: node -> {stage: share} windowed data-stall shares
+    stall_shares: Dict[int, Dict[str, float]] = field(
+        default_factory=dict
+    )
+    #: across-node median step-time EWMA (0 = not enough steps yet)
+    median_step_time_s: float = 0.0
+    #: live preemption fences (nodes already on their way out)
+    fenced: List[int] = field(default_factory=list)
+    #: the executor can CREATE nodes (a scaler is attached)
+    can_launch: bool = False
+    #: goodput ledger snapshot (advisory context, journaled with the
+    #: decision so every verdict carries the evidence it saw)
+    goodput: float = 0.0
+    #: wall clock (injected so rule tests control time)
+    now: float = 0.0
+
+
+@dataclass
+class BrainDecision:
+    """One planned action: what rule fired, against whom, and the
+    world transition it intends.  Serialized verbatim into the
+    journal and the ``scale_decision`` / ``scale_execute`` events."""
+
+    decision_id: int = 0
+    action: str = ""
+    reason: str = ""
+    node: int = -1  # target rank (drain/shrink victim; -1 for grow)
+    from_world: int = 0
+    to_world: int = 0
+    made_at: float = 0.0
+    goodput: float = 0.0
+
+    @property
+    def direction(self) -> str:
+        return _DIRECTION.get(self.action, "none")
+
+    def to_dict(self) -> dict:
+        return {
+            "decision_id": self.decision_id,
+            "action": self.action,
+            "reason": self.reason,
+            "node": self.node,
+            "from_world": self.from_world,
+            "to_world": self.to_world,
+            "made_at": self.made_at,
+            "goodput": self.goodput,
+        }
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "BrainDecision":
+        known = {
+            k: v
+            for k, v in (data or {}).items()
+            if k in cls.__dataclass_fields__
+        }
+        return cls(**known)
+
+
+class ObservatoryBrainOptimizer:
+    """The Brain's rule engine: ``decide()`` turns one cycle's
+    :class:`ObservatorySignals` into at most ONE
+    :class:`BrainDecision`, guarded by sustain streaks (a single
+    noisy snapshot is not a verdict), a post-execution cooldown, and
+    2x-cooldown hysteresis against direction flips.  All mutable
+    state round-trips through ``export_state``/``restore_state`` (the
+    journal component contract)."""
+
+    #: step-time EWMA blend for the per-world scaling history
+    HISTORY_ALPHA = 0.4
+
+    def __init__(
+        self,
+        cooldown_s: Optional[float] = None,
+        sustain_cycles: Optional[int] = None,
+        stall_share_threshold: float = 0.3,
+        linear_tolerance: float = 0.15,
+        hysteresis_factor: float = 2.0,
+    ):
+        from dlrover_tpu.common.env import (
+            brain_cooldown_s,
+            brain_sustain_cycles,
+        )
+
+        self.cooldown_s = (
+            brain_cooldown_s() if cooldown_s is None else cooldown_s
+        )
+        self.sustain_cycles = (
+            brain_sustain_cycles()
+            if sustain_cycles is None
+            else max(int(sustain_cycles), 1)
+        )
+        self.stall_share_threshold = stall_share_threshold
+        self.linear_tolerance = linear_tolerance
+        self.hysteresis_factor = hysteresis_factor
+        #: per-node consecutive-cycle streaks per signal
+        self._straggler_streak: Dict[int, int] = {}
+        self._hang_streak: Dict[int, int] = {}
+        #: job-level chronic-stall streak
+        self._stall_streak = 0
+        #: cycles observed at the current world size (grow evidence)
+        self._world_cycles: List[int] = [0, 0]  # [world_size, cycles]
+        #: median step time per observed world size (the scaling curve)
+        self._step_time_by_world: Dict[int, float] = {}
+        #: hysteresis/cooldown state — journaled
+        self._last: Optional[BrainDecision] = None
+        self._in_flight: Optional[BrainDecision] = None
+        self._next_id = 1
+        #: every other ControlPlaneJournal component locks its state
+        #: (the snapshot thread and the status RPC read concurrently
+        #: with the brain thread's mutations); reentrant because
+        #: decide() composes the locked helpers
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------ state
+    @property
+    def in_flight(self) -> Optional[BrainDecision]:
+        with self._lock:
+            return self._in_flight
+
+    @property
+    def last_decision(self) -> Optional[BrainDecision]:
+        with self._lock:
+            return self._last
+
+    def complete(self, outcome: str, now: Optional[float] = None):
+        """The executor finished (or abandoned) the in-flight action:
+        it becomes the cooldown anchor."""
+        del outcome
+        with self._lock:
+            if self._in_flight is None:
+                return
+            done = self._in_flight
+            # the cooldown runs from COMPLETION, not decision time —
+            # a slow execution must not eat its own quiet period
+            done.made_at = now if now is not None else time.time()
+            self._last = done
+            self._in_flight = None
+
+    def _cooled_down(self, action: str, now: float) -> bool:
+        if self._last is None:
+            return True
+        quiet = now - self._last.made_at
+        needed = self.cooldown_s
+        if _DIRECTION.get(action) != self._last.direction:
+            needed *= self.hysteresis_factor
+        return quiet >= needed
+
+    # ---------------------------------------------------------- streaks
+    def _update_streaks(self, signals: ObservatorySignals):
+        world = set(signals.world)
+        fenced = set(signals.fenced)
+        eligible = world - fenced
+
+        flagged = {
+            n: score
+            for n, score in signals.stragglers
+            if n in eligible
+        }
+        self._straggler_streak = {
+            n: self._straggler_streak.get(n, 0) + 1 for n in flagged
+        }
+        hung = {
+            n: silence
+            for n, silence in signals.hangs
+            if n in eligible
+        }
+        self._hang_streak = {
+            n: self._hang_streak.get(n, 0) + 1 for n in hung
+        }
+        stalled = [
+            n
+            for n in eligible
+            if max(
+                (signals.stall_shares.get(n) or {}).values(),
+                default=0.0,
+            )
+            >= self.stall_share_threshold
+        ]
+        # chronic = the job is input-bound, not one unlucky node: at
+        # least half the eligible world is past the share threshold
+        if eligible and len(stalled) * 2 >= len(eligible):
+            self._stall_streak += 1
+        else:
+            self._stall_streak = 0
+        return flagged, hung, stalled
+
+    def _update_history(self, signals: ObservatorySignals):
+        w = len(signals.world)
+        if w <= 0:
+            return
+        if self._world_cycles[0] != w:
+            self._world_cycles = [w, 1]
+        else:
+            self._world_cycles[1] += 1
+        if signals.median_step_time_s > 0:
+            prev = self._step_time_by_world.get(w, 0.0)
+            if prev <= 0:
+                self._step_time_by_world[w] = signals.median_step_time_s
+            else:
+                a = self.HISTORY_ALPHA
+                self._step_time_by_world[w] = (
+                    a * signals.median_step_time_s + (1 - a) * prev
+                )
+
+    # ----------------------------------------------------------- decide
+    def decide(
+        self, signals: ObservatorySignals
+    ) -> Optional[BrainDecision]:
+        now = signals.now or time.time()
+        if not signals.world:
+            return None  # no completed world yet: nothing to plan
+        with self._lock:
+            return self._decide_locked(signals, now)
+
+    def _decide_locked(
+        self, signals: ObservatorySignals, now: float
+    ) -> Optional[BrainDecision]:
+        self._update_history(signals)
+        flagged, hung, stalled = self._update_streaks(signals)
+        if self._in_flight is not None:
+            return None  # one planned action at a time
+        candidate = self._drain_candidate(signals, flagged, hung)
+        if candidate is None:
+            candidate = self._shrink_candidate(signals, stalled)
+        if candidate is None:
+            candidate = self._grow_candidate(signals)
+        if candidate is None:
+            return None
+        if not self._cooled_down(candidate.action, now):
+            return None
+        candidate.decision_id = self._next_id
+        self._next_id += 1
+        candidate.made_at = now
+        candidate.goodput = signals.goodput
+        self._in_flight = candidate
+        # acting on a verdict consumes its streak: if the condition
+        # persists after the action lands, it must re-prove itself
+        self._straggler_streak.pop(candidate.node, None)
+        self._hang_streak.pop(candidate.node, None)
+        if candidate.action == ACTION_SHRINK:
+            self._stall_streak = 0
+        return candidate
+
+    def _drain_candidate(
+        self, signals: ObservatorySignals, flagged: Dict[int, float],
+        hung: Dict[int, float],
+    ) -> Optional[BrainDecision]:
+        world = len(signals.world)
+        sustained = sorted(
+            (
+                (score, n)
+                for n, score in flagged.items()
+                if self._straggler_streak.get(n, 0)
+                >= self.sustain_cycles
+            ),
+            reverse=True,
+        )
+        reason = None
+        if sustained:
+            score, node = sustained[0]
+            reason = f"straggler:{score:.2f}x"
+        else:
+            hung_sustained = sorted(
+                (
+                    (silence, n)
+                    for n, silence in hung.items()
+                    if self._hang_streak.get(n, 0)
+                    >= self.sustain_cycles
+                ),
+                reverse=True,
+            )
+            if hung_sustained:
+                silence, node = hung_sustained[0]
+                reason = f"hang:{silence:.0f}s"
+        if reason is None:
+            return None
+        to_world = world if signals.can_launch else world - 1
+        if to_world < max(signals.min_nodes, 1):
+            logger.warning(
+                "brain: %s on node %s suppressed (world %d at "
+                "min_nodes %d, no launch capacity)",
+                reason, node, world, signals.min_nodes,
+            )
+            return None
+        return BrainDecision(
+            action=ACTION_DRAIN_REPLACE,
+            reason=reason,
+            node=node,
+            from_world=world,
+            to_world=to_world,
+        )
+
+    def _shrink_candidate(
+        self, signals: ObservatorySignals, stalled: List[int]
+    ) -> Optional[BrainDecision]:
+        if self._stall_streak < self.sustain_cycles or not stalled:
+            return None
+        world = len(signals.world)
+        if world - 1 < max(signals.min_nodes, 1):
+            return None
+        # victim: the worst-stalled node (ties -> highest rank, the
+        # scale-down convention)
+        victim = max(
+            stalled,
+            key=lambda n: (
+                max(
+                    (signals.stall_shares.get(n) or {}).values(),
+                    default=0.0,
+                ),
+                n,
+            ),
+        )
+        share = max(
+            (signals.stall_shares.get(victim) or {}).values(),
+            default=0.0,
+        )
+        return BrainDecision(
+            action=ACTION_SHRINK,
+            reason=f"data_stall:{share:.2f}",
+            node=victim,
+            from_world=world,
+            to_world=world - 1,
+        )
+
+    def _grow_candidate(
+        self, signals: ObservatorySignals
+    ) -> Optional[BrainDecision]:
+        world = len(signals.world)
+        if not signals.can_launch or world >= signals.max_nodes:
+            return None
+        # only a HEALTHY job grows: any live adverse signal means new
+        # capacity would feed the problem, not the throughput
+        if (
+            self._straggler_streak
+            or self._hang_streak
+            or self._stall_streak > 0
+        ):
+            return None
+        # evidence: enough settled cycles at this size (a world change
+        # resets the counter in _update_history), and the step time
+        # did not degrade past tolerance when the world last grew
+        # (near-linear scaling — adding a node bought real throughput)
+        if self._world_cycles[1] < self.sustain_cycles:
+            return None
+        here = self._step_time_by_world.get(world, 0.0)
+        if here <= 0:
+            return None  # insufficient samples at this size
+        smaller = [
+            w for w in self._step_time_by_world if w < world
+        ]
+        if smaller:
+            ref = self._step_time_by_world[max(smaller)]
+            if ref > 0 and here / ref > 1.0 + self.linear_tolerance:
+                return None  # scaling already sub-linear: stop
+        return BrainDecision(
+            action=ACTION_GROW,
+            reason=f"linear_scaling:{here:.3f}s",
+            node=-1,
+            from_world=world,
+            to_world=min(world + 1, signals.max_nodes),
+        )
+
+    # -------------------------------------------------- journal contract
+    def export_state(self) -> dict:
+        with self._lock:
+            return self._export_locked()
+
+    def _export_locked(self) -> dict:
+        return {
+            "next_id": self._next_id,
+            "last": self._last.to_dict() if self._last else None,
+            "in_flight": (
+                self._in_flight.to_dict() if self._in_flight else None
+            ),
+            "straggler_streak": {
+                str(k): v for k, v in self._straggler_streak.items()
+            },
+            "hang_streak": {
+                str(k): v for k, v in self._hang_streak.items()
+            },
+            "stall_streak": self._stall_streak,
+            "world_cycles": list(self._world_cycles),
+            "step_time_by_world": {
+                str(k): v
+                for k, v in self._step_time_by_world.items()
+            },
+        }
+
+    def restore_state(self, state: dict):
+        with self._lock:
+            self._restore_locked(state)
+
+    def _restore_locked(self, state: dict):
+        self._next_id = int(state.get("next_id", 1))
+        last = state.get("last")
+        self._last = BrainDecision.from_dict(last) if last else None
+        in_flight = state.get("in_flight")
+        self._in_flight = (
+            BrainDecision.from_dict(in_flight) if in_flight else None
+        )
+        self._straggler_streak = {
+            int(k): int(v)
+            for k, v in (state.get("straggler_streak") or {}).items()
+        }
+        self._hang_streak = {
+            int(k): int(v)
+            for k, v in (state.get("hang_streak") or {}).items()
+        }
+        self._stall_streak = int(state.get("stall_streak", 0))
+        cycles = state.get("world_cycles") or [0, 0]
+        self._world_cycles = [int(cycles[0]), int(cycles[1])]
+        self._step_time_by_world = {
+            int(k): float(v)
+            for k, v in (
+                state.get("step_time_by_world") or {}
+            ).items()
+        }

@@ -70,18 +70,28 @@ class InMemoryScaler(Scaler):
             if node:
                 node.update_status(NodeStatus.DELETED)
         for node_spec in plan.launch_nodes:
-            node = Node(
-                node_type=node_spec.get("type", NodeType.WORKER),
-                node_id=self._next_id,
-                config_resource=NodeResource(
-                    cpu=node_spec.get("cpu", 0),
-                    memory=node_spec.get("memory", 0),
-                    tpu_chips=node_spec.get("tpu_chips", 0),
-                ),
-                status=NodeStatus.PENDING,
-            )
-            self.alive[node.name] = node
-            self._next_id += 1
+            self._launch(node_spec)
+        # migrate = launch the replacement, then remove the old node
+        # (the Brain's drain_replace plan; TpuPodScaler mirrors this)
+        for name, node_spec in plan.migrate_nodes.items():
+            self._launch(node_spec)
+            node = self.alive.pop(name, None)
+            if node:
+                node.update_status(NodeStatus.DELETED)
+
+    def _launch(self, node_spec: Dict):
+        node = Node(
+            node_type=node_spec.get("type", NodeType.WORKER),
+            node_id=self._next_id,
+            config_resource=NodeResource(
+                cpu=node_spec.get("cpu", 0),
+                memory=node_spec.get("memory", 0),
+                tpu_chips=node_spec.get("tpu_chips", 0),
+            ),
+            status=NodeStatus.PENDING,
+        )
+        self.alive[node.name] = node
+        self._next_id += 1
 
 
 class TpuPodScaler(Scaler):

@@ -42,6 +42,7 @@ class MasterServicer:
         sync_service=None,
         timeline_aggregator=None,
         health_engine=None,
+        brain=None,
         job_epoch: int = 0,
         incarnation: int = 0,
     ):
@@ -64,6 +65,10 @@ class MasterServicer:
         #: DLROVER_TPU_OBSERVATORY=0 or a pre-observatory master);
         #: heartbeats / steps / failures / resource reports tap it
         self._health_engine = health_engine
+        #: the Brain auto-scaler (None = DLROVER_TPU_BRAIN=0):
+        #: node directives ride the WaitingNodeNum response and its
+        #: decision state joins the JobStatus snapshot
+        self._brain = brain
         self._start_training_time = 0.0
         #: lifetime RPC tally (gets + reports, batched items counted
         #: once per envelope) — the bench's server-side ground truth
@@ -131,7 +136,7 @@ class MasterServicer:
         if isinstance(request, msg.JoinRendezvousRequest):
             return self._join_rendezvous(request)
         if isinstance(request, msg.WaitingNodeNumRequest):
-            return self._get_waiting_num(request)
+            return self._get_waiting_num(request, node_id)
         if isinstance(request, msg.NetworkReadyRequest):
             return self._check_fault_node()
         if isinstance(request, msg.StragglerExistRequest):
@@ -237,6 +242,11 @@ class MasterServicer:
             "job_epoch": self.job_epoch,
             "incarnation": self.incarnation,
         }
+        if self._brain is not None:
+            try:
+                status["brain"] = self._brain.status()
+            except Exception as e:  # noqa: BLE001 - partial status
+                logger.warning("status brain failed: %s", e)
         return msg.JobStatusResponse(status=status, available=True)
 
     def _timeline_query(
@@ -316,13 +326,29 @@ class MasterServicer:
             version=version,
         )
 
-    def _get_waiting_num(self, request: msg.WaitingNodeNumRequest):
+    def _get_waiting_num(self, request: msg.WaitingNodeNumRequest,
+                         node_id: int = -1):
         manager = self._rdzv_managers.get(
             request.rdzv_name or RendezvousName.ELASTIC_TRAINING
         )
         if manager is None:
             return msg.WaitingNodeNum(waiting_num=0)
+        # Brain directive piggyback: a pending planned action for THIS
+        # node short-circuits the long poll (the agent must act now,
+        # not after the park) and is consumed on delivery
+        directive = None
+        if self._brain is not None and node_id >= 0:
+            directive = self._brain.directives.take(node_id)
         wait_timeout = getattr(request, "wait_timeout", 0.0)
+        if directive is not None:
+            waiting = manager.num_nodes_waiting()
+            action, reason, decision_id = directive
+            return msg.WaitingNodeNum(
+                waiting_num=waiting,
+                action=action,
+                action_reason=reason,
+                action_id=decision_id,
+            )
         if wait_timeout > 0:
             waiting = self._bounded_wait(
                 lambda: manager.wait_num_nodes(

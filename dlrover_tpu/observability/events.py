@@ -151,6 +151,12 @@ UNATTRIBUTED = "unattributed"
 #: observatory's DiagnosisManager): the problem, the recovery action
 #: and the node it names — the trace shows the verdict next to the
 #: evidence that produced it.
+#: ``scale_decision`` / ``scale_execute`` bracket one Brain planned
+#: action (``master/auto_scaler.BrainAutoScaler``): the decision as it
+#: was made (rule, direction, world transition) and its execution
+#: outcome (done / fallback-fenced / abandoned) — a chaos trace shows
+#: the autonomy loop's verdicts next to the drains and re-meshes they
+#: caused, and a failover-resumed action keeps the SAME decision id.
 INSTANT_EVENTS = frozenset(
     {
         "preemption_signal",
@@ -160,6 +166,8 @@ INSTANT_EVENTS = frozenset(
         "fault_injected",
         "master_restart",
         "diagnosis",
+        "scale_decision",
+        "scale_execute",
     }
 )
 
@@ -173,6 +181,11 @@ REQUIRED_INSTANT_LABELS: Dict[str, Tuple[str, ...]] = {
     # an anonymous conclusion is useless to the operator reading the
     # trace AND to scripts/top.py's conclusions pane
     "diagnosis": ("problem", "action", "node_rank"),
+    # a scale record without the rule that fired and the world
+    # transition it planned is unauditable — "drain_replace node 2,
+    # straggler 3.9x, 3→2" is the whole story of a Brain action
+    "scale_decision": ("action", "reason", "from_world", "to_world"),
+    "scale_execute": ("action", "reason", "from_world", "to_world"),
 }
 
 #: Labels an emit SITE must pass explicitly (beyond the automatic

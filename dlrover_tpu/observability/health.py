@@ -536,6 +536,13 @@ class HealthEngine:
                 if self._hang_suspect_locked(state, now_mono)
             ]
 
+    def median_step_time(self) -> float:
+        """The across-node median step-time EWMA (0 until enough
+        nodes have completed ``MIN_STEPS_FOR_STRAGGLER`` steps) — the
+        Brain's per-world scaling-history sample."""
+        with self._lock:
+            return self._median_step_time_locked()
+
     def stall_shares(self) -> Dict[int, Dict[str, float]]:
         """Per-node windowed data-stall share by stage (the
         ``DataStallOperator``'s input)."""

@@ -87,9 +87,20 @@ PLANS = (
     # fresh snapshot, flushes, fences itself at the master, and the
     # SURVIVOR re-meshes onto the shrunken world without a restart-
     # from-scratch; the pod is re-created after a delay and the world
-    # grows back.  Run twice (DLROVER_TPU_RESHARD on/off) by main()
-    # to produce the reshard-vs-restart goodput/MTTR artifact.
+    # grows back.  Run twice by main() — the full autonomy stack
+    # (DLROVER_TPU_BRAIN=1 + DLROVER_TPU_RESHARD=1) vs the static
+    # seed job (both off) — to produce the Brain-vs-static
+    # goodput/MTTR artifact.
     "preempt-storm",
+    # sleep-fault one pod of three MID-RUN (a chip degrades under the
+    # job): the coupled world runs at the slow rank's speed.  With
+    # the Brain on, the master's straggler derivation names the node,
+    # the Brain issues ONE planned drain_replace — cooperative drain
+    # directive → fence → survivors re-mesh and reshard-restore — and
+    # the job finishes at full speed on the shrunken world.  Brain
+    # off, nobody acts and the job limps to the target.  Run twice by
+    # main() to produce the Brain-vs-static goodput artifact.
+    "slow-node",
 )
 
 #: phase hook each plan pins its master kill to
@@ -147,7 +158,7 @@ class MasterSupervisor:
     the same port + Brain db, per-restart MTTR."""
 
     def __init__(self, workdir: str, fault_plan: str = "",
-                 job_name: str = "chaos"):
+                 job_name: str = "chaos", extra_env: dict = None):
         self.port = get_free_port()
         self.addr = f"127.0.0.1:{self.port}"
         self._workdir = workdir
@@ -155,6 +166,9 @@ class MasterSupervisor:
         self._log_path = os.path.join(workdir, "master.log")
         self._fault_plan = fault_plan
         self._job_name = job_name
+        #: master-side knob overrides (Brain cadence, straggler ratio
+        #: ... the slow-node plan tightens them to chaos timescales)
+        self._extra_env = dict(extra_env or {})
         self._proc = None
         self.incarnations = 0
         self.mttr_s = []
@@ -172,6 +186,7 @@ class MasterSupervisor:
             DLROVER_TPU_CONTROL_SNAPSHOT_INTERVAL_S="5",
             DLROVER_TPU_FAULT_ROLE="master",
         )
+        env.update(self._extra_env)
         if with_plan and self._fault_plan:
             env["DLROVER_TPU_FAULT_PLAN"] = self._fault_plan
         else:
@@ -347,6 +362,7 @@ def run_preempt_storm(
     relaunch_delay: float = 12.0,
     timeout: float = 300.0,
     reshard: bool = True,
+    brain: bool = None,
 ) -> dict:
     """SIGTERM-with-grace preemption waves against pod 1 of a 2-pod
     job.  With the reshard loop ON the dying pod drains + fences and
@@ -357,11 +373,19 @@ def run_preempt_storm(
     the survivor stalls wedged in its collective until the re-created
     pod rejoins, then replays back to the last periodic snapshot.
     Per-wave MTTR = SIGTERM → first step BEYOND the pre-death
-    watermark, logged AFTER the pod actually died."""
+    watermark, logged AFTER the pod actually died.
+
+    ``brain`` follows ``reshard`` unless overridden: the autonomy
+    comparison is the full stack (Brain + execution arm) vs the
+    static seed job (neither) — ``DLROVER_TPU_BRAIN`` rides both the
+    master and the job."""
+    if brain is None:
+        brain = reshard
     workdir = tempfile.mkdtemp(prefix="dlrover_preempt_")
     progress = os.path.join(workdir, "progress.jsonl")
     supervisor = MasterSupervisor(
-        workdir, fault_plan="", job_name="preempt"
+        workdir, fault_plan="", job_name="preempt",
+        extra_env={"DLROVER_TPU_BRAIN": "1" if brain else "0"},
     )
     if not supervisor.start():
         raise RuntimeError(
@@ -379,6 +403,7 @@ def run_preempt_storm(
             workdir, "events.jsonl"
         ),
         DLROVER_TPU_RESHARD="1" if reshard else "0",
+        DLROVER_TPU_BRAIN="1" if brain else "0",
         DLROVER_TPU_PREEMPT_DRAIN_GRACE_S="2.0",
         DLROVER_TPU_EMERGENCY_COMMIT_TIMEOUT_S="3.0",
         DLROVER_TPU_FENCE_TTL_S="8.0",
@@ -513,6 +538,7 @@ def run_preempt_storm(
     return {
         "plan": "preempt-storm",
         "reshard": reshard,
+        "brain": brain,
         "steps": final_step,
         "target_steps": steps,
         "save_every": save_every,
@@ -526,6 +552,183 @@ def run_preempt_storm(
             sum(recoveries) / len(recoveries), 3
         ) if recoveries else None,
         "steps_replayed": replayed,
+        "job_survived": final_step >= steps,
+        "workdir": workdir,
+    }
+
+
+def run_slow_node(
+    steps: int = 60,
+    pods: int = 3,
+    slow_node: int = 2,
+    slow_factor: float = 5.0,
+    slow_after: int = 0,
+    step_sleep: float = 0.25,
+    save_every: int = 5,
+    brain: bool = True,
+    timeout: float = 300.0,
+    seed: int = 7,
+) -> dict:
+    """Sleep-fault one pod of ``pods`` mid-run: from step
+    ``slow_after`` (default ~1/3 of the target) its simulated device
+    work takes ``slow_factor`` times longer, and the per-step
+    collective drags the WHOLE job down to its speed.
+
+    With ``brain=True`` the closed loop must rescue the job: the
+    observatory's step-time derivations brand the node a straggler,
+    the Brain issues one hysteresis-guarded ``drain_replace``, the
+    node drains (fresh snapshot, flush, fence) and exits with the
+    preemption code, and the survivors re-mesh + reshard-restore and
+    finish at full speed — the pool has no spare capacity, so the
+    shrunken world is the planned outcome.  ``brain=False`` is the
+    static job: nobody acts, every remaining step pays the slow tax.
+
+    Goodput uses the HEALTHY steady step time (median pre-onset
+    inter-step delta — identical across legs) so a leg that merely
+    runs slowly cannot look "efficient at the degraded speed"."""
+    workdir = tempfile.mkdtemp(prefix="dlrover_slownode_")
+    progress = os.path.join(workdir, "progress.jsonl")
+    slow_after = slow_after or max(int(steps * 0.25), 4)
+    brain_flag = "1" if brain else "0"
+    supervisor = MasterSupervisor(
+        workdir, fault_plan="", job_name="slownode",
+        extra_env={
+            "DLROVER_TPU_BRAIN": brain_flag,
+            # chaos timescales: decide every 0.5s, cool down 5s,
+            # 2-cycle sustain against CPU-CI step-time noise; factor
+            # 5 degradation clears ratio 2.0 with >2x margin
+            "DLROVER_TPU_BRAIN_INTERVAL_S": "0.5",
+            "DLROVER_TPU_BRAIN_COOLDOWN_S": "5",
+            "DLROVER_TPU_BRAIN_SUSTAIN": "2",
+            "DLROVER_TPU_STRAGGLER_RATIO": "2.0",
+        },
+    )
+    if not supervisor.start():
+        raise RuntimeError(
+            "master never came up: " + supervisor.log_tail()
+        )
+    env = dict(
+        os.environ,
+        GOODPUT_TARGET_STEPS=str(steps),
+        GOODPUT_STEP_SLEEP=str(step_sleep),
+        GOODPUT_SAVE_EVERY=str(save_every),
+        GOODPUT_PROGRESS_FILE=progress,
+        GOODPUT_CKPT_DIR=os.path.join(workdir, "ckpt"),
+        DLROVER_TPU_BRAIN=brain_flag,
+        DLROVER_TPU_RESHARD="1",
+        DLROVER_TPU_TIMELINE_REPORT_S="1.0",
+        DLROVER_TPU_PREEMPT_DRAIN_GRACE_S="2.0",
+        DLROVER_TPU_EMERGENCY_COMMIT_TIMEOUT_S="3.0",
+        DLROVER_TPU_FENCE_TTL_S="8.0",
+        JAX_PLATFORMS="cpu",
+        JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="0",
+        PYTHONPATH=REPO,
+        XLA_FLAGS="",
+    )
+    del seed  # the fault is deterministic (step-count onset)
+    pod_list = []
+    for rank in range(pods):
+        pod_env = dict(
+            env,
+            DLROVER_TPU_EVENTS_FILE=os.path.join(
+                workdir, f"events_pod{rank}.jsonl"
+            ),
+        )
+        if rank == slow_node:
+            pod_env["GOODPUT_SLOW_AFTER"] = str(slow_after)
+            pod_env["GOODPUT_SLOW_FACTOR"] = str(slow_factor)
+        pod_list.append(
+            NodePod(
+                workdir, rank, supervisor.addr, pod_env,
+                max_nodes=pods,
+            )
+        )
+    t_start_wall = time.time()
+    t_start = time.perf_counter()
+    for pod in pod_list:
+        pod.launch()
+
+    slow_dead_wall = None
+    slow_rc = None
+    deadline = time.time() + timeout
+    try:
+        while any(p.alive() for p in pod_list):
+            if time.time() > deadline:
+                raise RuntimeError(
+                    "slow-node run timed out; pod0 tail:\n"
+                    + pod_list[0].log_tail()
+                    + f"\npod{slow_node} tail:\n"
+                    + pod_list[slow_node].log_tail()
+                )
+            if not supervisor.alive():
+                raise RuntimeError(
+                    "master died during slow-node run: "
+                    + supervisor.log_tail()
+                )
+            if (
+                slow_dead_wall is None
+                and not pod_list[slow_node].alive()
+            ):
+                slow_dead_wall = time.time()
+                slow_rc = pod_list[slow_node].proc.returncode
+            time.sleep(0.05)
+    finally:
+        for pod in pod_list:
+            pod.stop()
+        supervisor.stop()
+    wall_s = time.perf_counter() - t_start
+
+    lines = _read_progress(progress)
+    final_step = max((e["step"] for e in lines), default=0)
+    rank0 = sorted(
+        (e for e in lines if e["rank"] == 0),
+        key=lambda e: e["step"],
+    )
+    healthy_deltas = sorted(
+        b["t"] - a["t"]
+        for a, b in zip(rank0, rank0[1:])
+        if b["step"] == a["step"] + 1
+        and b["t"] > a["t"]
+        and b["step"] < slow_after
+    )
+    steady_s = (
+        healthy_deltas[len(healthy_deltas) // 2]
+        if healthy_deltas
+        else step_sleep
+    )
+    onset = [e["t"] for e in lines if e["step"] >= slow_after]
+    onset_wall = min(onset) if onset else None
+    done_t = [e["t"] for e in lines if e["step"] >= steps]
+    train_wall_s = (
+        min(done_t) - t_start_wall if done_t else wall_s
+    )
+    goodput = (
+        min(1.0, final_step * steady_s / train_wall_s)
+        if train_wall_s
+        else 0.0
+    )
+    from dlrover_tpu.agent.training import AgentExitCode
+
+    drained = slow_rc == AgentExitCode.NODE_PREEMPTED
+    return {
+        "plan": "slow-node",
+        "brain": brain,
+        "steps": final_step,
+        "target_steps": steps,
+        "slow_node": slow_node,
+        "slow_after": slow_after,
+        "slow_factor": slow_factor,
+        "wall_s": round(wall_s, 2),
+        "train_wall_s": round(train_wall_s, 2),
+        "goodput": round(goodput, 4),
+        "steady_step_s": round(steady_s, 4),
+        "slow_node_drained": drained,
+        "slow_node_rc": slow_rc,
+        "time_to_drain_s": (
+            round(slow_dead_wall - onset_wall, 2)
+            if drained and onset_wall and slow_dead_wall
+            else None
+        ),
         "job_survived": final_step >= steps,
         "workdir": workdir,
     }
@@ -753,6 +956,15 @@ def main(argv=None) -> int:
                         "both and reports the comparison)")
     parser.add_argument("--reshard-only", action="store_true",
                         help="preempt-storm: run only the reshard leg")
+    parser.add_argument("--brain-only", action="store_true",
+                        help="slow-node: run only the Brain-on leg")
+    parser.add_argument("--static-only", action="store_true",
+                        help="slow-node: run only the Brain-off leg")
+    parser.add_argument("--slow_factor", type=float, default=5.0,
+                        help="slow-node: sleep-fault multiplier")
+    parser.add_argument("--pods", type=int, default=3,
+                        help="slow-node: pod count (the straggler "
+                        "median needs >= 3)")
     parser.add_argument("--out", default="")
     args = parser.parse_args(argv)
 
@@ -761,7 +973,10 @@ def main(argv=None) -> int:
     if budget.tight(120):
         steps = min(steps, 30)
     if budget.tight(45):
-        steps = min(steps, 12)
+        # slow-node keeps a higher floor: the Brain leg pays a fixed
+        # detect+re-mesh cost, and the comparison needs enough
+        # post-onset steps for the steady-state win to dominate it
+        steps = min(steps, 20 if args.plan == "slow-node" else 12)
 
     payload = {
         "metric": "chaos_mttr_mean_s",
@@ -770,6 +985,54 @@ def main(argv=None) -> int:
         "vs_baseline": None,
         "extras": {"bench_budget_s": budget.total},
     }
+
+    if args.plan == "slow-node":
+        payload["metric"] = "slow_node_goodput_gain"
+        legs = (
+            [True] if args.brain_only
+            else [False] if args.static_only
+            else [True, False]
+        )
+        timeout = budget.cap_timeout(args.timeout)
+        # the slow leg must dominate scheduler noise: steps slower
+        # than teardown, degradation >> the straggler ratio
+        storm_sleep = max(args.step_sleep, 0.25)
+        try:
+            for brain in legs:
+                leg = run_slow_node(
+                    steps=steps,
+                    pods=args.pods,
+                    slow_node=args.pods - 1,
+                    slow_factor=args.slow_factor,
+                    step_sleep=storm_sleep,
+                    brain=brain,
+                    timeout=timeout,
+                    seed=args.seed,
+                )
+                payload["extras"]["brain" if brain else "static"] = leg
+                if args.out:
+                    _flush(args.out, payload)
+        except RuntimeError as e:
+            payload["extras"]["error"] = str(e)
+            if args.out:
+                _flush(args.out, payload)
+            print(json.dumps(payload, indent=2))
+            return 1
+        on = payload["extras"].get("brain")
+        off = payload["extras"].get("static")
+        if on and off:
+            payload["value"] = round(
+                on["goodput"] - off["goodput"], 4
+            )
+        if args.out:
+            _flush(args.out, payload)
+        print(json.dumps(payload, indent=2))
+        survived = all(
+            payload["extras"].get(k, {}).get("job_survived", False)
+            for k in ("brain", "static")
+            if k in payload["extras"]
+        )
+        return 0 if survived else 1
 
     if args.plan == "preempt-storm":
         payload["metric"] = "preempt_recovery_mean_s"

@@ -84,6 +84,14 @@ DECLARED_METRICS = {
     # step-time-over-median straggler score
     "dlrover_tpu_node_health",
     "dlrover_tpu_straggler_score",
+    # the Brain autonomy loop (master/auto_scaler.BrainAutoScaler):
+    # decisions and execution outcomes by action, failing decision
+    # cycles (both scaler generations count here), and the world size
+    # the Brain last planned against
+    "dlrover_tpu_autoscale_decisions",
+    "dlrover_tpu_autoscale_executions",
+    "dlrover_tpu_autoscale_errors",
+    "dlrover_tpu_autoscale_world",
 }
 METRIC_METHODS = {"set_gauge", "inc_counter", "observe_duration"}
 _METRIC_PREFIX = "dlrover_tpu_"

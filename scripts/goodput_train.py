@@ -50,6 +50,13 @@ CKPT_DIR = os.environ["GOODPUT_CKPT_DIR"]
 # preemption interrupted; without, they replay up to SAVE_EVERY-1
 # steps per wave.
 SAVE_EVERY = max(int(os.environ.get("GOODPUT_SAVE_EVERY", "1")), 1)
+# sleep-fault (the chaos slow-node plan): from step SLOW_AFTER on,
+# this process's simulated device work takes SLOW_FACTOR times longer
+# — a degraded chip appearing MID-RUN.  The whole coupled world runs
+# at the slow rank's speed until the Brain drains it (or, Brain off,
+# until the job limps to the target).  0 = healthy (default).
+SLOW_AFTER = int(os.environ.get("GOODPUT_SLOW_AFTER", "0"))
+SLOW_FACTOR = max(float(os.environ.get("GOODPUT_SLOW_FACTOR", "1")), 1.0)
 
 
 def log_progress(step: int) -> None:
@@ -222,7 +229,11 @@ def main() -> int:
         else:
             state, loss = step_fn(state, x)
             jax.block_until_ready(state)
-        time.sleep(STEP_SLEEP)  # simulated per-step device work
+        # simulated per-step device work (slowed past the sleep-fault
+        # onset — the step span's dur carries the degradation to the
+        # master's health derivations)
+        slowed = SLOW_AFTER and step >= SLOW_AFTER
+        time.sleep(STEP_SLEEP * (SLOW_FACTOR if slowed else 1.0))
         step += 1
         if not first_step:
             EVENTS.complete(

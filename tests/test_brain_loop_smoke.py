@@ -1,0 +1,78 @@
+"""Tier-1 Brain-loop smoke: a budget-scaled ``slow-node`` chaos leg.
+
+The full acceptance run is ``scripts/chaos.py --plan slow-node``
+(Brain-on vs Brain-off goodput); this smoke runs ONE Brain-on leg at
+smoke scale and asserts the closed loop end to end: the sleep-faulted
+pod is branded a straggler by the observatory, the Brain emits a
+``scale_decision``, executes it as a planned action (cooperative
+drain directive → fence → survivor re-mesh), the slow pod exits with
+the preemption code, the job still reaches its target, and the
+``scale_execute`` record closes the loop in the master's own
+timeline.
+"""
+
+import glob
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from scripts.chaos import run_slow_node  # noqa: E402
+
+from dlrover_tpu.common.constants import AgentExitCode  # noqa: E402
+
+
+def _read_instants(workdir: str, name: str):
+    out = []
+    for path in glob.glob(os.path.join(workdir, "events*.jsonl")):
+        with open(path) as f:
+            for line in f:
+                try:
+                    e = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if e.get("name") == name:
+                    out.append(e)
+    return out
+
+
+@pytest.mark.timeout(300)
+def test_slow_node_brain_leg_drains_and_completes():
+    try:
+        result = run_slow_node(
+            steps=14,
+            step_sleep=0.15,
+            slow_factor=5.0,
+            brain=True,
+            timeout=200.0,
+            seed=11,
+        )
+    except RuntimeError as e:  # pragma: no cover - harness noise
+        pytest.fail(f"slow-node harness failed: {e}")
+
+    assert result["job_survived"], result
+    assert result["steps"] >= result["target_steps"], result
+    # the planned action, not an emergent crash: the slow pod exited
+    # with the preemption code after its graceful drain
+    assert result["slow_node_drained"], result
+    assert result["slow_node_rc"] == AgentExitCode.NODE_PREEMPTED
+
+    workdir = result["workdir"]
+    decisions = _read_instants(workdir, "scale_decision")
+    executes = _read_instants(workdir, "scale_execute")
+    assert decisions, "the Brain must journal its decision on the timeline"
+    labels = decisions[-1]["labels"]
+    assert labels["action"] == "drain_replace"
+    assert labels["target_node"] == result["slow_node"]
+    assert labels["reason"].startswith("straggler:")
+    assert labels["from_world"] == 3
+    assert labels["to_world"] == 2
+    assert executes, "execution must close the loop on the timeline"
+    exec_labels = executes[-1]["labels"]
+    assert exec_labels["decision_id"] == labels["decision_id"]
+    assert exec_labels["outcome"] in ("done", "fenced_fallback")

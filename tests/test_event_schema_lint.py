@@ -203,3 +203,55 @@ def test_lint_enforces_control_wait_retry_label(tmp_path):
     assert proc.returncode == 1, proc.stdout + proc.stderr
     assert "event_schema_violations=1" in proc.stdout, proc.stdout
     assert "missing the 'retries' label" in proc.stdout
+
+
+def test_lint_enforces_scale_event_labels(tmp_path):
+    """Brain planned-action markers must be auditable: a
+    ``scale_decision`` / ``scale_execute`` without the rule that
+    fired and the world transition it planned fails the lint."""
+    bad = tmp_path / "bad_scale.py"
+    bad.write_text(
+        "events = None\n"
+        "def f(events):\n"
+        "    events.instant('scale_decision', action='grow')\n"
+        "    events.instant('scale_decision', action='grow',\n"
+        "                   reason='linear', from_world=2,\n"
+        "                   to_world=3)\n"
+        "    events.instant('scale_execute', action='grow',\n"
+        "                   reason='linear', from_world=2)\n"
+        "    events.instant('scale_execute', action='grow',\n"
+        "                   reason='linear', from_world=2,\n"
+        "                   to_world=3, outcome='done')\n"
+    )
+    proc = _run(str(bad))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "event_schema_violations=2" in proc.stdout, proc.stdout
+    assert (
+        "missing required label(s) "
+        "['reason', 'from_world', 'to_world']" in proc.stdout
+    )
+    assert "missing required label(s) ['to_world']" in proc.stdout
+
+
+def test_lint_declares_autoscale_metrics():
+    """The Brain's metric names are part of the declared vocabulary
+    (dashboards key on them), and an in-package typo still fails."""
+    probe = os.path.join(
+        REPO, "dlrover_tpu", "_lint_probe_autoscale_delete_me.py"
+    )
+    with open(probe, "w") as f:
+        f.write(
+            "def f(reg):\n"
+            "    reg.inc_counter('dlrover_tpu_autoscale_decisions')\n"
+            "    reg.inc_counter('dlrover_tpu_autoscale_executions')\n"
+            "    reg.inc_counter('dlrover_tpu_autoscale_errors')\n"
+            "    reg.set_gauge('dlrover_tpu_autoscale_world', 2)\n"
+            "    reg.inc_counter('dlrover_tpu_autoscale_decsions')\n"
+        )
+    try:
+        proc = _run(probe)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "event_schema_violations=1" in proc.stdout, proc.stdout
+        assert "dlrover_tpu_autoscale_decsions" in proc.stdout
+    finally:
+        os.unlink(probe)
