@@ -418,6 +418,113 @@ def _control_micro(n_agents: int, wait_s: float) -> dict:
     return out
 
 
+def measure_profiling_overhead(
+    steps: int = 60, every: int = 15, step_sleep: float = 0.02
+) -> dict:
+    """Continuous-attribution-leg overhead: steady step time with
+    ``DLROVER_TPU_PROFILE_EVERY_N_STEPS`` effectively on vs off.
+
+    Mirrors the trainer's mechanics exactly — every ``every`` steps a
+    one-step ``jax.profiler`` window opens and the parse runs on the
+    background :class:`AttributionWorker` — and runs the on/off legs
+    in ALTERNATING halves so container drift cancels (the
+    bench_restart trick).  Two numbers:
+
+    - ``profiling_overhead`` — median STEADY (non-traced) step time
+      ratio minus 1: what profiling costs the steps it does not
+      touch.  This is the tier-1 < 2% assertion: the background
+      parse must not steal the training thread.
+    - ``profiling_amortized_overhead`` — mean-over-all-steps ratio,
+      including the traced steps' trace start/stop cost.  On CPU CI
+      with ~20 ms steps this is dominated by the capture itself and
+      NOT held to the 2% bar; on real hardware (seconds-long steps,
+      N ≥ 100) it converges to the steady number.
+
+    Shared with ``tests/test_profiling.py`` — ONE definition of the
+    measurement."""
+    import statistics
+    import tempfile as _tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from dlrover_tpu.observability.attribution import (
+        AttributionWorker,
+    )
+
+    f = jax.jit(lambda x: x * 1.0001 + 1.0)
+    x = jnp.ones((256, 256))
+    for _ in range(3):  # warm the jit
+        x = f(x)
+    jax.block_until_ready(x)
+
+    worker = AttributionWorker()
+    off_times, on_steady, on_traced = [], [], []
+
+    def leg(n: int, profile_every: int):
+        nonlocal x
+        count = 0
+        for _ in range(n):
+            count += 1
+            traced = profile_every > 0 and count % profile_every == 0
+            t0 = time.perf_counter()
+            trace_dir = None
+            if traced:
+                trace_dir = _tempfile.mkdtemp(
+                    prefix="dlrover_profovh_"
+                )
+                jax.profiler.start_trace(trace_dir)
+            y = f(x)
+            jax.block_until_ready(y)
+            time.sleep(step_sleep)
+            x = y
+            if trace_dir is not None:
+                jax.profiler.stop_trace()
+                worker.submit(
+                    trace_dir,
+                    count,
+                    time.time(),
+                    time.perf_counter() - t0,
+                    steps=1,
+                    mode="profile",
+                )
+            dt = time.perf_counter() - t0
+            if profile_every <= 0:
+                off_times.append(dt)
+            elif traced:
+                on_traced.append(dt)
+            else:
+                on_steady.append(dt)
+
+    # each ON leg must hold at least one traced step (half >= every),
+    # so callers shrinking `steps` should shrink `every` with it
+    half = max(steps // 4, every)
+    for _ in range(2):  # A/B/A/B: drift cancels
+        leg(half, 0)
+        leg(half, every)
+    worker.close()
+    med_off = statistics.median(off_times)
+    med_on = statistics.median(on_steady)
+    overhead = med_on / med_off - 1.0 if med_off > 0 else 0.0
+    on_all = on_steady + on_traced
+    amortized = (
+        (sum(on_all) / len(on_all)) / med_off - 1.0
+        if med_off > 0 and on_all
+        else 0.0
+    )
+    return {
+        "profiling_overhead": round(overhead, 4),
+        "profiling_amortized_overhead": round(amortized, 4),
+        "profiling_steady_step_s": round(med_on, 5),
+        "profiling_off_step_s": round(med_off, 5),
+        "profiling_traced_step_s": round(
+            statistics.median(on_traced), 5
+        ) if on_traced else None,
+        "profiling_every": every,
+        "profiling_steps": 4 * half,
+    }
+
+
 def _brain_loop_bench(budget: "BenchBudget" = None) -> dict:
     """The closed autonomy loop's acceptance artifact: Brain-on vs
     Brain-off goodput under the slow-node sleep fault, plus — when
@@ -618,6 +725,21 @@ def main(argv=None) -> int:
             extras.update(_failover_bench(budget))
         except Exception as e:  # noqa: BLE001
             extras["failover_bench_error"] = str(e)
+        flush_partial(args.out, payload)
+
+        # continuous attribution leg's overhead: steady step time
+        # with the one-step profile window on vs off (the < 2%
+        # always-on claim, pinned by the tier-1 smoke)
+        try:
+            tightish = budget.tight(300)
+            extras.update(
+                measure_profiling_overhead(
+                    steps=40 if tightish else 60,
+                    every=10 if tightish else 15,
+                )
+            )
+        except Exception as e:  # noqa: BLE001
+            extras["profiling_overhead_error"] = str(e)
         flush_partial(args.out, payload)
 
         # observatory leg: injected straggler + hang must be named

@@ -61,21 +61,16 @@ def _parse_json_line(stdout: str):
 
 
 def _chip_peak_flops(device) -> tuple:
-    """(peak bf16 FLOP/s, kind string) for the attached chip."""
+    """(peak bf16 FLOP/s, kind string) for the attached chip — ONE
+    table (``observability/profiler.py``) shared with the live
+    per-node MFU gauge, so the bench and the running job can never
+    disagree about what "peak" means.  CPU CI / unknown kinds fall
+    back to the v5e number (meaningless there, flagged by the backend
+    field) with the table's loud once-per-kind warning."""
+    from dlrover_tpu.observability.profiler import device_peak_flops
+
     kind = str(getattr(device, "device_kind", "")).lower()
-    if "v6" in kind:
-        return 918e12, kind
-    if "v5" in kind and ("lite" in kind or "v5e" in kind):
-        return 197e12, kind
-    if "v5" in kind:  # v5p
-        return 459e12, kind
-    if "v4" in kind:
-        return 275e12, kind
-    if "v3" in kind:
-        return 123e12, kind
-    # CPU CI / unknown: report against the v5e number so the mfu field
-    # is always populated (meaningless on CPU, flagged by backend field)
-    return 197e12, kind
+    return device_peak_flops(device), kind
 
 
 def _candidates(on_tpu: bool):

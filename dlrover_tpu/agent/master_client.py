@@ -740,6 +740,28 @@ class MasterClient:
     def report_succeeded(self) -> bool:
         return self._channel.report(msg.SucceededRequest())
 
+    def report_profile(
+        self,
+        node_rank: int,
+        kind: str = "capture",
+        reason: str = "",
+        capture_id: int = 0,
+        summary: Optional[Dict] = None,
+        artifact: str = "",
+    ) -> bool:
+        """Ship one deep-capture result (parsed profile summary +
+        artifact path) to the master's CaptureCoordinator."""
+        return self._channel.report(
+            msg.ProfileReport(
+                node_rank=node_rank,
+                kind=kind,
+                reason=reason,
+                capture_id=capture_id,
+                summary=summary or {},
+                artifact=artifact,
+            )
+        )
+
     def report_timeline_events(self, events: list) -> bool:
         """Ship a batch of timeline records (``observability/events``
         JSONL schema) to the master's TimelineAggregator."""

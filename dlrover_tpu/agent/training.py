@@ -23,6 +23,7 @@ import socket
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
@@ -237,6 +238,10 @@ class ElasticTrainingAgent:
         #: (timeline batches, heartbeats, metric samples); flushed
         #: before every rendezvous and drained on shutdown
         self._report_buffer: Optional[ReportBuffer] = None
+        #: capture ids already executed — a failover-re-armed
+        #: directive for an in-flight capture must not double-fire
+        #: (two SIGUSR2 bursts + duplicate Brain rows)
+        self._seen_capture_ids: List[int] = []
 
     # ------------------------------------------------------------- workers
     def _rendezvous(self):
@@ -336,13 +341,46 @@ class ElasticTrainingAgent:
             )
         if not self._config.restart_overlap:
             env["DLROVER_TPU_RESTART_OVERLAP"] = "0"
+        # deep-capture rendezvous point: agent and workers must agree
+        # where stack dumps and profile artifacts land — the NODE-
+        # scoped dir (base from DLROVER_TPU_CAPTURE_DIR / the events
+        # file, namespaced by node rank so a shared artifact volume
+        # never mixes two nodes' captures).  Explicit assignment: the
+        # worker must see the node-scoped path, not the inherited base.
+        from dlrover_tpu.common.env import profile_enabled
+
+        if profile_enabled():
+            cdir = self._capture_dir()
+            if cdir:
+                env["DLROVER_TPU_CAPTURE_DIR"] = cdir
         return env
+
+    def _clear_armed_markers(self):
+        """Drop the previous worker generation's ``armed_<pid>``
+        markers BEFORE spawning the next one: a recycled pid matching
+        a stale marker would let a capture SIGUSR2 a worker that
+        never installed the handler (default disposition: death)."""
+        import glob as _glob
+
+        cdir = self._capture_dir()
+        if not cdir:
+            return
+        from dlrover_tpu.trainer.capture import ARMED_FILE_PREFIX
+
+        for path in _glob.glob(
+            os.path.join(cdir, f"{ARMED_FILE_PREFIX}*")
+        ):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
 
     def _initialize_workers(self) -> bool:
         """One rendezvous round + process spawn. Returns False when the
         master excluded this node."""
         if self._config.network_check:
             self._run_network_check()
+        self._clear_armed_markers()
         try:
             rdzv_round, world = self._rendezvous()
         except NodeExcludedError as e:
@@ -758,14 +796,19 @@ class ElasticTrainingAgent:
         return default
 
     def _take_brain_directive(self):
-        """A Brain planned action delivered on the monitor-pacing
-        poll.  Ignored (and logged) when the reshard/drain machinery
-        is kill-switched — the master's execution deadline then falls
-        back to fencing this node without our cooperation."""
+        """A master directive delivered on the monitor-pacing poll.
+        ``capture`` executes here (background — the monitor loop keeps
+        supervising); ``drain`` is returned to the loop.  Ignored (and
+        logged) when the respective machinery is kill-switched — the
+        master's execution deadline then falls back to fencing this
+        node without our cooperation."""
         directive = self._client.take_node_action()
         if directive is None:
             return None
         action, reason, decision_id = directive
+        if action == "capture":
+            self._start_capture(reason, decision_id)
+            return None
         if action != "drain":
             logger.warning(
                 "ignoring unknown brain directive %r (decision %s)",
@@ -778,6 +821,274 @@ class ElasticTrainingAgent:
             )
             return None
         return directive
+
+    # ------------------------------------------------------ deep capture
+    def _capture_dir(self) -> str:
+        """This NODE's capture artifact dir: the resolved base
+        (``DLROVER_TPU_CAPTURE_DIR`` / events-dir default) namespaced
+        by node rank, so agents sharing one pinned artifact volume
+        can never collect each other's worker profiles as their own.
+        "" when no base is resolvable."""
+        from dlrover_tpu.common.env import capture_dir
+
+        base = capture_dir()
+        if not base:
+            return ""
+        return os.path.join(base, f"node_{self._node_rank}")
+
+    def _start_capture(self, reason: str, capture_id: int):
+        """A master ``capture`` directive: run the deep capture on a
+        background thread — the monitor loop must keep supervising
+        workers while the trace window and the artifact wait run.
+        A re-delivered id (failover re-armed the directive while the
+        first execution was still in flight) is dropped — one
+        capture, one SIGUSR2 burst, one Brain row."""
+        from dlrover_tpu.common.env import profile_enabled
+
+        if not profile_enabled():
+            logger.warning(
+                "capture directive ignored: DLROVER_TPU_PROFILE=0"
+            )
+            return
+        if capture_id in self._seen_capture_ids:
+            logger.info(
+                "capture %s already executed; ignoring re-delivery",
+                capture_id,
+            )
+            return
+        self._seen_capture_ids.append(capture_id)
+        del self._seen_capture_ids[:-64]
+        threading.Thread(
+            target=self._execute_capture,
+            args=(reason, capture_id),
+            name="deep-capture",
+            daemon=True,
+        ).start()
+
+    @staticmethod
+    def _capture_dir_state(cdir: str) -> Dict[str, tuple]:
+        """``{path: (mtime, size)}`` of the artifact files currently
+        in the capture dir — the freshness baseline.  New-or-changed
+        against this snapshot beats comparing mtimes to
+        ``time.time()``: the two clocks need not agree (sandboxed
+        filesystems), and a stale artifact from an older capture must
+        not be re-shipped either way."""
+        import glob as _glob
+
+        state = {}
+        for pattern in ("profile_*.json", "stacks_*.txt"):
+            for path in _glob.glob(os.path.join(cdir, pattern)):
+                try:
+                    st = os.stat(path)
+                    state[path] = (st.st_mtime, st.st_size)
+                except OSError:
+                    continue
+        return state
+
+    @classmethod
+    def _collect_capture_profiles(
+        cls, cdir: str, before: Dict[str, tuple]
+    ) -> List[dict]:
+        """Worker profile JSONs that appeared (or changed) since the
+        ``before`` snapshot (the attribution worker drops them
+        atomically)."""
+        import glob as _glob
+        import json as _json
+
+        out = []
+        for path in sorted(
+            _glob.glob(os.path.join(cdir, "profile_*.json"))
+        ):
+            try:
+                st = os.stat(path)
+                if before.get(path) == (st.st_mtime, st.st_size):
+                    continue  # a stale artifact of an older capture
+                with open(path) as f:
+                    out.append(_json.load(f))
+            except (OSError, ValueError):
+                continue
+        return out
+
+    @classmethod
+    def _collect_capture_stacks(
+        cls, cdir: str, before: Dict[str, tuple],
+        tail_chars: int = 4000,
+    ) -> Dict[str, str]:
+        """Stack-dump tails that appeared (or grew) since the
+        ``before`` snapshot (faulthandler appends one all-thread dump
+        per signal) — the xpu_timer hang-dump parity: for a rank
+        wedged in a collective this is the whole artifact.  Only the
+        file TAIL is read: the dump file grows one append per capture
+        over the job's life (cooldown-bounded), and the newest dump
+        is the one this capture wants."""
+        import glob as _glob
+
+        out = {}
+        for path in sorted(
+            _glob.glob(os.path.join(cdir, "stacks_*.txt"))
+        ):
+            try:
+                st = os.stat(path)
+                if before.get(path) == (st.st_mtime, st.st_size):
+                    continue
+                with open(path, "rb") as f:
+                    if st.st_size > 4 * tail_chars:
+                        f.seek(-4 * tail_chars, os.SEEK_END)
+                    text = f.read().decode(errors="replace")
+            except OSError:
+                continue
+            if text.strip():
+                out[os.path.basename(path)] = text[-tail_chars:]
+        return out
+
+    @staticmethod
+    def _sweep_capture_dir(cdir: str, keep: int = 16):
+        """Bound the captures dir: keep only the newest ``keep``
+        capture/profile JSON artifacts (a chronically slow rank
+        triggers one capture per cooldown forever; the repo's growth
+        bounds apply here like everywhere else — the stacks files are
+        already cooldown-bounded appends read tail-only)."""
+        import glob as _glob
+
+        files = []
+        for pattern in ("capture_*.json", "profile_*.json"):
+            for path in _glob.glob(os.path.join(cdir, pattern)):
+                try:
+                    files.append((os.path.getmtime(path), path))
+                except OSError:
+                    continue
+        files.sort(reverse=True)
+        for _mtime, path in files[keep:]:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    def _execute_capture(self, reason: str, capture_id: int) -> dict:
+        """The cooperative half of a deep capture: signal every live
+        worker (SIGUSR2 → faulthandler all-thread dump + an N-step
+        ``jax.profiler`` window via ``trainer/capture.py``), wait —
+        bounded — for the worker profile artifacts, assemble ONE
+        combined artifact under the events dir, and report the parsed
+        summary to the master's Brain ``profiles`` table.  A hung
+        worker never writes a profile; its stack dump is the
+        evidence and the wait simply times out."""
+        import json as _json
+        import tempfile
+
+        from dlrover_tpu.common.env import capture_timeout_s
+        from dlrover_tpu.trainer.capture import CAPTURE_SIGNAL
+
+        cdir = self._capture_dir() or tempfile.mkdtemp(
+            prefix="dlrover_capture_"
+        )
+        try:
+            os.makedirs(cdir, exist_ok=True)
+        except OSError as e:
+            logger.warning("capture dir unavailable: %s", e)
+            return {}
+        get_event_logger().instant(
+            "capture",
+            node_rank=self._node_rank,
+            reason=reason,
+            capture_id=capture_id,
+        )
+        from dlrover_tpu.trainer.capture import ARMED_FILE_PREFIX
+
+        t0 = time.time()
+        before = self._capture_dir_state(cdir)
+        # only signal workers that ARMED the handler (they drop a
+        # marker at install): the default SIGUSR2 disposition
+        # TERMINATES a process, so signalling an arbitrary
+        # entrypoint that never installed it would kill the exact
+        # node this diagnostic wanted to observe
+        live = []
+        skipped = 0
+        for p in self._procs:
+            if p.poll() is not None:
+                continue
+            pid = getattr(p, "pid", None)
+            if pid is not None and os.path.exists(
+                os.path.join(cdir, f"{ARMED_FILE_PREFIX}{pid}")
+            ):
+                live.append(p)
+            else:
+                skipped += 1
+        if skipped:
+            logger.warning(
+                "capture %s: %d workers never armed the capture "
+                "handler; not signalling them (stacks unavailable)",
+                capture_id, skipped,
+            )
+        for proc in live:
+            try:
+                proc.send_signal(CAPTURE_SIGNAL)
+            except (ProcessLookupError, OSError):
+                pass
+        logger.info(
+            "capture %s: signalled %d workers (%s)",
+            capture_id, len(live), reason,
+        )
+        deadline = time.time() + capture_timeout_s()
+        profiles: List[dict] = []
+        while time.time() < deadline:
+            profiles = self._collect_capture_profiles(cdir, before)
+            if live and len(profiles) >= len(live):
+                break
+            if not live:
+                break  # nothing will ever answer
+            time.sleep(0.2)
+        stacks = self._collect_capture_stacks(cdir, before)
+        summary = {
+            "reason": reason,
+            "capture_id": capture_id,
+            "node": self._node_rank,
+            "workers_signalled": len(live),
+            "workers_unarmed": skipped,
+            "profiles_collected": len(profiles),
+            "stack_dumps": len(stacks),
+            "profiles": [
+                {
+                    k: p.get(k)
+                    for k in (
+                        "pid", "step", "steps", "step_time_s",
+                        "shares", "tflops", "mfu", "truncated",
+                    )
+                }
+                for p in profiles
+            ],
+            # the op-level evidence: top-10 ops, category shares and
+            # GEMM clusters from the first (usually only) worker
+            "profile_summary": (
+                profiles[0].get("summary") if profiles else None
+            ),
+        }
+        artifact = os.path.join(
+            cdir,
+            f"capture_{self._node_rank}_{capture_id}.json",
+        )
+        try:
+            tmp = artifact + ".tmp"
+            with open(tmp, "w") as f:
+                _json.dump(
+                    dict(summary, stacks=stacks, t=t0), f
+                )
+            os.replace(tmp, artifact)
+        except OSError as e:
+            logger.warning("capture artifact write failed: %s", e)
+            artifact = ""
+        self._sweep_capture_dir(cdir)
+        try:
+            self._client.report_profile(
+                node_rank=self._node_rank,
+                reason=reason,
+                capture_id=capture_id,
+                summary=summary,
+                artifact=artifact,
+            )
+        except ConnectionError as e:
+            logger.warning("capture report failed: %s", e)
+        return summary
 
     def _execute_brain_drain(self, reason: str, decision_id: int) -> int:
         """The cooperative half of a Brain drain_replace/shrink: the

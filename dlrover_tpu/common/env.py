@@ -195,6 +195,74 @@ def preempt_drain_grace_s() -> float:
     return env_float(PREEMPT_DRAIN_GRACE_ENV, 5.0)
 
 
+PROFILE_ENV = "DLROVER_TPU_PROFILE"
+PROFILE_EVERY_ENV = "DLROVER_TPU_PROFILE_EVERY_N_STEPS"
+CAPTURE_STEPS_ENV = "DLROVER_TPU_CAPTURE_STEPS"
+CAPTURE_COOLDOWN_ENV = "DLROVER_TPU_CAPTURE_COOLDOWN_S"
+CAPTURE_TIMEOUT_ENV = "DLROVER_TPU_CAPTURE_TIMEOUT_S"
+CAPTURE_DIR_ENV = "DLROVER_TPU_CAPTURE_DIR"
+
+
+def profile_enabled() -> bool:
+    """Kill-switch for the live attribution profiler: the continuous
+    ``step_profile`` leg in the trainer, the per-node MFU /
+    device-share derivations + gauges in the ``HealthEngine``, the
+    master's ``CaptureCoordinator`` (diagnosis-triggered deep
+    captures riding the directive piggyback), the worker-side capture
+    signal handler, and the Brain ``profiles`` surface.
+    ``DLROVER_TPU_PROFILE=0`` reproduces today's paths exactly: no
+    ``step_profile`` spans, no mfu/device-share gauges, no ``capture``
+    directives on the wire (pinned by tests).  Default: enabled —
+    though the continuous leg additionally needs
+    ``DLROVER_TPU_PROFILE_EVERY_N_STEPS`` > 0 (default 0 = off, zero
+    per-step overhead)."""
+    return os.getenv(PROFILE_ENV, "1").lower() not in (
+        "0", "false", "off",
+    )
+
+
+def profile_every_n_steps() -> int:
+    """Continuous-leg cadence: every N steps the trainer captures a
+    one-step ``jax.profiler`` trace and emits a ``step_profile`` span
+    (0 = off; the default, so the always-on claim costs nothing until
+    an operator opts in)."""
+    return max(int(env_float(PROFILE_EVERY_ENV, 0.0)), 0)
+
+
+def capture_steps() -> int:
+    """How many consecutive steps a deep capture traces."""
+    return max(int(env_float(CAPTURE_STEPS_ENV, 3.0)), 1)
+
+
+def capture_cooldown_s() -> float:
+    """Per-node throttle on diagnosis-triggered deep captures: the
+    hang-watchdog / sustained-straggler conclusions auto-trigger at
+    most ONE capture of a node per this window."""
+    return env_float(CAPTURE_COOLDOWN_ENV, 600.0)
+
+
+def capture_timeout_s() -> float:
+    """How long the agent waits for its workers' profile artifacts
+    after the capture signal before shipping what it has (a hung
+    worker never answers — its stack dump is the artifact)."""
+    return env_float(CAPTURE_TIMEOUT_ENV, 15.0)
+
+
+def capture_dir() -> str:
+    """Where capture artifacts (stack dumps, trace summaries) land:
+    ``DLROVER_TPU_CAPTURE_DIR``, else a ``captures/`` dir next to the
+    node's events file, else "" (no capture surface)."""
+    d = os.getenv(CAPTURE_DIR_ENV, "")
+    if d:
+        return d
+    events_file = os.getenv("DLROVER_TPU_EVENTS_FILE", "")
+    if events_file:
+        return os.path.join(
+            os.path.dirname(os.path.abspath(events_file)), "captures"
+        )
+    return ""
+
+
 BRAIN_ENV = "DLROVER_TPU_BRAIN"
 BRAIN_INTERVAL_ENV = "DLROVER_TPU_BRAIN_INTERVAL_S"
 BRAIN_COOLDOWN_ENV = "DLROVER_TPU_BRAIN_COOLDOWN_S"

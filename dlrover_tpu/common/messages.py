@@ -577,6 +577,23 @@ class JobStatusResponse(Message):
 
 
 @dataclass
+class ProfileReport(Message):
+    """One node's deep-capture result (the agent answering a
+    ``capture`` directive): the parsed profile summary — top ops,
+    category shares, GEMM clusters, stack-dump inventory — plus the
+    path of the artifact written under the events dir.  The master's
+    ``CaptureCoordinator`` exposes it on ``/status`` and persists a
+    row to the Brain ``profiles`` table."""
+
+    node_rank: int = -1
+    kind: str = "capture"
+    reason: str = ""
+    capture_id: int = 0
+    summary: Dict = field(default_factory=dict)
+    artifact: str = ""
+
+
+@dataclass
 class BrainQueryRequest(Message):
     """Query the master's durable Brain datastore (speed history /
     node events / measured workloads) — the TPU analog of the Go

@@ -1,0 +1,231 @@
+"""The deep-capture arm's master side: who gets captured, when, and
+what came back.
+
+A diagnosis conclusion (hang watchdog, sustained straggler) or an
+operator request asks :meth:`CaptureCoordinator.request` for a deep
+capture of one rank.  The coordinator:
+
+- **throttles** per node (``DLROVER_TPU_CAPTURE_COOLDOWN_S`` + an
+  in-flight dedupe): repeated conclusions about the same wedged rank
+  produce ONE capture per window, not a storm of profiler signals at
+  a struggling node;
+- **delivers** by posting a ``capture`` directive on a
+  :class:`~dlrover_tpu.master.brain.NodeDirectives` slot — the PR-10
+  piggyback: the directive rides the target agent's next
+  monitor-pacing ``WaitingNodeNum`` poll, zero extra RPCs;
+- **collects** the agent's ``ProfileReport`` (parsed summary + the
+  artifact path holding stacks and the trace profile), keeps the
+  newest per node for ``/status`` / ``top.py``'s "why" surface, and
+  persists a row to the Brain ``profiles`` table so the evidence
+  survives master failover;
+- **journals** its state as the ``capture`` component of the PR-7
+  ``ControlPlaneJournal``: a failed-over master re-arms an in-flight
+  capture directive under the SAME id (the directive died with the
+  old master's memory) and keeps the cooldown anchors, so a capture
+  neither vanishes nor double-fires across a failover.
+
+Constructed only when the observatory AND ``DLROVER_TPU_PROFILE`` are
+on — kill-switched off, no directives ride the wire and ``/status``
+carries no ``profiles`` key, exactly today's surface.
+"""
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from dlrover_tpu.common.env import capture_cooldown_s
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.brain import NodeDirectives
+
+#: the directive verb the agent understands (next to brain's "drain")
+DIRECTIVE_CAPTURE = "capture"
+
+
+class CaptureCoordinator:
+    """Master-side owner of the deep-capture lifecycle."""
+
+    def __init__(
+        self,
+        job: str = "",
+        datastore=None,
+        cooldown_s: Optional[float] = None,
+        directives: Optional[NodeDirectives] = None,
+    ):
+        self._job = job or "default"
+        self._datastore = datastore
+        self._cooldown = (
+            capture_cooldown_s() if cooldown_s is None else cooldown_s
+        )
+        self.directives = directives or NodeDirectives()
+        self._lock = threading.Lock()
+        #: node -> wall time of the last REQUESTED capture (the
+        #: cooldown anchor; requesting consumes the window even if
+        #: the node never answers — a wedged rank must not be
+        #: re-signalled every diagnosis sweep)
+        self._last_request: Dict[int, float] = {}
+        #: node -> {"id", "reason", "t"} awaiting a ProfileReport
+        self._in_flight: Dict[int, dict] = {}
+        #: node -> newest completed capture entry (the /status view)
+        self._latest: Dict[int, dict] = {}
+        self._next_id = 1
+        self._journal_cb: Optional[Callable[[str, dict], None]] = None
+
+    # ----------------------------------------------------------- request
+    def request(self, node: int, reason: str = "") -> Optional[int]:
+        """Ask ``node`` for a deep capture; returns the capture id or
+        None when throttled (cooldown / already in flight)."""
+        node = int(node)
+        now = time.time()
+        with self._lock:
+            pending = self._in_flight.get(node)
+            if pending is not None:
+                # a stale in-flight entry (agent died before
+                # reporting) expires with the cooldown so the node
+                # stays capturable
+                if now - pending["t"] < self._cooldown:
+                    return None
+                self._in_flight.pop(node, None)
+            if now - self._last_request.get(node, 0.0) < self._cooldown:
+                return None
+            capture_id = self._next_id
+            self._next_id += 1
+            self._last_request[node] = now
+            self._in_flight[node] = {
+                "id": capture_id,
+                "reason": reason,
+                "t": now,
+            }
+        self.directives.post(
+            node, DIRECTIVE_CAPTURE, reason, capture_id
+        )
+        logger.info(
+            "capture %d requested of node %s (%s)",
+            capture_id, node, reason or "operator",
+        )
+        self._journal()
+        return capture_id
+
+    # ------------------------------------------------------------ result
+    def record_result(
+        self,
+        node: int,
+        summary: Optional[dict] = None,
+        artifact: str = "",
+        reason: str = "",
+        capture_id: int = 0,
+    ):
+        """One agent's ``ProfileReport`` landed: expose it and make
+        it durable."""
+        node = int(node)
+        now = time.time()
+        with self._lock:
+            pending = self._in_flight.pop(node, None)
+            if pending is not None:
+                reason = reason or pending["reason"]
+                capture_id = capture_id or pending["id"]
+            entry = {
+                "node": node,
+                "id": capture_id,
+                "reason": reason,
+                "t": now,
+                "summary": summary or {},
+                "artifact": artifact,
+            }
+            self._latest[node] = entry
+        if self._datastore is not None:
+            try:
+                self._datastore.record_profile(
+                    self._job,
+                    node,
+                    kind="capture",
+                    reason=reason,
+                    summary=summary or {},
+                    artifact=artifact,
+                )
+            except Exception as e:  # noqa: BLE001 - durability is best-effort
+                logger.warning("capture persist failed: %s", e)
+        logger.info(
+            "capture %d of node %s landed (%s)",
+            capture_id, node, artifact or "no artifact",
+        )
+        self._journal()
+
+    def latest(self) -> Dict[int, dict]:
+        """Newest capture per node — the ``/status``/``top.py``
+        surface (in-flight requests show with ``summary=None`` so the
+        operator can see a capture is underway)."""
+        with self._lock:
+            out = {n: dict(e) for n, e in self._latest.items()}
+            for node, pending in self._in_flight.items():
+                if node not in out or out[node]["id"] < pending["id"]:
+                    out[node] = {
+                        "node": node,
+                        "id": pending["id"],
+                        "reason": pending["reason"],
+                        "t": pending["t"],
+                        "summary": None,
+                        "artifact": "",
+                    }
+            return out
+
+    # ------------------------------------------------- journal contract
+    def set_journal(self, cb: Optional[Callable[[str, dict], None]]):
+        self._journal_cb = cb
+
+    def _journal(self):
+        if self._journal_cb is None:
+            return
+        try:
+            self._journal_cb("state", self.export_state())
+        except Exception as e:  # noqa: BLE001
+            logger.warning("capture journal failed: %s", e)
+
+    def export_state(self) -> dict:
+        with self._lock:
+            return {
+                "next_id": self._next_id,
+                "last_request": {
+                    str(n): t for n, t in self._last_request.items()
+                },
+                "in_flight": {
+                    str(n): dict(e)
+                    for n, e in self._in_flight.items()
+                },
+                "latest": {
+                    str(n): dict(e) for n, e in self._latest.items()
+                },
+            }
+
+    def restore_state(self, state: dict):
+        """Journal replay: cooldown anchors and results come back,
+        and an in-flight capture re-arms its directive under the SAME
+        id — it died with the old incarnation's memory, like a PR-10
+        drain."""
+        with self._lock:
+            self._next_id = max(
+                int(state.get("next_id", 1)), self._next_id
+            )
+            self._last_request = {
+                int(n): float(t)
+                for n, t in (state.get("last_request") or {}).items()
+            }
+            self._in_flight = {
+                int(n): dict(e)
+                for n, e in (state.get("in_flight") or {}).items()
+            }
+            self._latest = {
+                int(n): dict(e)
+                for n, e in (state.get("latest") or {}).items()
+            }
+            pending = list(self._in_flight.items())
+        for node, entry in pending:
+            self.directives.post(
+                node,
+                DIRECTIVE_CAPTURE,
+                entry.get("reason", ""),
+                int(entry.get("id", 0)),
+            )
+            logger.info(
+                "capture %s of node %s re-armed after failover",
+                entry.get("id"), node,
+            )

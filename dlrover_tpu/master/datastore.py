@@ -89,6 +89,17 @@ CREATE TABLE IF NOT EXISTS timeline_events (
 );
 CREATE INDEX IF NOT EXISTS idx_timeline_job
     ON timeline_events (job, wall);
+CREATE TABLE IF NOT EXISTS profiles (
+    job TEXT NOT NULL,
+    node INTEGER NOT NULL,
+    kind TEXT NOT NULL DEFAULT 'capture',
+    reason TEXT NOT NULL DEFAULT '',
+    summary TEXT NOT NULL DEFAULT '{}',
+    artifact TEXT NOT NULL DEFAULT '',
+    created_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_profiles_job
+    ON profiles (job, created_at);
 CREATE TABLE IF NOT EXISTS control_journal (
     job TEXT NOT NULL,
     seq INTEGER NOT NULL,
@@ -133,6 +144,7 @@ _SQL_TIMELINE = (
     "INSERT INTO timeline_events VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)"
 )
 _SQL_JOURNAL = "INSERT INTO control_journal VALUES (?,?,?,?,?,?)"
+_SQL_PROFILE = "INSERT INTO profiles VALUES (?,?,?,?,?,?,?)"
 
 
 class BrainDatastore:
@@ -474,6 +486,66 @@ class BrainDatastore:
             for n, e, d, t in rows
         ]
 
+    # -------------------------------------------------- deep captures
+    def record_profile(
+        self,
+        job: str,
+        node: int,
+        kind: str = "capture",
+        reason: str = "",
+        summary: Optional[Dict] = None,
+        artifact: str = "",
+    ):
+        """One deep-capture (or profile) row: the diagnosis-triggered
+        capture evidence survives master failover like the rest of
+        the Brain."""
+        self._submit(
+            _SQL_PROFILE,
+            [
+                (
+                    job,
+                    int(node),
+                    str(kind),
+                    str(reason),
+                    json.dumps(
+                        summary or {},
+                        separators=(",", ":"),
+                        default=str,
+                    ),
+                    str(artifact),
+                    time.time(),
+                )
+            ],
+        )
+
+    def profiles(self, job: str, limit: int = 32) -> List[Dict]:
+        """Newest ``limit`` capture rows for a job, newest first."""
+        self._drain()
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT node, kind, reason, summary, artifact, "
+                "created_at FROM profiles WHERE job = ? "
+                "ORDER BY created_at DESC LIMIT ?",
+                (job, limit),
+            ).fetchall()
+        out = []
+        for node, kind, reason, summary, artifact, created_at in rows:
+            try:
+                parsed = json.loads(summary) if summary else {}
+            except json.JSONDecodeError:
+                parsed = {}
+            out.append(
+                {
+                    "node": node,
+                    "kind": kind,
+                    "reason": reason,
+                    "summary": parsed,
+                    "artifact": artifact,
+                    "created_at": created_at,
+                }
+            )
+        return out
+
     # ---------------------------------------------- timeline events
     def record_timeline_events(self, job: str, events: List[Dict]):
         """Persist a batch of timeline records (the JSONL schema of
@@ -809,6 +881,7 @@ class BrainDatastore:
                 "speed_samples",
                 "node_events",
                 "timeline_events",
+                "profiles",
             ):
                 q = f"DELETE FROM {table} WHERE created_at < ?"  # noqa: S608 - fixed table names
                 args: List = [cutoff]

@@ -249,13 +249,34 @@ class GemmRegressionOperator(InferenceOperator):
         return results
 
 
+def _attribution_hint(health_engine, node: int) -> str:
+    """"; dominant device time: copy 40%" when the live attribution
+    profiler has a step_profile-derived share for the node, "" when
+    not (profiler off, old engine, or test facade without the
+    accessor) — conclusions cite WHY, not just WHO."""
+    accessor = getattr(health_engine, "attribution", None)
+    if not callable(accessor):
+        return ""
+    try:
+        dominant = accessor().get(node)
+    except Exception:  # noqa: BLE001 - advisory context only
+        return ""
+    if not dominant:
+        return ""
+    category, share = dominant
+    return f"; dominant device time: {category} {share:.0%}"
+
+
 class StragglerOperator(InferenceOperator):
     """Relative straggler verdicts from the observatory's streaming
     step-time EWMAs (``observability/health.py``): a node whose EWMA
     exceeds the across-node median by the engine's ratio is concluded
     a straggler.  Replaces nothing — per-STEP timing at the master was
     simply never derived before; the network-check manager only sees
-    the pre-flight rounds."""
+    the pre-flight rounds.  With the live attribution profiler on,
+    the cause cites the node's dominant device-time category (a
+    straggler at 40% copy share is an offload problem, not a bad
+    host)."""
 
     def __init__(self, health_engine):
         self._health = health_engine
@@ -268,6 +289,7 @@ class StragglerOperator(InferenceOperator):
                 cause=(
                     f"step time x{score:.2f} vs across-node median "
                     f"(ratio {self._health.straggler_ratio:.2f})"
+                    + _attribution_hint(self._health, node)
                 ),
                 action="none",
                 node_rank=node,
@@ -303,6 +325,7 @@ class DataStallOperator(InferenceOperator):
                         f"{stage} stall share {share:.0%} of the "
                         f"window (threshold "
                         f"{self._threshold:.0%})"
+                        + _attribution_hint(self._health, node)
                     ),
                     action="none",
                     node_rank=node,
@@ -357,6 +380,13 @@ DIAGNOSIS_INTERVAL_ENV = "DLROVER_TPU_DIAGNOSIS_INTERVAL_S"
 
 
 class DiagnosisManager:
+    #: conclusion problems that auto-trigger ONE throttled deep
+    #: capture of the named rank (the CaptureCoordinator's per-node
+    #: cooldown owns the throttle) — the xpu_timer reflex: a hang or
+    #: sustained straggler verdict is exactly when you want stacks +
+    #: an op trace of that rank
+    CAPTURE_PROBLEMS = frozenset({"hang", "straggler"})
+
     def __init__(
         self,
         speed_monitor=None,
@@ -366,6 +396,7 @@ class DiagnosisManager:
         health_engine=None,
         datastore=None,
         job: str = "",
+        capture=None,
     ):
         """With a ``health_engine`` (the observatory is on) the chain
         sits ON TOP of the streaming derivations: straggler /
@@ -381,6 +412,10 @@ class DiagnosisManager:
         self._emitted: Dict = {}
         self._health = health_engine
         self._datastore = datastore
+        #: CaptureCoordinator (master/capture.py) — None when the
+        #: profiler is kill-switched; fresh hang/straggler
+        #: conclusions then trigger nothing extra, exactly as today
+        self._capture = capture
         self._job = job or os.getenv("DLROVER_TPU_JOB_NAME", "default")
         if operators is None:
             operators = [
@@ -454,6 +489,19 @@ class DiagnosisManager:
                 )
             except Exception as e:  # noqa: BLE001
                 logger.warning("diagnosis persist failed: %s", e)
+        if (
+            self._capture is not None
+            and c.node_rank >= 0
+            and c.problem in self.CAPTURE_PROBLEMS
+        ):
+            # deep-capture reflex: ask the named rank for stacks +
+            # an N-step trace.  The coordinator's per-node cooldown
+            # and in-flight dedupe make this at most ONE capture per
+            # window no matter how many conclusions repeat.
+            try:
+                self._capture.request(c.node_rank, reason=c.problem)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("capture trigger failed: %s", e)
 
     def diagnose(self) -> List[Inference]:
         """Run the chain, de-duplicating conclusions: the same

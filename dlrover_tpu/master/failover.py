@@ -46,6 +46,10 @@ NODES = "nodes"
 #: immediately re-grow (flip-flop), and an in-flight planned action
 #: must resume or be safely abandoned, never silently dropped
 BRAIN = "brain"
+#: the deep-capture coordinator's cooldown anchors + in-flight
+#: directives — a failed-over master re-arms a pending capture under
+#: the SAME id instead of losing it (or double-firing a new one)
+CAPTURE = "capture"
 
 
 class ControlPlaneJournal:
@@ -61,6 +65,7 @@ class ControlPlaneJournal:
         task_manager=None,
         job_manager=None,
         brain=None,
+        capture=None,
         snapshot_interval_s: Optional[float] = None,
     ):
         self._store = store
@@ -70,6 +75,7 @@ class ControlPlaneJournal:
         self._tasks = task_manager
         self._nodes = job_manager
         self._brain = brain
+        self._capture = capture
         self._interval = (
             control_snapshot_interval_s()
             if snapshot_interval_s is None
@@ -109,6 +115,8 @@ class ControlPlaneJournal:
             self._nodes.set_journal(self._cb(NODES))
         if self._brain is not None:
             self._brain.set_journal(self._cb(BRAIN))
+        if self._capture is not None:
+            self._capture.set_journal(self._cb(CAPTURE))
 
     def detach(self):
         if self._kv is not None:
@@ -121,6 +129,8 @@ class ControlPlaneJournal:
             self._nodes.set_journal(None)
         if self._brain is not None:
             self._brain.set_journal(None)
+        if self._capture is not None:
+            self._capture.set_journal(None)
 
     # ------------------------------------------------------- recovery
     def recover(self) -> dict:
@@ -182,6 +192,8 @@ class ControlPlaneJournal:
             return self._nodes
         if key == BRAIN:
             return self._brain
+        if key == CAPTURE:
+            return self._capture
         if key.startswith(RDZV_PREFIX):
             return self._rdzv.get(key[len(RDZV_PREFIX):])
         return None
@@ -222,6 +234,8 @@ class ControlPlaneJournal:
                 components[NODES] = self._nodes.export_state()
             if self._brain is not None:
                 components[BRAIN] = self._brain.export_state()
+            if self._capture is not None:
+                components[CAPTURE] = self._capture.export_state()
             self._store.save_control_snapshot(
                 self._job, {"components": components}, seq
             )

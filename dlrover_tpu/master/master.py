@@ -75,6 +75,23 @@ class JobMaster:
             datastore=get_default_datastore(),
             health=self.health_engine,
         )
+        # the deep-capture arm (None = DLROVER_TPU_PROFILE=0 or
+        # observatory off): diagnosis-triggered captures ride the
+        # directive piggyback, results land in the Brain `profiles`
+        # table and the JobStatus snapshot
+        self.capture_coordinator = None
+        if self.health_engine is not None:
+            from dlrover_tpu.common.env import profile_enabled
+
+            if profile_enabled():
+                from dlrover_tpu.master.capture import (
+                    CaptureCoordinator,
+                )
+
+                self.capture_coordinator = CaptureCoordinator(
+                    job=self._job_name,
+                    datastore=get_default_datastore(),
+                )
         self.task_manager = TaskManager(speed_monitor=self.speed_monitor)
         self.rdzv_managers = {
             RendezvousName.ELASTIC_TRAINING:
@@ -95,6 +112,7 @@ class JobMaster:
                 health_engine=self.health_engine,
                 datastore=get_default_datastore(),
                 job=self._job_name,
+                capture=self.capture_coordinator,
             )
         self.diagnosis_manager = diagnosis_manager
         # the autonomy loop (ROADMAP item 1): observatory signals ->
@@ -186,6 +204,7 @@ class JobMaster:
             task_manager=self.task_manager,
             job_manager=self.job_manager,
             brain=self.brain,
+            capture=self.capture_coordinator,
         )
         stats = self.control_journal.recover()
         self.control_journal.attach()
@@ -214,6 +233,7 @@ class JobMaster:
             timeline_aggregator=self.timeline_aggregator,
             health_engine=self.health_engine,
             brain=self.brain,
+            capture_coordinator=self.capture_coordinator,
             job_epoch=self.job_epoch,
             incarnation=self.incarnation,
         )

@@ -43,6 +43,7 @@ class MasterServicer:
         timeline_aggregator=None,
         health_engine=None,
         brain=None,
+        capture_coordinator=None,
         job_epoch: int = 0,
         incarnation: int = 0,
     ):
@@ -69,6 +70,11 @@ class MasterServicer:
         #: node directives ride the WaitingNodeNum response and its
         #: decision state joins the JobStatus snapshot
         self._brain = brain
+        #: the deep-capture coordinator (None = DLROVER_TPU_PROFILE=0
+        #: or observatory off): capture directives ride the SAME
+        #: WaitingNodeNum piggyback (a Brain drain outranks them) and
+        #: the latest capture per node joins the JobStatus snapshot
+        self._capture = capture_coordinator
         self._start_training_time = 0.0
         #: lifetime RPC tally (gets + reports, batched items counted
         #: once per envelope) — the bench's server-side ground truth
@@ -247,6 +253,11 @@ class MasterServicer:
                 status["brain"] = self._brain.status()
             except Exception as e:  # noqa: BLE001 - partial status
                 logger.warning("status brain failed: %s", e)
+        if self._capture is not None:
+            try:
+                status["profiles"] = self._capture.latest()
+            except Exception as e:  # noqa: BLE001 - partial status
+                logger.warning("status profiles failed: %s", e)
         return msg.JobStatusResponse(status=status, available=True)
 
     def _timeline_query(
@@ -281,6 +292,12 @@ class MasterServicer:
             }
         elif request.kind == "workloads":
             payload = {"workloads": store.measured_workloads()}
+        elif request.kind == "profiles":
+            payload = {
+                "profiles": store.profiles(
+                    request.job, limit=request.limit
+                )
+            }
         elif request.kind == "measurements":
             # cross-job calibration: ANY job's strategy service can
             # pull this fleet's history for a workload signature
@@ -339,6 +356,13 @@ class MasterServicer:
         directive = None
         if self._brain is not None and node_id >= 0:
             directive = self._brain.directives.take(node_id)
+        if directive is None and self._capture is not None and (
+            node_id >= 0
+        ):
+            # a deep-capture request rides the same slot; a Brain
+            # drain outranks it (the node is leaving anyway — its
+            # capture stays pending and expires with the cooldown)
+            directive = self._capture.directives.take(node_id)
         wait_timeout = getattr(request, "wait_timeout", 0.0)
         if directive is not None:
             waiting = manager.num_nodes_waiting()
@@ -606,6 +630,25 @@ class MasterServicer:
                     )
                 )
             return True
+        if isinstance(request, msg.ProfileReport):
+            if self._capture is not None:
+                self._capture.record_result(
+                    request.node_rank
+                    if request.node_rank >= 0
+                    else node_id,
+                    summary=request.summary,
+                    artifact=request.artifact,
+                    reason=request.reason,
+                    capture_id=getattr(request, "capture_id", 0),
+                )
+                return True
+            # profiler kill-switched on the master: drop with a trace
+            # (an old agent answering a pre-switch directive)
+            logger.warning(
+                "profile report from node %s dropped: no capture "
+                "coordinator", node_id,
+            )
+            return False
         if isinstance(request, msg.TimelineEventsReport):
             if self._timeline_aggregator is not None:
                 self._timeline_aggregator.add_events(

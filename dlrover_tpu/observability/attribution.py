@@ -1,0 +1,324 @@
+"""Live device-time attribution: from *who* is slow to *why*.
+
+The observatory (PR 8) can name a slow or hung rank, but nothing in
+the live job says what the device was doing — compute vs collective
+vs copy vs host — which until now was only visible in offline bench
+runs (``bench_mfu``'s op-trace leg).  This module is the bridge: the
+trainer periodically captures a short ``jax.profiler`` trace around
+one step (``DLROVER_TPU_PROFILE_EVERY_N_STEPS``; default off ⇒ zero
+overhead), a background thread runs the existing ``trace.py`` parser,
+folds the HLO categories into five stable buckets —
+
+- **compute**   (fusions, convolutions/dots — the MXU doing work)
+- **collective** (all-reduce / all-gather / reduce-scatter / permute —
+  waiting on peers; a straggler with a LOW collective share is the
+  slow one, its peers show HIGH shares)
+- **copy**      (copy / copy-start / copy-done / data formatting —
+  the host-offload DMA and reshard traffic)
+- **infeed**    (infeed / outfeed / host transfers — input pipeline)
+- **idle**      (step wall time no device op covers)
+
+— and emits ONE ``step_profile`` span whose labels carry the shares,
+the achieved TFLOP/s, and this node's MFU (FLOPs from the jitted
+step's ``cost_analysis`` when available, trace-summed op FLOPs as the
+fallback; peak FLOPs from the per-device-kind table in
+``profiler.py``).  The span rides the ordinary timeline path (agent
+``TimelineReporter`` → master ``TimelineAggregator``), so the
+``HealthEngine`` grows per-node rolling attribution for free and the
+straggler/data-stall diagnosis conclusions can cite the dominant
+category: a straggler at 40% copy share is an offload problem, not a
+bad host.
+
+Everything is behind ``DLROVER_TPU_PROFILE=0`` (no spans, no gauges)
+and parsing never runs on the training thread.
+"""
+
+import json
+import os
+import queue
+import re
+import shutil
+import threading
+from typing import Callable, Dict, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.observability import trace as trace_mod
+from dlrover_tpu.observability.trace import (
+    _COLLECTIVE_RE,
+    TraceReport,
+)
+
+#: the closed bucket vocabulary — gauge labels, span labels and the
+#: top.py "why" column all key on these five names
+CATEGORIES = ("compute", "collective", "copy", "infeed", "idle")
+
+_COPY_RE = re.compile(r"copy|data formatting|transpose", re.IGNORECASE)
+_INFEED_RE = re.compile(r"infeed|outfeed|host", re.IGNORECASE)
+
+
+def bucket_category(hlo_category: str) -> str:
+    """Fold one HLO category string into the 4 busy buckets."""
+    if _COLLECTIVE_RE.search(hlo_category):
+        return "collective"
+    if _COPY_RE.search(hlo_category):
+        return "copy"
+    if _INFEED_RE.search(hlo_category):
+        return "infeed"
+    return "compute"
+
+
+def bucket_shares(report: TraceReport) -> Dict[str, float]:
+    """Per-bucket share of the traced step WALL time (all five sum to
+    ~1).  Idle is the step-window time no device op covers; when the
+    trace has no module (step) track — CPU backends — idle is 0 and
+    the busy buckets are normalized over device time alone."""
+    shares = {c: 0.0 for c in CATEGORIES}
+    busy_us = report.total_device_us
+    if busy_us <= 0:
+        return shares
+    by_bucket: Dict[str, float] = {}
+    for cat, us in report.by_category.items():
+        bucket = bucket_category(cat)
+        by_bucket[bucket] = by_bucket.get(bucket, 0.0) + us
+    window_us = report.mean_step_us * max(report.step_count, 1)
+    if window_us > busy_us:
+        idle = (window_us - busy_us) / window_us
+        scale = (1.0 - idle) / busy_us
+    else:
+        # no step windows (or ops overlap past the window — async
+        # streams): normalize over device time, idle unknown ⇒ 0
+        idle = 0.0
+        scale = 1.0 / busy_us
+    for bucket, us in by_bucket.items():
+        shares[bucket] = round(us * scale, 4)
+    shares["idle"] = round(idle, 4)
+    return shares
+
+
+def trace_flops_per_step(report: TraceReport) -> float:
+    """Fallback FLOPs source: the trace's per-op ``model_flops``
+    summed over the window, per step (0 on CPU traces, which carry no
+    device ops)."""
+    total = sum(a.flops for a in report.top_ops)
+    return total / max(report.step_count, 1)
+
+
+def dominant_category(shares: Dict[str, float]) -> Optional[tuple]:
+    """``(name, share)`` of the biggest bucket, None when empty."""
+    busy = [(c, shares.get(c, 0.0)) for c in CATEGORIES]
+    busy = [t for t in busy if t[1] > 0]
+    if not busy:
+        return None
+    return max(busy, key=lambda t: t[1])
+
+
+class AttributionWorker:
+    """Single background thread parsing captured traces off the
+    training thread: the trainer hands it ``(trace_dir, step, ...)``
+    and keeps stepping; the worker parses, emits the ``step_profile``
+    span, and (for deep captures) writes the artifact JSON where the
+    agent collects it.  The queue is bounded — a wedged parse drops
+    the OLDEST pending capture rather than growing without bound."""
+
+    MAX_PENDING = 4
+
+    def __init__(self, flops_fn: Optional[Callable[[], float]] = None):
+        #: lazily-evaluated cost-analysis FLOPs (cached after the
+        #: first call; any failure caches 0 and the trace fallback
+        #: carries the number)
+        self._flops_fn = flops_fn
+        self._flops_cache: Optional[float] = None
+        self._queue: "queue.Queue[Optional[dict]]" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        #: newest processed summary (tests / bench introspection)
+        self.last_profile: Optional[dict] = None
+
+    def _ensure_thread(self):
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._thread = threading.Thread(
+                target=self._loop,
+                name="attribution-profiler",
+                daemon=True,
+            )
+            self._thread.start()
+
+    def submit(
+        self,
+        trace_dir: str,
+        step: int,
+        start_wall: float,
+        duration_s: float,
+        steps: int = 1,
+        mode: str = "profile",
+        reason: str = "",
+        artifact_dir: str = "",
+    ):
+        """Queue one captured window for background processing."""
+        job = {
+            "trace_dir": trace_dir,
+            "step": int(step),
+            "start_wall": float(start_wall),
+            "duration_s": float(duration_s),
+            "steps": max(int(steps), 1),
+            "mode": mode,
+            "reason": reason,
+            "artifact_dir": artifact_dir,
+        }
+        while self._queue.qsize() >= self.MAX_PENDING:
+            try:
+                stale = self._queue.get_nowait()
+                if stale is not None:
+                    shutil.rmtree(
+                        stale["trace_dir"], ignore_errors=True
+                    )
+                    logger.warning(
+                        "attribution worker backlogged; dropped the "
+                        "capture at step %s", stale.get("step"),
+                    )
+            except queue.Empty:
+                break
+        self._queue.put(job)
+        self._ensure_thread()
+
+    def close(self, timeout: float = 10.0):
+        """Drain pending captures (train end / tests)."""
+        thread = self._thread
+        if thread is None or not thread.is_alive():
+            return
+        self._queue.put(None)
+        thread.join(timeout=timeout)
+
+    # ------------------------------------------------------------ worker
+    def _flops_per_step(self, report: TraceReport):
+        """``(flops, global_scope)``: cost-analysis FLOPs count the
+        whole jitted computation (GLOBAL device scope), the
+        trace-summed fallback only this process's device tracks
+        (LOCAL scope) — the MFU denominator must match or multi-host
+        numbers are off by the process count."""
+        if self._flops_cache is None:
+            flops = 0.0
+            if self._flops_fn is not None:
+                try:
+                    flops = float(self._flops_fn() or 0.0)
+                except Exception as e:  # noqa: BLE001 - fall back to trace
+                    logger.warning(
+                        "cost-analysis FLOPs unavailable (%s); using "
+                        "trace-summed op FLOPs", e,
+                    )
+            self._flops_cache = flops
+        if self._flops_cache > 0:
+            return self._flops_cache, True
+        return trace_flops_per_step(report), False
+
+    def _loop(self):
+        while True:
+            job = self._queue.get()
+            if job is None:
+                return
+            try:
+                self._process(job)
+            except Exception as e:  # noqa: BLE001 - observability only
+                logger.warning("attribution processing failed: %s", e)
+                shutil.rmtree(job["trace_dir"], ignore_errors=True)
+
+    def _process(self, job: dict):
+        from dlrover_tpu.observability.events import get_event_logger
+        from dlrover_tpu.observability.profiler import (
+            device_peak_flops,
+        )
+
+        try:
+            # via the module attr so test monkeypatching of
+            # trace.parse_trace reaches this thread too
+            report = trace_mod.parse_trace(job["trace_dir"])
+        finally:
+            shutil.rmtree(job["trace_dir"], ignore_errors=True)
+        shares = bucket_shares(report)
+        flops_per_step, global_flops = self._flops_per_step(report)
+        step_s = job["duration_s"] / job["steps"]
+        if report.step_count and report.mean_step_us > 0:
+            # the trace's own step timing is tighter than the wall
+            # window (which includes trace start/stop overhead)
+            step_s = report.mean_step_us / 1e6
+        tflops = (
+            flops_per_step / step_s / 1e12 if step_s > 0 else 0.0
+        )
+        # the MFU denominator matches the numerator's scope: the
+        # jitted step's cost analysis counts the GLOBAL computation
+        # (peak = per-chip × all devices, the same peak_total ratio
+        # bench_mfu reports; per-node variation then comes from this
+        # node's measured step time), while trace-summed FLOPs only
+        # cover this PROCESS's device tracks (peak = local devices)
+        try:
+            import jax
+
+            n_devices = max(
+                jax.device_count()
+                if global_flops
+                else jax.local_device_count(),
+                1,
+            )
+        except Exception:  # noqa: BLE001 - no backend
+            n_devices = 1
+        peak = device_peak_flops() * n_devices
+        mfu = (
+            flops_per_step / step_s / peak
+            if step_s > 0 and peak > 0
+            else 0.0
+        )
+        profile = {
+            "step": job["step"],
+            "steps": job["steps"],
+            "mode": job["mode"],
+            "step_time_s": round(step_s, 6),
+            "shares": shares,
+            "tflops": round(tflops, 3),
+            "mfu": round(mfu, 4),
+            "flops_per_step": flops_per_step,
+            "truncated": report.truncated,
+            "summary": report.summary(top_k=10),
+        }
+        self.last_profile = profile
+        get_event_logger().complete(
+            "step_profile",
+            job["start_wall"],
+            job["duration_s"],
+            step=job["step"],
+            share_compute=shares["compute"],
+            share_collective=shares["collective"],
+            share_copy=shares["copy"],
+            share_infeed=shares["infeed"],
+            share_idle=shares["idle"],
+            tflops=round(tflops, 3),
+            mfu=round(mfu, 4),
+            steps=job["steps"],
+            mode=job["mode"],
+            truncated=report.truncated,
+        )
+        if job["mode"] == "capture" and job["artifact_dir"]:
+            self._write_capture_artifact(job, profile)
+
+    def _write_capture_artifact(self, job: dict, profile: dict):
+        """Deep capture: drop this worker's parsed profile where the
+        agent's capture executor collects it (atomic rename so the
+        collector never reads a torn file)."""
+        try:
+            os.makedirs(job["artifact_dir"], exist_ok=True)
+            path = os.path.join(
+                job["artifact_dir"],
+                f"profile_{os.getpid()}_{job['step']}.json",
+            )
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(
+                    dict(profile, pid=os.getpid(),
+                         reason=job["reason"]),
+                    f,
+                )
+            os.replace(tmp, path)
+            logger.info("capture profile written to %s", path)
+        except OSError as e:
+            logger.warning("capture artifact write failed: %s", e)

@@ -106,6 +106,14 @@ PHASE_OFFLOAD_COPY = "offload_copy"
 # restart critical path; the child legs above carve their shares out
 PHASE_RESTART_PATH = "restart_path"
 PHASE_RESTART = "restart"
+# live attribution profiler (observability/attribution.py): one
+# traced-window span per continuous-leg capture, whose labels carry
+# the per-category device-time shares + achieved TFLOP/s + MFU the
+# HealthEngine derives per-node gauges from.  Ranks BELOW step on
+# purpose: the window covers real train steps, which keep their
+# ledger attribution; only standalone profiler overhead (trace
+# start/stop outside a step span) surfaces as its own bucket.
+PHASE_STEP_PROFILE = "step_profile"
 # client-side control-plane wait (a long-poll RPC parked on the
 # master, or the legacy polling loop it replaces).  LOWEST priority:
 # these waits are almost always nested inside rendezvous/restart
@@ -130,6 +138,7 @@ PHASES: Tuple[str, ...] = (
     PHASE_OFFLOAD_COPY,
     PHASE_RESTART_PATH,
     PHASE_RESTART,
+    PHASE_STEP_PROFILE,
     PHASE_CONTROL_WAIT,
 )
 
@@ -168,6 +177,7 @@ INSTANT_EVENTS = frozenset(
         "diagnosis",
         "scale_decision",
         "scale_execute",
+        "capture",
     }
 )
 
@@ -186,6 +196,11 @@ REQUIRED_INSTANT_LABELS: Dict[str, Tuple[str, ...]] = {
     # straggler 3.9x, 3→2" is the whole story of a Brain action
     "scale_decision": ("action", "reason", "from_world", "to_world"),
     "scale_execute": ("action", "reason", "from_world", "to_world"),
+    # one deep capture fired at a node (the agent's xpu_timer
+    # hang-dump analog): the trace must show WHICH node was captured
+    # and WHY (hang / straggler / operator request), next to the
+    # diagnosis conclusion that triggered it
+    "capture": ("node_rank", "reason"),
 }
 
 #: Labels an emit SITE must pass explicitly (beyond the automatic
@@ -215,6 +230,20 @@ REQUIRED_SPAN_LABELS: Dict[str, Tuple[str, ...]] = {
                     "throughput_gbps"),
     PHASE_RESTART: ("reason",),
     PHASE_PREEMPTION_DRAIN: ("event",),
+    # the live attribution payload: a step_profile span without the
+    # category shares + achieved TFLOP/s + MFU is just a blip — the
+    # labels ARE the signal the HealthEngine's per-node gauges and the
+    # "why" column in top.py are built from
+    PHASE_STEP_PROFILE: (
+        "step",
+        "share_compute",
+        "share_collective",
+        "share_copy",
+        "share_infeed",
+        "share_idle",
+        "tflops",
+        "mfu",
+    ),
     # which control-plane wait parked (kv | comm_world | task |
     # status) so rendezvous-bootstrap waits and shard starvation stay
     # distinguishable in the ledger

@@ -99,6 +99,10 @@ class _NodeState:
         "open_spans",
         "rss_mb",
         "cpu_percent",
+        "mfu",
+        "tflops",
+        "device_share",
+        "profile_wall",
     )
 
     def __init__(self, node: int):
@@ -131,6 +135,13 @@ class _NodeState:
         self.open_spans: Dict[Tuple[int, str], Tuple[int, float]] = {}
         self.rss_mb = 0.0
         self.cpu_percent = 0.0
+        #: live attribution (newest step_profile span from this
+        #: node): per-category device-time shares + achieved MFU —
+        #: what turns "node 3 is slow" into "node 3 is 40% copy"
+        self.mfu = 0.0
+        self.tflops = 0.0
+        self.device_share: Dict[str, float] = {}
+        self.profile_wall = 0.0
 
 
 class HealthEngine:
@@ -242,6 +253,8 @@ class HealthEngine:
                     self._observe_step_span(state, e, wall)
                 elif name == "data_stall":
                     self._observe_stall_span(state, e, wall)
+                elif name == "step_profile":
+                    self._observe_profile_span(state, e, wall)
                 elif name == "restart" and ph in ("B", "X"):
                     state.restarts += 1
                 elif name == "fault_injected" and ph == "i":
@@ -297,6 +310,38 @@ class HealthEngine:
             stage, deque(maxlen=1024)
         )
         window.append((wall + dur, dur))
+
+    def _observe_profile_span(
+        self, state: _NodeState, e: dict, wall: float
+    ):
+        """One ``step_profile`` span (the live attribution profiler's
+        continuous leg): newest-wins per-category shares + MFU for
+        this node."""
+        if e.get("ph") != "X":
+            return  # emitted as X records (attribution.py)
+        if wall < state.profile_wall:
+            return  # an older batch arriving late must not regress
+        labels = e.get("labels") or {}
+        share = {}
+        for key, value in labels.items():
+            if not str(key).startswith("share_"):
+                continue
+            try:
+                share[str(key)[len("share_"):]] = float(value)
+            except (TypeError, ValueError):
+                continue
+        if not share:
+            return
+        state.device_share = share
+        state.profile_wall = wall
+        try:
+            state.mfu = float(labels.get("mfu", 0.0) or 0.0)
+        except (TypeError, ValueError):
+            state.mfu = 0.0
+        try:
+            state.tflops = float(labels.get("tflops", 0.0) or 0.0)
+        except (TypeError, ValueError):
+            state.tflops = 0.0
 
     def observe_heartbeat(self, node_id: int, timestamp: float):
         """Agent heartbeat tap.  Freshness is judged on the master's
@@ -450,7 +495,7 @@ class HealthEngine:
             span = state.step_walls[-1] - state.step_walls[0]
             if span > 0:
                 rate = (len(state.step_walls) - 1) / span
-        return {
+        snap = {
             "node": state.node,
             "status": status,
             "health": health,
@@ -472,6 +517,24 @@ class HealthEngine:
             ) if state.last_event_seen > 0 else None,
             "last_step_wall": state.last_step_wall or None,
         }
+        # live attribution fields only once a step_profile span
+        # arrived: with the profiler off the snapshot is EXACTLY the
+        # pre-profiling one (pinned by tests)
+        if state.device_share:
+            from dlrover_tpu.observability.attribution import (
+                dominant_category,
+            )
+
+            dom = dominant_category(state.device_share)
+            snap["mfu"] = round(state.mfu, 4)
+            snap["tflops"] = round(state.tflops, 3)
+            snap["device_share"] = dict(state.device_share)
+            snap["dominant"] = (
+                {"category": dom[0], "share": dom[1]}
+                if dom
+                else None
+            )
+        return snap
 
     def snapshot(self) -> dict:
         """The full derived state — what ``JobStatusRequest``,
@@ -543,6 +606,26 @@ class HealthEngine:
         with self._lock:
             return self._median_step_time_locked()
 
+    def attribution(self) -> Dict[int, Tuple[str, float]]:
+        """Per-node dominant device-time category from the newest
+        ``step_profile`` span: ``{node: (category, share)}``.  The
+        straggler/data-stall operators cite this so a conclusion says
+        WHY — a straggler at 40% copy share is an offload problem,
+        not a bad host.  Empty until the continuous profiling leg is
+        on (``DLROVER_TPU_PROFILE_EVERY_N_STEPS`` > 0)."""
+        from dlrover_tpu.observability.attribution import (
+            dominant_category,
+        )
+
+        with self._lock:
+            out: Dict[int, Tuple[str, float]] = {}
+            for state in self._nodes.values():
+                dom = dominant_category(state.device_share)
+                if dom is None:
+                    continue  # no profile yet / all-zero CPU shares
+                out[state.node] = (dom[0], round(dom[1], 4))
+            return out
+
     def stall_shares(self) -> Dict[int, Dict[str, float]]:
         """Per-node windowed data-stall share by stage (the
         ``DataStallOperator``'s input)."""
@@ -586,6 +669,24 @@ class HealthEngine:
                     n["straggler_score"],
                     labels=labels,
                 )
+                # attribution gauges only once a step_profile span
+                # arrived — a profiler-off job exports EXACTLY the
+                # pre-profiling series set (pinned by tests)
+                if n.get("device_share"):
+                    self._registry.set_gauge(
+                        "dlrover_tpu_node_mfu",
+                        n["mfu"],
+                        labels=labels,
+                    )
+                    for cat, share in n["device_share"].items():
+                        self._registry.set_gauge(
+                            "dlrover_tpu_device_share",
+                            share,
+                            labels={
+                                "node": n["node"],
+                                "category": cat,
+                            },
+                        )
         except Exception as e:  # noqa: BLE001 - gauges must not break reports
             logger.warning("health gauge refresh failed: %s", e)
 

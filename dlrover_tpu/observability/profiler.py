@@ -9,14 +9,88 @@ interposition needed (SURVEY.md §5.1 TPU equivalent).
 """
 
 import contextlib
+import os
 import threading
 import time
 from collections import deque
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, Optional, Tuple
 
 import jax
 
 from dlrover_tpu.common.log import default_logger as logger
+
+#: Per-device-kind peak bf16 FLOP/s (per chip).  ONE table behind
+#: every MFU number in the repo — ``AProfiler.mfu``, ``bench_mfu``'s
+#: candidate scoring, and the observatory's per-node
+#: ``dlrover_tpu_node_mfu`` gauge all route through
+#: :func:`peak_flops_for_kind` so the bench and the live job can never
+#: disagree about what "peak" means.  Matching is by substring on the
+#: lowercased ``device_kind`` string, FIRST match wins — order the
+#: specific patterns (v5 lite) before the generic ones (v5).
+PEAK_FLOPS_BY_KIND: Tuple[Tuple[str, float], ...] = (
+    ("v6", 918e12),     # Trillium / v6e
+    ("v5 lite", 197e12),
+    ("v5lite", 197e12),
+    ("v5e", 197e12),
+    ("v5", 459e12),     # v5p
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+#: the fallback when the kind is unknown (CPU CI, exotic plugin):
+#: the v5e number, so MFU is always populated — meaningless off-TPU,
+#: flagged by the loud warning below and the backend field in benches
+DEFAULT_PEAK_FLOPS = 197e12
+
+PEAK_FLOPS_ENV = "DLROVER_TPU_PEAK_FLOPS"
+
+#: unknown kinds warn ONCE per process, not once per step
+_warned_unknown_kinds = set()
+_warned_lock = threading.Lock()
+
+
+def peak_flops_for_kind(kind: str) -> Tuple[float, bool]:
+    """``(peak bf16 FLOP/s, known)`` for a ``device_kind`` string.
+    ``known=False`` means the table had no entry and the v5e fallback
+    was used (logged loudly, once per kind)."""
+    lowered = str(kind or "").lower()
+    for pattern, peak in PEAK_FLOPS_BY_KIND:
+        if pattern in lowered:
+            return peak, True
+    with _warned_lock:
+        if lowered not in _warned_unknown_kinds:
+            _warned_unknown_kinds.add(lowered)
+            logger.warning(
+                "unknown device kind %r: no peak-FLOPs table entry, "
+                "falling back to %.0fe12 (v5e) — MFU numbers are NOT "
+                "meaningful; set %s to the chip's real bf16 peak",
+                kind, DEFAULT_PEAK_FLOPS / 1e12, PEAK_FLOPS_ENV,
+            )
+    return DEFAULT_PEAK_FLOPS, False
+
+
+def device_peak_flops(device=None) -> float:
+    """Peak bf16 FLOP/s of ONE attached chip: the
+    ``DLROVER_TPU_PEAK_FLOPS`` override when set (malformed values
+    fall through, loudly), else the table entry for
+    ``jax.devices()[0].device_kind``."""
+    raw = os.getenv(PEAK_FLOPS_ENV, "")
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            logger.warning(
+                "ignoring malformed %s=%r", PEAK_FLOPS_ENV, raw
+            )
+    if device is None:
+        try:
+            device = jax.devices()[0]
+        except Exception:  # noqa: BLE001 - no backend at all
+            return DEFAULT_PEAK_FLOPS
+    kind = getattr(device, "device_kind", "")
+    peak, _known = peak_flops_for_kind(kind)
+    return peak
 
 
 class AProfiler:
@@ -90,11 +164,18 @@ class AProfiler:
         return sum(self._step_times) / len(self._step_times)
 
     def mfu(self, flops_per_step: float,
-            peak_flops: float = 197e12) -> float:
-        """Model FLOPs utilization vs peak (v5e bf16 default)."""
+            peak_flops: Optional[float] = None) -> float:
+        """Model FLOPs utilization vs peak.  ``peak_flops`` defaults
+        to the attached chip's table entry
+        (:func:`device_peak_flops`: ``DLROVER_TPU_PEAK_FLOPS``
+        override → ``device_kind`` table → loud v5e fallback) — the
+        hard-coded ``197e12`` default used to make every non-v5e
+        number silently wrong."""
         t = self.mean_step_time()
         if t <= 0:
             return 0.0
+        if peak_flops is None:
+            peak_flops = device_peak_flops()
         return flops_per_step / t / peak_flops
 
 

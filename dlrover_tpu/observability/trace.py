@@ -21,6 +21,7 @@ import gzip
 import json
 import os
 import re
+import zlib
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -76,6 +77,10 @@ class TraceReport:
     collectives: List[OpAggregate] = field(default_factory=list)
     top_ops: List[OpAggregate] = field(default_factory=list)
     device: str = ""
+    #: the trace file was torn (a capture interrupted by preemption /
+    #: a SIGKILLed writer): the report is the parsed PREFIX, flagged
+    #: so consumers can tell a clean short trace from a truncated one
+    truncated: bool = False
     # device time carried by ops OUTSIDE any step (module) window —
     # host-transfer artifacts of the capture harness (state readbacks
     # etc.).  VERDICT-r4 weak #2: counting these inflated the census
@@ -100,6 +105,7 @@ class TraceReport:
 
         return {
             "total_device_us": round(self.total_device_us, 1),
+            "truncated": self.truncated,
             "steps": self.step_count,
             "mean_step_us": round(self.mean_step_us, 1),
             "outside_step_us": round(self.outside_step_us, 1),
@@ -155,13 +161,83 @@ def _find_trace_file(path: str) -> str:
     return candidates[-1]
 
 
-def _load_events(trace_file: str) -> List[dict]:
-    opener = gzip.open if trace_file.endswith(".gz") else open
-    with opener(trace_file, "rb") as f:
-        raw = json.loads(f.read())
-    if isinstance(raw, list):  # bare-array chrome format
-        return raw
-    return raw.get("traceEvents", [])
+def _read_raw(trace_file: str) -> Tuple[bytes, bool]:
+    """Raw (decompressed) trace bytes, tolerating a TORN gzip stream:
+    a capture interrupted by preemption leaves the file without its
+    end-of-stream marker — ``zlib.decompressobj`` recovers the
+    decodable prefix instead of raising.  Returns
+    ``(bytes, truncated)``."""
+    with open(trace_file, "rb") as f:
+        data = f.read()
+    if not trace_file.endswith(".gz"):
+        return data, False
+    try:
+        return gzip.decompress(data), False
+    except (EOFError, OSError, zlib.error):
+        pass
+    d = zlib.decompressobj(47)  # gzip or zlib header, autodetected
+    try:
+        out = d.decompress(data)
+        out += d.flush()
+    except zlib.error:
+        out = b""
+    return out, True
+
+
+def _recover_events_prefix(text: str) -> List[dict]:
+    """Best-effort parse of a truncated chrome-trace JSON: walk the
+    ``traceEvents`` array object-by-object with ``raw_decode`` and
+    keep everything before the tear.  Handles both the wrapped
+    (``{"traceEvents": [...]``) and the bare-array formats."""
+    decoder = json.JSONDecoder()
+    start = 0
+    key = text.find('"traceEvents"')
+    if key >= 0:
+        start = text.find("[", key)
+    else:
+        start = text.find("[")
+    if start < 0:
+        return []
+    out: List[dict] = []
+    i = start + 1
+    n = len(text)
+    while i < n:
+        while i < n and text[i] in " \t\r\n,":
+            i += 1
+        if i >= n or text[i] != "{":
+            break
+        try:
+            obj, end = decoder.raw_decode(text, i)
+        except ValueError:
+            break  # the torn tail: everything before it is kept
+        if isinstance(obj, dict):
+            out.append(obj)
+        i = end
+    return out
+
+
+def _load_events(trace_file: str) -> Tuple[List[dict], bool]:
+    """``(events, truncated)``.  A torn/partially-written trace (the
+    writer was preempted mid-dump) yields the parsed PREFIX with
+    ``truncated=True`` instead of raising — a capture that survives a
+    preemption is still evidence."""
+    raw, truncated = _read_raw(trace_file)
+    text = raw.decode("utf-8", errors="replace")
+    if not truncated:
+        try:
+            parsed = json.loads(text)
+            if isinstance(parsed, list):  # bare-array chrome format
+                return parsed, False
+            return parsed.get("traceEvents", []), False
+        except ValueError:
+            truncated = True
+    events = _recover_events_prefix(text)
+    if truncated:
+        logger.warning(
+            "trace %s is truncated; parsed %d-event prefix",
+            trace_file, len(events),
+        )
+    return events, truncated
 
 
 def _shape_key(args: dict, name: str) -> str:
@@ -182,7 +258,7 @@ def parse_trace(path: str, device_prefix: str = "/device:") -> TraceReport:
     events and yield an empty report rather than an error).
     """
     trace_file = _find_trace_file(path)
-    events = _load_events(trace_file)
+    events, truncated = _load_events(trace_file)
     pids: Dict[int, str] = {}
     tids: Dict[Tuple[int, int], str] = {}
     for e in events:
@@ -195,7 +271,7 @@ def parse_trace(path: str, device_prefix: str = "/device:") -> TraceReport:
                 "name", ""
             )
 
-    report = TraceReport()
+    report = TraceReport(truncated=truncated)
     ops: Dict[str, OpAggregate] = {}
     step_durs: List[float] = []
     # pass 1: step windows from the "XLA Modules" track — each module

@@ -1,0 +1,155 @@
+"""Worker-side deep-capture protocol (SIGUSR2).
+
+The xpu_timer hang-dump parity: when the master's diagnosis chain
+concludes a node is hung or a sustained straggler, the agent receives
+a ``capture`` directive (piggybacked on its monitor poll) and sends
+every training process ``SIGUSR2``.  Two things happen here:
+
+1. **faulthandler dumps ALL thread stacks** to a per-pid file under
+   the capture dir — at C level, from the signal handler itself, so
+   it works even when the process is wedged in a collective and can
+   never run another Python bytecode.  For a hung rank this dump IS
+   the artifact (the xpu_timer's hang stack dump).
+2. For a process that is still stepping, the chained Python handler
+   sets a flag the training loop polls at the step boundary
+   (:func:`take_capture_request`): the trainer opens an N-step
+   ``jax.profiler`` window (``DLROVER_TPU_CAPTURE_STEPS``) and the
+   background :class:`~dlrover_tpu.observability.attribution.
+   AttributionWorker` writes the parsed profile JSON next to the
+   stack dump.
+
+Order matters: the Python handler is installed FIRST (``signal``),
+then ``faulthandler.register(..., chain=True)`` takes the C slot and
+chains to it — the dump always happens, the profile happens when the
+interpreter can still run.  Everything is a no-op under
+``DLROVER_TPU_PROFILE=0`` (the handler is simply never installed).
+"""
+
+import faulthandler
+import os
+import signal
+import threading
+from typing import Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+#: the deep-capture request signal the agent sends
+CAPTURE_SIGNAL = signal.SIGUSR2
+
+#: the stack-dump filename pattern the agent's collector globs for
+STACK_FILE_PREFIX = "stacks_"
+#: marker a worker drops once its SIGUSR2 handler is ARMED — the
+#: agent only signals workers that wrote one: the default SIGUSR2
+#: disposition TERMINATES a process, so capturing an arbitrary
+#: entrypoint that never installed the handler would kill the exact
+#: node the diagnostic wanted to observe
+ARMED_FILE_PREFIX = "armed_"
+
+_capture = threading.Event()
+_stack_file = None  # kept referenced: faulthandler writes to its fd
+_install_lock = threading.Lock()
+_installed = False
+
+
+def _on_capture(signum, frame):  # pragma: no cover - signal path
+    if not _capture.is_set():
+        logger.warning(
+            "deep capture requested (signal %s): tracing the next "
+            "step window", signum,
+        )
+    _capture.set()
+
+
+def install_capture_handler(
+    stack_dir: Optional[str] = None,
+) -> bool:
+    """Install the SIGUSR2 capture handler + the faulthandler
+    all-thread stack dump (main thread only for the Python half;
+    ``faulthandler.register`` works from any thread).  ``stack_dir``
+    defaults to :func:`dlrover_tpu.common.env.capture_dir`; with no
+    dir resolvable only the Python flag half is installed (nothing
+    to dump into).  Idempotent."""
+    global _stack_file, _installed
+    with _install_lock:
+        if _installed:
+            return True
+        if stack_dir is None:
+            from dlrover_tpu.common.env import capture_dir
+
+            stack_dir = capture_dir()
+        try:
+            signal.signal(CAPTURE_SIGNAL, _on_capture)
+        except ValueError:
+            logger.warning(
+                "not on main thread: capture signal handler not "
+                "installed"
+            )
+            return False
+        if stack_dir:
+            try:
+                os.makedirs(stack_dir, exist_ok=True)
+                path = os.path.join(
+                    stack_dir, f"{STACK_FILE_PREFIX}{os.getpid()}.txt"
+                )
+                _stack_file = open(path, "w")  # noqa: SIM115 - held open for faulthandler
+                # chain=True: dump the stacks (C level — works even
+                # wedged in a collective), THEN run the Python flag
+                # handler above when the interpreter can
+                faulthandler.register(
+                    CAPTURE_SIGNAL,
+                    file=_stack_file,
+                    all_threads=True,
+                    chain=True,
+                )
+            except (OSError, ValueError, AttributeError) as e:
+                logger.warning(
+                    "faulthandler stack dump not armed: %s", e
+                )
+            try:
+                # tell the agent this pid is SAFE to SIGUSR2
+                with open(
+                    os.path.join(
+                        stack_dir,
+                        f"{ARMED_FILE_PREFIX}{os.getpid()}",
+                    ),
+                    "w",
+                ):
+                    pass
+            except OSError as e:
+                logger.warning("capture armed marker failed: %s", e)
+        _installed = True
+        return True
+
+
+def capture_requested() -> bool:
+    """Whether a deep-capture request is pending."""
+    return _capture.is_set()
+
+
+def take_capture_request() -> bool:
+    """Consume the pending capture request (the training loop polls
+    this at the step boundary; True at most once per signal burst)."""
+    if _capture.is_set():
+        _capture.clear()
+        return True
+    return False
+
+
+def reset_capture():
+    """Test hook: clear flag + installed state (a fresh test process
+    can re-install against a different dir)."""
+    global _installed, _stack_file
+    _capture.clear()
+    with _install_lock:
+        if _installed:
+            try:
+                faulthandler.unregister(CAPTURE_SIGNAL)
+            except (ValueError, AttributeError):
+                pass
+            if _stack_file is not None:
+                try:
+                    _stack_file.close()
+                except OSError:
+                    pass
+                _stack_file = None
+            _installed = False

@@ -205,6 +205,29 @@ class Trainer:
         self._snapshot_mode = (
             None if args.snapshot_mode == "auto" else args.snapshot_mode
         )
+        # live attribution profiler (observability/attribution.py):
+        # the continuous leg traces ONE step every
+        # DLROVER_TPU_PROFILE_EVERY_N_STEPS (default 0 = off, zero
+        # overhead) and a background thread emits the step_profile
+        # span; the SIGUSR2 capture handler arms the deep-capture arm
+        # (agent directive → N-step trace + faulthandler stack dump).
+        # DLROVER_TPU_PROFILE=0 disables both exactly.
+        from dlrover_tpu.common.env import (
+            profile_enabled,
+            profile_every_n_steps,
+        )
+
+        self._profile_on = profile_enabled()
+        self._profile_every = (
+            profile_every_n_steps() if self._profile_on else 0
+        )
+        self._attribution = None
+        if self._profile_on:
+            from dlrover_tpu.trainer.capture import (
+                install_capture_handler,
+            )
+
+            install_capture_handler()
         self._registry = None
         self._exporter = None
         if args.metrics_port:
@@ -449,6 +472,95 @@ class Trainer:
             top[0]["key"] if top else "n/a",
         )
 
+    # ------------------------------------------- attribution profiler
+    def _take_capture_request(self) -> bool:
+        """A pending agent deep-capture request (SIGUSR2), consumed."""
+        if not self._profile_on:
+            return False
+        from dlrover_tpu.trainer.capture import take_capture_request
+
+        return take_capture_request()
+
+    #: cost-analysis FLOPs require a second lower+compile of the
+    #: train step (jax's call cache does not serve explicit
+    #: ``.lower().compile()``); past this state size the duplicate
+    #: compile is only worth it when a persistent compilation cache
+    #: can answer it — otherwise the trace-summed fallback carries
+    #: the number
+    COST_ANALYSIS_MAX_STATE_BYTES = 2 << 30
+
+    def _flops_fn_from(self, batch):
+        """Lazy cost-analysis FLOPs for the attribution worker: the
+        jitted step lowered from shape specs (no live arrays held by
+        the background thread).  None when the step exposes no
+        ``lower`` (multi-jit offload steps) or when the recompile
+        would be expensive (big state, no persistent compile cache)
+        — the worker then uses trace-summed op FLOPs."""
+        train_step = self._fns.train_step
+        if not hasattr(train_step, "lower"):
+            return None
+        try:
+            spec = lambda t: jax.tree_util.tree_map(  # noqa: E731
+                lambda x: jax.ShapeDtypeStruct(
+                    tuple(x.shape), x.dtype
+                ),
+                t,
+            )
+            state_spec = spec(self.state)
+            batch_spec = spec(batch)
+            state_bytes = sum(
+                s.size * s.dtype.itemsize
+                for s in jax.tree_util.tree_leaves(state_spec)
+            )
+        except Exception:  # noqa: BLE001 - exotic leaves
+            return None
+        if state_bytes > self.COST_ANALYSIS_MAX_STATE_BYTES and (
+            not os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        ):
+            logger.info(
+                "attribution FLOPs: skipping the cost-analysis "
+                "recompile (%.1f GB state, no compilation cache); "
+                "using trace-summed op FLOPs",
+                state_bytes / 1e9,
+            )
+            return None
+
+        def flops():
+            compiled = train_step.lower(
+                state_spec, batch_spec
+            ).compile()
+            costs = compiled.cost_analysis()
+            if isinstance(costs, list):
+                costs = costs[0] if costs else {}
+            return float(costs.get("flops", 0.0))
+
+        return flops
+
+    def _submit_profile(
+        self, trace_dir, step, start_wall, dur_s, steps, mode, batch
+    ):
+        """Hand one captured window to the background attribution
+        worker (parse + step_profile span off the training thread)."""
+        from dlrover_tpu.common.env import capture_dir
+
+        if self._attribution is None:
+            from dlrover_tpu.observability.attribution import (
+                AttributionWorker,
+            )
+
+            self._attribution = AttributionWorker(
+                flops_fn=self._flops_fn_from(batch)
+            )
+        self._attribution.submit(
+            trace_dir,
+            step,
+            start_wall,
+            dur_s,
+            steps=steps,
+            mode=mode,
+            artifact_dir=capture_dir() if mode == "capture" else "",
+        )
+
     # ------------------------------------------------------------- eval
     def evaluate(self, eval_iter_fn=None, max_batches: int = 0):
         """One evaluation pass: mean forward loss over the eval
@@ -541,6 +653,15 @@ class Trainer:
             trace_every = self._args.trace_interval
             tracing_left = 0
             trace_dir_cur = None
+            # window bookkeeping for the attribution legs: what kind
+            # of window is open ("census" = the inline resident
+            # profiler, "profile" = the continuous attribution leg,
+            # "capture" = an agent deep-capture), how many steps it
+            # spans, and when it opened (for the step_profile span)
+            trace_mode = None
+            trace_window_steps = 0
+            trace_t0_mono = 0.0
+            trace_t0_wall = 0.0
             while step < self._args.max_steps:
                 if pipeline_on:
                     # batches arrive device-resident, with `size`
@@ -557,19 +678,42 @@ class Trainer:
                 for batch in epoch_iter:
                     if step >= self._args.max_steps:
                         break
-                    if (
-                        trace_every > 0
-                        and tracing_left == 0
-                        and step != start_step
-                        and step % trace_every == 0
-                    ):
-                        # resident profiler: trace the NEXT
-                        # trace_steps REAL steps (not replayed extras
-                        # — an out-of-band capture would advance the
-                        # optimizer off the training trajectory).
-                        # Settle the pipelined metrics first so the
-                        # window holds only whole steps.
+                    open_mode = None
+                    if tracing_left == 0:
+                        # priority: a deep-capture request beats the
+                        # periodic cadences (the diagnosis chain is
+                        # waiting on it); the census leg keeps its
+                        # historical precedence over the continuous
+                        # attribution leg on a shared step
+                        if self._take_capture_request():
+                            open_mode = "capture"
+                        elif (
+                            trace_every > 0
+                            and step != start_step
+                            and step % trace_every == 0
+                        ):
+                            open_mode = "census"
+                        elif (
+                            self._profile_every > 0
+                            and step != start_step
+                            and step % self._profile_every == 0
+                        ):
+                            open_mode = "profile"
+                    if open_mode is not None:
+                        # trace the NEXT window of REAL steps (not
+                        # replayed extras — an out-of-band capture
+                        # would advance the optimizer off the
+                        # training trajectory).  Settle the pipelined
+                        # metrics first so the window holds only
+                        # whole steps.
                         import tempfile
+
+                        from dlrover_tpu.common.env import (
+                            capture_steps,
+                        )
+                        from dlrover_tpu.observability.events import (
+                            anchored_now,
+                        )
 
                         if pending is not None:
                             step_times.append(
@@ -580,9 +724,18 @@ class Trainer:
                             prefix="dlrover_optrace_"
                         )
                         jax.profiler.start_trace(trace_dir_cur)
-                        tracing_left = max(
-                            1, self._args.trace_steps
-                        )
+                        trace_mode = open_mode
+                        if open_mode == "census":
+                            tracing_left = max(
+                                1, self._args.trace_steps
+                            )
+                        elif open_mode == "capture":
+                            tracing_left = capture_steps()
+                        else:  # the lightweight continuous leg
+                            tracing_left = 1
+                        trace_window_steps = tracing_left
+                        trace_t0_mono = time.monotonic()
+                        trace_t0_wall = anchored_now(trace_t0_mono)
                     if self._replay is not None:
                         # on the pipelined path `batch` is already
                         # device-resident; the recorder's np.asarray
@@ -626,8 +779,27 @@ class Trainer:
                             )
                             pending = None
                             jax.profiler.stop_trace()
-                            self._process_trace(trace_dir_cur, step)
+                            if trace_mode == "census":
+                                # historical inline path: census to
+                                # registry + diagnosis drop file
+                                self._process_trace(
+                                    trace_dir_cur, step
+                                )
+                            else:
+                                # attribution legs parse on the
+                                # BACKGROUND worker — the next step
+                                # dispatches immediately
+                                self._submit_profile(
+                                    trace_dir_cur,
+                                    step,
+                                    trace_t0_wall,
+                                    time.monotonic() - trace_t0_mono,
+                                    trace_window_steps,
+                                    trace_mode,
+                                    batch,
+                                )
                             trace_dir_cur = None
+                            trace_mode = None
                             self._last_done = time.perf_counter()
                     self._maybe_checkpoint(step)
                     if eval_every and step % eval_every == 0:
@@ -657,6 +829,10 @@ class Trainer:
                     self._process_trace(trace_dir_cur, step)
                 except Exception as e:  # noqa: BLE001
                     logger.warning("trace close failed: %s", e)
+            if self._attribution is not None:
+                # drain in-flight attribution parses so the final
+                # step_profile span lands before the timeline ships
+                self._attribution.close(timeout=10.0)
             self._hang.stop()
             if self._exporter is not None:
                 self._exporter.stop()

@@ -58,12 +58,24 @@ def run_scenario(
     detect_within: int = 3,
     timeout_s: float = 60.0,
     probe=None,
+    profile: bool = True,
 ) -> dict:
     """One closed-loop run; returns the metrics dict.  ``probe``,
     when given, is called with the live master's address after
     detection (the tier-1 smoke drives ``scripts/top.py`` through
     it).  Raises RuntimeError only on harness failure — a missed
-    detection is a RESULT (``detected=False``)."""
+    detection is a RESULT (``detected=False``).
+
+    With ``profile=True`` (the default) the ATTRIBUTION leg runs too:
+    every node emits periodic ``step_profile`` spans — the straggler
+    with a copy-dominant share (the offload-problem signature), the
+    healthy ranks compute-dominant — and each node runs a simulated
+    agent monitor poll so the master's diagnosis-triggered ``capture``
+    directive is delivered, answered with a ``ProfileReport``, and
+    lands in the Brain ``profiles`` table.  ``profile=False`` pins
+    the pre-profiling observatory surface (no ``profiles`` key, no
+    attribution fields)."""
+    import dlrover_tpu.master.datastore as ds_mod
     from dlrover_tpu.agent.master_client import MasterClient
     from dlrover_tpu.agent.monitor import (
         HeartbeatReporter,
@@ -86,14 +98,34 @@ def run_scenario(
         "DLROVER_TPU_HANG_WATCHDOG_S": str(2.0 * interval),
         "DLROVER_TPU_DIAGNOSIS_INTERVAL_S": str(interval / 2.0),
         "DLROVER_TPU_STRAGGLER_RATIO": "1.5",
+        "DLROVER_TPU_PROFILE": "1" if profile else "0",
     }
+    if profile:
+        # a Brain db so the deep-capture summary row is DURABLE (the
+        # acceptance bar: the capture lands in the db, not just in
+        # master memory)
+        overrides["DLROVER_TPU_BRAIN_DB"] = os.path.join(
+            workdir, "brain.db"
+        )
     saved = {k: os.environ.get(k) for k in overrides}
+    saved_store = ds_mod._default_store
+    if profile:
+        ds_mod._default_store = None
     os.environ.update(overrides)
     try:
         from dlrover_tpu.master.master import LocalJobMaster
 
         master = LocalJobMaster(get_free_port(), node_num=nodes)
         master.prepare()
+    except BaseException:
+        # construction failed: the swapped-out datastore global must
+        # not leak into the caller's process
+        if profile:
+            store = ds_mod._default_store
+            if store is not None and store is not saved_store:
+                store.close()
+            ds_mod._default_store = saved_store
+        raise
     finally:
         for k, v in saved.items():
             if v is None:
@@ -104,6 +136,24 @@ def run_scenario(
     stop = threading.Event()
     hang_onset = [0.0]
     clients, reporters, threads = [], [], []
+    #: node -> number of capture directives the simulated agent
+    #: received (the delivered-once assertion)
+    captures_delivered = {}
+
+    def _profile_shares(n: int):
+        """Synthetic attribution: the straggler looks like an offload
+        problem (copy-dominant), everyone else MXU-bound."""
+        if n == straggler_node:
+            return dict(
+                share_compute=0.30, share_collective=0.10,
+                share_copy=0.45, share_infeed=0.05,
+                share_idle=0.10, tflops=30.0, mfu=0.11,
+            )
+        return dict(
+            share_compute=0.70, share_collective=0.15,
+            share_copy=0.05, share_infeed=0.05,
+            share_idle=0.05, tflops=90.0, mfu=0.38,
+        )
 
     def node_worker(n: int, events: EventLogger):
         step = 0
@@ -126,6 +176,82 @@ def run_scenario(
                 time.monotonic() - t0_mono,
                 step=step,
             )
+            if profile and step % 3 == 0:
+                # the continuous attribution leg: one step_profile
+                # span per few steps, the way the trainer's
+                # background worker emits them
+                shares = _profile_shares(n)
+                events.complete(
+                    "step_profile",
+                    t0_wall,
+                    time.monotonic() - t0_mono,
+                    step=step,
+                    share_compute=shares["share_compute"],
+                    share_collective=shares["share_collective"],
+                    share_copy=shares["share_copy"],
+                    share_infeed=shares["share_infeed"],
+                    share_idle=shares["share_idle"],
+                    tflops=shares["tflops"],
+                    mfu=shares["mfu"],
+                )
+
+    def agent_poll(n: int, client: MasterClient):
+        """The simulated agent's monitor-pacing poll: the capture
+        directive rides it (zero extra RPCs) and is answered with a
+        ProfileReport + an artifact file, like the real agent."""
+        last = 0
+        while not stop.is_set():
+            try:
+                last = client.num_nodes_waiting(
+                    wait_timeout=interval / 2.0, last_num=last
+                )
+            except (ConnectionError, OSError):
+                time.sleep(interval / 2.0)
+                continue
+            directive = client.take_node_action()
+            if directive is None:
+                continue
+            action, reason, cid = directive
+            if action != "capture":
+                continue
+            captures_delivered[n] = captures_delivered.get(n, 0) + 1
+            artifact = os.path.join(
+                workdir, f"capture_{n}_{cid}.json"
+            )
+            summary = {
+                "reason": reason,
+                "capture_id": cid,
+                "node": n,
+                "workers_signalled": 1,
+                "profiles_collected": 0 if n == hung_node else 1,
+                "stack_dumps": 1,
+                "profiles": [],
+            }
+            try:
+                with open(artifact, "w") as f:
+                    json.dump(
+                        dict(
+                            summary,
+                            stacks={
+                                f"stacks_{n}.txt":
+                                    "Thread 0x1 (most recent call "
+                                    "first): wedged in collective"
+                            },
+                        ),
+                        f,
+                    )
+            except OSError:
+                artifact = ""
+            try:
+                client.report_profile(
+                    node_rank=n,
+                    reason=reason,
+                    capture_id=cid,
+                    summary=summary,
+                    artifact=artifact,
+                )
+            except (ConnectionError, OSError):
+                pass
 
     try:
         for n in range(nodes):
@@ -155,6 +281,15 @@ def run_scenario(
             )
             t.start()
             threads.append(t)
+            if profile:
+                t = threading.Thread(
+                    target=agent_poll,
+                    args=(n, client),
+                    name=f"sim-agent-{n}",
+                    daemon=True,
+                )
+                t.start()
+                threads.append(t)
 
         poller = MasterClient(master.addr, node_id=nodes)
         clients.append(poller)
@@ -162,6 +297,8 @@ def run_scenario(
         deadline = t_start + timeout_s
         straggler_detected_at = 0.0
         hang_detected_at = 0.0
+        hang_concluded_at = 0.0
+        capture_landed_at = 0.0
         conclusion_hits = {}
         snapshot = {}
         while time.monotonic() < deadline:
@@ -184,11 +321,25 @@ def run_scenario(
                     (c.get("problem"), c.get("node_rank")), c
                 )
             if (
+                not hang_concluded_at
+                and ("hang", hung_node) in conclusion_hits
+            ):
+                hang_concluded_at = now
+            if profile and not capture_landed_at:
+                entry = (status.get("profiles") or {}).get(
+                    hung_node
+                ) or (status.get("profiles") or {}).get(
+                    str(hung_node)
+                )
+                if entry and entry.get("summary") is not None:
+                    capture_landed_at = now
+            core_done = (
                 straggler_detected_at
                 and hang_detected_at
                 and ("straggler", straggler_node) in conclusion_hits
                 and ("hang", hung_node) in conclusion_hits
-            ):
+            )
+            if core_done and (not profile or capture_landed_at):
                 break
             time.sleep(interval / 4.0)
 
@@ -203,6 +354,21 @@ def run_scenario(
         for c in clients:
             c.close()
         master.stop()
+        # the durable half of the capture acceptance: the summary
+        # row must be in the Brain profiles table (read before the
+        # scenario store is torn down and the global restored)
+        profile_rows = []
+        if profile:
+            store = ds_mod._default_store
+            try:
+                if store is not None:
+                    profile_rows = store.profiles(job)
+            except Exception:  # noqa: BLE001 - harness robustness
+                profile_rows = []
+            finally:
+                if store is not None and store is not saved_store:
+                    store.close()
+                ds_mod._default_store = saved_store
 
     nodes_snap = {
         n.get("node"): n
@@ -230,6 +396,48 @@ def run_scenario(
         for n in (snapshot.get("health") or {}).get("stragglers", [])
         if n != straggler_node
     ]
+    # ----- the attribution leg's verdicts -----
+    attribution = None
+    if profile:
+        straggler_cause = conclusion_hits.get(
+            ("straggler", straggler_node), {}
+        ).get("cause", "")
+        straggler_snap = nodes_snap.get(straggler_node, {})
+        capture_intervals = (
+            round(
+                (capture_landed_at - hang_concluded_at) / interval, 2
+            )
+            if capture_landed_at and hang_concluded_at
+            else None
+        )
+        attribution = {
+            # the slowed rank's conclusion must NAME its dominant
+            # device-time category ("copy 45%" = offload problem)
+            "straggler_cause": straggler_cause,
+            "straggler_cause_names_category": (
+                "copy" in straggler_cause
+            ),
+            "straggler_dominant": straggler_snap.get("dominant"),
+            "straggler_mfu": straggler_snap.get("mfu"),
+            # deep capture of the hung rank: delivered exactly once,
+            # landed in /status and the Brain db within the bound
+            "captures_delivered": dict(captures_delivered),
+            "capture_delivered_once": (
+                captures_delivered.get(hung_node, 0) == 1
+            ),
+            "capture_intervals": capture_intervals,
+            "capture_in_db": any(
+                r.get("node") == hung_node for r in profile_rows
+            ),
+            "db_profile_rows": len(profile_rows),
+        }
+        detected = bool(
+            detected
+            and attribution["straggler_cause_names_category"]
+            and attribution["capture_in_db"]
+            and capture_intervals is not None
+            and capture_intervals <= detect_within
+        )
     return {
         "nodes": nodes,
         "straggler_node": straggler_node,
@@ -262,6 +470,8 @@ def run_scenario(
         "node_statuses": {
             n: s.get("status") for n, s in nodes_snap.items()
         },
+        "profile": profile,
+        "attribution": attribution,
         "workdir": workdir,
     }
 
@@ -277,6 +487,12 @@ def main(argv=None) -> int:
     parser.add_argument("--detect-within", type=int, default=3,
                         dest="detect_within")
     parser.add_argument("--timeout", type=float, default=60.0)
+    parser.add_argument(
+        "--no-profile", action="store_false", dest="profile",
+        help="skip the attribution leg (step_profile spans + "
+        "diagnosis-triggered deep capture) — the pre-profiling "
+        "observatory scenario exactly",
+    )
     parser.add_argument("--out", default="")
     args = parser.parse_args(argv)
 
@@ -298,6 +514,7 @@ def main(argv=None) -> int:
             straggler_factor=args.straggler_factor,
             detect_within=args.detect_within,
             timeout_s=timeout,
+            profile=args.profile,
         )
     except RuntimeError as e:
         payload["extras"]["error"] = str(e)

@@ -84,6 +84,12 @@ DECLARED_METRICS = {
     # step-time-over-median straggler score
     "dlrover_tpu_node_health",
     "dlrover_tpu_straggler_score",
+    # the live attribution profiler's per-node derivations
+    # (HealthEngine over step_profile spans): model-FLOPs utilization
+    # and the five-bucket device-time shares
+    # (compute/collective/copy/infeed/idle)
+    "dlrover_tpu_node_mfu",
+    "dlrover_tpu_device_share",
     # the Brain autonomy loop (master/auto_scaler.BrainAutoScaler):
     # decisions and execution outcomes by action, failing decision
     # cycles (both scaler generations count here), and the world size

@@ -71,6 +71,20 @@ def _fmt_share(shares: dict) -> str:
     )
 
 
+def _fmt_why(node: dict) -> str:
+    """The attribution column: dominant device-time category + MFU
+    from the live profiler's step_profile spans ("-" until the
+    continuous leg has produced one for this node)."""
+    dominant = node.get("dominant") or {}
+    if not dominant:
+        return "-"
+    why = f"{dominant.get('category', '?')}:{dominant.get('share', 0.0):.0%}"
+    mfu = node.get("mfu") or 0.0
+    if mfu:
+        why += f" mfu:{mfu:.2f}"
+    return why
+
+
 def render(status: dict) -> str:
     """The dashboard frame as a string (separated from the fetch loop
     so tests can assert on it without a tty)."""
@@ -100,6 +114,7 @@ def render(status: dict) -> str:
     header = (
         f"{'node':>4} {'state':>6} {'step':>8} {'t/step':>8} "
         f"{'rate':>7} {'straggle':>8} {'stall':>14} "
+        f"{'why':>18} "
         f"{'rst':>3} {'flt':>3} {'inc':>3} {'silent':>7}"
     )
     lines.append(header)
@@ -114,11 +129,39 @@ def render(status: dict) -> str:
             f"{n.get('step_rate', 0.0):>7.2f} "
             f"{n.get('straggler_score', 0.0):>7.2f}x "
             f"{_fmt_share(n.get('stall_share') or {}):>14} "
+            f"{_fmt_why(n):>18} "
             f"{n.get('restarts', 0):>3} "
             f"{n.get('faults', 0):>3} "
             f"{n.get('inc', 0):>3} "
             f"{(f'{age:.0f}s' if age is not None else '-'):>7}"
         )
+    profiles = status.get("profiles") or {}
+    if profiles:
+        lines.append("")
+        lines.append("deep captures (newest per node):")
+        for key in sorted(profiles, key=lambda k: str(k)):
+            p = profiles[key] or {}
+            t = time.strftime(
+                "%H:%M:%S", time.localtime(p.get("t", 0))
+            )
+            summary = p.get("summary")
+            if summary is None:
+                detail = "in flight"
+            else:
+                detail = (
+                    f"{summary.get('profiles_collected', 0)} "
+                    f"profiles, "
+                    f"{summary.get('stack_dumps', 0)} stack dumps"
+                )
+            lines.append(
+                f"  {t} node {p.get('node', key):>3} "
+                f"{p.get('reason', '?'):<12} {detail}"
+                + (
+                    f" -> {p.get('artifact')}"
+                    if p.get("artifact")
+                    else ""
+                )
+            )
     conclusions = status.get("conclusions") or []
     if conclusions:
         lines.append("")

@@ -233,6 +233,71 @@ def test_lint_enforces_scale_event_labels(tmp_path):
     assert "missing required label(s) ['to_world']" in proc.stdout
 
 
+def test_lint_enforces_step_profile_labels(tmp_path):
+    """A ``step_profile`` span without the category shares + achieved
+    TFLOP/s + MFU is just a blip — every label is REQUIRED and a site
+    missing any of them fails the lint."""
+    bad = tmp_path / "bad_profile.py"
+    bad.write_text(
+        "events = None\n"
+        "def f(events):\n"
+        "    events.complete('step_profile', 0.0, 1.0, step=4,\n"
+        "                    share_compute=0.5, tflops=10.0,\n"
+        "                    mfu=0.3)\n"
+        "    events.complete('step_profile', 0.0, 1.0, step=4,\n"
+        "                    share_compute=0.5,\n"
+        "                    share_collective=0.2, share_copy=0.1,\n"
+        "                    share_infeed=0.1, share_idle=0.1,\n"
+        "                    tflops=10.0, mfu=0.3)\n"
+    )
+    proc = _run(str(bad))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "event_schema_violations=1" in proc.stdout, proc.stdout
+    assert (
+        "missing required label(s) ['share_collective', "
+        "'share_copy', 'share_infeed', 'share_idle']" in proc.stdout
+    )
+
+
+def test_lint_enforces_capture_instant_labels(tmp_path):
+    """A ``capture`` instant must name the captured node and the
+    reason — an anonymous capture marker is useless next to the
+    diagnosis conclusion that triggered it."""
+    bad = tmp_path / "bad_capture.py"
+    bad.write_text(
+        "events = None\n"
+        "def f(events):\n"
+        "    events.instant('capture', node_rank=3)\n"
+        "    events.instant('capture', node_rank=3, reason='hang')\n"
+    )
+    proc = _run(str(bad))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "event_schema_violations=1" in proc.stdout, proc.stdout
+    assert "missing required label(s) ['reason']" in proc.stdout
+
+
+def test_lint_declares_attribution_metrics():
+    """The per-node MFU / device-share gauges are declared; an
+    in-package near-miss typo is not."""
+    probe = os.path.join(
+        REPO, "dlrover_tpu", "_lint_probe_attr_delete_me.py"
+    )
+    with open(probe, "w") as f:
+        f.write(
+            "def f(reg):\n"
+            "    reg.set_gauge('dlrover_tpu_node_mfu', 0.4)\n"
+            "    reg.set_gauge('dlrover_tpu_device_share', 0.5)\n"
+            "    reg.set_gauge('dlrover_tpu_device_shares', 0.5)\n"
+        )
+    try:
+        proc = _run(probe)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "event_schema_violations=1" in proc.stdout, proc.stdout
+        assert "dlrover_tpu_device_shares" in proc.stdout
+    finally:
+        os.unlink(probe)
+
+
 def test_lint_declares_autoscale_metrics():
     """The Brain's metric names are part of the declared vocabulary
     (dashboards key on them), and an in-package typo still fails."""

@@ -186,6 +186,67 @@ class TestStepSegmentation:
         assert report.outside_step_us == 0
 
 
+class TestTruncatedTrace:
+    """A capture interrupted by preemption leaves a torn (partially
+    written) trace file: ``parse_trace`` must return the parsed
+    PREFIX with an explicit ``truncated`` marker, never raise."""
+
+    def _trace_bytes(self, tmp_path):
+        import json
+
+        path = _synthetic_trace(
+            tmp_path,
+            ops=[
+                ("fusion.1", "convolution fusion", 1000, 400),
+                ("copy-done.5", "copy-done", 1500, 80),
+                ("fusion.2", "convolution fusion", 2000, 300),
+            ],
+            modules=[(990, 1400)],
+        )
+        raw = open(path, "rb").read()
+        # sanity: the intact file parses clean
+        report = parse_trace(path)
+        assert report.truncated is False
+        assert report.summary()["truncated"] is False
+        # cut mid-way through the LAST op record's JSON
+        cut = raw.rfind(b'{"ph": "X"')
+        assert cut > 0
+        return raw[: cut + 25], json.loads(raw)
+
+    def test_torn_plain_json_returns_prefix(self, tmp_path):
+        torn, _full = self._trace_bytes(tmp_path)
+        path = tmp_path / "torn.trace.json"
+        path.write_bytes(torn)
+        report = parse_trace(str(path))
+        assert report.truncated is True
+        assert report.summary()["truncated"] is True
+        # the prefix ops survived (the last, torn record is dropped)
+        assert report.total_device_us == 400 + 80
+        assert "convolution fusion" in report.by_category
+
+    def test_torn_gzip_returns_prefix(self, tmp_path):
+        import gzip
+
+        torn, _full = self._trace_bytes(tmp_path)
+        # compress the FULL file, then tear the COMPRESSED stream —
+        # the preemption-mid-write shape for .trace.json.gz captures
+        full_path = tmp_path / "full.trace.json"
+        blob = gzip.compress(open(full_path.parent / "synth.trace.json", "rb").read())
+        path = tmp_path / "torn.trace.json.gz"
+        path.write_bytes(blob[: int(len(blob) * 0.7)])
+        report = parse_trace(str(path))
+        assert report.truncated is True
+        # whatever decompressed must have parsed without raising
+        assert report.total_device_us >= 0.0
+
+    def test_garbage_yields_empty_truncated_report(self, tmp_path):
+        path = tmp_path / "junk.trace.json.gz"
+        path.write_bytes(b"\x1f\x8b\x00garbage-not-gzip")
+        report = parse_trace(str(path))
+        assert report.truncated is True
+        assert report.total_device_us == 0.0
+
+
 class TestCaptureOnCpu:
     def test_capture_yields_empty_but_valid_report(self, tmp_path):
         """CPU traces carry no device tracks: the capture helper must
