@@ -418,6 +418,45 @@ def _control_micro(n_agents: int, wait_s: float) -> dict:
     return out
 
 
+def _fleet_bench(budget: "BenchBudget", out_path: str,
+                 payload: dict) -> dict:
+    """Fleet-scale saturation leg (``scripts/bench_control_plane.py``
+    owns the simulator — ONE definition): 64..256 (512 when the
+    budget allows) simulated agents against one real self-telemetry
+    master, p50/p99 per RPC kind vs N + the saturation knee, plus the
+    shrunken-pool synthetic overload.  The partial payload is flushed
+    after EVERY sweep point — a 512-agent leg that hits the budget
+    must not lose the 64/128/256 points (the BENCH_r05 early-flush
+    rule)."""
+    sys.path.insert(
+        0,
+        os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts"
+        ),
+    )
+    from bench_control_plane import run_fleet, run_overload
+
+    tightish = budget.tight(420)
+    ns = [64, 128, 256]
+    if not tightish and not budget.tight(600):
+        ns.append(512)
+    if budget.tight(240):
+        ns = [64, 128]
+    duration = 2.5 if tightish else 4.0
+
+    def _checkpoint(partial):
+        payload["extras"]["fleet"] = partial
+        flush_partial(out_path, payload)
+
+    fleet = run_fleet(ns, duration_s=duration,
+                      checkpoint=_checkpoint)
+    try:
+        fleet["overload"] = run_overload()
+    except Exception as e:  # noqa: BLE001 - the sweep points stand alone
+        fleet["overload_error"] = str(e)
+    return {"fleet": fleet}
+
+
 def measure_profiling_overhead(
     steps: int = 60, every: int = 15, step_sleep: float = 0.02
 ) -> dict:
@@ -717,6 +756,15 @@ def main(argv=None) -> int:
             )
         except Exception as e:  # noqa: BLE001
             extras["control_micro_error"] = str(e)
+        flush_partial(args.out, payload)
+
+        # fleet-scale saturation leg: p50/p99 per RPC kind vs N
+        # against one self-telemetry master + the shrunken-pool
+        # overload proof (flushes per sweep point internally)
+        try:
+            extras.update(_fleet_bench(budget, args.out, payload))
+        except Exception as e:  # noqa: BLE001
+            extras["fleet_bench_error"] = str(e)
         flush_partial(args.out, payload)
 
         # master-failover leg: goodput under a master-kill storm vs

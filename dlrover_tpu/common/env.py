@@ -304,6 +304,40 @@ def brain_sustain_cycles() -> int:
     return max(int(env_float(BRAIN_SUSTAIN_ENV, 2.0)), 1)
 
 
+SELF_OBS_ENV = "DLROVER_TPU_SELF_OBS"
+MASTER_WORKERS_ENV = "DLROVER_TPU_MASTER_WORKERS"
+
+
+def self_obs_enabled() -> bool:
+    """Kill-switch for the master's control-plane SELF-telemetry: the
+    per-RPC-kind latency / request-size / response-size histograms,
+    the in-flight / parked-long-poll / thread-pool-occupancy gauges,
+    the per-job state row counts, the datastore write-behind health
+    gauges (queue depth, flush-latency histogram, journal lag), the
+    snapshot age/duration gauges, the ``master`` section of
+    ``/status`` + ``JobStatusResponse``, and the ``MasterHealth``
+    overload deriver.  ``DLROVER_TPU_SELF_OBS=0`` reproduces the
+    pre-self-obs metric surface exactly — no ``dlrover_tpu_master_*``
+    / ``dlrover_tpu_datastore_*`` / ``dlrover_tpu_journal_*`` /
+    ``dlrover_tpu_snapshot_*`` series exist (pinned by tests).
+    Default: enabled."""
+    return os.getenv(SELF_OBS_ENV, "1").lower() not in (
+        "0", "false", "off",
+    )
+
+
+def master_workers() -> int:
+    """gRPC thread-pool size of the master server
+    (``DLROVER_TPU_MASTER_WORKERS``).  Each PARKED long-poll holds a
+    pool thread for its whole wait, so the ceiling bounds the fleet a
+    single master can serve — it must be raisable without a code
+    change, and the occupancy gauge
+    (``dlrover_tpu_master_busy_workers`` over
+    ``dlrover_tpu_master_worker_pool_size``) is derived from this
+    same value so the two can never disagree."""
+    return max(int(env_float(MASTER_WORKERS_ENV, 64.0)), 1)
+
+
 MASTER_FAILOVER_ENV = "DLROVER_TPU_MASTER_FAILOVER"
 RECONNECT_DEADLINE_ENV = "DLROVER_TPU_MASTER_RECONNECT_DEADLINE_S"
 SNAPSHOT_INTERVAL_ENV = "DLROVER_TPU_CONTROL_SNAPSHOT_INTERVAL_S"

@@ -308,13 +308,27 @@ class BrainDatastore:
 
     def _write_batch(self, batch: List[Tuple[str, tuple]]):
         """Per-table ``executemany`` over consecutive same-SQL runs
-        (insertion order preserved), ONE commit for the whole batch."""
+        (insertion order preserved), ONE commit for the whole batch.
+        Commit latency lands in the
+        ``dlrover_tpu_datastore_flush_seconds`` histogram (self-obs)
+        — its tail IS the durability lag of everything the journal
+        claims committed."""
         # chaos hook: the enqueue->flush window is exactly where a
         # crash tears the write-behind tail; the fault plan can pin a
         # SIGKILL here to prove journal replay tolerates it
         from dlrover_tpu.common.fault_injection import maybe_crash
+        from dlrover_tpu.observability.metrics import (
+            record_datastore_flush,
+        )
 
         maybe_crash("mid_report_flush")
+        t0 = time.perf_counter()
+        self._flush_batch_locked(batch)
+        record_datastore_flush(
+            len(batch), time.perf_counter() - t0
+        )
+
+    def _flush_batch_locked(self, batch: List[Tuple[str, tuple]]):
         with self._lock:
             try:
                 i = 0
@@ -337,6 +351,26 @@ class BrainDatastore:
                     self._conn.rollback()
                 except sqlite3.Error:
                     pass
+
+    def health(self) -> dict:
+        """The write-behind queue's live vitals for the master's
+        self-telemetry: queue depth vs bound (backpressure distance)
+        and the JOURNAL LAG — rows enqueued minus rows flushed, i.e.
+        how much claimed-durable state a crash right now would lose.
+        Cheap (one lock hold, no sqlite); safe to call per scrape."""
+        with self._wb_cond:
+            return {
+                "sync": self._sync,
+                "queue_depth": len(self._pending),
+                "queue_cap": self.MAX_PENDING,
+                "enqueued_rows": self._enqueued,
+                "flushed_rows": self._flushed,
+                "lag_rows": max(self._enqueued - self._flushed, 0),
+                "flusher_alive": bool(
+                    self._flusher is not None
+                    and self._flusher.is_alive()
+                ),
+            }
 
     def _drain(self):
         """Barrier: block until every row enqueued so far is

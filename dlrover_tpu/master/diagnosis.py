@@ -363,6 +363,42 @@ class HangWatchdogOperator(InferenceOperator):
         ]
 
 
+class MasterOverloadOperator(InferenceOperator):
+    """The control plane diagnosing ITSELF: each diagnose cycle is
+    one derivation interval of the ``MasterHealth`` deriver
+    (``observability/health.py``) — sustained p99 RPC latency,
+    write-behind queue-near-bound, journal-lag and pool-saturation
+    streaks become ``master_overload`` conclusions.  ``action`` is
+    ``none`` on purpose: the remedy (raise
+    ``DLROVER_TPU_MASTER_WORKERS``, shard the job off this master) is
+    an operator decision, not a node relaunch — but the conclusion
+    rides the same timeline/status/Brain surfaces as every fleet
+    verdict, so the signal chain covers its own substrate."""
+
+    def __init__(self, master_health):
+        self._master_health = master_health
+
+    def infer(self, store: "DiagnosisDataStore") -> List[Inference]:
+        del store  # derived from self-telemetry, not the evidence
+        # the reason rides the PROBLEM key ("master_overload:<reason>")
+        # on purpose: the manager dedupes on (problem, node, action),
+        # and a journal_lag breach must not be swallowed for 600 s
+        # because a pool_saturated verdict fired first — MasterHealth
+        # keeps reasons independent, the conclusion keys must too
+        return [
+            Inference(
+                problem=f"master_overload:{v['reason']}",
+                cause=(
+                    f"{v['reason']} at {v['value']:g} vs threshold "
+                    f"{v['threshold']:g} for {v['streak']} intervals"
+                ),
+                action="none",
+                node_rank=-1,
+            )
+            for v in self._master_health.evaluate()
+        ]
+
+
 class InferenceChain:
     def __init__(self, operators: List[InferenceOperator]):
         self._operators = operators
@@ -397,6 +433,7 @@ class DiagnosisManager:
         datastore=None,
         job: str = "",
         capture=None,
+        master_health=None,
     ):
         """With a ``health_engine`` (the observatory is on) the chain
         sits ON TOP of the streaming derivations: straggler /
@@ -431,6 +468,13 @@ class DiagnosisManager:
                         DataStallOperator(health_engine),
                         HangWatchdogOperator(health_engine),
                     ]
+                )
+            if master_health is not None:
+                # the diagnose loop's cadence IS the MasterHealth
+                # derivation interval — the master's own overload
+                # verdicts join the chain like any fleet signal
+                operators.append(
+                    MasterOverloadOperator(master_health)
                 )
             if speed_monitor is not None:
                 # the whole-job stagnation rule stays EVEN WITH the

@@ -86,6 +86,12 @@ class ControlPlaneJournal:
         #: journaling errors must never break the serving path; after
         #: the first failure the journal goes quiet (logged once)
         self._broken = False
+        #: self-telemetry: when the last compacted snapshot landed
+        #: (mono) and how long it took — a stale snapshot means the
+        #: next failover replays a long journal tail
+        self._last_snapshot_mono = 0.0
+        self._last_snapshot_s = 0.0
+        self._last_snapshot_seq = 0
 
     # ------------------------------------------------------ recording
     def record(self, component: str, op: str, args: dict):
@@ -219,6 +225,7 @@ class ControlPlaneJournal:
         export every component, persist, prune."""
         if self._broken:
             return
+        t0 = time.monotonic()
         try:
             seq = self._store.journal_seq(self._job)
             components = {}
@@ -239,8 +246,30 @@ class ControlPlaneJournal:
             self._store.save_control_snapshot(
                 self._job, {"components": components}, seq
             )
+            self._last_snapshot_mono = time.monotonic()
+            self._last_snapshot_s = (
+                self._last_snapshot_mono - t0
+            )
+            self._last_snapshot_seq = seq
         except Exception as e:  # noqa: BLE001
             logger.warning("control snapshot failed: %s", e)
+
+    def health(self) -> dict:
+        """Snapshot vitals for the master's self-telemetry: age (how
+        long the journal tail a failover would replay has been
+        growing) and duration of the last compacted snapshot.  Age is
+        None until the first snapshot landed."""
+        last = self._last_snapshot_mono
+        return {
+            "snapshot_age_s": (
+                round(time.monotonic() - last, 3) if last > 0
+                else None
+            ),
+            "snapshot_duration_s": round(self._last_snapshot_s, 4),
+            "snapshot_seq": self._last_snapshot_seq,
+            "interval_s": self._interval,
+            "broken": self._broken,
+        }
 
     def _loop(self):
         while not self._stopped.wait(self._interval):

@@ -27,6 +27,13 @@ def parse_master_args(argv=None):
         "restarts); also via $DLROVER_TPU_BRAIN_DB",
     )
     parser.add_argument(
+        "--workers", type=int, default=0,
+        help="gRPC thread-pool size (0 = $DLROVER_TPU_MASTER_WORKERS "
+        "or 64).  Each parked long-poll holds one worker for its "
+        "whole wait — raise this before a 256+ agent fan-in; the "
+        "occupancy gauges say when.",
+    )
+    parser.add_argument(
         "--status_port", type=int, default=None,
         help="serve plain-HTTP /metrics (Prometheus text) + /status "
         "(observatory JSON snapshot) on this port (0 = pick a free "
@@ -46,6 +53,11 @@ def run(args) -> int:
 
     if args.brain_db:
         os.environ["DLROVER_TPU_BRAIN_DB"] = args.brain_db
+    if args.workers:
+        # through the env so the servicer's parked-wait cap, the
+        # create_master_service pool and the occupancy gauge all read
+        # ONE value
+        os.environ["DLROVER_TPU_MASTER_WORKERS"] = str(args.workers)
     if args.status_port is not None:
         os.environ["DLROVER_TPU_STATUS_PORT"] = str(args.status_port)
     os.environ.setdefault("DLROVER_TPU_JOB_NAME", args.job_name)

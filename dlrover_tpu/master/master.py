@@ -45,7 +45,9 @@ class JobMaster:
 
         from dlrover_tpu.common.env import (
             brain_enabled,
+            master_workers,
             observatory_enabled,
+            self_obs_enabled,
         )
         from dlrover_tpu.master.datastore import get_default_datastore
         from dlrover_tpu.observability.events import TimelineAggregator
@@ -100,6 +102,31 @@ class JobMaster:
         }
         self.kv_store = KVStoreService()
         self.job_manager = job_manager
+        # control-plane SELF-telemetry: the master watching itself
+        # (per-RPC-kind latency histograms, pool occupancy, state
+        # growth, journal lag) + the MasterHealth overload deriver.
+        # None under DLROVER_TPU_SELF_OBS=0 — the pre-self-obs metric
+        # surface exactly (pinned by tests).
+        self.master_telemetry = None
+        self.master_health = None
+        if self_obs_enabled():
+            from dlrover_tpu.observability.health import MasterHealth
+            from dlrover_tpu.observability.self_telemetry import (
+                MasterSelfTelemetry,
+            )
+
+            self.master_telemetry = MasterSelfTelemetry(
+                registry=get_registry(),
+                pool_size=master_workers(),
+            )
+            self.master_telemetry.attach(
+                kv_store=self.kv_store,
+                rdzv_managers=self.rdzv_managers,
+                task_manager=self.task_manager,
+                timeline_aggregator=self.timeline_aggregator,
+                datastore=get_default_datastore(),
+            )
+            self.master_health = MasterHealth(self.master_telemetry)
         if diagnosis_manager is None:
             from dlrover_tpu.master.diagnosis import DiagnosisManager
 
@@ -113,6 +140,7 @@ class JobMaster:
                 datastore=get_default_datastore(),
                 job=self._job_name,
                 capture=self.capture_coordinator,
+                master_health=self.master_health,
             )
         self.diagnosis_manager = diagnosis_manager
         # the autonomy loop (ROADMAP item 1): observatory signals ->
@@ -223,6 +251,15 @@ class JobMaster:
 
     def prepare(self):
         self._setup_failover()
+        if (
+            self.master_telemetry is not None
+            and self.control_journal is not None
+        ):
+            # the journal only exists once failover setup ran; its
+            # snapshot age/duration joins the self-telemetry sweep
+            self.master_telemetry.attach(
+                journal=self.control_journal
+            )
         servicer = MasterServicer(
             task_manager=self.task_manager,
             job_manager=self.job_manager,
@@ -236,6 +273,7 @@ class JobMaster:
             capture_coordinator=self.capture_coordinator,
             job_epoch=self.job_epoch,
             incarnation=self.incarnation,
+            telemetry=self.master_telemetry,
         )
         self._servicer = servicer
         self._server = create_master_service(self._port, servicer)
@@ -289,6 +327,7 @@ class JobMaster:
             registry=get_registry(),
             snapshot_fn=_snapshot,
             health_engine=self.health_engine,
+            telemetry=self.master_telemetry,
         )
         try:
             self.status_server.start()

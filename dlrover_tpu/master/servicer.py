@@ -17,19 +17,23 @@ from dlrover_tpu.common.constants import (
     TrainingExceptionLevel,
     TrainingLoopStatus,
 )
-from dlrover_tpu.common.env import master_failover_enabled
+from dlrover_tpu.common.env import (
+    master_failover_enabled,
+    master_workers,
+)
 from dlrover_tpu.common.fault_injection import maybe_crash
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.observability.metrics import record_control_rpc
 
 
 class MasterServicer:
-    #: at most this many RPC workers may PARK in long-poll waits at
-    #: once (the gRPC pool has 64); past the cap a wait degrades to an
-    #: immediate answer (the client just re-issues), so join/set/report
-    #: mutations — the RPCs that WAKE parked waiters — always find a
-    #: free worker and the pool cannot deadlock on its own waiters
-    MAX_PARKED_WAITS = 32
+    # at most ``max_parked_waits`` (HALF the gRPC pool —
+    # ``DLROVER_TPU_MASTER_WORKERS`` scales both together, 32 for the
+    # default 64-worker pool) RPC workers may PARK in long-poll waits
+    # at once; past the cap a wait degrades to an immediate answer
+    # (the client just re-issues), so join/set/report mutations — the
+    # RPCs that WAKE parked waiters — always find a free worker and
+    # the pool cannot deadlock on its own waiters
 
     def __init__(
         self,
@@ -46,6 +50,7 @@ class MasterServicer:
         capture_coordinator=None,
         job_epoch: int = 0,
         incarnation: int = 0,
+        telemetry=None,
     ):
         #: fencing identity: requests carrying a DIFFERENT job_epoch
         #: get a typed ``StaleEpoch`` answer (client refreshes and
@@ -79,8 +84,16 @@ class MasterServicer:
         #: lifetime RPC tally (gets + reports, batched items counted
         #: once per envelope) — the bench's server-side ground truth
         self.rpc_count = 0
+        #: self-telemetry collector (None = DLROVER_TPU_SELF_OBS=0 or
+        #: a pre-self-obs caller): per-RPC-kind latency/size
+        #: histograms, in-flight/parked gauges, the ``master`` status
+        #: section
+        self._telemetry = telemetry
+        #: the parked-wait cap scales with the pool: half the workers
+        #: may park, so mutations always find a free one
+        self.max_parked_waits = max(master_workers() // 2, 1)
         self._wait_slots = threading.BoundedSemaphore(
-            self.MAX_PARKED_WAITS
+            self.max_parked_waits
         )
 
     def _count_rpc(self):
@@ -93,7 +106,11 @@ class MasterServicer:
         ⇒ answer immediately (the client loop re-issues, with its own
         backoff) instead of parking another pool thread."""
         if not self._wait_slots.acquire(blocking=False):
+            if self._telemetry is not None:
+                self._telemetry.wait_rejected()
             return immediate_fn()
+        if self._telemetry is not None:
+            self._telemetry.wait_parked()
         try:
             # chaos hook: a kill pinned here dies with RPCs parked
             # mid-long-poll — the waiters must re-park on the next
@@ -101,6 +118,8 @@ class MasterServicer:
             maybe_crash("mid_long_poll")
             return wait_fn()
         finally:
+            if self._telemetry is not None:
+                self._telemetry.wait_unparked()
             self._wait_slots.release()
 
     def _fenced(self, envelope: msg.Envelope) -> Optional[msg.StaleEpoch]:
@@ -116,10 +135,42 @@ class MasterServicer:
             job_epoch=self.job_epoch, incarnation=self.incarnation
         )
 
+    @staticmethod
+    def _response_bytes(response) -> Optional[int]:
+        """Wire size of one response (None when there is none).  The
+        extra serialize only runs with self-obs ON and control
+        responses are small pickles — the histogram is worth the
+        double-encode; a failure must not break the RPC."""
+        if response is None:
+            return None
+        try:
+            return len(msg.serialize_message(response))
+        except Exception:  # noqa: BLE001
+            return None
+
     # ------------------------------------------------------------------ get
     def get(self, envelope: msg.Envelope) -> Optional[msg.Message]:
         self._count_rpc()
         request = msg.deserialize_message(envelope.data)
+        if self._telemetry is None:
+            return self._get_dispatch(envelope, request)
+        t0 = time.perf_counter()
+        self._telemetry.rpc_begin()
+        response = None
+        try:
+            response = self._get_dispatch(envelope, request)
+            return response
+        finally:
+            self._telemetry.rpc_end(
+                type(request).__name__,
+                time.perf_counter() - t0,
+                len(envelope.data or b""),
+                self._response_bytes(response),
+            )
+
+    def _get_dispatch(
+        self, envelope: msg.Envelope, request
+    ) -> Optional[msg.Message]:
         node_id, node_type = envelope.node_id, envelope.node_type
         if isinstance(request, msg.ControlEpochRequest):
             # the refresh path — answered even to stale clients (it is
@@ -258,6 +309,14 @@ class MasterServicer:
                 status["profiles"] = self._capture.latest()
             except Exception as e:  # noqa: BLE001 - partial status
                 logger.warning("status profiles failed: %s", e)
+        if self._telemetry is not None:
+            # the control plane's own vitals: RPC latency per kind,
+            # pool occupancy, state growth, journal/datastore health
+            # (absent under DLROVER_TPU_SELF_OBS=0 — pinned)
+            try:
+                status["master"] = self._telemetry.snapshot()
+            except Exception as e:  # noqa: BLE001 - partial status
+                logger.warning("status master section failed: %s", e)
         return msg.JobStatusResponse(status=status, available=True)
 
     def _timeline_query(
@@ -459,18 +518,44 @@ class MasterServicer:
     # --------------------------------------------------------------- report
     def report(self, envelope: msg.Envelope):
         self._count_rpc()
+        if self._telemetry is None:
+            return self._report_dispatch(envelope)[1]
+        t0 = time.perf_counter()
+        self._telemetry.rpc_begin()
+        kind, response = "?", None
+        try:
+            kind, response = self._report_dispatch(envelope)
+            return response
+        finally:
+            self._telemetry.rpc_end(
+                kind,
+                time.perf_counter() - t0,
+                len(envelope.data or b""),
+                self._response_bytes(response),
+            )
+
+    def _report_dispatch(self, envelope: msg.Envelope):
+        """Fence FIRST, deserialize second (the pre-self-obs order):
+        a stale client must get its typed ``StaleEpoch`` even when
+        its payload no longer unpickles across a rolling upgrade, and
+        a fenced request must not pay deserialization.  Returns
+        ``(kind, response)`` so the telemetry wrapper can label the
+        series without deserializing itself."""
         stale = self._fenced(envelope)
         if stale is not None:
-            return stale
+            return "StaleEpoch", stale
         request = msg.deserialize_message(envelope.data)
         node_id, node_type = envelope.node_id, envelope.node_type
+        kind = type(request).__name__
         success = False
         try:
             success = self._dispatch_report(node_id, node_type, request)
         except Exception as e:  # noqa: BLE001
             logger.error("report handler error for %r: %s", request, e)
-            return msg.BoolResponse(success=False, reason=repr(e))
-        return msg.BoolResponse(success=bool(success))
+            return kind, msg.BoolResponse(
+                success=False, reason=repr(e)
+            )
+        return kind, msg.BoolResponse(success=bool(success))
 
     def _dispatch_report(self, node_id, node_type, request) -> bool:
         if isinstance(request, msg.BatchedReport):
@@ -677,8 +762,14 @@ class MasterServicer:
 
 
 def create_master_service(port: int, servicer: MasterServicer,
-                          max_workers: int = 64):
-    """Build the gRPC server wired to the servicer."""
+                          max_workers: int = 0):
+    """Build the gRPC server wired to the servicer.  ``max_workers``
+    0 resolves ``DLROVER_TPU_MASTER_WORKERS`` (default 64) — each
+    parked long-poll holds one of these threads for its whole wait,
+    so the fan-in ceiling must be raisable without a code change."""
     return build_master_server(
-        port, servicer.report, servicer.get, max_workers=max_workers
+        port,
+        servicer.report,
+        servicer.get,
+        max_workers=max_workers or master_workers(),
     )

@@ -215,6 +215,17 @@ class TaskManager:
     def training_started(self) -> bool:
         return bool(self._datasets)
 
+    def row_counts(self) -> int:
+        """Live shard bookkeeping rows (todo + in-flight leases over
+        every dataset) — the self-telemetry state-growth gauge's
+        cheap accessor (``export_state`` would serialize every
+        shard)."""
+        with self._lock:
+            return sum(
+                len(d.todo) + len(d.doing)
+                for d in self._datasets.values()
+            )
+
     def get_dataset_checkpoint(self, dataset_name: str) -> Optional[ShardCheckpoint]:
         with self._lock:
             dataset = self._datasets.get(dataset_name)

@@ -178,6 +178,10 @@ INSTANT_EVENTS = frozenset(
         "scale_decision",
         "scale_execute",
         "capture",
+        # the master's own overload deriver fired: sustained p99 /
+        # queue-near-bound / journal-lag / pool-saturation streak
+        # (observability/health.py MasterHealth)
+        "master_overload",
     }
 )
 
@@ -201,6 +205,11 @@ REQUIRED_INSTANT_LABELS: Dict[str, Tuple[str, ...]] = {
     # and WHY (hang / straggler / operator request), next to the
     # diagnosis conclusion that triggered it
     "capture": ("node_rank", "reason"),
+    # an overload verdict without WHICH signal breached and by how
+    # much is unactionable — "journal_lag 8200 rows vs 5000" tells
+    # the operator to grow the flusher, "pool_saturated 0.97 vs 0.9"
+    # to raise DLROVER_TPU_MASTER_WORKERS
+    "master_overload": ("reason", "value", "threshold"),
 }
 
 #: Labels an emit SITE must pass explicitly (beyond the automatic
@@ -891,6 +900,13 @@ class TimelineAggregator:
             if limit and limit > 0:
                 return list(self._events[-limit:])
             return list(self._events)
+
+    def size(self) -> int:
+        """Ring occupancy without copying it (the self-telemetry
+        state-rows sweep runs per scrape — ``len(events())`` would
+        copy up to MAX_EVENTS dicts each time)."""
+        with self._lock:
+            return len(self._events)
 
     def ledger(self) -> dict:
         """Current goodput ledger over everything merged so far."""

@@ -693,3 +693,194 @@ class HealthEngine:
     # ------------------------------------------------------------- misc
     def to_json(self) -> str:
         return json.dumps(self.snapshot(), separators=(",", ":"))
+
+
+#: MasterHealth thresholds (see class docstring)
+MASTER_P99_ENV = "DLROVER_TPU_MASTER_OVERLOAD_P99_S"
+MASTER_QUEUE_FRAC_ENV = "DLROVER_TPU_MASTER_OVERLOAD_QUEUE_FRAC"
+MASTER_LAG_ROWS_ENV = "DLROVER_TPU_MASTER_OVERLOAD_LAG_ROWS"
+MASTER_OCCUPANCY_ENV = "DLROVER_TPU_MASTER_OVERLOAD_OCCUPANCY"
+MASTER_REJECTS_ENV = "DLROVER_TPU_MASTER_OVERLOAD_REJECTS"
+MASTER_SUSTAIN_ENV = "DLROVER_TPU_MASTER_OVERLOAD_SUSTAIN"
+MASTER_COOLDOWN_ENV = "DLROVER_TPU_MASTER_OVERLOAD_COOLDOWN_S"
+
+
+class MasterHealth:
+    """The master's own health deriver — the :class:`HealthEngine`
+    watches the fleet, this watches the component every fleet signal
+    flows through.  Each :meth:`evaluate` call (the DiagnosisManager's
+    loop cadence is the derivation interval) reads the live
+    self-telemetry (``observability/self_telemetry.py``) and keeps a
+    per-reason STREAK; a breach sustained for ``sustain`` consecutive
+    evaluations becomes one overload verdict:
+
+    - ``rpc_p99``        — windowed p99 latency of the FAST RPC
+      kinds (parked long-polls excluded — their latency is the wait
+      window they asked for; ``self_telemetry.WAIT_KINDS``) past
+      ``DLROVER_TPU_MASTER_OVERLOAD_P99_S`` (default 0.5 s: a healthy
+      dispatch is single-digit ms, half a second means the master is
+      the job's critical path);
+    - ``queue_depth``    — write-behind queue past
+      ``..._QUEUE_FRAC`` (0.8) of its bound: the next burst
+      backpressures the report RPC path;
+    - ``journal_lag``    — rows enqueued minus rows flushed past
+      ``..._LAG_ROWS`` (5000): a crash now loses that much claimed
+      durability;
+    - ``pool_saturated`` — busy workers (parked long-polls included)
+      past ``..._OCCUPANCY`` (0.9) of the pool: mutation RPCs are
+      about to queue behind parked waiters;
+    - ``parked_rejects`` — at least ``..._REJECTS`` (1) long-polls
+      per interval degraded to immediate answers because every
+      parked-wait slot was held: the pool is too small for this
+      fleet's idle waits (raise ``DLROVER_TPU_MASTER_WORKERS``).
+      Occupancy is an instantaneous sample and can flap; the
+      rejection COUNTER only moves when the cap was genuinely hit,
+      so this is the robust shrunken-pool signature.
+
+    Firing emits a ``master_overload`` instant (labels lint-enforced)
+    and starts a per-reason cooldown (``..._COOLDOWN_S``, 300 s); the
+    ``MasterOverloadOperator`` in ``master/diagnosis.py`` turns the
+    same verdicts into diagnosis conclusions, so the Brain's signal
+    chain covers its own substrate.
+    """
+
+    def __init__(
+        self,
+        telemetry,
+        p99_s: Optional[float] = None,
+        queue_frac: Optional[float] = None,
+        lag_rows: Optional[float] = None,
+        occupancy: Optional[float] = None,
+        sustain: Optional[int] = None,
+        cooldown_s: Optional[float] = None,
+    ):
+        self._telemetry = telemetry
+        self.p99_s = (
+            p99_s if p99_s is not None
+            else env_float(MASTER_P99_ENV, 0.5)
+        )
+        self.queue_frac = (
+            queue_frac if queue_frac is not None
+            else env_float(MASTER_QUEUE_FRAC_ENV, 0.8)
+        )
+        self.lag_rows = (
+            lag_rows if lag_rows is not None
+            else env_float(MASTER_LAG_ROWS_ENV, 5000.0)
+        )
+        self.occupancy = (
+            occupancy if occupancy is not None
+            else env_float(MASTER_OCCUPANCY_ENV, 0.9)
+        )
+        self.rejects = env_float(MASTER_REJECTS_ENV, 1.0)
+        self.sustain = max(
+            int(
+                sustain if sustain is not None
+                else env_float(MASTER_SUSTAIN_ENV, 2.0)
+            ),
+            1,
+        )
+        self.cooldown_s = (
+            cooldown_s if cooldown_s is not None
+            else env_float(MASTER_COOLDOWN_ENV, 300.0)
+        )
+        self._lock = threading.Lock()
+        self._streaks: Dict[str, int] = {}
+        self._last_fired: Dict[str, float] = {}
+        self._last_verdicts: List[dict] = []
+        #: rejected-waits counter at the previous evaluate — the
+        #: per-interval delta is the parked_rejects signal
+        self._last_rejected = 0
+
+    def _breaches(self) -> List[Tuple[str, float, float]]:
+        """Current ``(reason, value, threshold)`` breaches from one
+        telemetry read."""
+        tel = self._telemetry
+        out: List[Tuple[str, float, float]] = []
+        p99 = tel.window_p99()
+        if p99 >= self.p99_s:
+            out.append(("rpc_p99", p99, self.p99_s))
+        ds = tel.datastore_health()
+        if ds:
+            cap = max(float(ds.get("queue_cap", 0) or 0), 1.0)
+            depth = float(ds.get("queue_depth", 0) or 0)
+            if depth / cap >= self.queue_frac:
+                out.append(
+                    ("queue_depth", depth, self.queue_frac * cap)
+                )
+            lag = float(ds.get("lag_rows", 0) or 0)
+            if lag >= self.lag_rows:
+                out.append(("journal_lag", lag, self.lag_rows))
+        occ = tel.occupancy()
+        if occ >= self.occupancy:
+            out.append(("pool_saturated", occ, self.occupancy))
+        rejected = getattr(tel, "rejected_waits", 0)
+        delta = rejected - self._last_rejected
+        self._last_rejected = rejected
+        if delta >= self.rejects:
+            out.append(("parked_rejects", float(delta), self.rejects))
+        return out
+
+    def evaluate(self) -> List[dict]:
+        """One derivation interval: update streaks, fire sustained
+        breaches past their cooldown.  Returns the verdicts fired
+        THIS call (each also emitted as a ``master_overload``
+        instant)."""
+        now = time.monotonic()
+        breaches = self._breaches()
+        fired: List[dict] = []
+        with self._lock:
+            current = {r for r, _v, _t in breaches}
+            for reason in list(self._streaks):
+                if reason not in current:
+                    self._streaks.pop(reason)
+            for reason, value, threshold in breaches:
+                streak = self._streaks.get(reason, 0) + 1
+                self._streaks[reason] = streak
+                if streak < self.sustain:
+                    continue
+                last = self._last_fired.get(reason, -1e18)
+                if now - last < self.cooldown_s:
+                    continue
+                self._last_fired[reason] = now
+                # acting consumes the streak (like the Brain's rules)
+                self._streaks[reason] = 0
+                fired.append(
+                    {
+                        "reason": reason,
+                        "value": round(float(value), 6),
+                        "threshold": round(float(threshold), 6),
+                        "streak": streak,
+                        "t": time.time(),
+                    }
+                )
+            if fired:
+                self._last_verdicts = fired
+        for v in fired:
+            try:
+                from dlrover_tpu.observability.events import (
+                    get_event_logger,
+                )
+
+                get_event_logger().instant(
+                    "master_overload",
+                    reason=v["reason"],
+                    value=v["value"],
+                    threshold=v["threshold"],
+                    streak=v["streak"],
+                )
+            except Exception as e:  # noqa: BLE001 - telemetry only
+                logger.warning(
+                    "master_overload instant emit failed: %s", e
+                )
+        return fired
+
+    def status(self) -> dict:
+        """Streaks + newest verdicts for the ``master`` status
+        section."""
+        with self._lock:
+            return {
+                "streaks": dict(self._streaks),
+                "last_verdicts": list(self._last_verdicts),
+                "sustain": self.sustain,
+                "cooldown_s": self.cooldown_s,
+            }

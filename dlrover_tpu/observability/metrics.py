@@ -13,12 +13,102 @@ import subprocess
 import tempfile
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.common.native_build import needs_rebuild, write_stamp
 
 BASE_PORT = 28888  # xpu_timer's port convention
+
+
+def log_bounds(base: float, growth: float, count: int) -> Tuple[float, ...]:
+    """Geometric (log-spaced) histogram bucket upper bounds:
+    ``base * growth**i`` for ``i in range(count)``.  Log buckets give
+    constant RELATIVE resolution — the right shape for latencies and
+    sizes, whose interesting range spans decades."""
+    return tuple(base * growth ** i for i in range(count))
+
+
+#: default latency buckets: 100 µs .. ~210 s, ×2 per bucket (22
+#: buckets + the implicit +Inf).  A control-plane RPC lands in the
+#: low-millisecond buckets when healthy and walks up the ladder as the
+#: master saturates — exactly the drift the p99 gauges key on.
+LATENCY_BOUNDS = log_bounds(1e-4, 2.0, 22)
+#: default size buckets: 64 B .. ~1 GB, ×4 per bucket (13 buckets +
+#: +Inf) — request/response payloads and flush batches.
+SIZE_BOUNDS = log_bounds(64.0, 4.0, 13)
+
+
+class Histogram:
+    """One log-bucketed histogram series: cumulative bucket counts +
+    sum + count, rendered in the classic Prometheus text format
+    (``<name>_bucket{le=...}`` / ``<name>_sum`` / ``<name>_count``).
+    NOT thread-safe on its own — the owning registry's lock guards
+    every observe/render."""
+
+    __slots__ = ("bounds", "counts", "sum", "count")
+
+    def __init__(self, bounds: Tuple[float, ...] = LATENCY_BOUNDS):
+        self.bounds = tuple(sorted(bounds))
+        # one count per finite bound + the +Inf overflow bucket;
+        # NON-cumulative internally (one increment per observe),
+        # accumulated at render time
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float):
+        value = float(value)
+        self.sum += value
+        self.count += 1
+        # linear scan: bounds are ~20 entries and the loop is cheaper
+        # than bisect's call overhead at that size
+        for i, bound in enumerate(self.bounds):
+            if value <= bound:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def quantile(self, q: float) -> float:
+        """Upper-bound estimate of the ``q`` quantile (0..1) from the
+        bucket counts: the smallest bucket bound whose cumulative
+        count reaches ``q * count``.  Observations past the last
+        finite bound report that bound — an under-estimate, loudly
+        conservative rather than invented."""
+        if self.count <= 0:
+            return 0.0
+        target = q * self.count
+        cum = 0
+        for i, bound in enumerate(self.bounds):
+            cum += self.counts[i]
+            if cum >= target:
+                return bound
+        return self.bounds[-1] if self.bounds else 0.0
+
+    @staticmethod
+    def _fmt_le(bound: float) -> str:
+        return f"{bound:.9g}"
+
+    def render_lines(
+        self, name: str, inner_labels: str, stamp: str = ""
+    ) -> List[str]:
+        """The exposition lines for this series.  ``inner_labels`` is
+        the pre-rendered ``k="v"`` list (may be empty); ``le`` is
+        appended last so the caller's label escaping is reused."""
+        lines = []
+        cum = 0
+        for i, bound in enumerate(self.bounds):
+            cum += self.counts[i]
+            le = f'le="{self._fmt_le(bound)}"'
+            inner = f"{inner_labels},{le}" if inner_labels else le
+            lines.append(f"{name}_bucket{{{inner}}} {cum}{stamp}")
+        le = 'le="+Inf"'
+        inner = f"{inner_labels},{le}" if inner_labels else le
+        lines.append(f"{name}_bucket{{{inner}}} {self.count}{stamp}")
+        suffix = f"{{{inner_labels}}}" if inner_labels else ""
+        lines.append(f"{name}_sum{suffix} {self.sum:.9g}{stamp}")
+        lines.append(f"{name}_count{suffix} {self.count}{stamp}")
+        return lines
 
 _REPO_ROOT = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -43,6 +133,10 @@ class MetricsRegistry:
             f"dlrover_tpu_metrics_{os.getpid()}.prom",
         )
         self._metrics: Dict[str, float] = {}
+        #: (name, rendered-inner-labels) -> Histogram — kept separate
+        #: from the scalar map because one logical series renders as
+        #: many exposition lines
+        self._histograms: Dict[Tuple[str, str], Histogram] = {}
         self._lock = threading.Lock()
         self._flush_interval = flush_interval
         self._last_flush = 0.0
@@ -66,18 +160,25 @@ class MetricsRegistry:
 
     _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 
-    def _key(self, name: str, labels: Optional[Dict] = None) -> str:
-        name = self._NAME_RE.sub("_", name)
+    def _inner_labels(self, labels: Optional[Dict] = None) -> str:
+        """The rendered ``k="v"`` label list (no braces; "" when no
+        labels survive the merge)."""
         merged = dict(labels or {})
         if self._rank is not None:
             merged.setdefault("rank", self._rank)
         if not merged:
-            return name
-        inner = ",".join(
+            return ""
+        return ",".join(
             f'{self._NAME_RE.sub("_", str(k))}='
             f'"{self._escape_label(v)}"'
             for k, v in sorted(merged.items())
         )
+
+    def _key(self, name: str, labels: Optional[Dict] = None) -> str:
+        name = self._NAME_RE.sub("_", name)
+        inner = self._inner_labels(labels)
+        if not inner:
+            return name
         return f"{name}{{{inner}}}"
 
     def set_gauge(self, name: str, value: float, labels=None):
@@ -96,6 +197,57 @@ class MetricsRegistry:
         self.inc_counter(name + "_seconds_sum", seconds, labels)
         self.inc_counter(name + "_count", 1.0, labels)
 
+    def observe_histogram(self, name: str, value: float, labels=None,
+                          bounds: Optional[Tuple[float, ...]] = None):
+        """Record one observation into a log-bucketed histogram
+        series (created on first observe; ``bounds`` only applies
+        then — a series' bucket layout is immutable).  Rendered as
+        classic Prometheus ``_bucket``/``_sum``/``_count`` lines by
+        ``render_text()``/``flush()``."""
+        name = self._NAME_RE.sub("_", name)
+        with self._lock:
+            key = (name, self._inner_labels(labels))
+            hist = self._histograms.get(key)
+            if hist is None:
+                hist = self._histograms[key] = Histogram(
+                    bounds if bounds is not None else LATENCY_BOUNDS
+                )
+            hist.observe(value)
+        self._maybe_flush()
+
+    def histogram(self, name: str, labels=None) -> Optional[Histogram]:
+        """The live ``Histogram`` for a series (None before its first
+        observe) — quantile reads for the self-telemetry snapshot and
+        the fleet bench.  The returned object is shared; treat it as
+        read-only."""
+        with self._lock:
+            return self._histograms.get(
+                (self._NAME_RE.sub("_", name),
+                 self._inner_labels(labels))
+            )
+
+    def histogram_series(self, name: str) -> Dict[str, Histogram]:
+        """Every label-set of one histogram name, keyed by the
+        rendered inner-label string (reader for per-kind sweeps)."""
+        name = self._NAME_RE.sub("_", name)
+        with self._lock:
+            return {
+                inner: hist
+                for (n, inner), hist in self._histograms.items()
+                if n == name
+            }
+
+    def _histogram_lines(self, stamp: str = "") -> list:
+        """Caller holds the lock."""
+        lines = []
+        for (name, inner) in sorted(self._histograms):
+            lines.extend(
+                self._histograms[(name, inner)].render_lines(
+                    name, inner, stamp
+                )
+            )
+        return lines
+
     def render_text(self) -> str:
         """The current metrics as Prometheus exposition text for the
         master's plain-HTTP ``/metrics`` endpoint.  NO trailing
@@ -110,6 +262,7 @@ class MetricsRegistry:
                 f"{k} {v:.9g}"
                 for k, v in sorted(self._metrics.items())
             ]
+            lines.extend(self._histogram_lines())
         return "\n".join(lines) + "\n"
 
     def _maybe_flush(self):
@@ -128,6 +281,7 @@ class MetricsRegistry:
                 f"{k} {v:.9g} {now:.3f}"
                 for k, v in sorted(self._metrics.items())
             ]
+            lines.extend(self._histogram_lines(f" {now:.3f}"))
             self._last_flush = now
         tmp = self._path + ".tmp"
         try:
@@ -249,6 +403,31 @@ def record_reshard_io(from_world: int, to_world: int, nbytes: int,
         reg.inc_counter("dlrover_tpu_reshard_total")
     except Exception as e:  # noqa: BLE001
         logger.warning("reshard metric export failed: %s", e)
+
+
+def record_datastore_flush(rows: int, seconds: float):
+    """One write-behind flush batch landed: its commit latency feeds
+    the ``dlrover_tpu_datastore_flush_seconds`` histogram and the
+    batch size the ``dlrover_tpu_datastore_flush_rows`` histogram —
+    the tail of this distribution is the journal's durability lag
+    under load.  Gated by ``DLROVER_TPU_SELF_OBS=0`` (the pre-self-obs
+    metric surface must stay exact).  Never raises — telemetry must
+    not break a flush."""
+    from dlrover_tpu.common.env import self_obs_enabled
+
+    try:
+        if not self_obs_enabled():
+            return
+        reg = get_registry()
+        reg.observe_histogram(
+            "dlrover_tpu_datastore_flush_seconds", seconds
+        )
+        reg.observe_histogram(
+            "dlrover_tpu_datastore_flush_rows", float(rows),
+            bounds=SIZE_BOUNDS,
+        )
+    except Exception as e:  # noqa: BLE001
+        logger.warning("datastore flush metric export failed: %s", e)
 
 
 def record_dropped_reports(n: int = 1):

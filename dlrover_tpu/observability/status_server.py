@@ -39,6 +39,7 @@ class StatusServer:
         registry=None,
         snapshot_fn: Optional[Callable[[], dict]] = None,
         health_engine=None,
+        telemetry=None,
         host: str = "0.0.0.0",
     ):
         self._port = port
@@ -46,6 +47,10 @@ class StatusServer:
         self._registry = registry
         self._snapshot_fn = snapshot_fn
         self._health = health_engine
+        #: the master's self-telemetry collector (None = self-obs
+        #: off): its sweep gauges refresh at scrape time like the
+        #: health engine's
+        self._telemetry = telemetry
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -80,6 +85,8 @@ class StatusServer:
                             # scrape-time freshness: the throttled
                             # report-path refresh may be seconds old
                             server._health.refresh_gauges()
+                        if server._telemetry is not None:
+                            server._telemetry.refresh_gauges()
                         text = (
                             server._registry.render_text()
                             if server._registry is not None

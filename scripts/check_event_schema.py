@@ -98,8 +98,42 @@ DECLARED_METRICS = {
     "dlrover_tpu_autoscale_executions",
     "dlrover_tpu_autoscale_errors",
     "dlrover_tpu_autoscale_world",
+    # the master's control-plane SELF-telemetry
+    # (observability/self_telemetry.py, behind DLROVER_TPU_SELF_OBS):
+    # per-RPC-kind latency + request/response-size histograms
+    "dlrover_tpu_master_rpc_latency_seconds",
+    "dlrover_tpu_master_rpc_request_bytes",
+    "dlrover_tpu_master_rpc_response_bytes",
+    # pool vitals: in-flight RPCs (each holds a gRPC worker),
+    # busy/pool occupancy pair, parked long-polls, and long-polls
+    # degraded to immediate answers at the parked-wait cap
+    "dlrover_tpu_master_inflight_rpcs",
+    "dlrover_tpu_master_busy_workers",
+    "dlrover_tpu_master_worker_pool_size",
+    "dlrover_tpu_master_parked_waits",
+    "dlrover_tpu_master_rejected_waits",
+    # per-job control-plane state growth (kv | rdzv/* | tasks |
+    # timeline row counts)
+    "dlrover_tpu_master_state_rows",
+    # write-behind datastore health (record_datastore_flush +
+    # MasterSelfTelemetry.refresh_gauges): flush latency/batch-size
+    # histograms, live queue depth, journal lag (rows enqueued minus
+    # rows flushed = claimed durability a crash would lose)
+    "dlrover_tpu_datastore_flush_seconds",
+    "dlrover_tpu_datastore_flush_rows",
+    "dlrover_tpu_datastore_queue_depth",
+    "dlrover_tpu_journal_lag_rows",
+    # compacted control-plane snapshot vitals (failover.py health):
+    # age bounds the journal tail a failover replays
+    "dlrover_tpu_snapshot_age_seconds",
+    "dlrover_tpu_snapshot_duration_seconds",
 }
-METRIC_METHODS = {"set_gauge", "inc_counter", "observe_duration"}
+METRIC_METHODS = {
+    "set_gauge",
+    "inc_counter",
+    "observe_duration",
+    "observe_histogram",
+}
 _METRIC_PREFIX = "dlrover_tpu_"
 
 

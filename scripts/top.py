@@ -135,6 +135,54 @@ def render(status: dict) -> str:
             f"{n.get('inc', 0):>3} "
             f"{(f'{age:.0f}s' if age is not None else '-'):>7}"
         )
+    master = status.get("master") or {}
+    if master:
+        # the control plane's own vitals (absent when the master
+        # runs with DLROVER_TPU_SELF_OBS=0 or predates self-obs)
+        pool = master.get("pool") or {}
+        ds = master.get("datastore") or {}
+        jrn = master.get("journal") or {}
+        line = (
+            f"master: pool {pool.get('busy', 0)}/"
+            f"{pool.get('size', '?')} busy"
+            f" ({pool.get('parked_waits', 0)} parked,"
+            f" {pool.get('rejected_waits', 0)} rejected)"
+            f" · rpc p99(window)"
+            f" {master.get('rpc_p99_window_ms', 0.0):.1f}ms"
+        )
+        if ds:
+            line += (
+                f" · wb queue {ds.get('queue_depth', 0)}/"
+                f"{ds.get('queue_cap', '?')}"
+                f" lag {ds.get('lag_rows', 0)} rows"
+            )
+        if jrn.get("snapshot_age_s") is not None:
+            line += f" · snapshot {jrn['snapshot_age_s']:.0f}s ago"
+        lines.append("")
+        lines.append(line)
+        rpc = master.get("rpc") or {}
+        if rpc:
+            top_rpc = sorted(
+                rpc.items(),
+                key=lambda kv: -(kv[1].get("p99_ms") or 0.0),
+            )[:4]
+            lines.append(
+                "rpc (worst p99): " + "  ".join(
+                    f"{kind}"
+                    f" p50={stats.get('p50_ms', 0.0):g}ms"
+                    f" p99={stats.get('p99_ms', 0.0):g}ms"
+                    f" n={stats.get('count', 0)}"
+                    for kind, stats in top_rpc
+                )
+            )
+        rows = master.get("state_rows") or {}
+        if rows:
+            lines.append(
+                "state rows: " + "  ".join(
+                    f"{kind}={n}"
+                    for kind, n in sorted(rows.items())
+                )
+            )
     profiles = status.get("profiles") or {}
     if profiles:
         lines.append("")

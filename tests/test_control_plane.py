@@ -382,14 +382,14 @@ class TestRollingUpgradeCompat:
 
 class TestParkedWaiterCap:
     def test_saturated_wait_degrades_to_immediate_answer(self):
-        """Past MAX_PARKED_WAITS the master answers a long-poll
-        immediately instead of parking another pool thread — mutation
-        RPCs can always find a worker."""
+        """Past the parked-wait cap (half the pool) the master
+        answers a long-poll immediately instead of parking another
+        pool thread — mutation RPCs can always find a worker."""
         from dlrover_tpu.master.servicer import MasterServicer
 
         servicer = MasterServicer(kv_store=KVStoreService())
-        # exhaust every wait slot
-        for _ in range(MasterServicer.MAX_PARKED_WAITS):
+        # exhaust every wait slot (cap follows the configured pool)
+        for _ in range(servicer.max_parked_waits):
             assert servicer._wait_slots.acquire(blocking=False)
         envelope = msg.Envelope(
             node_id=0,
