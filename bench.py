@@ -656,6 +656,57 @@ def _failover_bench(budget: "BenchBudget" = None) -> dict:
     return out
 
 
+def _run_serving_bench(budget: "BenchBudget" = None) -> dict:
+    """Run scripts/bench_serving.py in a subprocess (its replica
+    workers each hold a jax runtime; isolation keeps them off this
+    process's backend) and return its extras + headline speedup:
+    continuous batching vs the sequential request loop, the QPS
+    latency sweep, replica scaling and the kill-mid-load leg."""
+    if os.getenv("DLROVER_BENCH_SKIP_SERVING"):
+        return {"skipped": True}
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "scripts", "bench_serving.py",
+    )
+    out_file = os.path.join(
+        tempfile.mkdtemp(prefix="dlrover_bench_serving_"), "out.json"
+    )
+    timeout_s = 600
+    if budget is not None:
+        timeout_s = budget.cap_timeout(600, reserve_s=120)
+    cmd = [sys.executable, script, "--out", out_file]
+    if budget is not None and budget.tight(420):
+        cmd += ["--skip_replica_leg", "--requests", "12"]
+    try:
+        proc = subprocess.run(
+            cmd,
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+        parsed = _read_result_file(out_file, proc.stdout)
+        if parsed is not None and parsed.get("value") is not None:
+            out = dict(parsed.get("extras", {}))
+            out["speedup_vs_sequential"] = parsed.get("value")
+            out["vs_serving_bar_2x"] = parsed.get("vs_baseline")
+            return out
+        if parsed is not None:  # the child died mid-run (early stub)
+            return {
+                "error": f"incomplete run (rc={proc.returncode})",
+                "partial": parsed.get("extras"),
+                "stderr_tail": proc.stderr[-500:],
+            }
+        return {
+            "error": f"no JSON output (rc={proc.returncode})",
+            "stderr_tail": proc.stderr[-500:],
+        }
+    except subprocess.TimeoutExpired as e:
+        # the killed child flushes a partial payload per sweep point
+        return {"error": str(e), "partial": _partial_extras(out_file)}
+    except Exception as e:  # noqa: BLE001
+        return {"error": str(e)}
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -773,6 +824,15 @@ def main(argv=None) -> int:
             extras.update(_failover_bench(budget))
         except Exception as e:  # noqa: BLE001
             extras["failover_bench_error"] = str(e)
+        flush_partial(args.out, payload)
+
+        # inference plane: continuous batching vs the sequential
+        # request loop + replica scaling + kill-mid-load
+        # (scripts/bench_serving.py)
+        if budget.tight(180):
+            extras["serving"] = {"skipped": "budget"}
+        else:
+            extras["serving"] = _run_serving_bench(budget)
         flush_partial(args.out, payload)
 
         # continuous attribution leg's overhead: steady step time

@@ -195,6 +195,77 @@ def preempt_drain_grace_s() -> float:
     return env_float(PREEMPT_DRAIN_GRACE_ENV, 5.0)
 
 
+SERVING_ENV = "DLROVER_TPU_SERVING"
+GEN_TIMEOUT_ENV = "DLROVER_TPU_GEN_TIMEOUT_S"
+GEN_CLOSE_TIMEOUT_ENV = "DLROVER_TPU_GEN_CLOSE_TIMEOUT_S"
+GEN_BUCKETS_ENV = "DLROVER_TPU_GEN_BUCKETS"
+GEN_BATCHED_PREFILL_ENV = "DLROVER_TPU_GEN_BATCHED_PREFILL"
+SERVING_DRAIN_ENV = "DLROVER_TPU_SERVING_DRAIN_S"
+
+
+def serving_enabled() -> bool:
+    """Kill-switch for the continuous-batching inference plane
+    (``rl/scheduler.py`` + the multi-replica dispatcher in
+    ``rl/generation_service.py``).  ``DLROVER_TPU_SERVING=0``
+    reproduces today's single-worker request/queue loop exactly
+    (``make_generation_engine`` returns the legacy engine; pinned by
+    tests).  Default: enabled."""
+    return os.getenv(SERVING_ENV, "1").lower() not in (
+        "0", "false", "off",
+    )
+
+
+def gen_timeout_s() -> float:
+    """Per-request response timeout of the cross-process generation
+    engines (was a hard-coded 600 s in
+    ``CrossProcessGenerationEngine.generate``)."""
+    return env_float(GEN_TIMEOUT_ENV, 600.0)
+
+
+def gen_close_timeout_s() -> float:
+    """How long generation-engine ``close()`` waits for the worker's
+    stop handshake / process exit before killing it (the
+    ``DLROVER_TPU_CKPT_CLOSE_TIMEOUT_S`` pattern)."""
+    return env_float(GEN_CLOSE_TIMEOUT_ENV, 30.0)
+
+
+def gen_buckets() -> tuple:
+    """Prompt-length buckets for the generation backends: prompts pad
+    up to the smallest bucket >= their length, so
+    ``JitSamplerBackend`` / ``KVCacheBackend`` compile once per
+    (batch, BUCKET) instead of once per distinct ``[B, P]``.  Causal
+    masking makes the padded result identical to the exact-shape one
+    at any temperature (the batch dim — which shapes the sampler's
+    noise — is never padded).  Unset/empty = exact shapes (today's
+    behavior)."""
+    raw = os.getenv(GEN_BUCKETS_ENV, "")
+    out = []
+    for part in raw.replace(";", ",").split(","):
+        part = part.strip()
+        if part:
+            try:
+                out.append(int(part))
+            except ValueError:
+                continue  # junk entries are ignored, not fatal
+    return tuple(sorted(set(b for b in out if b > 0)))
+
+
+def gen_batched_prefill_enabled() -> bool:
+    """Kill-switch for ``KVCacheBackend``'s batched single-forward
+    prefill; ``0`` restores the one-token-at-a-time ``lax.scan``
+    prefill exactly."""
+    return os.getenv(GEN_BATCHED_PREFILL_ENV, "1").lower() not in (
+        "0", "false", "off",
+    )
+
+
+def serving_drain_grace_s() -> float:
+    """How long a draining serving replica keeps stepping to flush
+    responses before handing unfinished sequences back to the
+    dispatcher (SIGUSR1/SIGTERM drain protocol)."""
+    return env_float(SERVING_DRAIN_ENV, 2.0)
+
+
 PROFILE_ENV = "DLROVER_TPU_PROFILE"
 PROFILE_EVERY_ENV = "DLROVER_TPU_PROFILE_EVERY_N_STEPS"
 CAPTURE_STEPS_ENV = "DLROVER_TPU_CAPTURE_STEPS"

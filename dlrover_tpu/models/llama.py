@@ -534,6 +534,236 @@ def decode_step(
     return logits[:, 0], {"k": new_k, "v": new_v}
 
 
+def prefill(
+    params: Dict,
+    tokens: jnp.ndarray,  # [B, P] prompt tokens
+    cache: Dict,
+    cfg: LlamaConfig,
+) -> Tuple[jnp.ndarray, Dict]:
+    """Batched single-forward prefill: one causal pass over the whole
+    prompt that writes every position's K/V into the cache — the
+    replacement for feeding the prompt one token at a time through
+    ``decode_step`` under ``lax.scan`` (P cached steps -> 1 forward).
+    Returns (logits [B, P, vocab] fp32, cache); callers gather the
+    last *real* position's row to sample the first new token."""
+    dt = cfg.dtype
+    b, p = tokens.shape
+    nh, nkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    x = params["embed"].astype(dt)[tokens]  # [B, P, D]
+    cos, sin = rope_frequencies(cfg, jnp.arange(p))
+
+    def body(x, layer_in):
+        lp, k_cache, v_cache = layer_in
+
+        def proj(a, w):
+            return jnp.matmul(
+                a, w.astype(dt), preferred_element_type=jnp.float32
+            ).astype(dt)
+
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = apply_rope(proj(h, lp["wq"]).reshape(b, p, nh, hd), cos, sin)
+        k = apply_rope(
+            proj(h, lp["wk"]).reshape(b, p, nkv, hd), cos, sin
+        )
+        v = proj(h, lp["wv"]).reshape(b, p, nkv, hd)
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k, (0, 0, 0, 0)
+        )
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v, (0, 0, 0, 0)
+        )
+        attn = dot_product_attention(q, k, v, causal=True)
+        x = x + proj(attn.reshape(b, p, nh * hd), lp["wo"])
+        h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(proj(h, lp["w_gate"]))
+        up = proj(h, lp["w_up"])
+        x = x + proj(gate * up, lp["w_down"])
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, params["lm_head"].astype(dt),
+        preferred_element_type=jnp.float32,
+    )
+    return logits, {"k": new_k, "v": new_v}
+
+
+# ----------------------------------------------- paged (block-table) decode
+
+
+def _apply_rope_rows(x, cos, sin):
+    """x: [B, 1, H, D] single position per row; cos/sin [B, D/2]
+    (each row at its OWN position — the continuous-batching decode
+    case, where slot b sits at position ``positions[b]``)."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    cos = cos[:, None, None, :]
+    sin = sin[:, None, None, :]
+    out1 = x1 * cos - x2 * sin
+    out2 = x2 * cos + x1 * sin
+    return jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)
+
+
+def paged_decode_step(
+    params: Dict,
+    tokens: jnp.ndarray,  # [B] current token per slot
+    pool: Dict,  # {"k","v"}: [L, num_blocks, block_size, KV, D]
+    block_tables: jnp.ndarray,  # [B, max_blocks] int32
+    positions: jnp.ndarray,  # [B] int32 position being decoded per slot
+    active: jnp.ndarray,  # [B] bool: slot holds a live sequence
+    cfg: LlamaConfig,
+) -> Tuple[jnp.ndarray, Dict]:
+    """One continuous-batching decode step: every ACTIVE slot advances
+    its own sequence by one token at its own position.  All shapes are
+    functions of (max_slots, pool geometry) only — admissions and
+    evictions change the *contents* of ``block_tables`` / ``positions``
+    / ``active``, never the program, so this compiles exactly once.
+
+    Inactive lanes write to the null block (id 0) and read garbage
+    that callers discard; their table rows must be zeroed on eviction
+    so a freed block re-issued to another sequence is never gathered
+    through a stale table."""
+    from dlrover_tpu.ops.paged_attention import (
+        paged_decode_attention,
+        write_block_kv,
+    )
+
+    dt = cfg.dtype
+    b = tokens.shape[0]
+    nh, nkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    bs = pool["k"].shape[2]
+    x = params["embed"].astype(dt)[tokens][:, None]  # [B, 1, D]
+    cos, sin = rope_frequencies(cfg, positions)  # [B, hd/2]
+    blk = jnp.where(
+        active,
+        jnp.take_along_axis(
+            block_tables, (positions // bs)[:, None], axis=1
+        )[:, 0],
+        0,
+    )
+    off = jnp.where(active, positions % bs, 0)
+    seq_lens = jnp.where(active, positions + 1, 1)
+
+    def body(x, layer_in):
+        lp, k_pool, v_pool = layer_in
+
+        def proj(a, w):
+            return jnp.matmul(
+                a, w.astype(dt), preferred_element_type=jnp.float32
+            ).astype(dt)
+
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = _apply_rope_rows(
+            proj(h, lp["wq"]).reshape(b, 1, nh, hd), cos, sin
+        )
+        k = _apply_rope_rows(
+            proj(h, lp["wk"]).reshape(b, 1, nkv, hd), cos, sin
+        )
+        v = proj(h, lp["wv"]).reshape(b, 1, nkv, hd)
+        k_pool, v_pool = write_block_kv(
+            k_pool, v_pool, k[:, 0], v[:, 0], blk, off
+        )
+        attn = paged_decode_attention(
+            q[:, 0], k_pool, v_pool, block_tables, seq_lens
+        )
+        x = x + proj(attn.reshape(b, 1, nh * hd), lp["wo"])
+        h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(proj(h, lp["w_gate"]))
+        up = proj(h, lp["w_up"])
+        x = x + proj(gate * up, lp["w_down"])
+        return x, (k_pool, v_pool)
+
+    x, (new_k, new_v) = lax.scan(
+        body, x, (params["layers"], pool["k"], pool["v"])
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, params["lm_head"].astype(dt),
+        preferred_element_type=jnp.float32,
+    )
+    return logits[:, 0], {"k": new_k, "v": new_v}
+
+
+def paged_prefill_chunk(
+    params: Dict,
+    tokens: jnp.ndarray,  # [1, C] one sequence's prompt chunk
+    pool: Dict,  # {"k","v"}: [L, num_blocks, block_size, KV, D]
+    block_table: jnp.ndarray,  # [max_blocks] int32
+    start_pos: jnp.ndarray,  # scalar int32: chunk's first position
+    cfg: LlamaConfig,
+) -> Tuple[jnp.ndarray, Dict]:
+    """Prefill C prompt positions of ONE sequence into its paged
+    blocks (fixed chunk shape — a long prompt runs as several chunks
+    interleaved with other sequences' decode steps, so it can never
+    stall them).  Padded tail positions write ahead of the prompt into
+    the sequence's own reservation; decode overwrites each position
+    before it becomes visible, so the garbage is never attended.
+    Returns (logits [1, C, vocab], pool)."""
+    from dlrover_tpu.ops.paged_attention import (
+        paged_prefill_attention,
+        write_block_kv,
+    )
+
+    dt = cfg.dtype
+    b, c = tokens.shape
+    nh, nkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    bs = pool["k"].shape[2]
+    positions = start_pos + jnp.arange(c)  # [C]
+    x = params["embed"].astype(dt)[tokens]  # [1, C, D]
+    cos, sin = rope_frequencies(cfg, positions)
+    # a padded chunk tail can run past the table: route those writes
+    # to the null block explicitly — a clamped gather would alias the
+    # sequence's LAST real block and let pad garbage race real K/V
+    blk_idx = positions // bs
+    mb = block_table.shape[0]
+    blks = jnp.where(
+        blk_idx < mb,
+        block_table[jnp.minimum(blk_idx, mb - 1)],
+        0,
+    )  # [C]
+    offs = positions % bs
+
+    def body(x, layer_in):
+        lp, k_pool, v_pool = layer_in
+
+        def proj(a, w):
+            return jnp.matmul(
+                a, w.astype(dt), preferred_element_type=jnp.float32
+            ).astype(dt)
+
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = apply_rope(proj(h, lp["wq"]).reshape(b, c, nh, hd), cos, sin)
+        k = apply_rope(
+            proj(h, lp["wk"]).reshape(b, c, nkv, hd), cos, sin
+        )
+        v = proj(h, lp["wv"]).reshape(b, c, nkv, hd)
+        k_pool, v_pool = write_block_kv(
+            k_pool, v_pool, k[0], v[0], blks, offs
+        )
+        attn = paged_prefill_attention(
+            q[0], k_pool, v_pool, block_table, start_pos
+        )
+        x = x + proj(attn[None].reshape(b, c, nh * hd), lp["wo"])
+        h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(proj(h, lp["w_gate"]))
+        up = proj(h, lp["w_up"])
+        x = x + proj(gate * up, lp["w_down"])
+        return x, (k_pool, v_pool)
+
+    x, (new_k, new_v) = lax.scan(
+        body, x, (params["layers"], pool["k"], pool["v"])
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, params["lm_head"].astype(dt),
+        preferred_element_type=jnp.float32,
+    )
+    return logits, {"k": new_k, "v": new_v}
+
+
 # fused CE kicks in for real vocabularies; tiny test configs keep the
 # dense form so the loss is bit-identical to the naive reference
 _FUSED_CE_MIN_VOCAB = 8192

@@ -114,6 +114,15 @@ PHASE_RESTART = "restart"
 # ledger attribution; only standalone profiler overhead (trace
 # start/stop outside a step span) surfaces as its own bucket.
 PHASE_STEP_PROFILE = "step_profile"
+# the inference plane (rl/scheduler.py + the multi-replica serving
+# workers): one ``serve_step`` span per scheduler iteration, with
+# ``prefill`` (prompt-chunk) and ``decode`` (token-step) legs inside
+# it.  Serving processes run no train steps, so these never contend
+# with ``step`` for attribution; they rank just below step_profile so
+# a trainer-co-located rollout keeps its training attribution.
+PHASE_SERVE_STEP = "serve_step"
+PHASE_PREFILL = "prefill"
+PHASE_DECODE = "decode"
 # client-side control-plane wait (a long-poll RPC parked on the
 # master, or the legacy polling loop it replaces).  LOWEST priority:
 # these waits are almost always nested inside rendezvous/restart
@@ -139,6 +148,9 @@ PHASES: Tuple[str, ...] = (
     PHASE_RESTART_PATH,
     PHASE_RESTART,
     PHASE_STEP_PROFILE,
+    PHASE_SERVE_STEP,
+    PHASE_PREFILL,
+    PHASE_DECODE,
     PHASE_CONTROL_WAIT,
 )
 
@@ -257,6 +269,17 @@ REQUIRED_SPAN_LABELS: Dict[str, Tuple[str, ...]] = {
     # status) so rendezvous-bootstrap waits and shard starvation stay
     # distinguishable in the ledger
     PHASE_CONTROL_WAIT: ("kind",),
+    # the serving loop's per-iteration record: prompt tokens
+    # prefilled + tokens sampled + the iteration's token throughput —
+    # without them a serve_step is an unactionable blip, with them
+    # the trace alone answers "why did tokens/s dip" (prefill-heavy
+    # interval vs starved slots)
+    PHASE_SERVE_STEP: ("tokens", "new_tokens", "throughput_tps"),
+    # a prefill leg without its chunk size can't distinguish a long
+    # prompt's chunks from a trivial one
+    PHASE_PREFILL: ("tokens",),
+    # a decode leg's sampled-token count IS its progress record
+    PHASE_DECODE: ("new_tokens",),
 }
 
 

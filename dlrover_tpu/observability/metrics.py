@@ -356,6 +356,52 @@ def record_input_io(stage: str, nbytes: int, seconds: float):
         logger.warning("input io metric export failed: %s", e)
 
 
+def record_serving(
+    replica: str,
+    tokens_per_s=None,
+    queue_depth=None,
+    kv_blocks_used=None,
+    p99_latency_s=None,
+):
+    """Export one serving-plane snapshot as gauges
+    (``dlrover_tpu_serving_*{replica=...}``): generation throughput,
+    dispatch/admission queue depth, paged-KV pool occupancy and the
+    dispatcher-side end-to-end p99 — the four numbers the serving
+    pane in ``scripts/top.py`` and ``bench_serving.py`` key on.
+    ``None`` fields are skipped (replicas know their pool, only the
+    dispatcher knows fleet latency).  Never raises — metrics must not
+    break the serving loop."""
+    try:
+        reg = get_registry()
+        labels = {"replica": replica}
+        if tokens_per_s is not None:
+            reg.set_gauge(
+                "dlrover_tpu_serving_tokens_per_s",
+                float(tokens_per_s),
+                labels=labels,
+            )
+        if queue_depth is not None:
+            reg.set_gauge(
+                "dlrover_tpu_serving_queue_depth",
+                float(queue_depth),
+                labels=labels,
+            )
+        if kv_blocks_used is not None:
+            reg.set_gauge(
+                "dlrover_tpu_serving_kv_blocks_used",
+                float(kv_blocks_used),
+                labels=labels,
+            )
+        if p99_latency_s is not None:
+            reg.set_gauge(
+                "dlrover_tpu_serving_p99_latency",
+                float(p99_latency_s),
+                labels=labels,
+            )
+    except Exception as e:  # noqa: BLE001
+        logger.warning("serving metric export failed: %s", e)
+
+
 def record_offload_io(nbytes: int, seconds: float, buffered: bool):
     """Export one host-offload chunk-stream measurement as gauges
     (``dlrover_tpu_offload_gbps{buffered=...}`` / ``_bytes``): the

@@ -1,0 +1,135 @@
+"""Paged (block-table) KV attention for continuous-batching decode.
+
+Reference parity: vLLM's PagedAttention — the serving-side dual of the
+flash kernels next door.  The KV cache is a pool of fixed-size blocks
+(``[num_blocks, block_size, KV, head_dim]`` per layer); a sequence owns
+a list of block ids (its *block table*) instead of a contiguous slab,
+so admission/eviction churn never copies or fragments cache memory.
+
+Two ops, both pure-jnp reference implementations that run on CPU CI:
+
+- :func:`paged_decode_attention` — one query token per sequence
+  (``[B, H, D]``) over each sequence's paged prefix; the decode-hot op.
+- :func:`paged_prefill_attention` — a chunk of C query tokens for ONE
+  sequence over its paged prefix (causal within the chunk); the
+  chunked-prefill op.
+
+Layout contract (Pallas-friendly, so a Mosaic kernel can swap in
+without touching callers): ``head_dim`` is the minormost (lane) axis,
+``block_size`` the sublane axis of each block — a block is a
+``[block_size, KV, head_dim]`` contiguous tile, and a kernel grid over
+(sequence, block-table entry) streams exactly one tile per step, the
+same shape the flash kernels tile at 128-aligned boundaries.  The
+gather here (``pool[tables]``) is the reference semantics of that
+grid; on TPU the kernel would DMA blocks VMEM-resident instead of
+materializing the gathered ``[B, T, KV, D]`` intermediate.
+
+Masking contract: key position ``t`` is visible iff ``t < seq_len``
+(decode) / ``t <= query_pos`` (prefill).  Block 0 is the NULL block —
+schedulers point unallocated table entries and inactive lanes at it;
+its contents are garbage by design and every read of it is masked.
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _gather_pool(pool: jnp.ndarray, tables: jnp.ndarray) -> jnp.ndarray:
+    """``[num_blocks, bs, KV, D]`` gathered by ``[..., max_blocks]``
+    tables -> ``[..., max_blocks * bs, KV, D]`` (the logical
+    contiguous view of each sequence's paged cache)."""
+    g = pool[tables]  # [..., MB, bs, KV, D]
+    shape = g.shape[:-4] + (g.shape[-4] * g.shape[-3],) + g.shape[-2:]
+    return g.reshape(shape)
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,  # [B, H, D] one query token per sequence
+    k_pool: jnp.ndarray,  # [num_blocks, block_size, KV, D]
+    v_pool: jnp.ndarray,  # [num_blocks, block_size, KV, D]
+    block_tables: jnp.ndarray,  # [B, max_blocks] int32 block ids
+    seq_lens: jnp.ndarray,  # [B] int32: valid positions per sequence
+) -> jnp.ndarray:
+    """Single-token GQA attention over each sequence's paged prefix.
+
+    Returns ``[B, H, D]``.  fp32 logits/softmax accumulation (the MXU
+    contract the dense kernels follow); masked lanes contribute
+    exactly zero weight, so garbage in unallocated/null blocks can
+    never leak into the output.
+    """
+    b, nh, d = q.shape
+    nkv = k_pool.shape[2]
+    group = nh // nkv
+    k = _gather_pool(k_pool, block_tables)  # [B, T, KV, D]
+    v = _gather_pool(v_pool, block_tables)
+    t = k.shape[1]
+    qg = q.reshape(b, nkv, group, d)
+    logits = jnp.einsum(
+        "bkgd,btkd->bkgt", qg, k, preferred_element_type=jnp.float32
+    ) * (d**-0.5)
+    valid = jnp.arange(t)[None] < seq_lens[:, None]  # [B, T]
+    logits = jnp.where(valid[:, None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum(
+        "bkgt,btkd->bkgd",
+        probs.astype(v.dtype),
+        v,
+        preferred_element_type=jnp.float32,
+    ).astype(v.dtype)
+    return out.reshape(b, nh, d)
+
+
+def paged_prefill_attention(
+    q: jnp.ndarray,  # [C, H, D] chunk of query tokens, one sequence
+    k_pool: jnp.ndarray,  # [num_blocks, block_size, KV, D]
+    v_pool: jnp.ndarray,  # [num_blocks, block_size, KV, D]
+    block_table: jnp.ndarray,  # [max_blocks] int32: ONE sequence's table
+    start_pos: jnp.ndarray,  # scalar int32: chunk's first position
+) -> jnp.ndarray:
+    """Chunked-prefill attention: query position ``start_pos + i``
+    attends keys at positions ``<= start_pos + i`` (cached prefix +
+    causal within the chunk).  The chunk's K/V must already be written
+    into the pool.  Returns ``[C, H, D]``."""
+    c, nh, d = q.shape
+    nkv = k_pool.shape[2]
+    group = nh // nkv
+    k = _gather_pool(k_pool, block_table)  # [T, KV, D]
+    v = _gather_pool(v_pool, block_table)
+    t = k.shape[0]
+    qg = q.reshape(c, nkv, group, d)
+    logits = jnp.einsum(
+        "ckgd,tkd->ckgt", qg, k, preferred_element_type=jnp.float32
+    ) * (d**-0.5)
+    q_pos = start_pos + jnp.arange(c)  # [C]
+    visible = jnp.arange(t)[None] <= q_pos[:, None]  # [C, T]
+    logits = jnp.where(visible[:, None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum(
+        "ckgt,tkd->ckgd",
+        probs.astype(v.dtype),
+        v,
+        preferred_element_type=jnp.float32,
+    ).astype(v.dtype)
+    return out.reshape(c, nh, d)
+
+
+def write_block_kv(
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    k_new: jnp.ndarray,  # [N, KV, D] one token's K per write
+    v_new: jnp.ndarray,
+    block_ids: jnp.ndarray,  # [N] int32 destination block per token
+    offsets: jnp.ndarray,  # [N] int32 in-block slot per token
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter N tokens' K/V into their (block, offset) cells.
+
+    Callers route masked-out writes (inactive lanes, padded chunk
+    tail) to the null block (id 0) — concurrent lanes may collide
+    there, which is fine: null-block contents are never unmasked."""
+    k_pool = k_pool.at[block_ids, offsets].set(k_new)
+    v_pool = v_pool.at[block_ids, offsets].set(v_new)
+    return k_pool, v_pool

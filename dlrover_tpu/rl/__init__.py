@@ -11,3 +11,14 @@ from dlrover_tpu.rl.inference import (  # noqa: F401
     KVCacheBackend,
 )
 from dlrover_tpu.rl.trainer import RLHFTrainer  # noqa: F401
+from dlrover_tpu.rl.kv_cache import (  # noqa: F401
+    BlockPool,
+    PagedCacheConfig,
+    init_block_pool,
+)
+from dlrover_tpu.rl.scheduler import (  # noqa: F401
+    ContinuousBatchingScheduler,
+    GenRequest,
+    GenResult,
+    SchedulerConfig,
+)

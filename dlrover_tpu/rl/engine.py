@@ -66,10 +66,19 @@ class ModelEngine:
     ):
         """Jitted autoregressive sampler on the actor (no KV cache —
         fine for short RLHF responses; a cached decoder can swap in
-        without changing callers)."""
+        without changing callers).
 
-        def sample(params, prompt, rng):
-            b, plen = prompt.shape
+        ``prompt_len`` (optional traced scalar) is the REAL prompt
+        length when ``prompt`` is padded to a length bucket
+        (``DLROVER_TPU_GEN_BUCKETS``): sampling starts there, and
+        causal attention keeps the padded tail invisible to every
+        sampled position."""
+
+        def sample(params, prompt, rng, prompt_len=None):
+            b, padded_len = prompt.shape
+            # shapes come from the (possibly padded) static length;
+            # only the sampling START position is traced
+            start = padded_len if prompt_len is None else prompt_len
 
             def step(carry, _):
                 tokens, cur_len, rng = carry
@@ -93,10 +102,10 @@ class ModelEngine:
                 )(tokens, cur_len, nxt)
                 return (tokens, cur_len + 1, rng), nxt
 
-            total = plen + max_new_tokens
+            total = padded_len + max_new_tokens
             padded = jnp.zeros((b, total), dtype=prompt.dtype)
-            padded = padded.at[:, :plen].set(prompt)
-            cur = jnp.full((b,), plen, dtype=jnp.int32)
+            padded = padded.at[:, :padded_len].set(prompt)
+            cur = jnp.full((b,), start, dtype=jnp.int32)
             (tokens, _, _), _ = jax.lax.scan(
                 step, (padded, cur, rng), None, length=max_new_tokens
             )

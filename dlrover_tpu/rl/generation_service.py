@@ -1,4 +1,5 @@
-"""Cross-process RLHF generation engine.
+"""Cross-process generation: the legacy single-worker engine and the
+continuous-batching multi-replica serving plane.
 
 Reference parity: ``atorch/atorch/rl/inference_backend/
 vllm_backend.py`` — actor weights are SHIPPED to a dedicated vLLM
@@ -10,32 +11,62 @@ serving engine, not pointer-shared — plus ``rl/ds_hybrid_engine/``
 - actor weights travel over the flash-checkpoint shm substrate
   (``agent/ckpt_shm.SharedMemoryHandler``: double-buffered segment +
   SharedDict meta) — the same zero-extra-infrastructure path training
-  snapshots already ride, so a policy update is ONE ``save_state``;
+  snapshots already ride, so a policy update is ONE ``save_state``
+  and N replicas adopt it from ONE segment (fan-out by attach, not
+  by copy);
 - train->inference RESHARDING happens at restore: the worker's params
   template carries the inference shardings, and
   ``restore_to_target`` device_puts every leaf onto them in one
-  batched call (train-side layouts never leak into the generator);
-- requests/responses ride ``common/multi_process.SharedQueue``
-  (unix-socket, crash-isolated), and every response carries the
-  serving stats the reference's engine exposes: weight-handoff
-  latency, generation seconds, tokens/s, weight version.
+  batched call (train-side layouts never leak into the generator).
 
-The in-process backends (``rl/inference.py``) remain for co-located
-generation; this module is the serving-engine form.
+Two serving shapes share this module:
+
+- :class:`CrossProcessGenerationEngine` — the legacy single-worker
+  request/queue loop (one whole batch to completion per request).
+  ``DLROVER_TPU_SERVING=0`` pins exactly this path.
+- :class:`ServingEngine` — N replica workers, each running the
+  token-level continuous-batching scheduler (``rl/scheduler.py``)
+  over a paged KV cache, behind a dispatcher with per-replica
+  shm-ring request/response transport (the PR-4 zero-copy path —
+  prompts and sampled tokens never pickle through a socket).
+  Replicas are first-class elastic workloads: SIGUSR1/SIGTERM drains
+  a replica (unfinished sequences requeue onto survivors — sampling
+  is (seed, position)-pure, so a requeued tail is the same tail), a
+  SIGKILL'd replica's in-flight requests redispatch automatically,
+  and completions dedup by request id so every request finishes
+  exactly once.  ``make_generation_engine`` picks the shape from the
+  environment.
 """
 
 import json
 import os
+import signal
 import subprocess
 import sys
+import threading
 import time
-from typing import Callable, Dict, Optional
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from dlrover_tpu.common.env import (
+    gen_close_timeout_s,
+    gen_timeout_s,
+    serving_enabled,
+)
 from dlrover_tpu.common.log import default_logger as logger
 
 WORKER_SPEC_ENV = "DLROVER_TPU_GEN_SPEC"
+
+# response-ring message kinds
+_KIND_RESULT = 0
+_KIND_READY = 1
+_KIND_DRAINED = 2
+_KIND_STATS = 3
+_FINISH_CODES = {"length": 0, "eos": 1}
+_FINISH_NAMES = {v: k for k, v in _FINISH_CODES.items()}
 
 
 def _import_factory(path: str) -> Callable:
@@ -54,8 +85,10 @@ def tiny_llama_factory(**cfg_kwargs):
     """Built-in factory: a llama sampler whose config comes from the
     spec (tests / example).  Returns the worker contract:
     ``forward_fn``, ``params_template_fn`` (inference-sharded params
-    the shm snapshot restores ONTO)."""
+    the shm snapshot restores ONTO) and ``cfg`` (the model config the
+    serving scheduler builds its paged decode programs from)."""
     import jax
+    import jax.numpy as jnp
 
     from dlrover_tpu.models.llama import (
         LlamaConfig,
@@ -63,6 +96,11 @@ def tiny_llama_factory(**cfg_kwargs):
         init_params,
     )
 
+    if isinstance(cfg_kwargs.get("dtype"), str):
+        # the spec rides through JSON: dtype arrives as a name
+        cfg_kwargs = dict(
+            cfg_kwargs, dtype=jnp.dtype(cfg_kwargs["dtype"])
+        )
     cfg = LlamaConfig(**cfg_kwargs)
 
     def forward_fn(params, tokens):
@@ -77,12 +115,16 @@ def tiny_llama_factory(**cfg_kwargs):
     return {
         "forward_fn": forward_fn,
         "params_template_fn": params_template_fn,
+        "cfg": cfg,
     }
 
 
-def worker_main() -> int:
-    """Generation-process entry (``python -m
-    dlrover_tpu.rl.generation_service``); spec arrives via env."""
+# --------------------------------------------------------------------------
+# legacy single-worker loop (DLROVER_TPU_SERVING=0 pins this path)
+# --------------------------------------------------------------------------
+
+
+def _legacy_worker_loop(spec) -> int:
     import jax
     import jax.numpy as jnp
 
@@ -93,7 +135,6 @@ def worker_main() -> int:
     from dlrover_tpu.common.multi_process import SharedQueue
     from dlrover_tpu.rl.inference import JitSamplerBackend
 
-    spec = json.loads(os.environ[WORKER_SPEC_ENV])
     name = spec["name"]
     factory = _import_factory(spec["factory"])
     parts = factory(**spec.get("factory_kwargs", {}))
@@ -161,6 +202,15 @@ def worker_main() -> int:
         except Exception as e:  # noqa: BLE001 - per-request isolation
             logger.error("generation request failed: %s", e)
             resp.put({"error": f"{type(e).__name__}: {e}"})
+
+
+def worker_main() -> int:
+    """Generation-process entry (``python -m
+    dlrover_tpu.rl.generation_service``); spec arrives via env."""
+    spec = json.loads(os.environ[WORKER_SPEC_ENV])
+    if spec.get("mode") == "serve":
+        return _serving_worker_loop(spec)
+    return _legacy_worker_loop(spec)
 
 
 class CrossProcessGenerationEngine:
@@ -251,7 +301,7 @@ class CrossProcessGenerationEngine:
                 "seed": int(seed),
             }
         )
-        out = self._get_response(timeout=600.0)
+        out = self._get_response(timeout=gen_timeout_s())
         if "error" in out:
             raise RuntimeError(out["error"])
         self.last_stats = {
@@ -294,19 +344,900 @@ class CrossProcessGenerationEngine:
                     ) from None
 
     def close(self):
+        timeout = gen_close_timeout_s()
         try:
             self._req.put({"cmd": "stop"})
-            self._resp.get(timeout=30.0)
+            self._resp.get(timeout=timeout)
         except Exception:  # noqa: BLE001 - worker may be dead already
             pass
         if self._proc.poll() is None:
             try:
-                self._proc.wait(timeout=30.0)
+                self._proc.wait(timeout=timeout)
             except subprocess.TimeoutExpired:
                 self._proc.kill()
         self._shm.close(unlink=True)
         self._req.close()
         self._resp.close()
+
+
+# --------------------------------------------------------------------------
+# shm-ring transport (PR-4 zero-copy path, serving-sized slots)
+# --------------------------------------------------------------------------
+
+
+def _req_spec(max_prompt: int):
+    from dlrover_tpu.data.shm_dataloader import BatchSpec
+
+    return BatchSpec(
+        {
+            # req_id, prompt_len, max_new, seed
+            "meta": ((4,), "<i8"),
+            "prompt": ((max_prompt,), "<i4"),
+        }
+    )
+
+
+def _resp_spec(max_total: int):
+    from dlrover_tpu.data.shm_dataloader import BatchSpec
+
+    return BatchSpec(
+        {
+            # req_id, kind, total_len, new_tokens, finish_code, version
+            "meta": ((6,), "<i8"),
+            "tokens": ((max_total,), "<i4"),
+            # latency_s, ttft_s, worker_gen_s, tokens_per_s
+            "times": ((4,), "<f8"),
+        }
+    )
+
+
+class _Ring:
+    """Single-writer single-reader fixed-slot message ring over the
+    PR-4 shm substrate (``data/shm_dataloader._ShmRing``): prompts and
+    token tails move as zero-copy numpy views, never pickled.
+
+    The slot protocol (FREE -> WRITING -> fence -> FULL) intentionally
+    mirrors ``ShmBatchWriter.put`` / ``ShmDataLoader.next_batch``;
+    those classes assume the CONSUMER creates the ring and block on
+    reads, while serving needs creator-side writers, attach-side
+    readers and non-blocking polls on both ends — if the dataloader
+    grows those seams this wrapper should collapse into it."""
+
+    def __init__(self, name: str, spec=None, num_slots: int = 8,
+                 create: bool = False):
+        from dlrover_tpu.data import shm_dataloader as sd
+
+        if create:
+            self._ring = sd._ShmRing(name, spec, num_slots, create=True)
+        else:
+            self._ring = sd._attach_ring(name)
+        self._next_w = 0
+        self._next_r = 0
+
+    def try_put(self, msg: Dict[str, np.ndarray],
+                timeout: float = 0.0) -> bool:
+        from dlrover_tpu.data import shm_dataloader as sd
+
+        slot = self._next_w
+        deadline = time.monotonic() + timeout
+        delay = 0.0002
+        while self._ring.slot_state(slot) != sd.SLOT_FREE:
+            if time.monotonic() >= deadline:
+                return False
+            delay = sd._backoff_sleep(delay)
+        self._ring.set_slot_state(slot, sd.SLOT_WRITING)
+        self._ring.write_slot(slot, msg)
+        sd._memory_fence()
+        self._ring.set_slot_state(slot, sd.SLOT_FULL)
+        self._next_w = (slot + 1) % self._ring.num_slots
+        return True
+
+    def try_get(self) -> Optional[Dict[str, np.ndarray]]:
+        from dlrover_tpu.data import shm_dataloader as sd
+
+        slot = self._next_r
+        if self._ring.slot_state(slot) != sd.SLOT_FULL:
+            return None
+        sd._memory_fence()
+        msg = self._ring.read_slot(slot, copy=True)
+        self._ring.set_slot_state(slot, sd.SLOT_FREE)
+        self._next_r = (slot + 1) % self._ring.num_slots
+        return msg
+
+    def close(self, unlink: bool = False):
+        self._ring.close(unlink=unlink)
+
+
+# --------------------------------------------------------------------------
+# serving replica worker
+# --------------------------------------------------------------------------
+
+
+def _serving_worker_loop(spec) -> int:
+    """One continuous-batching replica: shm-ring requests in, shm-ring
+    responses out, weights adopted from the shared publish segment,
+    SIGUSR1/SIGTERM = drain (stop admitting, hand unfinished
+    sequences back to the dispatcher by exiting cleanly — the
+    dispatcher requeues everything it never saw complete)."""
+    import jax
+
+    from dlrover_tpu.agent.ckpt_shm import (
+        SharedMemoryHandler,
+        restore_to_target,
+    )
+    from dlrover_tpu.observability.events import get_event_logger
+    from dlrover_tpu.observability.metrics import record_serving
+    from dlrover_tpu.rl.scheduler import (
+        ContinuousBatchingScheduler,
+        SchedulerConfig,
+    )
+
+    name = spec["name"]
+    replica = int(spec["replica"])
+    tag = f"{name}-r{replica}"
+    drain = {"flag": False, "reason": ""}
+
+    def _on_signal(signum, _frame):
+        drain["flag"] = True
+        drain["reason"] = signal.Signals(signum).name
+
+    for sig in (signal.SIGUSR1, signal.SIGTERM):
+        signal.signal(sig, _on_signal)
+
+    factory = _import_factory(spec["factory"])
+    parts = factory(**spec.get("factory_kwargs", {}))
+    cfg = parts.get("cfg")
+    if cfg is None:
+        raise RuntimeError(
+            "serving mode needs the factory to expose 'cfg' (the "
+            "model config the paged decode programs build from)"
+        )
+    s = spec["sched"]
+    scheduler = ContinuousBatchingScheduler(
+        cfg,
+        SchedulerConfig(
+            max_slots=int(s["max_slots"]),
+            block_size=int(s["block_size"]),
+            num_blocks=int(s["num_blocks"]),
+            max_seq_len=int(s["max_seq_len"]),
+            prefill_chunk=int(s["prefill_chunk"]),
+            max_new_default=int(s["max_new_default"]),
+            temperature=float(s["temperature"]),
+            eos_id=s.get("eos_id"),
+        ),
+        paged_decode_fn=parts.get("paged_decode_fn"),
+        paged_prefill_fn=parts.get("paged_prefill_fn"),
+        events=get_event_logger(),
+    )
+    template = parts["params_template_fn"]()
+    scheduler.sync_weights(template)
+
+    shm = SharedMemoryHandler(rank=0, name=name)
+    req_ring = _Ring(f"{tag}-req")
+    resp_ring = _Ring(f"{tag}-resp")
+    max_total = int(s["max_seq_len"])
+    version = -1
+
+    def _adopt_weights():
+        nonlocal version, template
+        try:
+            step = shm.get_step()
+        except Exception:  # noqa: BLE001 - nothing published yet
+            return
+        if step <= version:
+            return
+        step, arrays = shm.load_state(copy=False)
+        template = restore_to_target(
+            template, arrays, to_device=True, copy_host=True
+        )
+        jax.block_until_ready(template)
+        scheduler.sync_weights(template)
+        version = step
+        del arrays
+
+    parent_pid = os.getppid()
+
+    def _respond(kind: int, req_id: int = -1, tokens=None,
+                 new_tokens: int = 0, finish: str = "length",
+                 times=(0.0, 0.0, 0.0, 0.0)):
+        """Publish one message; a RESULT must never be silently
+        dropped (the dispatcher would block its caller for the full
+        request timeout on a request whose compute finished), so a
+        full ring WAITS for the dispatcher to drain — giving up only
+        when the dispatcher process itself is gone (we are orphaned
+        and about to exit anyway).  STATS are best-effort."""
+        total = 0 if tokens is None else int(tokens.size)
+        buf = np.zeros((max_total,), np.int32)
+        if tokens is not None:
+            buf[:total] = tokens
+        msg = {
+            "meta": np.asarray(
+                [req_id, kind, total, new_tokens,
+                 _FINISH_CODES.get(finish, 0), version],
+                np.int64,
+            ),
+            "tokens": buf,
+            "times": np.asarray(times, np.float64),
+        }
+        while True:
+            if resp_ring.try_put(
+                msg, timeout=0.0 if kind == _KIND_STATS else 5.0
+            ):
+                return True
+            if kind == _KIND_STATS:
+                return False  # periodic; the next window resends
+            if os.getppid() != parent_pid:
+                logger.warning(
+                    "replica %s orphaned (dispatcher gone): "
+                    "dropping message for req %d", tag, req_id,
+                )
+                return False
+            logger.warning(
+                "replica %s: response ring full, waiting for the "
+                "dispatcher to drain", tag,
+            )
+
+    def _flush_result(res):
+        _respond(
+            _KIND_RESULT,
+            req_id=res.req_id,
+            tokens=res.tokens,
+            new_tokens=res.new_tokens,
+            finish=res.finish_reason,
+            times=(
+                res.latency_s,
+                res.stats.get("ttft_s", 0.0),
+                res.latency_s,
+                res.new_tokens / max(res.latency_s, 1e-9),
+            ),
+        )
+
+    _respond(_KIND_READY)
+    logger.info("serving replica %s ready (pid %d)", tag, os.getpid())
+    served = 0
+    window_tokens = 0
+    window_t0 = time.monotonic()
+    while True:
+        if drain["flag"]:
+            break
+        _adopt_weights()
+        # admit everything queued on the ring (token-level admission
+        # happens inside the scheduler)
+        while True:
+            msg = req_ring.try_get()
+            if msg is None:
+                break
+            req_id, plen, max_new, seed = (
+                int(v) for v in msg["meta"]
+            )
+            scheduler.submit(
+                msg["prompt"][:plen],
+                max_new=max_new,
+                seed=seed,
+                req_id=req_id,
+            )
+        if scheduler.idle:
+            time.sleep(0.002)
+            continue
+        for res in scheduler.step():
+            served += 1
+            window_tokens += res.new_tokens
+            _flush_result(res)
+        now = time.monotonic()
+        if now - window_t0 >= 1.0:
+            tps = window_tokens / (now - window_t0)
+            record_serving(
+                replica=tag,
+                tokens_per_s=tps,
+                queue_depth=scheduler.queue_depth,
+                kv_blocks_used=scheduler.block_pool.used_blocks,
+            )
+            # the dispatcher-side serving pane reads the same three
+            # numbers off the response ring (best-effort)
+            _respond(
+                _KIND_STATS,
+                times=(
+                    tps,
+                    float(scheduler.queue_depth),
+                    float(scheduler.block_pool.used_blocks),
+                    0.0,
+                ),
+            )
+            window_tokens = 0
+            window_t0 = now
+
+    # drain: stop admitting, flush what finishes inside the grace
+    # window (their compute is not thrown away), then hand the rest
+    # back to the dispatcher (it requeues everything not seen
+    # complete); tell it we left cleanly
+    from dlrover_tpu.common.env import serving_drain_grace_s
+
+    scheduler.draining = True
+    grace_deadline = time.monotonic() + serving_drain_grace_s()
+    while (
+        scheduler.active_count and time.monotonic() < grace_deadline
+    ):
+        for res in scheduler.step():
+            served += 1
+            _flush_result(res)
+    requeued = scheduler.drain()
+    _respond(_KIND_DRAINED, new_tokens=len(requeued))
+    logger.info(
+        "serving replica %s drained on %s: served %d, handed back %d",
+        tag, drain["reason"], served, len(requeued),
+    )
+    req_ring.close()
+    resp_ring.close()
+    shm.close()
+    return 0
+
+
+# --------------------------------------------------------------------------
+# dispatcher
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class _InFlight:
+    req_id: int
+    prompt: np.ndarray
+    max_new: int
+    seed: int
+    submit_t: float
+    done: threading.Event = field(default_factory=threading.Event)
+    result: Optional[Dict] = None
+    attempts: int = 0
+
+
+class _Replica:
+    def __init__(self, idx: int, proc, req_ring: _Ring,
+                 resp_ring: _Ring):
+        self.idx = idx
+        self.proc = proc
+        self.req_ring = req_ring
+        self.resp_ring = resp_ring
+        self.outstanding: Dict[int, _InFlight] = {}
+        self.ready = False
+        self.alive = True
+        self.draining = False  # signaled; stop routing to it
+        self.drained = False  # clean-handshake confirmation arrived
+        self.stats: Dict = {}  # newest _KIND_STATS payload
+
+
+class ServingEngine:
+    """The continuous-batching serving plane: N replicas behind a
+    dispatcher.  ``submit``/``result`` is the streaming surface;
+    ``generate`` keeps the legacy whole-batch surface so PPO rollouts
+    and ``examples/generate.py --serve`` swap engines without edits.
+
+    Elasticity: ``drain_replica`` (SIGUSR1) / ``close`` (SIGTERM)
+    drain; a replica that dies ANY way hands its uncompleted requests
+    back to the dispatch queue, completions dedup by request id, and
+    a request that kills ``max_attempts`` replicas in a row fails
+    loudly instead of poisoning the fleet forever."""
+
+    MAX_ATTEMPTS = 3
+
+    def __init__(
+        self,
+        factory: str,
+        max_new_tokens: int,
+        temperature: float = 1.0,
+        factory_kwargs: Optional[Dict] = None,
+        name: Optional[str] = None,
+        num_replicas: int = 2,
+        max_slots: int = 8,
+        block_size: int = 16,
+        num_blocks: int = 512,
+        max_seq_len: int = 512,
+        prefill_chunk: int = 32,
+        eos_id: Optional[int] = None,
+        start_timeout: float = 300.0,
+        ring_slots: int = 8,
+    ):
+        from dlrover_tpu.agent.ckpt_shm import SharedMemoryHandler
+        from dlrover_tpu.common.multi_process import SOCKET_DIR_ENV
+        from dlrover_tpu.observability.metrics import Histogram
+
+        self._name = name or f"serve-{os.getpid()}"
+        # pin the socket namespace for the engine's whole lifetime: a
+        # replica added LATER (scale-out) must land its ring handshake
+        # where the existing fleet's sockets live, even if the
+        # environment moved underneath us
+        self._socket_dir = os.getenv(SOCKET_DIR_ENV, "")
+        self._max_new = int(max_new_tokens)
+        self._max_seq_len = int(max_seq_len)
+        self._shm = SharedMemoryHandler(
+            rank=0, name=self._name, host=True
+        )
+        self._version = 0
+        self.publish_s = 0.0
+        self._reqs: Dict[int, _InFlight] = {}
+        self._dispatch_q: deque = deque()
+        self._completed: set = set()  # delivered-but-uncollected ids
+        self._completed_total = 0  # lifetime counter (the status pane)
+        self._lock = threading.Lock()
+        self._closed = False
+        self._latency = Histogram()
+        self._spec = {
+            "mode": "serve",
+            "name": self._name,
+            "factory": factory,
+            "factory_kwargs": factory_kwargs or {},
+            "sched": {
+                "max_slots": int(max_slots),
+                "block_size": int(block_size),
+                "num_blocks": int(num_blocks),
+                "max_seq_len": int(max_seq_len),
+                "prefill_chunk": int(prefill_chunk),
+                "max_new_default": int(max_new_tokens),
+                "temperature": float(temperature),
+                "eos_id": eos_id,
+            },
+        }
+        self._next_id = 0
+        self._replicas: List[_Replica] = []
+        for i in range(int(num_replicas)):
+            self._replicas.append(self._spawn(i))
+        deadline = time.monotonic() + start_timeout
+        for rep in self._replicas:
+            self._await_ready(rep, deadline)
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop,
+            name=f"serve-dispatch-{self._name}",
+            daemon=True,
+        )
+        self._dispatcher.start()
+        logger.info(
+            "serving engine %s up: %d replica(s), %d slots each",
+            self._name, len(self._replicas), max_slots,
+        )
+
+    # ----------------------------------------------------- lifecycle
+    def _spawn(self, idx: int) -> _Replica:
+        import contextlib
+
+        from dlrover_tpu.common.multi_process import SOCKET_DIR_ENV
+
+        @contextlib.contextmanager
+        def pinned_dir():
+            old = os.environ.get(SOCKET_DIR_ENV)
+            if self._socket_dir:
+                os.environ[SOCKET_DIR_ENV] = self._socket_dir
+            try:
+                yield
+            finally:
+                if old is None:
+                    os.environ.pop(SOCKET_DIR_ENV, None)
+                else:
+                    os.environ[SOCKET_DIR_ENV] = old
+
+        tag = f"{self._name}-r{idx}"
+        with pinned_dir():
+            req_ring = _Ring(
+                f"{tag}-req",
+                spec=_req_spec(self._max_seq_len),
+                num_slots=8,
+                create=True,
+            )
+            resp_ring = _Ring(
+                f"{tag}-resp",
+                spec=_resp_spec(self._max_seq_len),
+                num_slots=8,
+                create=True,
+            )
+        spec = dict(self._spec, replica=idx)
+        env = dict(os.environ)
+        env[WORKER_SPEC_ENV] = json.dumps(spec)
+        if self._socket_dir:
+            env[SOCKET_DIR_ENV] = self._socket_dir
+        import jax
+
+        if jax.default_backend() == "cpu":
+            env.setdefault("JAX_PLATFORMS", "cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "dlrover_tpu.rl.generation_service"],
+            env=env,
+        )
+        return _Replica(idx, proc, req_ring, resp_ring)
+
+    def _await_ready(self, rep: _Replica, deadline: float):
+        while time.monotonic() < deadline:
+            msg = rep.resp_ring.try_get()
+            if msg is not None and int(msg["meta"][1]) == _KIND_READY:
+                rep.ready = True
+                return
+            if rep.proc.poll() is not None:
+                raise RuntimeError(
+                    f"serving replica {rep.idx} died during startup "
+                    f"(exit {rep.proc.returncode})"
+                )
+            time.sleep(0.01)
+        raise TimeoutError(
+            f"serving replica {rep.idx} not ready in time"
+        )
+
+    # ----------------------------------------------------------- API
+    def sync_weights(self, params) -> float:
+        """One shm publish; every replica adopts it between scheduler
+        iterations (fan-out by attach — N readers, one segment)."""
+        self._version += 1
+        t0 = time.perf_counter()
+        self._shm.save_state(self._version, params)
+        self.publish_s = time.perf_counter() - t0
+        return self.publish_s
+
+    def submit(self, prompt, max_new: Optional[int] = None,
+               seed: int = 0) -> int:
+        """Queue one prompt; returns the request id."""
+        if self._closed:
+            raise RuntimeError("serving engine is closed")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("prompt must hold at least one token")
+        max_new = int(
+            self._max_new if max_new is None else max_new
+        )
+        if max_new < 1:
+            raise ValueError("max_new must be >= 1")
+        if prompt.size + max_new > self._max_seq_len:
+            raise ValueError(
+                f"prompt {prompt.size} + max_new {max_new} exceeds "
+                f"max_seq_len {self._max_seq_len}"
+            )
+        with self._lock:
+            req_id = self._next_id
+            self._next_id += 1
+            inflight = _InFlight(
+                req_id=req_id,
+                prompt=prompt,
+                max_new=max_new,
+                seed=int(seed),
+                submit_t=time.monotonic(),
+            )
+            self._reqs[req_id] = inflight
+            self._dispatch_q.append(req_id)
+        return req_id
+
+    def result(self, req_id: int,
+               timeout: Optional[float] = None) -> Dict:
+        """Block for one request's completion; returns
+        ``{"tokens", "finish_reason", "latency_s", ...}``."""
+        timeout = gen_timeout_s() if timeout is None else timeout
+        req = self._reqs.get(req_id)
+        if req is None:
+            raise KeyError(f"unknown request id {req_id}")
+        if not req.done.wait(timeout=timeout):
+            raise TimeoutError(
+                f"request {req_id} not completed within {timeout}s "
+                f"({self._alive_count()} replica(s) alive)"
+            )
+        res = req.result
+        # collection point: a delivered result leaves the engine's
+        # bookkeeping (an unbounded serving lifetime must not retain
+        # every prompt/tail ever served); late duplicates still land
+        # harmlessly — _complete finds no pending request
+        self._reqs.pop(req_id, None)
+        with self._lock:
+            self._completed_total += 1
+            self._completed.discard(req_id)
+        if res.get("error"):
+            raise RuntimeError(res["error"])
+        return res
+
+    def generate(self, prompts, rng=None, seed: Optional[int] = None):
+        """Legacy whole-batch surface: [B, P] in, [B, P + max_new]
+        out.  Per-row sampling seeds derive from ``seed`` + row."""
+        if seed is None:
+            seed = 0
+            if rng is not None:
+                import jax
+
+                seed = int(
+                    np.asarray(jax.random.key_data(rng)).ravel()[-1]
+                )
+        prompts = np.asarray(prompts, np.int32)
+        ids = [
+            self.submit(row, max_new=self._max_new,
+                        seed=int(seed) + i * 1000003)
+            for i, row in enumerate(prompts)
+        ]
+        rows = []
+        width = prompts.shape[1] + self._max_new
+        for rid in ids:
+            res = self.result(rid)
+            row = np.zeros((width,), np.int32)
+            toks = res["tokens"][:width]
+            row[: toks.size] = toks
+            rows.append(row)
+        return np.stack(rows)
+
+    # ------------------------------------------------------ elasticity
+    def drain_replica(self, idx: int, sig: int = signal.SIGUSR1):
+        """PR-9 drain protocol: SIGUSR1 (or SIGTERM — same handler)
+        -> the replica stops admitting and its unfinished sequences
+        requeue onto survivors.  The dispatcher stops routing to it
+        IMMEDIATELY — a request dispatched into the drain window
+        would only burn one of its redispatch attempts."""
+        rep = self._replicas[idx]
+        rep.draining = True
+        if rep.proc.poll() is None:
+            rep.proc.send_signal(sig)
+
+    def kill_replica(self, idx: int):
+        """Chaos arm: hard-kill (the crash path — requests redispatch
+        exactly as on drain, minus the clean handshake)."""
+        rep = self._replicas[idx]
+        if rep.proc.poll() is None:
+            rep.proc.send_signal(signal.SIGKILL)
+
+    def add_replica(self, wait_ready: bool = True,
+                    timeout: float = 300.0) -> int:
+        """Elastic scale-out: spawn one more replica; the dispatcher
+        starts routing to it the moment its READY lands.  Returns the
+        new replica index."""
+        if self._closed:
+            raise RuntimeError("serving engine is closed")
+        rep = self._spawn(len(self._replicas))
+        self._replicas.append(rep)
+        if wait_ready:
+            deadline = time.monotonic() + timeout
+            # the dispatcher thread owns the response rings now; wait
+            # on the flag it flips, not on the ring itself
+            while not rep.ready:
+                if rep.proc.poll() is not None:
+                    raise RuntimeError(
+                        f"replica {rep.idx} died during scale-out "
+                        f"(exit {rep.proc.returncode})"
+                    )
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"replica {rep.idx} not ready in {timeout}s"
+                    )
+                time.sleep(0.01)
+        return rep.idx
+
+    def _alive_count(self) -> int:
+        return sum(1 for r in self._replicas if r.alive)
+
+    # ------------------------------------------------------ dispatcher
+    def _complete(self, req_id: int, result: Dict):
+        with self._lock:
+            if req_id in self._completed:
+                return  # dedup: drain/crash races can answer twice
+            self._completed.add(req_id)
+        req = self._reqs.get(req_id)
+        if req is None:
+            return
+        req.result = result
+        if "latency_s" in result:
+            self._latency.observe(result["latency_s"])
+        req.done.set()
+
+    def _handle_responses(self, rep: _Replica) -> int:
+        n = 0
+        while True:
+            msg = rep.resp_ring.try_get()
+            if msg is None:
+                return n
+            n += 1
+            meta = msg["meta"]
+            kind = int(meta[1])
+            if kind == _KIND_DRAINED:
+                rep.drained = True
+                rep.draining = True
+                continue
+            if kind == _KIND_READY:
+                rep.ready = True
+                continue
+            if kind == _KIND_STATS:
+                rep.stats = {
+                    "tokens_per_s": round(float(msg["times"][0]), 2),
+                    "queue_depth": int(msg["times"][1]),
+                    "kv_blocks_used": int(msg["times"][2]),
+                }
+                continue
+            if kind != _KIND_RESULT:
+                continue
+            req_id = int(meta[0])
+            total = int(meta[2])
+            rep.outstanding.pop(req_id, None)
+            req = self._reqs.get(req_id)
+            latency = (
+                time.monotonic() - req.submit_t if req else 0.0
+            )
+            self._complete(
+                req_id,
+                {
+                    "tokens": msg["tokens"][:total].copy(),
+                    "new_tokens": int(meta[3]),
+                    "finish_reason": _FINISH_NAMES.get(
+                        int(meta[4]), "length"
+                    ),
+                    "version": int(meta[5]),
+                    "latency_s": latency,
+                    "worker_latency_s": float(msg["times"][0]),
+                    "ttft_s": float(msg["times"][1]),
+                    "replica": rep.idx,
+                },
+            )
+
+    def _handle_death(self, rep: _Replica):
+        rep.alive = False
+        rc = rep.proc.returncode
+        requeue = [
+            rid for rid in rep.outstanding
+            if rid not in self._completed
+        ]
+        rep.outstanding.clear()
+        if requeue:
+            logger.warning(
+                "serving replica %d exited (rc=%s): requeueing %d "
+                "in-flight request(s)", rep.idx, rc, len(requeue),
+            )
+        with self._lock:
+            for rid in reversed(requeue):
+                self._dispatch_q.appendleft(rid)
+
+    def _dispatch_loop(self):
+        from dlrover_tpu.observability.metrics import record_serving
+
+        self._last_gauges = 0.0
+        while not self._closed:
+            try:
+                moved = self._dispatch_once(record_serving)
+            except Exception as e:  # noqa: BLE001 - a dead dispatcher
+                # thread wedges EVERY caller; log and keep pumping
+                logger.error("serving dispatcher error: %s", e)
+                moved = 0
+            if not moved:
+                time.sleep(0.002)
+
+    def _dispatch_once(self, record_serving) -> int:
+        """One pump: drain responses, detect deaths, route the queue,
+        refresh gauges.  Returns how much moved (0 = idle tick)."""
+        moved = 0
+        for rep in self._replicas:
+            if not rep.alive:
+                continue
+            moved += self._handle_responses(rep)
+            if rep.proc.poll() is not None:
+                # late responses may still sit in the ring
+                moved += self._handle_responses(rep)
+                self._handle_death(rep)
+        alive = [
+            r for r in self._replicas
+            if r.alive and r.ready and not r.draining
+        ]
+        while self._dispatch_q and alive:
+            with self._lock:
+                if not self._dispatch_q:
+                    break
+                req_id = self._dispatch_q.popleft()
+            if req_id in self._completed:
+                continue
+            req = self._reqs[req_id]
+            req.attempts += 1
+            if req.attempts > self.MAX_ATTEMPTS:
+                self._complete(
+                    req_id,
+                    {
+                        "error": (
+                            f"request {req_id} failed after "
+                            f"{self.MAX_ATTEMPTS} dispatch "
+                            "attempts (replicas keep dying)"
+                        )
+                    },
+                )
+                continue
+            rep = min(alive, key=lambda r: len(r.outstanding))
+            ok = rep.req_ring.try_put(
+                {
+                    "meta": np.asarray(
+                        [req_id, req.prompt.size, req.max_new,
+                         req.seed],
+                        np.int64,
+                    ),
+                    "prompt": np.pad(
+                        req.prompt,
+                        (0, self._max_seq_len - req.prompt.size),
+                    ),
+                },
+                timeout=0.02,
+            )
+            if not ok:
+                req.attempts -= 1  # ring full is not a failure
+                with self._lock:
+                    self._dispatch_q.appendleft(req_id)
+                break
+            rep.outstanding[req_id] = req
+            moved += 1
+        now = time.monotonic()
+        if now - self._last_gauges >= 1.0:
+            self._last_gauges = now
+            record_serving(
+                replica="dispatcher",
+                tokens_per_s=None,
+                queue_depth=len(self._dispatch_q),
+                kv_blocks_used=None,
+                p99_latency_s=self._latency.quantile(0.99),
+            )
+        return moved
+
+    # --------------------------------------------------------- status
+    def status(self) -> Dict:
+        """The serving pane: what ``scripts/top.py`` renders and the
+        bench snapshots."""
+        return {
+            "replicas": [
+                dict(
+                    {
+                        "idx": r.idx,
+                        "alive": r.alive,
+                        "drained": r.drained,
+                        "outstanding": len(r.outstanding),
+                    },
+                    **r.stats,
+                )
+                for r in self._replicas
+            ],
+            "queue_depth": len(self._dispatch_q),
+            "completed": self._completed_total + len(self._completed),
+            "p50_latency_s": round(self._latency.quantile(0.5), 4),
+            "p99_latency_s": round(self._latency.quantile(0.99), 4),
+            "version": self._version,
+        }
+
+    def close(self):
+        if self._closed:
+            return
+        timeout = gen_close_timeout_s()
+        for rep in self._replicas:
+            if rep.proc.poll() is None:
+                rep.proc.send_signal(signal.SIGTERM)
+        deadline = time.monotonic() + timeout
+        for rep in self._replicas:
+            remain = max(deadline - time.monotonic(), 0.1)
+            try:
+                rep.proc.wait(timeout=remain)
+            except subprocess.TimeoutExpired:
+                rep.proc.kill()
+        self._closed = True
+        if self._dispatcher.is_alive():
+            self._dispatcher.join(timeout=5.0)
+        for rep in self._replicas:
+            rep.req_ring.close(unlink=True)
+            rep.resp_ring.close(unlink=True)
+        self._shm.close(unlink=True)
+
+
+def make_generation_engine(
+    factory: str,
+    max_new_tokens: int,
+    **kwargs,
+):
+    """The serving-plane selector: :class:`ServingEngine` (continuous
+    batching, multi-replica) unless ``DLROVER_TPU_SERVING=0`` pins the
+    legacy single-worker request/queue loop.  Extra kwargs route to
+    whichever engine is chosen (unknown ones are dropped for the
+    legacy engine, whose surface is frozen)."""
+    if serving_enabled():
+        return ServingEngine(factory, max_new_tokens, **kwargs)
+    legacy_keys = (
+        "temperature", "factory_kwargs", "name", "start_timeout",
+    )
+    legacy_kwargs = {
+        k: v for k, v in kwargs.items() if k in legacy_keys
+    }
+    dropped = sorted(set(kwargs) - set(legacy_kwargs))
+    if dropped:
+        logger.info(
+            "DLROVER_TPU_SERVING=0: legacy engine ignores %s",
+            dropped,
+        )
+    return CrossProcessGenerationEngine(
+        factory, max_new_tokens, **legacy_kwargs
+    )
 
 
 if __name__ == "__main__":

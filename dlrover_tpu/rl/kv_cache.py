@@ -1,0 +1,189 @@
+"""Paged KV cache: a block pool + per-sequence block tables.
+
+Reference parity: vLLM's ``BlockAllocator``/block tables (PagedAttention)
+— the serving path's answer to ``rl/inference.py``'s dense
+``[L, B, max_len, KV, head_dim]`` slab, which reserves worst-case
+memory per *batch* and cannot admit a new sequence without recompiling
+or re-allocating.  Here the cache is one fixed pool of
+``block_size``-token blocks (``[L, num_blocks, block_size, KV, D]``,
+the layout ``ops/paged_attention.py`` gathers), sequences own integer
+block lists, and admission/eviction is pure host-side bookkeeping —
+the device arrays never change shape, so the decode program compiles
+exactly once.
+
+Block 0 is the NULL block: never allocated, the scatter/gather target
+for inactive lanes and unwritten table entries (always masked).
+
+Accounting (the observatory's ``kv_blocks_used`` gauge and the
+fragmentation line in ``scripts/bench_serving.py`` read these):
+
+- ``used_blocks`` / ``free_blocks`` — pool occupancy;
+- ``internal_fragmentation()`` — reserved-but-unfilled token slots as
+  a share of reserved capacity (block-granularity waste, the quantity
+  paging keeps bounded at < ``block_size`` tokens/sequence where the
+  dense slab wastes ``max_len - len`` per sequence).
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class PagedCacheConfig:
+    n_layers: int
+    n_kv_heads: int
+    head_dim: int
+    num_blocks: int  # pool size INCLUDING the null block
+    block_size: int = 16
+    dtype: object = jnp.bfloat16
+
+    @property
+    def usable_blocks(self) -> int:
+        return self.num_blocks - 1  # block 0 is the null block
+
+    def blocks_for(self, n_tokens: int) -> int:
+        """Blocks needed to hold ``n_tokens`` cache positions."""
+        return -(-max(int(n_tokens), 0) // self.block_size)
+
+
+def init_block_pool(cfg: PagedCacheConfig) -> Dict[str, jnp.ndarray]:
+    """The device-side pool, stacked on the layer dim like the params
+    (``[L, num_blocks, block_size, KV, head_dim]``)."""
+    shape = (
+        cfg.n_layers,
+        cfg.num_blocks,
+        cfg.block_size,
+        cfg.n_kv_heads,
+        cfg.head_dim,
+    )
+    return {
+        "k": jnp.zeros(shape, dtype=cfg.dtype),
+        "v": jnp.zeros(shape, dtype=cfg.dtype),
+    }
+
+
+class OutOfBlocksError(RuntimeError):
+    """The pool cannot satisfy an allocation — admission control
+    should have checked :meth:`BlockPool.can_allocate` first."""
+
+
+@dataclass
+class _SeqAlloc:
+    blocks: List[int] = field(default_factory=list)
+    filled_tokens: int = 0  # cache positions actually written
+
+
+class BlockPool:
+    """Host-side block accounting (free list + per-sequence tables).
+
+    Pure bookkeeping — device memory is the fixed-size pool from
+    :func:`init_block_pool`; this class only decides which block ids a
+    sequence owns.  LIFO free list: a just-freed block is re-issued
+    first, which keeps the hot working set small.
+    """
+
+    def __init__(self, cfg: PagedCacheConfig):
+        self.cfg = cfg
+        # block 0 reserved as the null block
+        self._free: List[int] = list(range(cfg.num_blocks - 1, 0, -1))
+        self._seqs: Dict[int, _SeqAlloc] = {}
+        self.alloc_count = 0
+        self.free_count = 0
+        self.peak_used = 0
+
+    # ---------------------------------------------------------- queries
+    @property
+    def used_blocks(self) -> int:
+        return self.cfg.usable_blocks - len(self._free)
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def live_sequences(self) -> int:
+        return len(self._seqs)
+
+    def can_allocate(self, n_tokens: int) -> bool:
+        return self.cfg.blocks_for(n_tokens) <= len(self._free)
+
+    def blocks_of(self, seq_id: int) -> List[int]:
+        return list(self._seqs[seq_id].blocks)
+
+    def internal_fragmentation(self) -> float:
+        """Reserved-but-unfilled cache slots / reserved slots (0.0
+        when nothing is allocated)."""
+        reserved = sum(
+            len(s.blocks) * self.cfg.block_size
+            for s in self._seqs.values()
+        )
+        if reserved == 0:
+            return 0.0
+        filled = sum(s.filled_tokens for s in self._seqs.values())
+        return 1.0 - filled / reserved
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "used_blocks": self.used_blocks,
+            "free_blocks": self.free_blocks,
+            "peak_used_blocks": self.peak_used,
+            "live_sequences": self.live_sequences,
+            "allocs": self.alloc_count,
+            "frees": self.free_count,
+            "internal_fragmentation": round(
+                self.internal_fragmentation(), 4
+            ),
+        }
+
+    # ------------------------------------------------------- lifecycle
+    def allocate(self, seq_id: int, n_tokens: int) -> List[int]:
+        """Reserve blocks for ``n_tokens`` cache positions.  The
+        scheduler reserves a sequence's worst case (prompt + max_new)
+        at admission so decode can never die of pool exhaustion
+        mid-flight (reservation admission — the tradeoff is bounded
+        internal fragmentation, reported above)."""
+        if seq_id in self._seqs:
+            raise ValueError(f"sequence {seq_id} already allocated")
+        need = self.cfg.blocks_for(n_tokens)
+        if need > len(self._free):
+            raise OutOfBlocksError(
+                f"need {need} blocks for seq {seq_id}, "
+                f"{len(self._free)} free"
+            )
+        blocks = [self._free.pop() for _ in range(need)]
+        self._seqs[seq_id] = _SeqAlloc(blocks=blocks)
+        self.alloc_count += need
+        self.peak_used = max(self.peak_used, self.used_blocks)
+        return list(blocks)
+
+    def note_filled(self, seq_id: int, filled_tokens: int):
+        """Record how many cache positions the sequence has actually
+        written (drives the fragmentation figure)."""
+        self._seqs[seq_id].filled_tokens = int(filled_tokens)
+
+    def free(self, seq_id: int) -> int:
+        """Return a finished/evicted sequence's blocks to the pool."""
+        alloc = self._seqs.pop(seq_id, None)
+        if alloc is None:
+            return 0
+        self._free.extend(reversed(alloc.blocks))
+        self.free_count += len(alloc.blocks)
+        return len(alloc.blocks)
+
+    def table_row(
+        self, seq_id: int, max_blocks: int
+    ) -> Optional[List[int]]:
+        """The sequence's block table padded to ``max_blocks`` with
+        null-block ids (the fixed-shape row the jitted decode step
+        consumes)."""
+        alloc = self._seqs.get(seq_id)
+        if alloc is None:
+            return None
+        if len(alloc.blocks) > max_blocks:
+            raise ValueError(
+                f"seq {seq_id} owns {len(alloc.blocks)} blocks > "
+                f"table width {max_blocks}"
+            )
+        return alloc.blocks + [0] * (max_blocks - len(alloc.blocks))

@@ -1,0 +1,375 @@
+"""Serving-plane bench: continuous batching vs the sequential
+request loop, offered-QPS latency sweeps, replica scaling and a
+kill-one-replica-mid-load leg.
+
+Four legs (each flushes a partial ``--out`` payload the moment it
+lands, so a timeout can never lose an already-measured point):
+
+1. **capacity** (the headline): a closed-loop burst of mixed-length
+   requests served (a) one request at a time through the KV-cache
+   backend — the semantics of the legacy single-worker request/queue
+   loop — and (b) by the continuous-batching scheduler.  Both paths
+   are warmed before timing (compile excluded; the sequential loop
+   even gets the length-bucket fix), so the ratio is steady-state
+   tokens/s, not compile amortization.  Target: >= 2x.
+2. **qps sweep**: Poisson arrivals at each offered QPS against both
+   engines — p50/p99 completion latency + achieved tokens/s per
+   point (the latency story behind the capacity ratio).
+3. **replicas**: the real multi-process ``ServingEngine`` (shm-ring
+   transport, paged KV workers) at 1 and 2 replicas, closed-loop —
+   tokens/s per replica count.
+4. **kill**: 2 replicas, one SIGKILL'd mid-load — every request must
+   complete exactly once on the survivor (the elastic-serving
+   contract; zero lost, zero duplicated).
+
+Usage::
+
+    JAX_PLATFORMS=cpu python scripts/bench_serving.py --out serving.json
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+CFG_KW = dict(
+    vocab_size=128,
+    dim=32,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=2,
+    mlp_dim=64,
+    max_seq_len=128,
+    remat="none",
+)
+MAX_NEW = 12
+SCHED_KW = dict(
+    max_slots=8,
+    block_size=8,
+    num_blocks=128,
+    max_seq_len=64,
+    prefill_chunk=8,
+)
+
+
+def _percentile(xs, q):
+    return float(np.percentile(np.asarray(xs, np.float64), q)) if xs else 0.0
+
+
+def make_workload(n: int, seed: int):
+    """Mixed-length prompts (the traffic shape that starves a dense
+    batch): lengths 3..20, uniform."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        plen = int(rng.integers(3, 21))
+        out.append(
+            {
+                "prompt": rng.integers(
+                    0, CFG_KW["vocab_size"], (plen,)
+                ).astype(np.int32),
+                "max_new": MAX_NEW,
+                "seed": 1000 + i,
+            }
+        )
+    return out
+
+
+def _model():
+    import jax
+
+    from dlrover_tpu.models.llama import LlamaConfig, init_params
+
+    cfg = LlamaConfig(**CFG_KW)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _sequential_backend(cfg):
+    from dlrover_tpu.rl.inference import KVCacheBackend
+
+    return KVCacheBackend(cfg, max_new_tokens=MAX_NEW,
+                          temperature=0.0)
+
+
+def run_sequential(cfg, params, workload, arrivals=None):
+    """The legacy loop's semantics: one request at a time, whole
+    generation to completion, FIFO.  ``arrivals``: per-request offsets
+    (None = closed loop, all queued at t0)."""
+    import jax
+    import jax.numpy as jnp
+
+    backend = _sequential_backend(cfg)
+    backend.sync_weights(params)
+    # warm every bucket shape out of the timed region
+    os.environ.setdefault("DLROVER_TPU_GEN_BUCKETS", "8,16,32")
+    for plen in (4, 12, 20):
+        backend.generate(
+            jnp.zeros((1, plen), jnp.int32), jax.random.PRNGKey(0)
+        )
+    t0 = time.monotonic()
+    lat, new_tokens = [], 0
+    for i, w in enumerate(workload):
+        if arrivals is not None:
+            wait = t0 + arrivals[i] - time.monotonic()
+            if wait > 0:
+                time.sleep(wait)
+        arrive = t0 + (arrivals[i] if arrivals is not None else 0.0)
+        out = np.asarray(
+            backend.generate(
+                jnp.asarray(w["prompt"][None]),
+                jax.random.PRNGKey(w["seed"]),
+            )
+        )
+        new_tokens += out.shape[1] - w["prompt"].size
+        lat.append(time.monotonic() - arrive)
+    makespan = time.monotonic() - t0
+    return {
+        "engine": "sequential",
+        "requests": len(workload),
+        "new_tokens": new_tokens,
+        "makespan_s": round(makespan, 4),
+        "tokens_per_s": round(new_tokens / makespan, 2),
+        "p50_latency_s": round(_percentile(lat, 50), 4),
+        "p99_latency_s": round(_percentile(lat, 99), 4),
+    }
+
+
+def run_continuous(cfg, params, workload, arrivals=None):
+    """The same workload through the token-level scheduler."""
+    from dlrover_tpu.rl.scheduler import (
+        ContinuousBatchingScheduler,
+        SchedulerConfig,
+    )
+
+    sch = ContinuousBatchingScheduler(
+        cfg,
+        SchedulerConfig(temperature=0.0, max_new_default=MAX_NEW,
+                        **SCHED_KW),
+    )
+    sch.sync_weights(params)
+    # warmup: compile prefill/decode/sample out of the timed region
+    sch.submit(workload[0]["prompt"], max_new=2, seed=0)
+    sch.run()
+    t0 = time.monotonic()
+    lat, done, new_tokens = [], 0, 0
+    submit_t = {}
+    pending = list(enumerate(workload))
+    while done < len(workload):
+        now = time.monotonic() - t0
+        while pending and (
+            arrivals is None or arrivals[pending[0][0]] <= now
+        ):
+            i, w = pending.pop(0)
+            rid = sch.submit(
+                w["prompt"], max_new=w["max_new"], seed=w["seed"]
+            )
+            submit_t[rid] = t0 + (
+                arrivals[i] if arrivals is not None else 0.0
+            )
+        if sch.idle:
+            time.sleep(0.001)
+            continue
+        for res in sch.step():
+            done += 1
+            new_tokens += res.new_tokens
+            lat.append(time.monotonic() - submit_t[res.req_id])
+    makespan = time.monotonic() - t0
+    stats = sch.stats()
+    return {
+        "engine": "continuous",
+        "requests": len(workload),
+        "new_tokens": new_tokens,
+        "makespan_s": round(makespan, 4),
+        "tokens_per_s": round(new_tokens / makespan, 2),
+        "p50_latency_s": round(_percentile(lat, 50), 4),
+        "p99_latency_s": round(_percentile(lat, 99), 4),
+        "compile_counts": sch.compile_counts(),
+        "peak_kv_blocks": stats["peak_used_blocks"],
+        "kv_fragmentation": stats["internal_fragmentation"],
+    }
+
+
+def run_replicas(n_replicas: int, workload, kill_one: bool = False):
+    """The real multi-process plane: dispatcher + shm rings + paged
+    KV replica workers; optionally SIGKILL one replica mid-load."""
+    from dlrover_tpu.rl.generation_service import ServingEngine
+
+    eng = ServingEngine(
+        factory="dlrover_tpu.rl.generation_service:tiny_llama_factory",
+        factory_kwargs=CFG_KW,
+        max_new_tokens=MAX_NEW,
+        temperature=0.0,
+        name=f"bench-serve-{os.getpid()}-{n_replicas}"
+             f"{'k' if kill_one else ''}",
+        num_replicas=n_replicas,
+        **SCHED_KW,
+    )
+    try:
+        t0 = time.monotonic()
+        ids = [
+            eng.submit(w["prompt"], max_new=w["max_new"],
+                       seed=w["seed"])
+            for w in workload
+        ]
+        if kill_one:
+            eng.kill_replica(n_replicas - 1)
+        results = [eng.result(rid, timeout=300.0) for rid in ids]
+        makespan = time.monotonic() - t0
+        status = eng.status()
+        # "exactly once" must be falsifiable: the dispatcher saw one
+        # completion per submitted id (a duplicated completion would
+        # push its counter past len(ids)), and every result is the
+        # request it claims to be (its prompt rides back verbatim)
+        valid = all(
+            np.array_equal(
+                r["tokens"][: w["prompt"].size], w["prompt"]
+            )
+            and 1 <= r["new_tokens"] <= w["max_new"]
+            for r, w in zip(results, workload)
+        )
+        new_tokens = sum(r["new_tokens"] for r in results)
+        lat = [r["latency_s"] for r in results]
+        out = {
+            "replicas": n_replicas,
+            "killed": int(bool(kill_one)),
+            "requests": len(workload),
+            "completed": len(results),
+            "completed_exactly_once": (
+                status["completed"] == len(ids) and valid
+            ),
+            "new_tokens": new_tokens,
+            "makespan_s": round(makespan, 4),
+            "tokens_per_s": round(new_tokens / makespan, 2),
+            "p50_latency_s": round(_percentile(lat, 50), 4),
+            "p99_latency_s": round(_percentile(lat, 99), 4),
+            "status": status,
+        }
+        return out
+    finally:
+        eng.close()
+
+
+def flush(out_file: str, payload):
+    if not out_file:
+        return
+    tmp = out_file + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=2, default=str)
+        f.write("\n")
+    os.replace(tmp, out_file)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="serving bench")
+    parser.add_argument("--out", default="")
+    parser.add_argument("--requests", type=int, default=24)
+    parser.add_argument(
+        "--qps", default="20,80",
+        help="offered-QPS sweep points (comma-separated); the upper "
+        "point should exceed the sequential loop's request rate so "
+        "the queueing crossover is visible",
+    )
+    parser.add_argument(
+        "--replicas", default="1,2",
+        help="replica counts for the multi-process leg",
+    )
+    parser.add_argument(
+        "--skip_replica_leg", action="store_true",
+        help="in-process legs only (fast CI smoke)",
+    )
+    args = parser.parse_args(argv)
+
+    payload = {
+        "metric": "serving_continuous_vs_sequential_tokens_per_s",
+        "value": None,
+        "unit": "x",
+        "vs_baseline": None,
+        "extras": {"bar": 2.0},
+    }
+    extras = payload["extras"]
+    flush(args.out, payload)
+
+    cfg, params = _model()
+    workload = make_workload(args.requests, seed=7)
+
+    # leg 1: closed-loop capacity (the headline)
+    seq = run_sequential(cfg, params, workload)
+    extras["sequential"] = seq
+    flush(args.out, payload)
+    cont = run_continuous(cfg, params, workload)
+    extras["continuous"] = cont
+    speedup = round(
+        cont["tokens_per_s"] / max(seq["tokens_per_s"], 1e-9), 3
+    )
+    payload["value"] = speedup
+    payload["vs_baseline"] = round(speedup / 2.0, 3)
+    extras["speedup"] = speedup
+    flush(args.out, payload)
+    print(
+        f"capacity: sequential {seq['tokens_per_s']} tok/s vs "
+        f"continuous {cont['tokens_per_s']} tok/s -> {speedup}x"
+    )
+
+    # leg 2: offered-QPS latency sweep
+    sweep = []
+    qps_points = [
+        float(q) for q in args.qps.split(",") if q.strip()
+    ]
+    rng = np.random.default_rng(11)
+    for qps in qps_points:
+        gaps = rng.exponential(1.0 / qps, size=len(workload))
+        arrivals = np.cumsum(gaps).tolist()
+        point = {
+            "offered_qps": qps,
+            "sequential": run_sequential(
+                cfg, params, workload, arrivals
+            ),
+            "continuous": run_continuous(
+                cfg, params, workload, arrivals
+            ),
+        }
+        sweep.append(point)
+        extras["qps_sweep"] = sweep
+        flush(args.out, payload)
+        print(
+            f"qps={qps}: seq p99 "
+            f"{point['sequential']['p99_latency_s']}s vs cont p99 "
+            f"{point['continuous']['p99_latency_s']}s"
+        )
+
+    # legs 3+4: real replicas + kill-mid-load
+    if not args.skip_replica_leg:
+        rep_points = []
+        for n in [
+            int(r) for r in args.replicas.split(",") if r.strip()
+        ]:
+            rep_points.append(run_replicas(n, workload))
+            extras["replica_sweep"] = rep_points
+            flush(args.out, payload)
+            print(
+                f"replicas={n}: "
+                f"{rep_points[-1]['tokens_per_s']} tok/s"
+            )
+        kill = run_replicas(2, workload, kill_one=True)
+        extras["kill_leg"] = kill
+        flush(args.out, payload)
+        print(
+            f"kill leg: {kill['completed']}/{kill['requests']} "
+            f"completed (exactly_once="
+            f"{kill['completed_exactly_once']})"
+        )
+
+    flush(args.out, payload)
+    print(json.dumps({"value": payload["value"], "unit": "x"}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -127,6 +127,15 @@ DECLARED_METRICS = {
     # age bounds the journal tail a failover replays
     "dlrover_tpu_snapshot_age_seconds",
     "dlrover_tpu_snapshot_duration_seconds",
+    # the inference plane (observability/metrics.py record_serving):
+    # per-replica generation throughput, dispatch/admission queue
+    # depth, paged-KV block-pool occupancy and the dispatcher-side
+    # end-to-end p99 — the serving pane in scripts/top.py and
+    # bench_serving.py key on exactly these four
+    "dlrover_tpu_serving_tokens_per_s",
+    "dlrover_tpu_serving_queue_depth",
+    "dlrover_tpu_serving_kv_blocks_used",
+    "dlrover_tpu_serving_p99_latency",
 }
 METRIC_METHODS = {
     "set_gauge",

@@ -210,6 +210,39 @@ def render(status: dict) -> str:
                     else ""
                 )
             )
+    serving = status.get("serving") or {}
+    if serving:
+        # the inference plane (rl/generation_service.ServingEngine
+        # status + record_serving gauges): per-replica throughput /
+        # queue / KV occupancy, fleet p50/p99
+        lines.append("")
+        lines.append(
+            f"serving: queue {serving.get('queue_depth', 0)}"
+            f" · completed {serving.get('completed', 0)}"
+            f" · p50 {serving.get('p50_latency_s', 0.0):.3f}s"
+            f" · p99 {serving.get('p99_latency_s', 0.0):.3f}s"
+            f" · weights v{serving.get('version', 0)}"
+        )
+        reps = serving.get("replicas") or []
+        if reps:
+            hdr = (
+                f"{'repl':>4} {'state':>8} {'inflight':>8} "
+                f"{'tok/s':>8} {'queue':>6} {'kvblk':>6}"
+            )
+            lines.append(hdr)
+            lines.append("-" * len(hdr))
+            for r in reps:
+                state = (
+                    "ok" if r.get("alive")
+                    else ("drained" if r.get("drained") else "DEAD")
+                )
+                lines.append(
+                    f"{r.get('idx', '?'):>4} {state:>8} "
+                    f"{r.get('outstanding', 0):>8} "
+                    f"{r.get('tokens_per_s', 0.0):>8.1f} "
+                    f"{r.get('queue_depth', 0):>6} "
+                    f"{r.get('kv_blocks_used', 0):>6}"
+                )
     conclusions = status.get("conclusions") or []
     if conclusions:
         lines.append("")

@@ -187,6 +187,63 @@ def test_lint_catches_undeclared_metric_names():
         os.unlink(probe)
 
 
+def test_lint_enforces_serving_span_labels(tmp_path):
+    """Serving spans must carry their token accounting: a
+    ``serve_step`` without tokens/new_tokens/throughput (or a
+    prefill/decode leg without its count) is an unactionable blip in
+    exactly the trace that explains a tokens/s dip."""
+    bad = tmp_path / "bad_serving.py"
+    bad.write_text(
+        "events = None\n"
+        "def f(events):\n"
+        "    events.complete('serve_step', 0.0, 1.0, tokens=8,\n"
+        "                    new_tokens=4)\n"
+        "    events.complete('serve_step', 0.0, 1.0, tokens=8,\n"
+        "                    new_tokens=4, throughput_tps=120.0)\n"
+        "    events.complete('prefill', 0.0, 1.0)\n"
+        "    events.complete('prefill', 0.0, 1.0, tokens=8)\n"
+        "    events.complete('decode', 0.0, 1.0, new_tokens=4)\n"
+        "    events.complete('decode', 0.0, 1.0)\n"
+    )
+    proc = _run(str(bad))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "event_schema_violations=3" in proc.stdout, proc.stdout
+    assert (
+        "missing required label(s) ['throughput_tps']" in proc.stdout
+    )
+    assert "missing required label(s) ['tokens']" in proc.stdout
+    assert "missing required label(s) ['new_tokens']" in proc.stdout
+
+
+def test_lint_declares_serving_metrics():
+    """The four serving gauges are declared vocabulary; an in-package
+    near-miss typo is not."""
+    probe = os.path.join(
+        REPO, "dlrover_tpu", "_lint_probe_serving_delete_me.py"
+    )
+    with open(probe, "w") as f:
+        f.write(
+            "def f(reg):\n"
+            "    reg.set_gauge("
+            "'dlrover_tpu_serving_tokens_per_s', 1.0)\n"
+            "    reg.set_gauge("
+            "'dlrover_tpu_serving_queue_depth', 1.0)\n"
+            "    reg.set_gauge("
+            "'dlrover_tpu_serving_kv_blocks_used', 1.0)\n"
+            "    reg.set_gauge("
+            "'dlrover_tpu_serving_p99_latency', 1.0)\n"
+            "    reg.set_gauge("
+            "'dlrover_tpu_serving_token_per_s', 1.0)\n"
+        )
+    try:
+        proc = _run(probe)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "event_schema_violations=1" in proc.stdout, proc.stdout
+        assert "dlrover_tpu_serving_token_per_s" in proc.stdout
+    finally:
+        os.unlink(probe)
+
+
 def test_lint_enforces_control_wait_retry_label(tmp_path):
     """A ``control_wait`` span opened as a retry pause must carry the
     attempt ordinal so retry storms are countable on the timeline."""

@@ -1,0 +1,284 @@
+"""Paged KV cache: block-pool accounting and the paged attention ops
+(``rl/kv_cache.py`` + ``ops/paged_attention.py`` + the paged decode
+path in ``models/llama.py``).
+
+The correctness bar: a sequence decoded through scattered pool blocks
+must produce EXACTLY the tokens the dense contiguous-cache path
+produces (greedy, fp32) — block tables are an addressing scheme, not
+an approximation."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from dlrover_tpu.models import llama  # noqa: E402
+from dlrover_tpu.ops.paged_attention import (  # noqa: E402
+    paged_decode_attention,
+    paged_prefill_attention,
+)
+from dlrover_tpu.rl.kv_cache import (  # noqa: E402
+    BlockPool,
+    OutOfBlocksError,
+    PagedCacheConfig,
+    init_block_pool,
+)
+
+CACHE_CFG = PagedCacheConfig(
+    n_layers=2, n_kv_heads=2, head_dim=8, num_blocks=9, block_size=4,
+    dtype=jnp.float32,
+)
+
+
+class TestBlockPool:
+    def test_null_block_reserved(self):
+        pool = BlockPool(CACHE_CFG)
+        assert pool.free_blocks == 8  # 9 minus the null block
+        blocks = pool.allocate(0, 32)  # exactly the whole pool
+        assert 0 not in blocks
+        assert pool.free_blocks == 0
+
+    def test_alloc_free_no_leak_under_churn(self):
+        """Hundreds of mixed-size admissions/evictions must return
+        the pool to exactly its initial state — a leaked block would
+        eventually wedge admission forever."""
+        pool = BlockPool(CACHE_CFG)
+        rng = np.random.default_rng(0)
+        live = {}
+        for i in range(300):
+            if live and (len(live) > 3 or rng.random() < 0.4):
+                sid = rng.choice(list(live))
+                pool.free(int(sid))
+                del live[int(sid)]
+            n_tokens = int(rng.integers(1, 13))
+            if pool.can_allocate(n_tokens):
+                pool.allocate(i + 1000, n_tokens)
+                live[i + 1000] = n_tokens
+        for sid in list(live):
+            pool.free(sid)
+        assert pool.used_blocks == 0
+        assert pool.free_blocks == CACHE_CFG.usable_blocks
+        assert pool.live_sequences == 0
+        assert pool.alloc_count == pool.free_count > 0
+        # freed-everything => no reserved slots => no fragmentation
+        assert pool.internal_fragmentation() == 0.0
+
+    def test_out_of_blocks_is_loud(self):
+        pool = BlockPool(CACHE_CFG)
+        pool.allocate(1, 30)
+        assert not pool.can_allocate(8)
+        with pytest.raises(OutOfBlocksError):
+            pool.allocate(2, 8)
+
+    def test_double_allocate_rejected(self):
+        pool = BlockPool(CACHE_CFG)
+        pool.allocate(7, 4)
+        with pytest.raises(ValueError):
+            pool.allocate(7, 4)
+
+    def test_fragmentation_accounting(self):
+        """Reserved-but-unfilled slots / reserved slots: a 1-token
+        sequence holding one 4-slot block is 75% internal waste."""
+        pool = BlockPool(CACHE_CFG)
+        pool.allocate(1, 4)
+        pool.note_filled(1, 1)
+        assert pool.internal_fragmentation() == pytest.approx(0.75)
+        pool.note_filled(1, 4)
+        assert pool.internal_fragmentation() == 0.0
+
+    def test_table_row_pads_with_null(self):
+        pool = BlockPool(CACHE_CFG)
+        blocks = pool.allocate(1, 6)  # 2 blocks
+        row = pool.table_row(1, 5)
+        assert row[:2] == blocks
+        assert row[2:] == [0, 0, 0]
+        with pytest.raises(ValueError):
+            pool.table_row(1, 1)  # narrower than the allocation
+
+
+class TestPagedAttentionOps:
+    def _pool_with_seq(self, rng, t_real, nkv=2, d=8):
+        """A pool whose blocks 1.. hold one sequence's first
+        ``t_real`` positions, garbage elsewhere."""
+        cfg = PagedCacheConfig(
+            n_layers=1, n_kv_heads=nkv, head_dim=d, num_blocks=6,
+            block_size=4, dtype=jnp.float32,
+        )
+        k_dense = jnp.asarray(
+            rng.standard_normal((t_real, nkv, d)), jnp.float32
+        )
+        v_dense = jnp.asarray(
+            rng.standard_normal((t_real, nkv, d)), jnp.float32
+        )
+        # garbage everywhere (incl. the null block) proves masking
+        k_pool = jnp.asarray(
+            rng.standard_normal((6, 4, nkv, d)) * 100, jnp.float32
+        )
+        v_pool = jnp.asarray(
+            rng.standard_normal((6, 4, nkv, d)) * 100, jnp.float32
+        )
+        table = [1, 2, 3]
+        for t in range(t_real):
+            blk, off = table[t // 4], t % 4
+            k_pool = k_pool.at[blk, off].set(k_dense[t])
+            v_pool = v_pool.at[blk, off].set(v_dense[t])
+        return k_pool, v_pool, k_dense, v_dense, jnp.asarray(
+            table + [0], jnp.int32
+        )
+
+    def test_decode_matches_dense_attention(self):
+        rng = np.random.default_rng(1)
+        t_real, nh, nkv, d = 7, 4, 2, 8
+        k_pool, v_pool, k_dense, v_dense, table = self._pool_with_seq(
+            rng, t_real
+        )
+        q = jnp.asarray(
+            rng.standard_normal((1, nh, d)), jnp.float32
+        )
+        out = paged_decode_attention(
+            q, k_pool, v_pool, table[None],
+            jnp.asarray([t_real], jnp.int32),
+        )
+        # dense reference over the same 7 positions
+        ref = llama.dot_product_attention(
+            q[:, None],  # [1, 1, H, D] single query
+            k_dense[None],
+            v_dense[None],
+            causal=False,  # seq_lens mask plays causal's role here
+        )[:, 0]
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5
+        )
+
+    def test_prefill_causal_within_chunk(self):
+        """Chunk queries at positions 4..6 see the cached prefix plus
+        only their own causal prefix inside the chunk."""
+        rng = np.random.default_rng(2)
+        t_real, nh, nkv, d = 7, 4, 2, 8
+        k_pool, v_pool, k_dense, v_dense, table = self._pool_with_seq(
+            rng, t_real
+        )
+        q = jnp.asarray(
+            rng.standard_normal((3, nh, d)), jnp.float32
+        )  # positions 4, 5, 6
+        out = paged_prefill_attention(
+            q, k_pool, v_pool, table, jnp.int32(4)
+        )
+        for i, qpos in enumerate((4, 5, 6)):
+            ref = paged_decode_attention(
+                q[i][None], k_pool, v_pool, table[None],
+                jnp.asarray([qpos + 1], jnp.int32),
+            )[0]
+            np.testing.assert_allclose(
+                np.asarray(out[i]), np.asarray(ref),
+                rtol=1e-5, atol=1e-5,
+            )
+
+
+class TestPagedDecodePath:
+    def test_paged_equals_dense_decode(self):
+        """End to end: chunked paged prefill + paged decode over
+        scattered blocks produce EXACTLY the dense contiguous-cache
+        greedy tokens (fp32)."""
+        cfg = llama.LlamaConfig.tiny(
+            vocab_size=97, dim=32, n_layers=2, n_heads=4,
+            n_kv_heads=2, mlp_dim=64, remat="none",
+            dtype=jnp.float32,
+        )
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        prompt = jnp.array([[5, 9, 2, 7, 1]], jnp.int32)
+        plen, max_new = prompt.shape[1], 6
+        total = plen + max_new
+
+        # dense reference
+        cache = llama.init_kv_cache(cfg, 1, total)
+        logits = None
+        for t in range(plen):
+            logits, cache = llama.decode_step(
+                params, prompt[:, t], cache, jnp.int32(t), cfg
+            )
+        ref = []
+        tok = jnp.argmax(logits, -1).astype(jnp.int32)
+        for t in range(plen, total):
+            ref.append(int(tok[0]))
+            if t == total - 1:
+                break
+            logits, cache = llama.decode_step(
+                params, tok, cache, jnp.int32(t), cfg
+            )
+            tok = jnp.argmax(logits, -1).astype(jnp.int32)
+
+        # paged path, chunk=2 (pads the last chunk)
+        pcfg = PagedCacheConfig(
+            n_layers=cfg.n_layers, n_kv_heads=cfg.n_kv_heads,
+            head_dim=cfg.head_dim, num_blocks=8, block_size=4,
+            dtype=jnp.float32,
+        )
+        bpool = BlockPool(pcfg)
+        bpool.allocate(0, total)
+        table = jnp.asarray(bpool.table_row(0, 4), jnp.int32)
+        pool = init_block_pool(pcfg)
+        chunk_len, last_logits = 2, None
+        for start in range(0, plen, chunk_len):
+            chunk = prompt[:, start:start + chunk_len]
+            pad = chunk_len - chunk.shape[1]
+            if pad:
+                chunk = jnp.pad(chunk, ((0, 0), (0, pad)))
+            last_logits, pool = llama.paged_prefill_chunk(
+                params, chunk, pool, table, jnp.int32(start), cfg
+            )
+        idx = (plen - 1) % chunk_len
+        tok = jnp.argmax(last_logits[:, idx], -1).astype(jnp.int32)
+        out = []
+        for t in range(plen, total):
+            out.append(int(tok[0]))
+            if t == total - 1:
+                break
+            lg, pool = llama.paged_decode_step(
+                params, tok, pool, table[None],
+                jnp.array([t], jnp.int32), jnp.array([True]), cfg,
+            )
+            tok = jnp.argmax(lg, -1).astype(jnp.int32)
+        assert out == ref
+
+    def test_batched_prefill_matches_scan_cache(self):
+        """``llama.prefill`` (one forward) fills the same cache the
+        one-token-at-a-time ``decode_step`` scan fills (fp32)."""
+        cfg = llama.LlamaConfig.tiny(
+            vocab_size=97, dim=32, n_layers=2, n_heads=4,
+            n_kv_heads=2, mlp_dim=64, remat="none",
+            dtype=jnp.float32,
+        )
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        prompt = jnp.array(
+            [[5, 9, 2, 7], [11, 3, 8, 1]], jnp.int32
+        )
+        plen = prompt.shape[1]
+        scan_cache = llama.init_kv_cache(cfg, 2, plen + 2)
+        logits = None
+        for t in range(plen):
+            logits, scan_cache = llama.decode_step(
+                params, prompt[:, t], scan_cache, jnp.int32(t), cfg
+            )
+        fast_cache = llama.init_kv_cache(cfg, 2, plen + 2)
+        all_logits, fast_cache = llama.prefill(
+            params, prompt, fast_cache, cfg
+        )
+        np.testing.assert_allclose(
+            np.asarray(scan_cache["k"][:, :, :plen]),
+            np.asarray(fast_cache["k"][:, :, :plen]),
+            rtol=2e-5, atol=2e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits),
+            np.asarray(all_logits[:, -1]),
+            rtol=2e-4, atol=2e-4,
+        )
