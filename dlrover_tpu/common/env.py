@@ -266,6 +266,65 @@ def serving_drain_grace_s() -> float:
     return env_float(SERVING_DRAIN_ENV, 2.0)
 
 
+KV_INCREMENTAL_ENV = "DLROVER_TPU_KV_INCREMENTAL"
+KV_GROW_BLOCKS_ENV = "DLROVER_TPU_KV_GROW_BLOCKS"
+KV_ADMIT_WATERMARK_ENV = "DLROVER_TPU_KV_ADMIT_WATERMARK"
+KV_PREFIX_CACHE_ENV = "DLROVER_TPU_KV_PREFIX_CACHE"
+DECODE_STEPS_ENV = "DLROVER_TPU_DECODE_STEPS"
+
+
+def kv_incremental_enabled() -> bool:
+    """Kill-switch for the incremental-allocation serving discipline
+    (watermark admission + on-demand block growth + lowest-priority
+    sequence preemption + prefix caching in ``rl/scheduler.py`` /
+    ``rl/kv_cache.py``).  ``DLROVER_TPU_KV_INCREMENTAL=0`` reproduces
+    the PR-13 worst-case reservation admission byte-for-byte (admit
+    only when ``ceil((prompt + max_new) / block_size)`` blocks are
+    free; no growth, no preemption, no shared blocks — pinned by
+    tests).  Default: enabled."""
+    return os.getenv(KV_INCREMENTAL_ENV, "1").lower() not in (
+        "0", "false", "off",
+    )
+
+
+def kv_grow_blocks() -> int:
+    """Decode-time growth quantum: how many blocks an admitted
+    sequence reserves as headroom beyond its prompt, and the chunk its
+    block table grows by when decode crosses a block boundary (>= 1 —
+    the first decode position can sit past the prompt's last block)."""
+    return max(1, int(env_float(KV_GROW_BLOCKS_ENV, 2)))
+
+
+def kv_admit_watermark() -> float:
+    """Watermark admission (incremental mode): a new sequence is
+    admitted only if, after its initial allocation, at least this
+    FRACTION of the usable pool stays free as growth headroom for the
+    sequences already running.  0 = admit whenever the initial
+    allocation fits (maximum admission, maximum preemption churn).
+    The first sequence always admits regardless (progress)."""
+    return min(max(env_float(KV_ADMIT_WATERMARK_ENV, 0.1), 0.0), 0.9)
+
+
+def kv_prefix_cache_enabled() -> bool:
+    """Prefix caching (incremental mode only): content-hash full
+    prompt blocks into a ref-counted shared-block index so requests
+    with a common prompt prefix map the same physical blocks.
+    ``DLROVER_TPU_KV_PREFIX_CACHE=0`` disables sharing while keeping
+    incremental allocation.  Default: enabled (inert unless
+    ``kv_incremental_enabled()``)."""
+    return os.getenv(KV_PREFIX_CACHE_ENV, "1").lower() not in (
+        "0", "false", "off",
+    )
+
+
+def decode_steps() -> int:
+    """Multi-token decode: K decode steps fused into ONE compiled
+    scheduler iteration (K-greedy self-drafting + one batched verify
+    forward; ``rl/scheduler.py``).  ``DLROVER_TPU_DECODE_STEPS=1``
+    (the default) is exactly the PR-13 one-token-per-dispatch loop."""
+    return max(1, int(env_float(DECODE_STEPS_ENV, 1)))
+
+
 PROFILE_ENV = "DLROVER_TPU_PROFILE"
 PROFILE_EVERY_ENV = "DLROVER_TPU_PROFILE_EVERY_N_STEPS"
 CAPTURE_STEPS_ENV = "DLROVER_TPU_CAPTURE_STEPS"

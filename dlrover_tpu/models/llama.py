@@ -635,12 +635,19 @@ def paged_decode_step(
     b = tokens.shape[0]
     nh, nkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     bs = pool["k"].shape[2]
+    mb = block_tables.shape[1]
     x = params["embed"].astype(dt)[tokens][:, None]  # [B, 1, D]
     cos, sin = rope_frequencies(cfg, positions)  # [B, hd/2]
+    # a position past the table (a multi-token draft window running
+    # beyond the sequence's budget) must write to the null block — a
+    # clamped gather would alias the LAST real block and scribble
+    # draft garbage over real K/V
+    blk_idx = positions // bs
     blk = jnp.where(
-        active,
+        active & (blk_idx < mb),
         jnp.take_along_axis(
-            block_tables, (positions // bs)[:, None], axis=1
+            block_tables, jnp.minimum(blk_idx, mb - 1)[:, None],
+            axis=1,
         )[:, 0],
         0,
     )
@@ -685,6 +692,81 @@ def paged_decode_step(
         preferred_element_type=jnp.float32,
     )
     return logits[:, 0], {"k": new_k, "v": new_v}
+
+
+def _apply_rope_grid(x, cos, sin):
+    """x: [B, C, H, D]; cos/sin [B, C, D/2] — every (lane, window
+    offset) pair rotated at its OWN position (the multi-token verify
+    case, where lane b's window starts at ``positions[b]``)."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    cos = cos[:, :, None, :]
+    sin = sin[:, :, None, :]
+    out1 = x1 * cos - x2 * sin
+    out2 = x2 * cos + x1 * sin
+    return jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)
+
+
+def paged_verify_step(
+    params: Dict,
+    tokens: jnp.ndarray,  # [B, C]: window of C tokens per lane
+    pool: Dict,  # {"k","v"}: [L, num_blocks, block_size, KV, D]
+    block_tables: jnp.ndarray,  # [B, max_blocks] int32
+    positions: jnp.ndarray,  # [B] int32: lane's first window position
+    active: jnp.ndarray,  # [B] bool: lane holds a live sequence
+    cfg: LlamaConfig,
+) -> jnp.ndarray:
+    """The speculative-decode verify forward: score a C-token draft
+    window for every lane in ONE forward.  ``tokens[b, i]`` sits at
+    position ``positions[b] + i``; its K/V must already be in the
+    pool (the draft loop wrote it), so this is READ-ONLY — the pool is
+    never touched, which keeps the drafted cache bit-identical whether
+    or not verification ran.  Returns logits ``[B, C, vocab]`` (fp32);
+    row ``i`` predicts the token at position ``positions[b] + i + 1``.
+    Inactive lanes compute on garbage their caller discards."""
+    from dlrover_tpu.ops.paged_attention import paged_verify_attention
+
+    dt = cfg.dtype
+    b, c = tokens.shape
+    nh, nkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    pos_grid = positions[:, None] + jnp.arange(c)[None]  # [B, C]
+    x = params["embed"].astype(dt)[tokens]  # [B, C, D]
+    cos, sin = rope_frequencies(cfg, pos_grid.reshape(-1))
+    cos = cos.reshape(b, c, -1)
+    sin = sin.reshape(b, c, -1)
+    safe_pos = jnp.where(active, positions, 0)
+
+    def body(x, layer_in):
+        lp, k_pool, v_pool = layer_in
+
+        def proj(a, w):
+            return jnp.matmul(
+                a, w.astype(dt), preferred_element_type=jnp.float32
+            ).astype(dt)
+
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = _apply_rope_grid(
+            proj(h, lp["wq"]).reshape(b, c, nh, hd), cos, sin
+        )
+        attn = paged_verify_attention(
+            q, k_pool, v_pool, block_tables, safe_pos
+        )
+        x = x + proj(attn.reshape(b, c, nh * hd), lp["wo"])
+        h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(proj(h, lp["w_gate"]))
+        up = proj(h, lp["w_up"])
+        x = x + proj(gate * up, lp["w_down"])
+        return x, None
+
+    x, _ = lax.scan(
+        body, x, (params["layers"], pool["k"], pool["v"])
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, params["lm_head"].astype(dt),
+        preferred_element_type=jnp.float32,
+    )
+    return logits
 
 
 def paged_prefill_chunk(

@@ -123,6 +123,14 @@ PHASE_STEP_PROFILE = "step_profile"
 PHASE_SERVE_STEP = "serve_step"
 PHASE_PREFILL = "prefill"
 PHASE_DECODE = "decode"
+# incremental-allocation serving (ISSUE 15): one ``preempt`` span per
+# pool-pressure eviction (the victim's blocks return to the pool and
+# the request requeues with its generated tail), one ``verify`` span
+# per fused multi-token decode window (K drafted tokens scored by one
+# batched verify forward).  Same attribution rank as the serving
+# spans above.
+PHASE_PREEMPT = "preempt"
+PHASE_VERIFY = "verify"
 # client-side control-plane wait (a long-poll RPC parked on the
 # master, or the legacy polling loop it replaces).  LOWEST priority:
 # these waits are almost always nested inside rendezvous/restart
@@ -151,6 +159,8 @@ PHASES: Tuple[str, ...] = (
     PHASE_SERVE_STEP,
     PHASE_PREFILL,
     PHASE_DECODE,
+    PHASE_PREEMPT,
+    PHASE_VERIFY,
     PHASE_CONTROL_WAIT,
 )
 
@@ -276,10 +286,20 @@ REQUIRED_SPAN_LABELS: Dict[str, Tuple[str, ...]] = {
     # interval vs starved slots)
     PHASE_SERVE_STEP: ("tokens", "new_tokens", "throughput_tps"),
     # a prefill leg without its chunk size can't distinguish a long
-    # prompt's chunks from a trivial one
+    # prompt's chunks from a trivial one (sites may additionally
+    # carry ``prefix_hit_blocks`` — prompt blocks served from the
+    # shared-block index instead of prefilled)
     PHASE_PREFILL: ("tokens",),
     # a decode leg's sampled-token count IS its progress record
     PHASE_DECODE: ("new_tokens",),
+    # a preemption without its cost (blocks returned to the pool) and
+    # its waste (tokens the victim must re-prefill) is just a blip —
+    # the two numbers ARE the incremental-admission tradeoff
+    PHASE_PREEMPT: ("blocks_freed", "tokens_generated"),
+    # the speculative window's scoreboard: drafted vs accepted is the
+    # whole story of a multi-token decode step (accept rate == the
+    # dispatch amortization actually achieved)
+    PHASE_VERIFY: ("drafted", "accepted"),
 }
 
 

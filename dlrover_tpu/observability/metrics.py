@@ -362,15 +362,22 @@ def record_serving(
     queue_depth=None,
     kv_blocks_used=None,
     p99_latency_s=None,
+    kv_utilization=None,
+    preemptions=None,
+    prefix_hit_rate=None,
+    accepted_tokens_per_step=None,
 ):
     """Export one serving-plane snapshot as gauges
     (``dlrover_tpu_serving_*{replica=...}``): generation throughput,
-    dispatch/admission queue depth, paged-KV pool occupancy and the
-    dispatcher-side end-to-end p99 — the four numbers the serving
-    pane in ``scripts/top.py`` and ``bench_serving.py`` key on.
-    ``None`` fields are skipped (replicas know their pool, only the
-    dispatcher knows fleet latency).  Never raises — metrics must not
-    break the serving loop."""
+    dispatch/admission queue depth, paged-KV pool occupancy, the
+    dispatcher-side end-to-end p99, plus the incremental-allocation
+    vitals — filled-cache utilization, cumulative preemptions, the
+    shared-block prefix hit rate and the multi-token decode
+    accept-per-window mean — the numbers the serving pane in
+    ``scripts/top.py`` and ``bench_serving.py`` key on.  ``None``
+    fields are skipped (replicas know their pool, only the dispatcher
+    knows fleet latency).  Never raises — metrics must not break the
+    serving loop."""
     try:
         reg = get_registry()
         labels = {"replica": replica}
@@ -396,6 +403,30 @@ def record_serving(
             reg.set_gauge(
                 "dlrover_tpu_serving_p99_latency",
                 float(p99_latency_s),
+                labels=labels,
+            )
+        if kv_utilization is not None:
+            reg.set_gauge(
+                "dlrover_tpu_serving_kv_utilization",
+                float(kv_utilization),
+                labels=labels,
+            )
+        if preemptions is not None:
+            reg.set_gauge(
+                "dlrover_tpu_serving_preemptions",
+                float(preemptions),
+                labels=labels,
+            )
+        if prefix_hit_rate is not None:
+            reg.set_gauge(
+                "dlrover_tpu_serving_prefix_hit_rate",
+                float(prefix_hit_rate),
+                labels=labels,
+            )
+        if accepted_tokens_per_step is not None:
+            reg.set_gauge(
+                "dlrover_tpu_serving_accepted_tokens_per_step",
+                float(accepted_tokens_per_step),
                 labels=labels,
             )
     except Exception as e:  # noqa: BLE001

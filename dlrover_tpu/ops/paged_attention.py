@@ -24,10 +24,25 @@ gather here (``pool[tables]``) is the reference semantics of that
 grid; on TPU the kernel would DMA blocks VMEM-resident instead of
 materializing the gathered ``[B, T, KV, D]`` intermediate.
 
+A third op serves the multi-token (speculative self-drafting) decode
+path:
+
+- :func:`paged_verify_attention` — K query tokens PER LANE (``[B, C,
+  H, D]``) over each lane's paged prefix, causal within the window;
+  the one-forward verification of a K-token draft.
+
 Masking contract: key position ``t`` is visible iff ``t < seq_len``
-(decode) / ``t <= query_pos`` (prefill).  Block 0 is the NULL block —
-schedulers point unallocated table entries and inactive lanes at it;
-its contents are garbage by design and every read of it is masked.
+(decode) / ``t <= query_pos`` (prefill/verify).  Block 0 is the NULL
+block — schedulers point unallocated table entries and inactive lanes
+at it; its contents are garbage by design and every read of it is
+masked.
+
+Sharing contract (prefix caching): a block is IMMUTABLE once all
+``block_size`` positions are written, so several sequences' tables may
+alias the same physical block id read-only — the gather is oblivious
+to aliasing, and no copy-on-write is needed because writers only ever
+touch a sequence's private tail blocks (``rl/kv_cache.py`` enforces
+the ownership discipline).
 """
 
 from typing import Tuple
@@ -115,6 +130,47 @@ def paged_prefill_attention(
         preferred_element_type=jnp.float32,
     ).astype(v.dtype)
     return out.reshape(c, nh, d)
+
+
+def paged_verify_attention(
+    q: jnp.ndarray,  # [B, C, H, D] a window of C query tokens per lane
+    k_pool: jnp.ndarray,  # [num_blocks, block_size, KV, D]
+    v_pool: jnp.ndarray,  # [num_blocks, block_size, KV, D]
+    block_tables: jnp.ndarray,  # [B, max_blocks] int32 block ids
+    positions: jnp.ndarray,  # [B] int32: lane's first window position
+) -> jnp.ndarray:
+    """Batched-lane windowed attention: query ``i`` of lane ``b`` (at
+    position ``positions[b] + i``) attends keys at positions
+    ``<= positions[b] + i`` — the cached prefix plus causal within the
+    window.  The window's own K/V must already sit in the pool (the
+    draft loop wrote it); this op never writes.  Returns
+    ``[B, C, H, D]``.  The decode-hot verify forward of speculative
+    multi-token decode: one call scores a K-token draft for every
+    lane."""
+    b, c, nh, d = q.shape
+    nkv = k_pool.shape[2]
+    group = nh // nkv
+    k = _gather_pool(k_pool, block_tables)  # [B, T, KV, D]
+    v = _gather_pool(v_pool, block_tables)
+    t = k.shape[1]
+    qg = q.reshape(b, c, nkv, group, d)
+    logits = jnp.einsum(
+        "bckgd,btkd->bckgt", qg, k,
+        preferred_element_type=jnp.float32,
+    ) * (d**-0.5)
+    q_pos = positions[:, None] + jnp.arange(c)[None]  # [B, C]
+    visible = (
+        jnp.arange(t)[None, None] <= q_pos[:, :, None]
+    )  # [B, C, T]
+    logits = jnp.where(visible[:, :, None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum(
+        "bckgt,btkd->bckgd",
+        probs.astype(v.dtype),
+        v,
+        preferred_element_type=jnp.float32,
+    ).astype(v.dtype)
+    return out.reshape(b, c, nh, d)
 
 
 def write_block_kv(

@@ -65,6 +65,10 @@ _KIND_RESULT = 0
 _KIND_READY = 1
 _KIND_DRAINED = 2
 _KIND_STATS = 3
+# a request the replica REJECTED (scheduler refused the submit):
+# the dispatcher must fail it to the caller immediately — silence
+# here would block result() for the whole request timeout
+_KIND_REJECT = 4
 _FINISH_CODES = {"length": 0, "eos": 1}
 _FINISH_NAMES = {v: k for k, v in _FINISH_CODES.items()}
 
@@ -385,8 +389,11 @@ def _resp_spec(max_total: int):
             # req_id, kind, total_len, new_tokens, finish_code, version
             "meta": ((6,), "<i8"),
             "tokens": ((max_total,), "<i4"),
-            # latency_s, ttft_s, worker_gen_s, tokens_per_s
-            "times": ((4,), "<f8"),
+            # RESULT: latency_s, ttft_s, worker_gen_s, tokens_per_s
+            # STATS:  tokens_per_s, queue_depth, kv_blocks_used,
+            #         kv_utilization, preemptions, prefix_hit_rate,
+            #         accepted_tokens_per_step (trailing slot spare)
+            "times": ((8,), "<f8"),
         }
     )
 
@@ -507,6 +514,7 @@ def _serving_worker_loop(spec) -> int:
         ),
         paged_decode_fn=parts.get("paged_decode_fn"),
         paged_prefill_fn=parts.get("paged_prefill_fn"),
+        paged_verify_fn=parts.get("paged_verify_fn"),
         events=get_event_logger(),
     )
     template = parts["params_template_fn"]()
@@ -539,7 +547,7 @@ def _serving_worker_loop(spec) -> int:
 
     def _respond(kind: int, req_id: int = -1, tokens=None,
                  new_tokens: int = 0, finish: str = "length",
-                 times=(0.0, 0.0, 0.0, 0.0)):
+                 times=()):
         """Publish one message; a RESULT must never be silently
         dropped (the dispatcher would block its caller for the full
         request timeout on a request whose compute finished), so a
@@ -550,6 +558,8 @@ def _serving_worker_loop(spec) -> int:
         buf = np.zeros((max_total,), np.int32)
         if tokens is not None:
             buf[:total] = tokens
+        padded = np.zeros((8,), np.float64)
+        padded[: len(times)] = times
         msg = {
             "meta": np.asarray(
                 [req_id, kind, total, new_tokens,
@@ -557,7 +567,7 @@ def _serving_worker_loop(spec) -> int:
                 np.int64,
             ),
             "tokens": buf,
-            "times": np.asarray(times, np.float64),
+            "times": padded,
         }
         while True:
             if resp_ring.try_put(
@@ -610,12 +620,24 @@ def _serving_worker_loop(spec) -> int:
             req_id, plen, max_new, seed = (
                 int(v) for v in msg["meta"]
             )
-            scheduler.submit(
-                msg["prompt"][:plen],
-                max_new=max_new,
-                seed=seed,
-                req_id=req_id,
-            )
+            try:
+                scheduler.submit(
+                    msg["prompt"][:plen],
+                    max_new=max_new,
+                    seed=seed,
+                    req_id=req_id,
+                )
+            except ValueError as e:
+                # belt-and-suspenders (the dispatcher validates at
+                # its own submit): a malformed ring message must not
+                # kill the replica — a dead replica cascades the
+                # request onto the survivors — and must be ANSWERED,
+                # or the caller blocks for the full request timeout
+                logger.error(
+                    "replica %s rejected request %d: %s",
+                    tag, req_id, e,
+                )
+                _respond(_KIND_REJECT, req_id=req_id)
         if scheduler.idle:
             time.sleep(0.002)
             continue
@@ -626,21 +648,29 @@ def _serving_worker_loop(spec) -> int:
         now = time.monotonic()
         if now - window_t0 >= 1.0:
             tps = window_tokens / (now - window_t0)
+            st = scheduler.stats()
             record_serving(
                 replica=tag,
                 tokens_per_s=tps,
                 queue_depth=scheduler.queue_depth,
                 kv_blocks_used=scheduler.block_pool.used_blocks,
+                kv_utilization=st["kv_utilization"],
+                preemptions=st["preemptions"],
+                prefix_hit_rate=st["prefix_hit_rate"],
+                accepted_tokens_per_step=st["accepted_per_step"],
             )
-            # the dispatcher-side serving pane reads the same three
-            # numbers off the response ring (best-effort)
+            # the dispatcher-side serving pane reads the same numbers
+            # off the response ring (best-effort)
             _respond(
                 _KIND_STATS,
                 times=(
                     tps,
                     float(scheduler.queue_depth),
                     float(scheduler.block_pool.used_blocks),
-                    0.0,
+                    float(st["kv_utilization"]),
+                    float(st["preemptions"]),
+                    float(st["prefix_hit_rate"]),
+                    float(st["accepted_per_step"]),
                 ),
             )
             window_tokens = 0
@@ -675,6 +705,14 @@ def _serving_worker_loop(spec) -> int:
 # --------------------------------------------------------------------------
 # dispatcher
 # --------------------------------------------------------------------------
+
+
+def least_outstanding(replicas):
+    """Routing policy: fewest in-flight requests wins, ties broken by
+    LOWEST replica id — fully deterministic whatever order the alive
+    list was built in, so bench runs and the kill-one-mid-load test
+    reproduce across dict/list orderings (pinned by test)."""
+    return min(replicas, key=lambda r: (len(r.outstanding), r.idx))
 
 
 @dataclass
@@ -885,6 +923,25 @@ class ServingEngine:
                 f"prompt {prompt.size} + max_new {max_new} exceeds "
                 f"max_seq_len {self._max_seq_len}"
             )
+        # the replica scheduler's incremental-mode pool guard,
+        # enforced HERE with the SAME definition
+        # (kv_cache.pool_can_ever_hold): a request whose worst case
+        # exceeds a replica's whole pool would otherwise be refused
+        # inside the worker — answered as a rejection, but only after
+        # burning a dispatch — so fail it at the front door
+        from dlrover_tpu.common.env import kv_incremental_enabled
+        from dlrover_tpu.rl.kv_cache import pool_can_ever_hold
+
+        s = self._spec["sched"]
+        if kv_incremental_enabled() and not pool_can_ever_hold(
+            int(s["num_blocks"]), int(s["block_size"]),
+            prompt.size + max_new,
+        ):
+            raise ValueError(
+                f"prompt {prompt.size} + max_new {max_new} exceeds "
+                f"the replica pool of {int(s['num_blocks']) - 1} "
+                "blocks"
+            )
         with self._lock:
             req_id = self._next_id
             self._next_id += 1
@@ -1035,7 +1092,31 @@ class ServingEngine:
                     "tokens_per_s": round(float(msg["times"][0]), 2),
                     "queue_depth": int(msg["times"][1]),
                     "kv_blocks_used": int(msg["times"][2]),
+                    "kv_utilization": round(
+                        float(msg["times"][3]), 4
+                    ),
+                    "preemptions": int(msg["times"][4]),
+                    "prefix_hit_rate": round(
+                        float(msg["times"][5]), 4
+                    ),
+                    "accepted_per_step": round(
+                        float(msg["times"][6]), 4
+                    ),
                 }
+                continue
+            if kind == _KIND_REJECT:
+                req_id = int(meta[0])
+                rep.outstanding.pop(req_id, None)
+                self._complete(
+                    req_id,
+                    {
+                        "error": (
+                            f"request {req_id} rejected by replica "
+                            f"{rep.idx} (scheduler refused the "
+                            "submit — see the replica log)"
+                        )
+                    },
+                )
                 continue
             if kind != _KIND_RESULT:
                 continue
@@ -1130,7 +1211,7 @@ class ServingEngine:
                     },
                 )
                 continue
-            rep = min(alive, key=lambda r: len(r.outstanding))
+            rep = least_outstanding(alive)
             ok = rep.req_ring.try_put(
                 {
                     "meta": np.asarray(

@@ -22,12 +22,41 @@ the next request; here scheduling happens at TOKEN granularity:
   SAME iteration — mixed-length traffic never waits for the longest
   sequence in a batch (the dense-batch pathology this replaces).
 
+Allocation disciplines (``DLROVER_TPU_KV_INCREMENTAL``, default on):
+
+- **incremental** (vLLM-style): admission reserves only the prompt's
+  blocks plus ``DLROVER_TPU_KV_GROW_BLOCKS`` headroom and is gated by
+  a free-pool watermark (``DLROVER_TPU_KV_ADMIT_WATERMARK``); block
+  tables grow on demand at decode time, and when the pool runs dry
+  the LOWEST-PRIORITY running sequence (fewest tokens generated,
+  youngest admission) is PREEMPTED — its blocks freed, the request
+  requeued at the queue head carrying its generated tail, so it
+  re-prefills and resumes deterministically (sampling is a pure
+  function of (seed, position), so the final tokens are identical —
+  pinned by test).  Prefix caching rides this mode: full prompt
+  blocks are content-hashed into the pool's ref-counted shared-block
+  index, so a repeated system prompt maps the same physical blocks.
+- **reservation** (``=0``, the PR-13 kill-switch path): admission
+  reserves the worst case (prompt + max_new) up front — no growth, no
+  preemption, no sharing; byte-for-byte the old behavior.
+
+Multi-token decode (``DLROVER_TPU_DECODE_STEPS=K``, default 1): one
+fused compiled program runs K greedy self-drafting decode steps plus
+ONE batched verify forward (``models.llama.paged_verify_step``) per
+iteration, then accepts the longest draft prefix the verify pass
+agrees with — at temperature 0 the emitted stream is exactly the K=1
+loop's (each draft step IS the K=1 computation), at sampled
+temperatures acceptance is rejection-style (every emitted token is
+sampled from its true conditional).  Host dispatch drops by up to K×
+on the CPU-bound path — the ``dispatches`` counter measures it.
+
 Determinism: each request's tokens are sampled with
 ``fold_in(PRNGKey(seed), position)`` — a function of (seed, position)
 only, independent of which slot/iteration served it.  The same
 request produces the same tokens whether it ran alone, continuously
-batched, after a drain-requeue, or on a different replica; tests pin
-tail parity against an unbatched reference on exactly this property.
+batched, after a drain-requeue or preemption-resume, or on a
+different replica; tests pin tail parity against an unbatched
+reference on exactly this property.
 """
 
 import time
@@ -36,26 +65,46 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from dlrover_tpu.common.env import (
+    decode_steps,
+    kv_admit_watermark,
+    kv_grow_blocks,
+    kv_incremental_enabled,
+    kv_prefix_cache_enabled,
+)
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.rl.kv_cache import (
     BlockPool,
+    OutOfBlocksError,
     PagedCacheConfig,
     init_block_pool,
+    pool_can_ever_hold,
+    prefix_block_keys,
 )
 
 FINISH_EOS = "eos"
 FINISH_LENGTH = "length"
 
 
+def _empty_tokens() -> np.ndarray:
+    return np.zeros((0,), np.int32)
+
+
 @dataclass
 class GenRequest:
-    """One generation request (prompt in, sampled tail out)."""
+    """One generation request (prompt in, sampled tail out).
+
+    ``resume_tokens`` carries a preempted sequence's generated tail:
+    on re-admission the scheduler re-prefills prompt+tail and resumes
+    sampling at the next position — (seed, position)-purity makes the
+    continuation identical to the uninterrupted run."""
 
     req_id: int
     prompt: np.ndarray  # [P] int32
     max_new: int
     seed: int = 0
     submit_t: float = field(default_factory=time.monotonic)
+    resume_tokens: np.ndarray = field(default_factory=_empty_tokens)
 
 
 @dataclass
@@ -93,6 +142,11 @@ class _Slot:
     req: Optional[GenRequest] = None
     phase: str = "free"  # free | prefill | decode
     prefill_pos: int = 0
+    prefill_tokens: np.ndarray = field(default_factory=_empty_tokens)
+    prefill_len: int = 0  # prompt + resume-tail tokens to prefill
+    prefix_keys: List[str] = field(default_factory=list)
+    shared_upto: int = 0  # prompt blocks registered in the index
+    admit_seq: int = 0  # monotonic admission order (victim policy)
     generated: List[int] = field(default_factory=list)
     first_token_t: float = 0.0
 
@@ -101,8 +155,9 @@ class ContinuousBatchingScheduler:
     """The token-level serving loop over a paged KV cache.
 
     ``model_cfg`` is a ``models.llama.LlamaConfig`` (or any config the
-    supplied ``paged_decode_fn`` / ``paged_prefill_fn`` accept — the
-    same injection seam ``KVCacheBackend`` uses)."""
+    supplied ``paged_decode_fn`` / ``paged_prefill_fn`` /
+    ``paged_verify_fn`` accept — the same injection seam
+    ``KVCacheBackend`` uses)."""
 
     def __init__(
         self,
@@ -110,6 +165,7 @@ class ContinuousBatchingScheduler:
         sched: Optional[SchedulerConfig] = None,
         paged_decode_fn: Optional[Callable] = None,
         paged_prefill_fn: Optional[Callable] = None,
+        paged_verify_fn: Optional[Callable] = None,
         events=None,
     ):
         import jax
@@ -132,6 +188,19 @@ class ContinuousBatchingScheduler:
         self._prefill_model = paged_prefill_fn or partial(
             llama.paged_prefill_chunk, cfg=model_cfg
         )
+        self._verify_model = paged_verify_fn or partial(
+            llama.paged_verify_step, cfg=model_cfg
+        )
+
+        # allocation/decode discipline (env-pinned at construction so
+        # a scheduler never changes personality mid-flight)
+        self.incremental = kv_incremental_enabled()
+        self.grow_blocks = kv_grow_blocks()
+        self.admit_watermark = kv_admit_watermark()
+        self.prefix_cache = (
+            self.incremental and kv_prefix_cache_enabled()
+        )
+        self.decode_k = decode_steps()
 
         cache_cfg = PagedCacheConfig(
             n_layers=model_cfg.n_layers,
@@ -154,14 +223,26 @@ class ContinuousBatchingScheduler:
         self._keys = np.zeros((S, 2), np.uint32)
         self._slots = [_Slot() for _ in range(S)]
         self._queue: List[GenRequest] = []
+        # full-prompt block keys memoized per req_id: _admit probes
+        # the blocked queue head every iteration, and SHA-1-hashing a
+        # long system prompt 3x per step is hot-loop host work
+        # (dropped at finish; preemption re-admits the same req_id)
+        self._prompt_keys: Dict[int, List[str]] = {}
         self._next_req_id = 0
         self._prefill_rr = 0  # round-robin pointer over prefill slots
+        self._admit_counter = 0
         self.draining = False
 
         # counters the serving gauges/bench read
         self.total_new_tokens = 0
         self.total_prefill_tokens = 0
         self.iterations = 0
+        self.preemptions = 0
+        self.grown_blocks = 0
+        self.dispatches = 0  # jitted-program invocations (host cost)
+        self.accepted_tokens = 0  # multi-token decode: tokens kept
+        self.lane_windows = 0  # multi-token decode: (lane, window)s
+        self._window_hit_blocks = 0  # prefix hits since last emit
 
         temp = float(s.temperature)
 
@@ -176,6 +257,22 @@ class ContinuousBatchingScheduler:
                 lambda k, l: jax.random.categorical(k, l / temp)
             )(folded, logits).astype(jnp.int32)
 
+        def _sample_grid(logits, keys, sample_pos):
+            """logits [S, K, V]; sample_pos [S, K] — the K-window
+            version of ``_sample_rows`` (same contract per cell)."""
+            if temp <= 0:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            folded = jax.vmap(
+                lambda k, ps: jax.vmap(
+                    lambda p: jax.random.fold_in(k, p)
+                )(ps)
+            )(keys, sample_pos)
+            return jax.vmap(
+                jax.vmap(
+                    lambda k, l: jax.random.categorical(k, l / temp)
+                )
+            )(folded, logits).astype(jnp.int32)
+
         def _decode(params, pool, tokens, tables, positions, active,
                     keys):
             logits, pool = self._decode_model(
@@ -183,6 +280,42 @@ class ContinuousBatchingScheduler:
             )
             nxt = _sample_rows(logits, keys, positions + 1)
             return pool, nxt
+
+        K = self.decode_k
+
+        def _decode_multi(params, pool, tokens, tables, positions,
+                          active, keys):
+            """K fused decode steps: greedy self-drafting (each draft
+            step IS the K=1 computation, so at temp 0 drafts are the
+            reference stream) + ONE batched verify forward whose
+            real-rule samples gate acceptance.  Returns (pool, drafts
+            [S, K], verify samples [S, K], leading-match count [S])."""
+            drafts = []
+            tok, pos = tokens, positions
+            for _ in range(K):
+                logits, pool = self._decode_model(
+                    params, tok, pool, tables, pos, active
+                )
+                d = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                drafts.append(d)
+                tok, pos = d, pos + 1
+            drafts = jnp.stack(drafts, axis=1)  # [S, K]
+            # verify inputs: the window tokens actually occupying
+            # positions p..p+K-1 (current token + first K-1 drafts) —
+            # their K/V is already in the pool from the draft loop
+            vin = jnp.concatenate(
+                [tokens[:, None], drafts[:, :-1]], axis=1
+            )
+            vlogits = self._verify_model(
+                params, vin, pool, tables, positions, active
+            )  # [S, K, V]
+            steps = jnp.arange(K, dtype=positions.dtype)
+            ver = _sample_grid(
+                vlogits, keys, positions[:, None] + 1 + steps[None]
+            )
+            eq = (ver == drafts).astype(jnp.int32)
+            n_match = jnp.sum(jnp.cumprod(eq, axis=1), axis=1)
+            return pool, drafts, ver, n_match
 
         def _prefill(params, pool, chunk, table, start):
             logits, pool = self._prefill_model(
@@ -196,6 +329,10 @@ class ContinuousBatchingScheduler:
             )[0]
 
         self._decode_jit = jax.jit(_decode, donate_argnums=(1,))
+        self._decode_multi_jit = (
+            jax.jit(_decode_multi, donate_argnums=(1,))
+            if K > 1 else None
+        )
         self._prefill_jit = jax.jit(_prefill, donate_argnums=(1,))
         self._sample_jit = jax.jit(_sample_one)
 
@@ -234,6 +371,19 @@ class ContinuousBatchingScheduler:
                 f"prompt {prompt.size} + max_new {max_new} exceeds "
                 f"max_seq_len {self.sched.max_seq_len}"
             )
+        if self.incremental and not pool_can_ever_hold(
+            self.pool_cfg.num_blocks,
+            self.pool_cfg.block_size,
+            prompt.size + max_new,
+        ):
+            # under incremental allocation a lone sequence must be
+            # able to run to its budget after preempting everyone
+            # else; a worst case bigger than the whole pool can't
+            raise ValueError(
+                f"prompt {prompt.size} + max_new {max_new} needs "
+                f"{self.pool_cfg.blocks_for(prompt.size + max_new)} "
+                f"blocks > pool of {self.pool_cfg.usable_blocks}"
+            )
         if req_id is None:
             req_id = self._next_req_id
         self._next_req_id = max(self._next_req_id, req_id) + 1
@@ -257,7 +407,9 @@ class ContinuousBatchingScheduler:
 
     def compile_counts(self) -> Dict[str, int]:
         """Compiled-program census: decode must stay at 1 across any
-        admission/eviction traffic (asserted by tier-1)."""
+        admission/eviction/growth/preemption traffic (asserted by
+        tier-1).  ``decode`` reports the ACTIVE decode program — the
+        fused multi-token one when ``DLROVER_TPU_DECODE_STEPS>1``."""
 
         def n(f):
             try:
@@ -265,8 +417,13 @@ class ContinuousBatchingScheduler:
             except Exception:  # noqa: BLE001 - jax-version specific
                 return -1
 
+        active_decode = (
+            self._decode_multi_jit
+            if self._decode_multi_jit is not None
+            else self._decode_jit
+        )
         return {
-            "decode": n(self._decode_jit),
+            "decode": n(active_decode),
             "prefill": n(self._prefill_jit),
             "sample": n(self._sample_jit),
         }
@@ -279,10 +436,93 @@ class ContinuousBatchingScheduler:
             iterations=self.iterations,
             total_new_tokens=self.total_new_tokens,
             total_prefill_tokens=self.total_prefill_tokens,
+            preemptions=self.preemptions,
+            grown_blocks=self.grown_blocks,
+            dispatches=self.dispatches,
+            decode_steps=self.decode_k,
+            incremental=int(self.incremental),
+            accepted_tokens=self.accepted_tokens,
+            lane_windows=self.lane_windows,
+            accepted_per_step=round(
+                self.accepted_tokens / max(self.lane_windows, 1), 4
+            ),
         )
         return st
 
     # ------------------------------------------------------ scheduling
+    def _full_prompt_keys(self, req: GenRequest) -> List[str]:
+        """Content keys for every FULL block of the request's
+        original prompt (computed once per req_id; prompts are
+        immutable, resume tails never register)."""
+        keys = self._prompt_keys.get(req.req_id)
+        if keys is None:
+            bs = self.sched.block_size
+            keys = prefix_block_keys(
+                req.prompt[: (int(req.prompt.size) // bs) * bs], bs
+            )
+            self._prompt_keys[req.req_id] = keys
+        return keys
+
+    def _admissible(self, req: GenRequest):
+        """Decide admission and size the initial allocation.  Returns
+        ``None`` (keep queued — FIFO head-of-line) or a dict the
+        admission path consumes."""
+        cfgp = self.pool_cfg
+        bs = cfgp.block_size
+        prefill_tokens = (
+            np.concatenate([req.prompt, req.resume_tokens])
+            if req.resume_tokens.size else req.prompt
+        )
+        plen = int(prefill_tokens.size)
+        total = int(req.prompt.size) + int(req.max_new)
+        if not self.incremental:
+            # PR-13 reservation admission: the worst case must fit
+            if not self.block_pool.can_allocate(total):
+                return None
+            return {
+                "prefill_tokens": prefill_tokens,
+                "n_tokens": total,
+                "extra": 0,
+                "keys": [],
+                "peek_hits": 0,
+            }
+        keys: List[str] = []
+        peek = peek_lru = 0
+        if self.prefix_cache:
+            # only blocks fully inside the ORIGINAL prompt are ever
+            # registered, and at least one token must remain to
+            # prefill (its logits seed the first sampled token)
+            max_hit = min(
+                (plen - 1) // bs, int(req.prompt.size) // bs
+            )
+            if max_hit > 0:
+                keys = self._full_prompt_keys(req)[:max_hit]
+                peek, peek_lru = self.block_pool.peek_prefix(keys)
+        headroom = min(
+            self.grow_blocks,
+            max(cfgp.blocks_for(total) - cfgp.blocks_for(plen), 0),
+        )
+        need = cfgp.blocks_for(plen) - peek + headroom
+        watermark_blocks = int(
+            np.ceil(self.admit_watermark * cfgp.usable_blocks)
+        )
+        # hits parked in the LRU are consumed BY the acquire — they
+        # must not double-count as evictable capacity
+        avail = self.block_pool.available_blocks - peek_lru
+        if self.block_pool.live_sequences > 0 and (
+            avail - need < watermark_blocks
+        ):
+            return None  # watermark: keep headroom for running lanes
+        if avail < need:
+            return None
+        return {
+            "prefill_tokens": prefill_tokens,
+            "n_tokens": plen,
+            "extra": headroom,
+            "keys": keys,
+            "peek_hits": peek,
+        }
+
     def _admit(self):
         s = self.sched
         while self._queue and not self.draining:
@@ -293,14 +533,23 @@ class ContinuousBatchingScheduler:
             if not free:
                 return
             req = self._queue[0]
-            need = req.prompt.size + req.max_new
-            if not self.block_pool.can_allocate(need):
+            plan = self._admissible(req)
+            if plan is None:
                 # FIFO head-of-line: later (smaller) requests must not
                 # starve the head forever
                 return
             self._queue.pop(0)
             slot = free[0]
-            self.block_pool.allocate(req.req_id, need)
+            hit_ids = (
+                self.block_pool.acquire_prefix(plan["keys"])
+                if plan["keys"] else []
+            )
+            self.block_pool.allocate(
+                req.req_id,
+                plan["n_tokens"],
+                extra_blocks=plan["extra"],
+                prefix_blocks=hit_ids,
+            )
             row = self.block_pool.table_row(
                 req.req_id, s.max_blocks_per_seq
             )
@@ -311,7 +560,27 @@ class ContinuousBatchingScheduler:
             self._keys[slot] = np.asarray(
                 self._jax.random.key_data(key), np.uint32
             ).reshape(-1)[:2]
-            self._slots[slot] = _Slot(req=req, phase="prefill")
+            n_hit = len(hit_ids)
+            self._admit_counter += 1
+            sl = _Slot(
+                req=req,
+                phase="prefill",
+                prefill_tokens=plan["prefill_tokens"],
+                prefill_len=int(plan["prefill_tokens"].size),
+                prefix_keys=(
+                    self._full_prompt_keys(req)
+                    if self.prefix_cache else []
+                ),
+                shared_upto=n_hit,
+                admit_seq=self._admit_counter,
+            )
+            # cached prefix blocks are already filled: prefill starts
+            # past them
+            sl.prefill_pos = n_hit * s.block_size
+            sl.generated = [int(t) for t in req.resume_tokens]
+            self._slots[slot] = sl
+            self.block_pool.note_filled(req.req_id, sl.prefill_pos)
+            self._window_hit_blocks += n_hit
 
     def _finish(self, slot: int, reason: str,
                 finished: List[GenResult]):
@@ -336,12 +605,128 @@ class ContinuousBatchingScheduler:
             )
         )
         self.block_pool.free(req.req_id)
+        self._prompt_keys.pop(req.req_id, None)
         # zero the table row: a freed block re-issued to another
         # sequence must never be gathered through this lane again
         self._tables[slot] = 0
         self._positions[slot] = 0
         self._active[slot] = False
         self._slots[slot] = _Slot()
+
+    def _preempt(self, slot: int):
+        """Evict the sequence in ``slot`` (pool pressure): free its
+        blocks and requeue it AT THE HEAD carrying its generated tail
+        — on re-admission it re-prefills prompt+tail and resumes the
+        identical (seed, position)-pure continuation."""
+        sl = self._slots[slot]
+        req = sl.req
+        t0 = time.monotonic()
+        n_blocks = len(self.block_pool.blocks_of(req.req_id))
+        self.block_pool.free(req.req_id)
+        resume = np.asarray(sl.generated, np.int32)
+        self._queue.insert(
+            0,
+            GenRequest(
+                req_id=req.req_id,
+                prompt=req.prompt,
+                max_new=req.max_new,
+                seed=req.seed,
+                submit_t=req.submit_t,
+                resume_tokens=resume,
+            ),
+        )
+        self._tables[slot] = 0
+        self._positions[slot] = 0
+        self._active[slot] = False
+        self._slots[slot] = _Slot()
+        self.preemptions += 1
+        if self._events is not None and self._events.enabled:
+            from dlrover_tpu.observability.events import anchored_now
+
+            dur = max(time.monotonic() - t0, 1e-9)
+            self._events.complete(
+                "preempt",
+                anchored_now(t0),
+                dur,
+                blocks_freed=n_blocks,
+                tokens_generated=int(resume.size),
+            )
+        logger.info(
+            "preempted seq %d (pool dry): freed %d block(s), "
+            "requeued with %d generated token(s)",
+            req.req_id, n_blocks, resume.size,
+        )
+
+    def _pick_victim(self, exclude: int) -> Optional[int]:
+        """Lowest-priority live sequence: fewest tokens generated,
+        tie broken youngest-admission-first."""
+        candidates = [
+            i for i, sl in enumerate(self._slots)
+            if sl.req is not None and i != exclude
+        ]
+        if not candidates:
+            return None
+        return min(
+            candidates,
+            key=lambda i: (
+                len(self._slots[i].generated),
+                -self._slots[i].admit_seq,
+            ),
+        )
+
+    def _ensure_blocks(self):
+        """Incremental mode: before a decode window, every decoding
+        lane must own blocks covering its next K write positions —
+        grow on demand, preempt the lowest-priority lane when the pool
+        (free + evictable shared) runs dry.  Oldest lanes grow first
+        so pressure lands on the youngest."""
+        if not self.incremental:
+            return
+        cfgp = self.pool_cfg
+        order = sorted(
+            (
+                i for i, sl in enumerate(self._slots)
+                if sl.phase == "decode"
+            ),
+            key=lambda i: self._slots[i].admit_seq,
+        )
+        for slot in order:
+            sl = self._slots[slot]
+            if sl.req is None:
+                continue  # preempted while an older lane grew
+            req = sl.req
+            total = int(req.prompt.size) + int(req.max_new)
+            need_tokens = min(
+                int(self._positions[slot]) + self.decode_k, total
+            )
+            while (
+                self.block_pool.covered_tokens(req.req_id)
+                < need_tokens
+            ):
+                owned = len(self.block_pool.blocks_of(req.req_id))
+                short = cfgp.blocks_for(need_tokens) - owned
+                want = min(
+                    max(short, self.grow_blocks),
+                    cfgp.blocks_for(total) - owned,
+                )
+                try:
+                    self.block_pool.extend(req.req_id, want)
+                    self.grown_blocks += want
+                except OutOfBlocksError:
+                    victim = self._pick_victim(exclude=slot)
+                    if victim is None:
+                        raise OutOfBlocksError(
+                            f"seq {req.req_id} cannot grow and no "
+                            "victim remains — pool smaller than one "
+                            "sequence's worst case"
+                        ) from None
+                    self._preempt(victim)
+                    if self._slots[slot].req is None:
+                        break  # defensive: we were the victim
+            if self._slots[slot].req is not None:
+                self._tables[slot] = self.block_pool.table_row(
+                    req.req_id, self.sched.max_blocks_per_seq
+                )
 
     def _append_token(self, slot: int, token: int,
                       finished: List[GenResult]) -> bool:
@@ -361,6 +746,22 @@ class ContinuousBatchingScheduler:
             return True
         return False
 
+    def _share_filled_blocks(self, slot: int):
+        """Register prompt blocks the prefill has just completed into
+        the shared index (full blocks are immutable from here on)."""
+        sl = self._slots[slot]
+        if not sl.prefix_keys:
+            return
+        bs = self.sched.block_size
+        full_now = min(
+            sl.prefill_pos // bs, len(sl.prefix_keys)
+        )
+        for idx in range(sl.shared_upto, full_now):
+            self.block_pool.share_block(
+                sl.req.req_id, idx, sl.prefix_keys[idx]
+            )
+        sl.shared_upto = max(sl.shared_upto, full_now)
+
     def _prefill_one(self, finished: List[GenResult]) -> int:
         """Run ONE prompt chunk (round-robin over prefilling slots);
         returns the number of prompt tokens processed."""
@@ -375,9 +776,9 @@ class ContinuousBatchingScheduler:
         self._prefill_rr += 1
         sl = self._slots[slot]
         req = sl.req
-        plen = req.prompt.size
+        plen = sl.prefill_len
         start = sl.prefill_pos
-        chunk = req.prompt[start:start + s.prefill_chunk]
+        chunk = sl.prefill_tokens[start:start + s.prefill_chunk]
         real = chunk.size
         if real < s.prefill_chunk:
             chunk = np.pad(chunk, (0, s.prefill_chunk - real))
@@ -389,17 +790,20 @@ class ContinuousBatchingScheduler:
             jnp.asarray(self._tables[slot]),
             jnp.int32(start),
         )
+        self.dispatches += 1
         sl.prefill_pos += real
         self.total_prefill_tokens += real
         self.block_pool.note_filled(req.req_id, sl.prefill_pos)
+        self._share_filled_blocks(slot)
         if sl.prefill_pos >= plen:
-            # sample the first new token from the last REAL prompt
+            # sample the first new token from the last REAL prefill
             # position's logits (it lives inside this chunk)
             tok = self._sample_jit(
                 logits[0, plen - 1 - start],
                 jnp.asarray(self._keys[slot]),
                 jnp.int32(plen),
             )
+            self.dispatches += 1
             sl.phase = "decode"
             self._positions[slot] = plen
             self._active[slot] = True
@@ -427,6 +831,7 @@ class ContinuousBatchingScheduler:
             jnp.asarray(self._active),
             jnp.asarray(self._keys),
         )
+        self.dispatches += 1
         nxt = np.asarray(nxt)
         sampled = 0
         for slot in decoding:
@@ -441,9 +846,84 @@ class ContinuousBatchingScheduler:
                 self._next_token[slot] = tok
         return sampled
 
+    def _decode_multi_once(self, finished: List[GenResult]) -> int:
+        """One fused K-step decode window (drafts + verify in ONE
+        dispatch); returns the number of tokens accepted across
+        lanes."""
+        decoding = [
+            i for i, sl in enumerate(self._slots)
+            if sl.phase == "decode"
+        ]
+        if not decoding:
+            return 0
+        K = self.decode_k
+        temp = float(self.sched.temperature)
+        jnp = self._jnp
+        t0 = time.monotonic()
+        self._pool, drafts, ver, n_match = self._decode_multi_jit(
+            self._params,
+            self._pool,
+            jnp.asarray(self._next_token),
+            jnp.asarray(self._tables),
+            jnp.asarray(self._positions),
+            jnp.asarray(self._active),
+            jnp.asarray(self._keys),
+        )
+        self.dispatches += 1
+        drafts = np.asarray(drafts)
+        ver = np.asarray(ver)
+        n_match = np.asarray(n_match)
+        sampled = 0
+        for slot in decoding:
+            sl = self._slots[slot]
+            remaining = sl.req.max_new - len(sl.generated)
+            if temp <= 0:
+                # drafts ARE the K=1 greedy stream (each draft step
+                # is the K=1 computation); the verify pass gates how
+                # far we trust the window, never what we emit
+                acc = max(1, int(n_match[slot]))
+                emitted = drafts[slot]
+            else:
+                # rejection-style: every emitted token is the
+                # real-rule sample conditioned on a prefix that
+                # matched the drafts it was scored against
+                acc = min(int(n_match[slot]) + 1, K)
+                emitted = ver[slot]
+            acc = min(acc, remaining, K)
+            self.lane_windows += 1
+            kept_last = None
+            done = False
+            for j in range(acc):
+                tok = int(emitted[j])
+                self._positions[slot] += 1
+                self.block_pool.note_filled(
+                    sl.req.req_id, int(self._positions[slot])
+                )
+                sampled += 1
+                self.accepted_tokens += 1
+                kept_last = tok
+                if self._append_token(slot, tok, finished):
+                    done = True
+                    break
+            if not done and kept_last is not None:
+                self._next_token[slot] = kept_last
+        if self._events is not None and self._events.enabled:
+            from dlrover_tpu.observability.events import anchored_now
+
+            dur = max(time.monotonic() - t0, 1e-9)
+            self._events.complete(
+                "verify",
+                anchored_now(t0),
+                dur,
+                drafted=K * len(decoding),
+                accepted=sampled,
+            )
+        return sampled
+
     def step(self) -> List[GenResult]:
-        """One scheduler iteration: admit -> one prefill chunk -> one
-        decode step.  Returns the sequences that finished."""
+        """One scheduler iteration: admit -> one prefill chunk ->
+        (grow/preempt) -> one decode window.  Returns the sequences
+        that finished."""
         if self._params is None:
             raise RuntimeError(
                 "sync_weights() before step() — the scheduler has no "
@@ -454,11 +934,17 @@ class ContinuousBatchingScheduler:
         finished: List[GenResult] = []
         self._admit()
         pre_t0 = time.monotonic()
+        hit_blocks = self._window_hit_blocks
+        self._window_hit_blocks = 0
         pre = self._prefill_one(finished)
         pre_t1 = time.monotonic()
         self._admit()  # a first-token EOS may have freed a slot
+        self._ensure_blocks()
         dec_t0 = time.monotonic()
-        dec = self._decode_once(finished)
+        if self._decode_multi_jit is not None:
+            dec = self._decode_multi_once(finished)
+        else:
+            dec = self._decode_once(finished)
         dec_t1 = time.monotonic()
         self._admit()
         self.iterations += 1
@@ -471,6 +957,7 @@ class ContinuousBatchingScheduler:
                     anchored_now(pre_t0),
                     pre_t1 - pre_t0,
                     tokens=pre,
+                    prefix_hit_blocks=hit_blocks,
                 )
             if dec:
                 self._events.complete(
@@ -504,7 +991,10 @@ class ContinuousBatchingScheduler:
         back requeueable requests (the PR-9 preemption-drain dual for
         serving: nothing in flight is lost, it re-runs elsewhere and
         — sampling being (seed, position)-pure — reproduces the same
-        tail)."""
+        tail).  Each handed-back request carries its generated tail
+        as ``resume_tokens``, so an in-process requeue resumes instead
+        of regenerating (cross-process dispatchers resubmit the
+        original prompt; both are deterministic-identical)."""
         self.draining = True
         requeue: List[GenRequest] = list(self._queue)
         self._queue.clear()
@@ -515,8 +1005,10 @@ class ContinuousBatchingScheduler:
             self._tables[slot] = 0
             self._positions[slot] = 0
             self._active[slot] = False
+            sl.req.resume_tokens = np.asarray(sl.generated, np.int32)
             requeue.append(sl.req)
             self._slots[slot] = _Slot()
+        self._prompt_keys.clear()  # handed-back requests left us
         if requeue:
             logger.info(
                 "scheduler drained: %d request(s) handed back",

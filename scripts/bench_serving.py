@@ -1,8 +1,9 @@
 """Serving-plane bench: continuous batching vs the sequential
-request loop, offered-QPS latency sweeps, replica scaling and a
-kill-one-replica-mid-load leg.
+request loop, offered-QPS latency sweeps, replica scaling, a
+kill-one-replica-mid-load leg, and the ISSUE-15 allocation legs
+(incremental-vs-reservation utilization, shared-prefix caching).
 
-Four legs (each flushes a partial ``--out`` payload the moment it
+Legs (each flushes a partial ``--out`` payload the moment it
 lands, so a timeout can never lose an already-measured point):
 
 1. **capacity** (the headline): a closed-loop burst of mixed-length
@@ -21,6 +22,16 @@ lands, so a timeout can never lose an already-measured point):
 4. **kill**: 2 replicas, one SIGKILL'd mid-load — every request must
    complete exactly once on the survivor (the elastic-serving
    contract; zero lost, zero duplicated).
+5. **utilization** (``--utilization`` to run alone): the same
+   mixed-length workload against a pool sized at 50% of its
+   worst-case demand, served under reservation admission
+   (``DLROVER_TPU_KV_INCREMENTAL=0``, the PR-13 discipline) vs
+   incremental allocation + watermark admission + preemption —
+   admitted tokens/s, mean KV utilization, preemption count, and an
+   exact-tails check against the unbatched reference for BOTH modes.
+6. **prefix** (``--prefix`` to run alone): a shared-system-prompt
+   workload with the ref-counted shared-block prefix cache on vs the
+   PR-13 baseline — tokens/s + block hit rate.
 
 Usage::
 
@@ -256,6 +267,309 @@ def run_replicas(n_replicas: int, workload, kill_one: bool = False):
         eng.close()
 
 
+def _make_reference_fn(cfg, params, pad_to: int):
+    """The lone-sequence full-forward ground truth, compiled ONCE:
+    sequences are right-padded to ``pad_to`` so every reference token
+    reuses a single jitted forward (causal attention makes the pad
+    rows invisible to the sampled position).  A naive
+    length-per-token loop recompiles for every distinct sequence
+    length and dominates the whole leg's wall time."""
+    import jax
+    import jax.numpy as jnp
+
+    from dlrover_tpu.models import llama
+
+    @jax.jit
+    def _logits(tokens):  # [1, pad_to] int32
+        return llama.forward(
+            params, tokens, cfg,
+            attention_fn=llama.dot_product_attention,
+        )
+
+    def reference(prompt, max_new, seed, temp, eos=None):
+        toks = [int(t) for t in prompt]
+        key = jax.random.PRNGKey(seed)
+        for _ in range(max_new):
+            padded = np.zeros((1, pad_to), np.int32)
+            padded[0, : len(toks)] = toks
+            logits = _logits(jnp.asarray(padded))[0, len(toks) - 1]
+            if temp <= 0:
+                tok = int(jnp.argmax(logits))
+            else:
+                tok = int(
+                    jax.random.categorical(
+                        jax.random.fold_in(key, len(toks)),
+                        logits / temp,
+                    )
+                )
+            toks.append(tok)
+            if eos is not None and tok == eos:
+                break
+        return np.asarray(toks, np.int32)
+
+    return reference
+
+
+def _build_scheduler(cfg, sched_cfg, env):
+    """Construct a scheduler with ``env`` scoped to exactly the
+    construction (the allocation discipline is pinned then) — an
+    ambient kill-switch must not silently change what a leg
+    measures."""
+    from dlrover_tpu.rl.scheduler import ContinuousBatchingScheduler
+
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        return ContinuousBatchingScheduler(cfg, sched_cfg)
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _run_scheduler_mode(cfg, params, workload, sched_kw, temp, eos,
+                        incremental: bool, env=None, refs=None):
+    """One utilization-leg point: the whole workload through the
+    token-level scheduler under one allocation discipline, sampling
+    pool utilization every iteration.  ``env``: extra env knobs
+    scoped to the scheduler's construction (the discipline is pinned
+    then).  ``refs``: precomputed unbatched reference tails, one per
+    workload entry (computed ONCE per leg, not per mode)."""
+    from dlrover_tpu.rl.scheduler import SchedulerConfig
+
+    scoped = dict(env or {})
+    scoped["DLROVER_TPU_KV_INCREMENTAL"] = (
+        "1" if incremental else "0"
+    )
+    sch = _build_scheduler(
+        cfg,
+        SchedulerConfig(temperature=temp, eos_id=eos, **sched_kw),
+        scoped,
+    )
+    sch.sync_weights(params)
+    # warmup: compile out of the timed region
+    sch.submit(workload[0]["prompt"], max_new=2, seed=0)
+    sch.run()
+    results = {}
+    util_samples = []
+    t0 = time.monotonic()
+    ids = [
+        sch.submit(w["prompt"], max_new=w["max_new"], seed=w["seed"])
+        for w in workload
+    ]
+    while len(results) < len(workload):
+        for res in sch.step():
+            results[res.req_id] = res
+        util_samples.append(sch.block_pool.utilization())
+    makespan = max(time.monotonic() - t0, 1e-9)
+    st = sch.stats()
+    new_tokens = sum(r.new_tokens for r in results.values())
+    tails_exact = all(
+        np.array_equal(results[rid].tokens, ref)
+        for rid, ref in zip(ids, refs or [])
+    ) and len(refs or []) == len(ids)
+    return {
+        "mode": "incremental" if incremental else "reservation",
+        "requests": len(workload),
+        "new_tokens": new_tokens,
+        "makespan_s": round(makespan, 4),
+        "tokens_per_s": round(new_tokens / makespan, 2),
+        "mean_kv_utilization": round(
+            float(np.mean(util_samples)), 4
+        ),
+        "peak_kv_utilization": round(
+            float(np.max(util_samples)), 4
+        ),
+        "preemptions": st["preemptions"],
+        "preemption_rate": round(
+            st["preemptions"] / max(len(workload), 1), 4
+        ),
+        "grown_blocks": st["grown_blocks"],
+        "internal_fragmentation": st["internal_fragmentation"],
+        "tails_exact": bool(tails_exact),
+        "compile_counts": sch.compile_counts(),
+    }
+
+
+def run_utilization(n_requests: int):
+    """Leg 5: reservation vs incremental admission on a pool sized at
+    50% of the workload's worst-case concurrent demand.  Long
+    ``max_new`` budgets + EOS-early tails are exactly the traffic
+    that starves reservation admission: it reserves every lane's
+    budget up front while most lanes finish at a fraction of it.
+
+    This leg runs a SMALL-VOCAB model (its own params, not the shared
+    ``CFG_KW`` one) so a modal-token EOS genuinely fires early for
+    most sequences — with a 128-token vocabulary no single EOS id is
+    ever likely inside a 32-token budget and the workload shape the
+    leg exists to measure never materializes."""
+    import jax
+
+    from dlrover_tpu.models.llama import LlamaConfig, init_params
+
+    cfg_kw = dict(CFG_KW, vocab_size=24)
+    cfg = LlamaConfig(**cfg_kw)
+    params = init_params(jax.random.PRNGKey(3), cfg)
+    rng = np.random.default_rng(23)
+    # budget >> typical EOS-length: exactly the shape that starves
+    # reservation admission (it reserves all 64 for lanes that will
+    # mostly stop near 20)
+    max_new = 64
+    workload = []
+    for i in range(n_requests):
+        plen = int(rng.integers(4, 9))
+        workload.append(
+            {
+                "prompt": rng.integers(
+                    0, cfg_kw["vocab_size"], (plen,)
+                ).astype(np.int32),
+                "max_new": max_new,
+                "seed": 5000 + i,
+            }
+        )
+    temp = 0.8
+    reference = _make_reference_fn(cfg, params, pad_to=80)
+    # pick the EOS the model emits most often across probe tails, so
+    # most requests finish well under budget (the workload shape
+    # reservation admission wastes capacity on)
+    probe = np.concatenate(
+        [
+            reference(
+                w["prompt"], w["max_new"], w["seed"], temp
+            )[w["prompt"].size:]
+            for w in workload[:6]
+        ]
+    )
+    eos = int(np.bincount(probe).argmax())
+    refs = [
+        reference(w["prompt"], w["max_new"], w["seed"], temp, eos)
+        for w in workload
+    ]
+    block_size = 4  # fine granularity: holding tracks ACTUAL length
+    slots = 8
+    worst_blocks = -(-(8 + max_new) // block_size) * slots
+    sched_kw = dict(
+        max_slots=slots,
+        block_size=block_size,
+        num_blocks=worst_blocks // 2 + 1,  # 50% of worst-case demand
+        max_seq_len=80,
+        prefill_chunk=8,
+        max_new_default=max_new,
+    )
+    out = {"eos_id": eos, "pool_blocks": worst_blocks // 2}
+    out["reservation"] = _run_scheduler_mode(
+        cfg, params, workload, sched_kw, temp, eos,
+        incremental=False, refs=refs,
+    )
+    # a 1-block grow quantum keeps each lane's holding tight against
+    # its ACTUAL length — the whole point of incremental allocation
+    # when most lanes EOS at a fraction of their budget
+    out["incremental"] = _run_scheduler_mode(
+        cfg, params, workload, sched_kw, temp, eos, incremental=True,
+        env={"DLROVER_TPU_KV_GROW_BLOCKS": "1"}, refs=refs,
+    )
+    out["tokens_per_s_ratio"] = round(
+        out["incremental"]["tokens_per_s"]
+        / max(out["reservation"]["tokens_per_s"], 1e-9),
+        3,
+    )
+    out["utilization_ratio"] = round(
+        out["incremental"]["mean_kv_utilization"]
+        / max(out["reservation"]["mean_kv_utilization"], 1e-9),
+        3,
+    )
+    return out
+
+
+def run_prefix(cfg, params, n_requests: int):
+    """Leg 6: a shared 32-token system prompt + unique per-request
+    tails, with the shared-block prefix cache on (incremental
+    default) vs the PR-13 baseline (``DLROVER_TPU_KV_INCREMENTAL=0``:
+    every request prefills the whole prompt privately)."""
+    rng = np.random.default_rng(31)
+    system = rng.integers(0, CFG_KW["vocab_size"], (32,)).astype(
+        np.int32
+    )
+    workload = []
+    for i in range(n_requests):
+        tail = rng.integers(
+            0, CFG_KW["vocab_size"], (int(rng.integers(2, 7)),)
+        ).astype(np.int32)
+        workload.append(
+            {
+                "prompt": np.concatenate([system, tail]),
+                "max_new": 8,
+                "seed": 9000 + i,
+            }
+        )
+    sched_kw = dict(
+        max_slots=4,  # < n_requests: later admissions hit the cache
+        block_size=8,
+        num_blocks=128,
+        max_seq_len=64,
+        prefill_chunk=8,
+        max_new_default=8,
+    )
+    reference = _make_reference_fn(cfg, params, pad_to=64)
+    refs = [
+        reference(w["prompt"], w["max_new"], w["seed"], 0.0)
+        for w in workload
+    ]
+    out = {}
+    baseline = _run_scheduler_mode(
+        cfg, params, workload, sched_kw, temp=0.0, eos=None,
+        incremental=False, refs=refs,
+    )
+    out["baseline"] = baseline
+    from dlrover_tpu.rl.scheduler import SchedulerConfig
+
+    # pin the discipline: an ambient KV_INCREMENTAL=0 /
+    # KV_PREFIX_CACHE=0 would silently turn this leg into a second
+    # baseline still labeled "prefix_cached"
+    sch = _build_scheduler(
+        cfg,
+        SchedulerConfig(temperature=0.0, eos_id=None, **sched_kw),
+        {
+            "DLROVER_TPU_KV_INCREMENTAL": "1",
+            "DLROVER_TPU_KV_PREFIX_CACHE": "1",
+        },
+    )
+    sch.sync_weights(params)
+    sch.submit(workload[0]["prompt"], max_new=2, seed=0)
+    sch.run()
+    t0 = time.monotonic()
+    ids = [
+        sch.submit(w["prompt"], max_new=w["max_new"], seed=w["seed"])
+        for w in workload
+    ]
+    results = {r.req_id: r for r in sch.run()}
+    makespan = max(time.monotonic() - t0, 1e-9)
+    st = sch.stats()
+    tails_exact = all(
+        np.array_equal(results[rid].tokens, ref)
+        for rid, ref in zip(ids, refs)
+    )
+    new_tokens = sum(r.new_tokens for r in results.values())
+    out["prefix_cached"] = {
+        "requests": len(workload),
+        "new_tokens": new_tokens,
+        "makespan_s": round(makespan, 4),
+        "tokens_per_s": round(new_tokens / makespan, 2),
+        "prefix_hit_rate": st["prefix_hit_rate"],
+        "prefix_hits": st["prefix_hits"],
+        "prefill_tokens": st["total_prefill_tokens"],
+        "tails_exact": bool(tails_exact),
+    }
+    out["tokens_per_s_ratio"] = round(
+        out["prefix_cached"]["tokens_per_s"]
+        / max(baseline["tokens_per_s"], 1e-9),
+        3,
+    )
+    return out
+
+
 def flush(out_file: str, payload):
     if not out_file:
         return
@@ -284,7 +598,16 @@ def main(argv=None) -> int:
         "--skip_replica_leg", action="store_true",
         help="in-process legs only (fast CI smoke)",
     )
+    parser.add_argument(
+        "--utilization", action="store_true",
+        help="run ONLY the incremental-vs-reservation pool leg",
+    )
+    parser.add_argument(
+        "--prefix", action="store_true",
+        help="run ONLY the shared-prefix caching leg",
+    )
     args = parser.parse_args(argv)
+    only = args.utilization or args.prefix
 
     payload = {
         "metric": "serving_continuous_vs_sequential_tokens_per_s",
@@ -298,6 +621,31 @@ def main(argv=None) -> int:
 
     cfg, params = _model()
     workload = make_workload(args.requests, seed=7)
+
+    if only:
+        # selected-legs mode (fast smokes): headline value is the
+        # utilization leg's tokens/s ratio when it ran, else the
+        # prefix leg's
+        if args.utilization:
+            extras["utilization"] = run_utilization(
+                min(args.requests, 24)
+            )
+            payload["value"] = extras["utilization"][
+                "tokens_per_s_ratio"
+            ]
+            flush(args.out, payload)
+            print(json.dumps(extras["utilization"], default=str))
+        if args.prefix:
+            extras["prefix"] = run_prefix(
+                cfg, params, min(args.requests, 16)
+            )
+            if payload["value"] is None:
+                payload["value"] = extras["prefix"][
+                    "tokens_per_s_ratio"
+                ]
+            flush(args.out, payload)
+            print(json.dumps(extras["prefix"], default=str))
+        return 0
 
     # leg 1: closed-loop capacity (the headline)
     seq = run_sequential(cfg, params, workload)
@@ -343,6 +691,29 @@ def main(argv=None) -> int:
             f"{point['sequential']['p99_latency_s']}s vs cont p99 "
             f"{point['continuous']['p99_latency_s']}s"
         )
+
+    # leg 5: incremental-vs-reservation utilization (ISSUE 15)
+    extras["utilization"] = run_utilization(min(args.requests, 24))
+    flush(args.out, payload)
+    u = extras["utilization"]
+    print(
+        f"utilization: reservation "
+        f"{u['reservation']['tokens_per_s']} tok/s "
+        f"@ {u['reservation']['mean_kv_utilization']} util vs "
+        f"incremental {u['incremental']['tokens_per_s']} tok/s "
+        f"@ {u['incremental']['mean_kv_utilization']} util "
+        f"({u['incremental']['preemptions']} preemptions)"
+    )
+
+    # leg 6: shared-prefix caching (ISSUE 15)
+    extras["prefix"] = run_prefix(cfg, params, min(args.requests, 16))
+    flush(args.out, payload)
+    p = extras["prefix"]
+    print(
+        f"prefix: baseline {p['baseline']['tokens_per_s']} tok/s vs "
+        f"cached {p['prefix_cached']['tokens_per_s']} tok/s "
+        f"(hit rate {p['prefix_cached']['prefix_hit_rate']})"
+    )
 
     # legs 3+4: real replicas + kill-mid-load
     if not args.skip_replica_leg:

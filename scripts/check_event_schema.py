@@ -136,6 +136,16 @@ DECLARED_METRICS = {
     "dlrover_tpu_serving_queue_depth",
     "dlrover_tpu_serving_kv_blocks_used",
     "dlrover_tpu_serving_p99_latency",
+    # incremental-allocation serving vitals (ISSUE 15): filled-cache
+    # share of pool capacity (what reservation admission caps and
+    # incremental admission pushes toward 1.0), cumulative
+    # pool-pressure preemptions, shared-block prefix hit rate, and
+    # the multi-token decode accept-per-window mean (the dispatch
+    # amortization actually achieved)
+    "dlrover_tpu_serving_kv_utilization",
+    "dlrover_tpu_serving_preemptions",
+    "dlrover_tpu_serving_prefix_hit_rate",
+    "dlrover_tpu_serving_accepted_tokens_per_step",
 }
 METRIC_METHODS = {
     "set_gauge",

@@ -225,9 +225,13 @@ def render(status: dict) -> str:
         )
         reps = serving.get("replicas") or []
         if reps:
+            # kvutil/preempt/hit% are the incremental-allocation
+            # vitals (ISSUE 15): filled-cache share, pool-pressure
+            # preemptions, shared-prefix block hit rate
             hdr = (
                 f"{'repl':>4} {'state':>8} {'inflight':>8} "
-                f"{'tok/s':>8} {'queue':>6} {'kvblk':>6}"
+                f"{'tok/s':>8} {'queue':>6} {'kvblk':>6} "
+                f"{'kvutil':>6} {'preempt':>7} {'hit%':>6}"
             )
             lines.append(hdr)
             lines.append("-" * len(hdr))
@@ -241,7 +245,10 @@ def render(status: dict) -> str:
                     f"{r.get('outstanding', 0):>8} "
                     f"{r.get('tokens_per_s', 0.0):>8.1f} "
                     f"{r.get('queue_depth', 0):>6} "
-                    f"{r.get('kv_blocks_used', 0):>6}"
+                    f"{r.get('kv_blocks_used', 0):>6} "
+                    f"{r.get('kv_utilization', 0.0):>6.2f} "
+                    f"{r.get('preemptions', 0):>7} "
+                    f"{100.0 * r.get('prefix_hit_rate', 0.0):>5.1f}%"
                 )
     conclusions = status.get("conclusions") or []
     if conclusions:

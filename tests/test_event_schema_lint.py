@@ -215,6 +215,60 @@ def test_lint_enforces_serving_span_labels(tmp_path):
     assert "missing required label(s) ['new_tokens']" in proc.stdout
 
 
+def test_lint_enforces_preempt_verify_labels(tmp_path):
+    """ISSUE-15 spans: a ``preempt`` without its cost/waste numbers
+    or a ``verify`` without its drafted/accepted scoreboard is an
+    unactionable blip — the lint must refuse both."""
+    bad = tmp_path / "bad_preempt_verify.py"
+    bad.write_text(
+        "events = None\n"
+        "def f(events):\n"
+        "    events.complete('preempt', 0.0, 1.0, blocks_freed=3)\n"
+        "    events.complete('preempt', 0.0, 1.0, blocks_freed=3,\n"
+        "                    tokens_generated=7)\n"
+        "    events.complete('verify', 0.0, 1.0, drafted=16)\n"
+        "    events.complete('verify', 0.0, 1.0, drafted=16,\n"
+        "                    accepted=12)\n"
+    )
+    proc = _run(str(bad))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "event_schema_violations=2" in proc.stdout, proc.stdout
+    assert (
+        "missing required label(s) ['tokens_generated']"
+        in proc.stdout
+    )
+    assert "missing required label(s) ['accepted']" in proc.stdout
+
+
+def test_lint_declares_incremental_serving_metrics():
+    """The four ISSUE-15 gauges are declared vocabulary; an
+    in-package near-miss typo is not."""
+    probe = os.path.join(
+        REPO, "dlrover_tpu", "_lint_probe_kv_delete_me.py"
+    )
+    with open(probe, "w") as f:
+        f.write(
+            "def f(reg):\n"
+            "    reg.set_gauge("
+            "'dlrover_tpu_serving_kv_utilization', 1.0)\n"
+            "    reg.set_gauge("
+            "'dlrover_tpu_serving_preemptions', 1.0)\n"
+            "    reg.set_gauge("
+            "'dlrover_tpu_serving_prefix_hit_rate', 1.0)\n"
+            "    reg.set_gauge("
+            "'dlrover_tpu_serving_accepted_tokens_per_step', 1.0)\n"
+            "    reg.set_gauge("
+            "'dlrover_tpu_serving_kv_utilisation', 1.0)\n"
+        )
+    try:
+        proc = _run(probe)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "event_schema_violations=1" in proc.stdout, proc.stdout
+        assert "dlrover_tpu_serving_kv_utilisation" in proc.stdout
+    finally:
+        os.unlink(probe)
+
+
 def test_lint_declares_serving_metrics():
     """The four serving gauges are declared vocabulary; an in-package
     near-miss typo is not."""

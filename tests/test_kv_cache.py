@@ -27,9 +27,11 @@ from dlrover_tpu.ops.paged_attention import (  # noqa: E402
 )
 from dlrover_tpu.rl.kv_cache import (  # noqa: E402
     BlockPool,
+    DoubleFreeError,
     OutOfBlocksError,
     PagedCacheConfig,
     init_block_pool,
+    prefix_block_keys,
 )
 
 CACHE_CFG = PagedCacheConfig(
@@ -102,6 +104,141 @@ class TestBlockPool:
         assert row[2:] == [0, 0, 0]
         with pytest.raises(ValueError):
             pool.table_row(1, 1)  # narrower than the allocation
+
+    def test_extend_grows_and_raises_when_dry(self):
+        """Incremental allocation: ``extend`` appends blocks to a
+        live sequence's table and fails LOUDLY when the pool is dry
+        (the scheduler's cue to preempt)."""
+        pool = BlockPool(CACHE_CFG)
+        pool.allocate(1, 4)  # 1 block
+        assert pool.covered_tokens(1) == 4
+        added = pool.extend(1, 2)
+        assert len(added) == 2
+        assert pool.covered_tokens(1) == 12
+        assert pool.blocks_of(1)[1:] == added
+        pool.allocate(2, 20)  # 5 blocks -> pool full (8 usable)
+        with pytest.raises(OutOfBlocksError):
+            pool.extend(1, 1)
+        pool.free(2)
+        pool.extend(1, 1)
+        assert pool.covered_tokens(1) == 16
+
+
+class TestDoubleFreeGuard:
+    """Satellite: a block id landing on the free list twice must
+    raise instead of corrupting the LIFO free list into handing one
+    block to two sequences."""
+
+    def test_aliased_block_raises_loudly(self):
+        """Simulate the evict-racing-drain corruption: two sequences'
+        tables alias one physical block; freeing both must raise on
+        the second free, not silently double-list the block."""
+        pool = BlockPool(CACHE_CFG)
+        pool.allocate(1, 4)
+        pool.allocate(2, 4)
+        pool._seqs[2].blocks[0] = pool._seqs[1].blocks[0]
+        pool.free(1)
+        with pytest.raises(DoubleFreeError, match="freed twice"):
+            pool.free(2)
+
+    def test_shared_overrelease_raises(self):
+        pool = BlockPool(CACHE_CFG)
+        pool.allocate(1, 8)
+        keys = prefix_block_keys(np.arange(4, dtype=np.int32), 4)
+        assert pool.share_block(1, 0, keys[0])
+        shared = pool.blocks_of(1)[0]
+        pool.free(1)  # decref -> refcount 0, parked in the LRU
+        with pytest.raises(DoubleFreeError):
+            pool._release_block(shared)
+
+    def test_evict_then_drain_requeue_is_clean(self):
+        """The real-path regression (the race the guard exists for):
+        preemption (evict) followed by a drain's free of the SAME
+        requeued sequence after re-admission must free each block
+        exactly once — churn through evict/realloc cycles and end
+        with an intact pool."""
+        pool = BlockPool(CACHE_CFG)
+        pool.allocate(10, 12)
+        pool.allocate(11, 8)
+        pool.free(10)  # the evict leg
+        pool.allocate(10, 12)  # drain-requeue re-admitted it
+        pool.free(10)  # the drain leg frees the NEW allocation
+        pool.free(11)
+        assert pool.used_blocks == 0
+        assert pool.free_blocks == CACHE_CFG.usable_blocks
+
+
+class TestPrefixIndex:
+    def test_block_keys_are_position_chained(self):
+        """Key i hashes blocks 0..i: two prompts share key 1 only
+        when BOTH their first two blocks match."""
+        a = np.arange(8, dtype=np.int32)
+        b = np.concatenate([np.arange(4), np.array([9, 9, 9, 9])])
+        ka = prefix_block_keys(a, 4)
+        kb = prefix_block_keys(b.astype(np.int32), 4)
+        assert len(ka) == len(kb) == 2
+        assert ka[0] == kb[0]
+        assert ka[1] != kb[1]
+        # a partial tail block produces no key
+        assert len(prefix_block_keys(a[:7], 4)) == 1
+
+    def test_share_acquire_refcount_lru_cycle(self):
+        pool = BlockPool(CACHE_CFG)
+        keys = prefix_block_keys(np.arange(8, dtype=np.int32), 4)
+        pool.allocate(1, 8)
+        assert pool.share_block(1, 0, keys[0])
+        assert pool.share_block(1, 1, keys[1])
+        assert not pool.share_block(1, 0, keys[0])  # already indexed
+        shared = pool.blocks_of(1)
+        # a second identical prompt maps the SAME physical blocks
+        assert pool.peek_prefix(keys) == (2, 0)
+        hit = pool.acquire_prefix(keys)
+        assert hit == shared
+        pool.allocate(2, 8, prefix_blocks=hit)
+        assert pool.blocks_of(2) == shared
+        assert pool.prefix_hits == 2
+        # free both holders: blocks park in the LRU, content retained
+        pool.free(1)
+        pool.free(2)
+        assert pool.live_sequences == 0
+        assert pool.used_blocks == 0
+        assert pool.cached_shared_blocks == 2
+        n, in_lru = pool.peek_prefix(keys)
+        assert (n, in_lru) == (2, 2)
+        # a third request still hits straight from the cache
+        hit = pool.acquire_prefix(keys)
+        assert hit == shared
+        pool.allocate(3, 8, prefix_blocks=hit)
+        pool.free(3)
+
+    def test_lru_eviction_is_refcount_gated(self):
+        """Allocation pressure reclaims ONLY refcount-0 cached blocks
+        (oldest first); blocks still held by a live sequence never
+        move."""
+        pool = BlockPool(CACHE_CFG)
+        ka = prefix_block_keys(np.arange(4, dtype=np.int32), 4)
+        kb = prefix_block_keys(
+            np.arange(10, 14, dtype=np.int32), 4
+        )
+        pool.allocate(1, 4)
+        pool.share_block(1, 0, ka[0])
+        pool.allocate(2, 4)
+        pool.share_block(2, 0, kb[0])
+        pool.free(2)  # kb's block -> LRU
+        assert pool.cached_shared_blocks == 1
+        # exhaust the pool: 8 usable, 2 in use/cached -> take 6, then
+        # one more must evict the LRU'd kb block, never seq 1's
+        pool.allocate(3, 24)  # 6 blocks
+        assert pool.free_blocks == 0
+        pool.allocate(4, 4)  # forces the LRU eviction
+        assert pool.cached_shared_blocks == 0
+        assert pool.peek_prefix(kb) == (0, 0)  # evicted from index
+        assert pool.peek_prefix(ka) == (1, 0)  # still live via seq 1
+        pool.free(1)
+        pool.free(3)
+        pool.free(4)
+        # seq 1's shared block survives as cache after its free
+        assert pool.cached_shared_blocks == 1
 
 
 class TestPagedAttentionOps:

@@ -221,6 +221,335 @@ class TestSchedulerParity:
             np.testing.assert_array_equal(res[ids[i]].tokens, ref)
 
 
+class TestIncrementalAllocation:
+    """ISSUE 15 tentpole: watermark admission + on-demand growth +
+    lowest-priority preemption + deterministic resume, the
+    ``DLROVER_TPU_KV_INCREMENTAL=0`` kill-switch, and prefix-cached
+    shared blocks."""
+
+    @pytest.mark.parametrize("temp", [0.0, 0.8])
+    def test_churn_at_pool_exhaustion_exact_tails(
+        self, monkeypatch, temp
+    ):
+        """Admit/grow/preempt/resume interleavings on a pool far
+        below worst-case demand: ONE compiled decode program, at
+        least one real preemption, and tails EXACTLY equal to the
+        unbatched reference at temp 0 and 0.8 (resume is (seed,
+        position)-pure)."""
+        monkeypatch.setenv("DLROVER_TPU_KV_ADMIT_WATERMARK", "0")
+        monkeypatch.setenv("DLROVER_TPU_KV_GROW_BLOCKS", "1")
+        sch = ContinuousBatchingScheduler(
+            CFG,
+            SchedulerConfig(
+                max_slots=4, block_size=4, num_blocks=9,
+                max_seq_len=64, prefill_chunk=3, temperature=temp,
+            ),
+        )
+        sch.sync_weights(PARAMS)
+        assert sch.incremental
+        ids = [
+            sch.submit(p, max_new=12, seed=50 + i)
+            for i, p in enumerate(PROMPTS)
+        ]
+        res = {r.req_id: r for r in sch.run()}
+        st = sch.stats()
+        assert st["preemptions"] >= 1, st
+        assert st["grown_blocks"] > 0, st
+        assert sch.compile_counts()["decode"] == 1
+        assert st["used_blocks"] == 0  # nothing leaked
+        for i, p in enumerate(PROMPTS):
+            ref = unbatched_reference(p, 12, 50 + i, temp=temp)
+            np.testing.assert_array_equal(res[ids[i]].tokens, ref)
+
+    def test_kill_switch_reproduces_reservation_admission(
+        self, monkeypatch
+    ):
+        """``DLROVER_TPU_KV_INCREMENTAL=0``: worst-case reservation
+        at admission (the PR-13 discipline byte-for-byte) — the full
+        prompt+budget block count is held from admission on, nothing
+        grows, nothing preempts, nothing is shared, and a request
+        whose worst case can't fit STAYS QUEUED instead of raising."""
+        monkeypatch.setenv("DLROVER_TPU_KV_INCREMENTAL", "0")
+        sch = _scheduler(temp=0.0)
+        assert not sch.incremental
+        rid = sch.submit(PROMPTS[0], max_new=6, seed=50)
+        sch.step()
+        # worst case reserved up front: ceil((3 + 6) / 4) = 3 blocks
+        assert len(sch.block_pool.blocks_of(rid)) == 3
+        res = {r.req_id: r for r in sch.run()}
+        st = sch.stats()
+        assert st["preemptions"] == 0
+        assert st["grown_blocks"] == 0
+        assert st["prefix_queries"] == 0  # sharing fully inert
+        np.testing.assert_array_equal(
+            res[rid].tokens,
+            unbatched_reference(PROMPTS[0], 6, 50, temp=0.0),
+        )
+        # a worst case bigger than the pool queues forever (PR-13
+        # semantics) where incremental mode rejects at submit
+        tiny = ContinuousBatchingScheduler(
+            CFG,
+            SchedulerConfig(
+                max_slots=2, block_size=4, num_blocks=5,
+                max_seq_len=64, prefill_chunk=3, temperature=0.0,
+            ),
+        )
+        tiny.sync_weights(PARAMS)
+        tiny.submit(PROMPTS[1], max_new=12, seed=0)  # needs 5 > 4
+        for _ in range(4):
+            tiny.step()
+        assert tiny.queue_depth == 1  # still queued, never admitted
+        monkeypatch.delenv("DLROVER_TPU_KV_INCREMENTAL")
+        inc = ContinuousBatchingScheduler(
+            CFG,
+            SchedulerConfig(
+                max_slots=2, block_size=4, num_blocks=5,
+                max_seq_len=64, prefill_chunk=3, temperature=0.0,
+            ),
+        )
+        inc.sync_weights(PARAMS)
+        with pytest.raises(ValueError, match="blocks > pool"):
+            inc.submit(PROMPTS[1], max_new=12, seed=0)
+
+    def test_prefix_cache_shares_blocks_exactly(self, monkeypatch):
+        """Sequential requests with a common 16-token system prompt:
+        later admissions map the cached physical blocks (hit rate >
+        0, fewer prefill tokens) and every tail stays exact."""
+        system = np.arange(1, 17, dtype=np.int32)  # 4 full blocks
+        prompts = [
+            np.concatenate([system, np.array([40 + i, 41 + i],
+                                             np.int32)])
+            for i in range(3)
+        ]
+        sch = _scheduler(temp=0.0)
+        assert sch.prefix_cache
+        for i, p in enumerate(prompts):
+            rid = sch.submit(p, max_new=5, seed=70 + i)
+            res = {r.req_id: r for r in sch.run()}
+            np.testing.assert_array_equal(
+                res[rid].tokens,
+                unbatched_reference(p, 5, 70 + i, temp=0.0),
+            )
+        st = sch.stats()
+        assert st["prefix_hits"] > 0
+        assert st["prefix_hit_rate"] > 0.5
+        # requests 2 and 3 skipped the shared blocks' prefill: far
+        # fewer prompt tokens prefilled than 3 full prompts
+        assert st["total_prefill_tokens"] < 3 * prompts[0].size
+        # kill-switch: no sharing machinery at all
+        monkeypatch.setenv("DLROVER_TPU_KV_PREFIX_CACHE", "0")
+        off = _scheduler(temp=0.0)
+        assert not off.prefix_cache
+        rid = off.submit(prompts[0], max_new=5, seed=70)
+        res = {r.req_id: r for r in off.run()}
+        np.testing.assert_array_equal(
+            res[rid].tokens,
+            unbatched_reference(prompts[0], 5, 70, temp=0.0),
+        )
+        assert off.stats()["prefix_queries"] == 0
+
+    def test_preempted_drain_hand_back_carries_resume(self,
+                                                      monkeypatch):
+        """Evict-then-drain (the double-free guard's race): preempt a
+        sequence, drain mid-flight, and the pool must come back empty
+        with every request handed back exactly once."""
+        monkeypatch.setenv("DLROVER_TPU_KV_ADMIT_WATERMARK", "0")
+        monkeypatch.setenv("DLROVER_TPU_KV_GROW_BLOCKS", "1")
+        sch = ContinuousBatchingScheduler(
+            CFG,
+            SchedulerConfig(
+                max_slots=4, block_size=4, num_blocks=9,
+                max_seq_len=64, prefill_chunk=3, temperature=0.0,
+            ),
+        )
+        sch.sync_weights(PARAMS)
+        ids = [
+            sch.submit(p, max_new=12, seed=50 + i)
+            for i, p in enumerate(PROMPTS)
+        ]
+        done = []
+        while sch.stats()["preemptions"] == 0 and not sch.idle:
+            done.extend(sch.step())
+        requeued = sch.drain()  # the drain leg right after an evict
+        assert sch.block_pool.used_blocks == 0
+        handed = {r.req_id for r in requeued}
+        finished = {r.req_id for r in done}
+        assert handed | finished == set(ids)
+        assert not handed & finished
+
+
+class TestMultiTokenDecode:
+    """ISSUE 15 tentpole: ``DLROVER_TPU_DECODE_STEPS=K`` fused
+    windows — K-greedy self-drafting + one batched verify forward."""
+
+    def _run(self, max_new=8, temp=0.0, eos=None, seeds=50):
+        sch = _scheduler(temp=temp, eos=eos)
+        ids = [
+            sch.submit(p, max_new=max_new, seed=seeds + i)
+            for i, p in enumerate(PROMPTS)
+        ]
+        res = {r.req_id: r for r in sch.run()}
+        return sch, ids, res
+
+    def test_k4_temp0_exact_with_fewer_dispatches(self, monkeypatch):
+        """The acceptance pin: K=4 emits token streams EXACTLY equal
+        to the K=1 loop while issuing measurably fewer host
+        dispatches per token, still on ONE compiled decode program."""
+        monkeypatch.delenv("DLROVER_TPU_DECODE_STEPS", raising=False)
+        base_sch, base_ids, base_res = self._run()
+        base_dispatch = base_sch.stats()["dispatches"]
+        monkeypatch.setenv("DLROVER_TPU_DECODE_STEPS", "4")
+        sch, ids, res = self._run()
+        st = sch.stats()
+        assert sch.decode_k == 4
+        for bid, rid in zip(base_ids, ids):
+            np.testing.assert_array_equal(
+                res[rid].tokens, base_res[bid].tokens
+            )
+        for i, p in enumerate(PROMPTS):
+            np.testing.assert_array_equal(
+                res[ids[i]].tokens,
+                unbatched_reference(p, 8, 50 + i, temp=0.0),
+            )
+        assert sch.compile_counts()["decode"] == 1
+        # the dispatch amortization actually happened
+        assert st["dispatches"] < base_dispatch, (
+            st["dispatches"], base_dispatch
+        )
+        assert st["accepted_per_step"] > 1.0, st
+
+    def test_k3_temp08_eos_matches_reference(self, monkeypatch):
+        """Sampled temperature + EOS early-stop under K=3: tails
+        still match the unbatched reference (rejection-style
+        acceptance; on CPU the verify logits agree bit-for-bit, so
+        even the sampled path is exact here)."""
+        temp = 0.8
+        probe = unbatched_reference(PROMPTS[0], 8, 50, temp=temp)
+        eos = int(probe[PROMPTS[0].size + 1])
+        monkeypatch.setenv("DLROVER_TPU_DECODE_STEPS", "3")
+        sch, ids, res = self._run(temp=temp, eos=eos)
+        for i, p in enumerate(PROMPTS):
+            np.testing.assert_array_equal(
+                res[ids[i]].tokens,
+                unbatched_reference(p, 8, 50 + i, temp=temp,
+                                    eos=eos),
+            )
+        assert sch.stats()["accepted_tokens"] > 0
+
+    def test_k1_default_is_the_pr13_loop(self, monkeypatch):
+        """DECODE_STEPS unset/1: no fused program is even built —
+        the PR-13 one-token loop verbatim."""
+        monkeypatch.delenv("DLROVER_TPU_DECODE_STEPS", raising=False)
+        sch = _scheduler(temp=0.0)
+        assert sch.decode_k == 1
+        assert sch._decode_multi_jit is None
+
+
+class TestDispatcherTieBreak:
+    def test_lowest_replica_id_wins_ties(self):
+        """Satellite: the least-outstanding routing tie-break is the
+        LOWEST replica id, whatever order the alive list arrives in
+        — bench runs and the kill-one-mid-load test reproduce across
+        dict orderings."""
+        from types import SimpleNamespace
+
+        from dlrover_tpu.rl.generation_service import (
+            least_outstanding,
+        )
+
+        def rep(idx, n):
+            return SimpleNamespace(idx=idx, outstanding=dict.fromkeys(
+                range(n)))
+
+        a, b, c = rep(0, 2), rep(1, 1), rep(2, 1)
+        for order in ([a, b, c], [c, b, a], [b, c, a]):
+            assert least_outstanding(order).idx == 1
+        # all equal -> replica 0
+        a, b, c = rep(0, 3), rep(1, 3), rep(2, 3)
+        for order in ([c, a, b], [b, a, c], [a, c, b]):
+            assert least_outstanding(order).idx == 0
+
+    def test_engine_submit_rejects_pool_exceeding_request(
+        self, monkeypatch
+    ):
+        """Dispatcher-side mirror of the scheduler's incremental-mode
+        pool guard: a request whose worst case exceeds a replica's
+        whole pool must fail at ``ServingEngine.submit`` — raised in
+        the worker loop it would kill the replica and the on-death
+        redispatch would then cascade it onto the survivors."""
+        import threading
+        from collections import deque
+
+        from dlrover_tpu.rl.generation_service import ServingEngine
+
+        eng = object.__new__(ServingEngine)
+        eng._closed = False
+        eng._max_new = 12
+        eng._max_seq_len = 64
+        eng._lock = threading.Lock()
+        eng._reqs = {}
+        eng._dispatch_q = deque()
+        eng._next_id = 0
+        eng._spec = {"sched": {"num_blocks": 5, "block_size": 4}}
+        monkeypatch.delenv(
+            "DLROVER_TPU_KV_INCREMENTAL", raising=False
+        )
+        prompt = np.arange(1, 8, dtype=np.int32)  # needs 5 > 4 blocks
+        with pytest.raises(ValueError, match="replica pool"):
+            eng.submit(prompt, max_new=12)
+        # reservation kill-switch keeps PR-13 semantics: accepted,
+        # queues at the replica instead of raising
+        monkeypatch.setenv("DLROVER_TPU_KV_INCREMENTAL", "0")
+        assert eng.submit(prompt, max_new=12) == 0
+
+    def test_dispatcher_fails_rejected_request_immediately(self):
+        """A replica-side REJECT (belt-and-suspenders for env skew /
+        malformed ring messages) must complete the request with an
+        error RIGHT AWAY — silence would block the caller for the
+        whole request timeout."""
+        import threading
+
+        from dlrover_tpu.observability.metrics import Histogram
+        from dlrover_tpu.rl import generation_service as gs
+
+        eng = object.__new__(gs.ServingEngine)
+        eng._lock = threading.Lock()
+        eng._reqs = {}
+        eng._completed = set()
+        eng._completed_total = 0
+        eng._latency = Histogram()
+        inflight = gs._InFlight(
+            req_id=5, prompt=np.array([1], np.int32), max_new=2,
+            seed=0, submit_t=0.0,
+        )
+        eng._reqs[5] = inflight
+
+        class FakeRing:
+            def __init__(self):
+                self.msgs = [
+                    {
+                        "meta": np.asarray(
+                            [5, gs._KIND_REJECT, 0, 0, 0, 0],
+                            np.int64,
+                        ),
+                        "tokens": np.zeros((4,), np.int32),
+                        "times": np.zeros((8,), np.float64),
+                    }
+                ]
+
+            def try_get(self):
+                return self.msgs.pop(0) if self.msgs else None
+
+        rep = gs._Replica(0, proc=None, req_ring=None,
+                          resp_ring=FakeRing())
+        rep.outstanding[5] = inflight
+        eng._handle_responses(rep)
+        assert inflight.done.is_set()
+        assert not rep.outstanding
+        with pytest.raises(RuntimeError, match="rejected"):
+            eng.result(5, timeout=1.0)
+
+
 class TestShapeBuckets:
     """Satellite: ``DLROVER_TPU_GEN_BUCKETS`` — compile once per
     bucket, results identical to the exact-shape path."""
@@ -505,6 +834,24 @@ class TestBenchServingSmoke:
         assert extras["continuous"]["compile_counts"]["decode"] == 1
         # the sweep flushed into the artifact (partial-flush contract)
         assert extras["qps_sweep"][0]["offered_qps"] == 30.0
+        # ISSUE-15 satellite pin: on the pool-constrained workload
+        # (pool at 50% of worst-case demand), incremental admission
+        # sustains AT LEAST reservation admission's tokens/s — with
+        # every completed tail still exactly the unbatched reference
+        # in BOTH disciplines
+        util = extras["utilization"]
+        assert util["incremental"]["tokens_per_s"] >= (
+            util["reservation"]["tokens_per_s"]
+        ), util
+        assert util["incremental"]["tails_exact"], util
+        assert util["reservation"]["tails_exact"], util
+        assert util["incremental"]["mean_kv_utilization"] > (
+            util["reservation"]["mean_kv_utilization"]
+        ), util
+        # prefix leg: the shared-block cache actually hit, exactly
+        pfx = extras["prefix"]
+        assert pfx["prefix_cached"]["prefix_hit_rate"] > 0.3, pfx
+        assert pfx["prefix_cached"]["tails_exact"], pfx
 
 
 class TestTopServingPane:
@@ -533,7 +880,10 @@ class TestTopServingPane:
                     "replicas": [
                         {"idx": 0, "alive": True, "outstanding": 4,
                          "tokens_per_s": 120.5, "queue_depth": 1,
-                         "kv_blocks_used": 17},
+                         "kv_blocks_used": 17,
+                         "kv_utilization": 0.62,
+                         "preemptions": 3,
+                         "prefix_hit_rate": 0.254},
                         {"idx": 1, "alive": False, "drained": True,
                          "outstanding": 0},
                     ],
@@ -544,3 +894,7 @@ class TestTopServingPane:
         assert "p99 0.900s" in frame
         assert "drained" in frame
         assert "120.5" in frame
+        # ISSUE-15 columns: utilization / preemptions / prefix hits
+        assert "kvutil" in frame and "preempt" in frame
+        assert "0.62" in frame
+        assert "25.4%" in frame
