@@ -707,6 +707,59 @@ def _run_serving_bench(budget: "BenchBudget" = None) -> dict:
         return {"error": str(e)}
 
 
+def _run_serving_observatory(budget: "BenchBudget" = None) -> dict:
+    """Run the serving-observatory leg (``bench_serving.py
+    --observatory``) in a subprocess: the ServingHealthEngine must
+    name an injected SLO straggler AND a wedged-mid-decode replica
+    with the right reason inside the interval bound, the timeline
+    must carry a complete preempt->resume request lifecycle through
+    the Perfetto export, and the tracing hot path must stay cheap."""
+    if os.getenv("DLROVER_BENCH_SKIP_SERVING"):
+        return {"skipped": True}
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "scripts", "bench_serving.py",
+    )
+    out_file = os.path.join(
+        tempfile.mkdtemp(prefix="dlrover_bench_serving_obs_"),
+        "out.json",
+    )
+    timeout_s = 480
+    if budget is not None:
+        timeout_s = budget.cap_timeout(480, reserve_s=120)
+    cmd = [sys.executable, script, "--observatory", "--out", out_file]
+    if budget is not None and budget.tight(420):
+        cmd += ["--requests", "12"]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout_s
+        )
+        parsed = _read_result_file(out_file, proc.stdout)
+        if parsed is not None:
+            obs = (parsed.get("extras") or {}).get("observatory")
+            if obs is not None:
+                det = obs.get("detection") or {}
+                return {
+                    **obs,
+                    "faults_named_in_time": bool(
+                        det.get("both_named")
+                        and det.get("within_3_intervals")
+                    ),
+                }
+            return {
+                "error": f"incomplete run (rc={proc.returncode})",
+                "stderr_tail": proc.stderr[-500:],
+            }
+        return {
+            "error": f"no JSON output (rc={proc.returncode})",
+            "stderr_tail": proc.stderr[-500:],
+        }
+    except subprocess.TimeoutExpired as e:
+        return {"error": str(e), "partial": _partial_extras(out_file)}
+    except Exception as e:  # noqa: BLE001
+        return {"error": str(e)}
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -833,6 +886,18 @@ def main(argv=None) -> int:
             extras["serving"] = {"skipped": "budget"}
         else:
             extras["serving"] = _run_serving_bench(budget)
+        flush_partial(args.out, payload)
+
+        # serving observatory: injected straggler + wedge must be
+        # named with the right reason, plus the Perfetto lifecycle
+        # and tracing-overhead proofs (bench_serving.py --observatory
+        # owns the scenario — ONE definition)
+        if budget.tight(240):
+            extras["serving_observatory"] = {"skipped": "budget"}
+        else:
+            extras["serving_observatory"] = _run_serving_observatory(
+                budget
+            )
         flush_partial(args.out, payload)
 
         # continuous attribution leg's overhead: steady step time

@@ -266,6 +266,23 @@ def serving_drain_grace_s() -> float:
     return env_float(SERVING_DRAIN_ENV, 2.0)
 
 
+SERVE_OBS_ENV = "DLROVER_TPU_SERVE_OBS"
+
+
+def serve_obs_enabled() -> bool:
+    """Kill-switch for the serving observatory (ISSUE 16): per-request
+    lifecycle spans (``serve_request``/``queue_wait``/``admit``/
+    ``resume``), the per-replica TTFT/TBT/e2e/queue-wait SLO
+    histograms on ``/metrics``, and the ``ServingHealthEngine``
+    derivations (SLO-straggler score, dead-air watchdog, KV-pressure
+    streaks).  ``DLROVER_TPU_SERVE_OBS=0`` reproduces the PR-14
+    serving surfaces byte-for-byte — no new spans, gauges, histogram
+    series, or status keys (pinned by tests).  Default: enabled."""
+    return os.getenv(SERVE_OBS_ENV, "1").lower() not in (
+        "0", "false", "off",
+    )
+
+
 KV_INCREMENTAL_ENV = "DLROVER_TPU_KV_INCREMENTAL"
 KV_GROW_BLOCKS_ENV = "DLROVER_TPU_KV_GROW_BLOCKS"
 KV_ADMIT_WATERMARK_ENV = "DLROVER_TPU_KV_ADMIT_WATERMARK"

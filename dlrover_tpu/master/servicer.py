@@ -51,6 +51,7 @@ class MasterServicer:
         job_epoch: int = 0,
         incarnation: int = 0,
         telemetry=None,
+        serving_status_fn=None,
     ):
         #: fencing identity: requests carrying a DIFFERENT job_epoch
         #: get a typed ``StaleEpoch`` answer (client refreshes and
@@ -89,6 +90,11 @@ class MasterServicer:
         #: histograms, in-flight/parked gauges, the ``master`` status
         #: section
         self._telemetry = telemetry
+        #: zero-arg callable returning the serving plane's status dict
+        #: (``ServingEngine.status()``); None = no co-located serving
+        #: engine or DLROVER_TPU_SERVE_OBS=0 — the ``serving`` status
+        #: section is simply absent (pinned pre-16 shape)
+        self._serving_status_fn = serving_status_fn
         #: the parked-wait cap scales with the pool: half the workers
         #: may park, so mutations always find a free one
         self.max_parked_waits = max(master_workers() // 2, 1)
@@ -317,6 +323,13 @@ class MasterServicer:
                 status["master"] = self._telemetry.snapshot()
             except Exception as e:  # noqa: BLE001 - partial status
                 logger.warning("status master section failed: %s", e)
+        if self._serving_status_fn is not None:
+            # the serving observatory: replica table + SLO quantiles +
+            # per-replica health verdicts from the co-located engine
+            try:
+                status["serving"] = self._serving_status_fn()
+            except Exception as e:  # noqa: BLE001 - partial status
+                logger.warning("status serving section failed: %s", e)
         return msg.JobStatusResponse(status=status, available=True)
 
     def _timeline_query(

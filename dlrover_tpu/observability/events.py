@@ -131,6 +131,18 @@ PHASE_DECODE = "decode"
 # spans above.
 PHASE_PREEMPT = "preempt"
 PHASE_VERIFY = "verify"
+# per-request lifecycle tracing (ISSUE 16): every served request gets
+# a ``serve_request`` parent span covering submit→completion, with
+# ``queue_wait`` (dispatcher submit → scheduler admission, measured
+# from the wall-clock anchor that rides the shm request ring),
+# ``admit`` (the admission bookkeeping itself) and — after a
+# pool-pressure eviction — ``resume`` (re-admission of the preempted
+# tail) children.  The children rank above the parent so a request's
+# time attributes to the specific lifecycle stage, not the envelope.
+PHASE_QUEUE_WAIT = "queue_wait"
+PHASE_ADMIT = "admit"
+PHASE_RESUME = "resume"
+PHASE_SERVE_REQUEST = "serve_request"
 # client-side control-plane wait (a long-poll RPC parked on the
 # master, or the legacy polling loop it replaces).  LOWEST priority:
 # these waits are almost always nested inside rendezvous/restart
@@ -161,6 +173,10 @@ PHASES: Tuple[str, ...] = (
     PHASE_DECODE,
     PHASE_PREEMPT,
     PHASE_VERIFY,
+    PHASE_QUEUE_WAIT,
+    PHASE_ADMIT,
+    PHASE_RESUME,
+    PHASE_SERVE_REQUEST,
     PHASE_CONTROL_WAIT,
 )
 
@@ -204,6 +220,13 @@ INSTANT_EVENTS = frozenset(
         # queue-near-bound / journal-lag / pool-saturation streak
         # (observability/health.py MasterHealth)
         "master_overload",
+        # the serving observatory fired (observability/health.py
+        # ServingHealthEngine): a replica's derived verdict changed
+        # (serving_health) or a per-replica SLO signal breached its
+        # threshold for ``sustain`` consecutive derivations
+        # (slo_breach)
+        "serving_health",
+        "slo_breach",
     }
 )
 
@@ -232,6 +255,11 @@ REQUIRED_INSTANT_LABELS: Dict[str, Tuple[str, ...]] = {
     # the operator to grow the flusher, "pool_saturated 0.97 vs 0.9"
     # to raise DLROVER_TPU_MASTER_WORKERS
     "master_overload": ("reason", "value", "threshold"),
+    # a serving verdict without the replica it names and the reason it
+    # fired is exactly the "a node is slow" blip the observatory
+    # exists to replace with "this is why"
+    "serving_health": ("replica", "verdict", "reason"),
+    "slo_breach": ("replica", "reason", "value", "threshold"),
 }
 
 #: Labels an emit SITE must pass explicitly (beyond the automatic
@@ -300,6 +328,26 @@ REQUIRED_SPAN_LABELS: Dict[str, Tuple[str, ...]] = {
     # whole story of a multi-token decode step (accept rate == the
     # dispatch amortization actually achieved)
     PHASE_VERIFY: ("drafted", "accepted"),
+    # the request's whole life in one record: identity, where it ran,
+    # its size, and the SLO numbers (TTFT, per-token-gap p99) plus the
+    # efficiency story (preemptions suffered, prompt blocks served
+    # from the prefix cache) — the serve_request span alone must
+    # answer "was THIS request slow, and why"
+    PHASE_SERVE_REQUEST: (
+        "req_id",
+        "replica",
+        "prompt_tokens",
+        "gen_tokens",
+        "ttft_s",
+        "tbt_p99_s",
+        "preempts",
+        "prefix_hit_blocks",
+    ),
+    PHASE_QUEUE_WAIT: ("req_id",),
+    PHASE_ADMIT: ("req_id",),
+    # a resume without the restored tail size can't distinguish a
+    # cheap re-admission from re-prefilling hundreds of tokens
+    PHASE_RESUME: ("req_id", "resume_tokens"),
 }
 
 

@@ -884,3 +884,465 @@ class MasterHealth:
                 "sustain": self.sustain,
                 "cooldown_s": self.cooldown_s,
             }
+
+
+# --------------------------------------------------------------------------
+# serving-plane health (ISSUE 16): the replica observatory
+# --------------------------------------------------------------------------
+
+SERVING_SLO_RATIO_ENV = "DLROVER_TPU_SERVING_SLO_RATIO"
+SERVING_DEAD_AIR_ENV = "DLROVER_TPU_SERVING_DEAD_AIR_S"
+SERVING_KV_PRESSURE_ENV = "DLROVER_TPU_SERVING_KV_PRESSURE"
+SERVING_PREEMPT_RATE_ENV = "DLROVER_TPU_SERVING_PREEMPT_RATE"
+SERVING_SUSTAIN_ENV = "DLROVER_TPU_SERVING_SUSTAIN"
+SERVING_COOLDOWN_ENV = "DLROVER_TPU_SERVING_COOLDOWN_S"
+SERVING_DERIVE_ENV = "DLROVER_TPU_SERVING_DERIVE_S"
+
+#: Per-replica SLO samples kept for the rolling p99 (one sample per
+#: completed request); enough for a stable tail, small enough that a
+#: recovered replica sheds its bad history within ~2 windows.
+SERVING_SAMPLE_WINDOW = 128
+#: A p99 over fewer completions than this is noise, not a signal.
+MIN_SLO_SAMPLES = 3
+
+
+def _tail_q(samples, q: float) -> float:
+    """Nearest-rank quantile of a small sample deque (0.0 when
+    empty)."""
+    if not samples:
+        return 0.0
+    ordered = sorted(samples)
+    return ordered[min(len(ordered) - 1, int(q * (len(ordered) - 1) + 0.5))]
+
+
+class _ServingReplicaState:
+    """Per-replica derivation state (mirrors ``_NodeState``)."""
+
+    __slots__ = (
+        "idx",
+        "ttft",
+        "tbt",
+        "e2e",
+        "last_progress_t",
+        "last_preempts",
+        "preempt_delta",
+        "kv_utilization",
+        "prefix_hit_rate",
+        "outstanding",
+        "alive",
+        "drained",
+        "verdict",
+        "why",
+        "slo_score",
+        "streaks",
+    )
+
+    def __init__(self, idx: int, now: float):
+        self.idx = idx
+        self.ttft: Deque[float] = deque(maxlen=SERVING_SAMPLE_WINDOW)
+        self.tbt: Deque[float] = deque(maxlen=SERVING_SAMPLE_WINDOW)
+        self.e2e: Deque[float] = deque(maxlen=SERVING_SAMPLE_WINDOW)
+        # seeded at first sight so a freshly spawned replica gets a
+        # full dead-air grace window before the watchdog may name it
+        self.last_progress_t = now
+        self.last_preempts = 0
+        self.preempt_delta = 0
+        self.kv_utilization = 0.0
+        self.prefix_hit_rate = 0.0
+        self.outstanding = 0
+        self.alive = True
+        self.drained = False
+        self.verdict = "ok"
+        self.why = "ok"
+        self.slo_score = 0.0
+        self.streaks: Dict[str, int] = {}
+
+
+class ServingHealthEngine:
+    """Streaming per-replica health derivation for the serving plane —
+    the :class:`HealthEngine` pattern (per-node state + fleet-median
+    straggler scoring + a silence watchdog) crossed with
+    :class:`MasterHealth`'s streak/sustain/cooldown verdict machinery,
+    fed by the dispatcher instead of an RPC stream:
+
+    - ``note_result`` per completed request (TTFT / request-level TBT
+      p99 / e2e / queue-wait off the response ring);
+    - ``note_stats`` per replica STATS window (KV pressure, cumulative
+      preemptions, prefix hit rate; a window with tokens flowing
+      refreshes the progress clock);
+    - ``evaluate(fleet)`` once per derivation interval
+      (``DLROVER_TPU_SERVING_DERIVE_S``, default 1 s; internally
+      throttled so the dispatcher may call it every pump) with the
+      dispatcher's live view (alive/drained/outstanding per replica).
+
+    Derivations per replica:
+
+    - **slo_straggler** — rolling TTFT or TBT p99 at least
+      ``DLROVER_TPU_SERVING_SLO_RATIO`` (2.0) times the fleet median
+      of the same quantile (needs >= 2 replicas with
+      ``MIN_SLO_SAMPLES`` completions — a fleet of one has no peers
+      to be slower than);
+    - **dead_air** — outstanding requests, a live worker process, and
+      no token progress (no completion, no tokens-flowing STATS
+      window) for ``DLROVER_TPU_SERVING_DEAD_AIR_S`` (5 s) — the
+      wedged-mid-decode signature a throughput gauge can't show;
+    - **kv_pressure** — pool utilization at or past
+      ``DLROVER_TPU_SERVING_KV_PRESSURE`` (0.95);
+    - **preempt_storm** — at least ``DLROVER_TPU_SERVING_PREEMPT_RATE``
+      (3) NEW preemptions within one derivation interval.
+
+    A reason sustained ``DLROVER_TPU_SERVING_SUSTAIN`` (2) consecutive
+    derivations becomes the replica's verdict (priority: dead_air >
+    slo_straggler > kv_pressure > preempt_storm), emits one
+    ``slo_breach`` instant per reason under a per-(replica, reason)
+    cooldown (``DLROVER_TPU_SERVING_COOLDOWN_S``, 30 s), and every
+    verdict CHANGE emits a ``serving_health`` instant — the trace
+    shows the observatory naming the replica next to the spans that
+    convicted it.  Fleet-level: median TTFT/TBT p99 and the weighted
+    prefix hit rate."""
+
+    _VERDICT_GAUGE = {
+        "ok": 1.0,
+        "preempt_storm": 0.7,
+        "kv_pressure": 0.6,
+        "slo_straggler": 0.4,
+        "dead_air": 0.1,
+    }
+
+    def __init__(
+        self,
+        slo_ratio: Optional[float] = None,
+        dead_air_s: Optional[float] = None,
+        kv_pressure: Optional[float] = None,
+        preempt_rate: Optional[float] = None,
+        sustain: Optional[int] = None,
+        cooldown_s: Optional[float] = None,
+        interval_s: Optional[float] = None,
+    ):
+        self.slo_ratio = (
+            slo_ratio if slo_ratio is not None
+            else env_float(SERVING_SLO_RATIO_ENV, 2.0)
+        )
+        self.dead_air_s = (
+            dead_air_s if dead_air_s is not None
+            else env_float(SERVING_DEAD_AIR_ENV, 5.0)
+        )
+        self.kv_pressure = (
+            kv_pressure if kv_pressure is not None
+            else env_float(SERVING_KV_PRESSURE_ENV, 0.95)
+        )
+        self.preempt_rate = (
+            preempt_rate if preempt_rate is not None
+            else env_float(SERVING_PREEMPT_RATE_ENV, 3.0)
+        )
+        self.sustain = max(
+            int(
+                sustain if sustain is not None
+                else env_float(SERVING_SUSTAIN_ENV, 2.0)
+            ),
+            1,
+        )
+        self.cooldown_s = (
+            cooldown_s if cooldown_s is not None
+            else env_float(SERVING_COOLDOWN_ENV, 30.0)
+        )
+        self.interval_s = max(
+            interval_s if interval_s is not None
+            else env_float(SERVING_DERIVE_ENV, 1.0),
+            0.05,
+        )
+        self._lock = threading.Lock()
+        self._replicas: Dict[int, _ServingReplicaState] = {}
+        self._last_eval = 0.0
+        self._last_fired: Dict[Tuple[int, str], float] = {}
+        self._fleet: Dict[str, float] = {}
+        self.derivations = 0
+
+    def _state(self, idx: int) -> _ServingReplicaState:
+        st = self._replicas.get(idx)
+        if st is None:
+            st = self._replicas[idx] = _ServingReplicaState(
+                idx, time.monotonic()
+            )
+        return st
+
+    # ------------------------------------------------------- ingest
+    def note_result(self, idx: int, ttft_s: float = 0.0,
+                    tbt_p99_s: float = 0.0, e2e_s: float = 0.0,
+                    queue_wait_s: float = 0.0):
+        """One completed request from replica ``idx`` (dispatcher's
+        RESULT path)."""
+        with self._lock:
+            st = self._state(idx)
+            st.ttft.append(float(ttft_s))
+            st.tbt.append(float(tbt_p99_s))
+            st.e2e.append(float(e2e_s))
+            st.last_progress_t = time.monotonic()
+
+    def note_stats(self, idx: int, stats: Dict):
+        """One replica STATS window.  Tokens flowing refresh the
+        progress clock; a zero-throughput window with work outstanding
+        deliberately does NOT — that silence is the dead-air signal."""
+        with self._lock:
+            st = self._state(idx)
+            now = time.monotonic()
+            if float(stats.get("tokens_per_s", 0.0) or 0.0) > 0.0:
+                st.last_progress_t = now
+            st.kv_utilization = float(
+                stats.get("kv_utilization", 0.0) or 0.0
+            )
+            st.prefix_hit_rate = float(
+                stats.get("prefix_hit_rate", 0.0) or 0.0
+            )
+            preempts = int(stats.get("preemptions", 0) or 0)
+            st.preempt_delta += max(preempts - st.last_preempts, 0)
+            st.last_preempts = preempts
+
+    # ----------------------------------------------------- derivation
+    def _breaches(self, st: _ServingReplicaState, now: float,
+                  med_ttft: float, med_tbt: float, peers: int):
+        """Current (reason, value, threshold) breaches for one LIVE
+        replica."""
+        out: List[Tuple[str, float, float]] = []
+        if (
+            st.outstanding > 0
+            and now - st.last_progress_t >= self.dead_air_s
+        ):
+            out.append(
+                ("dead_air", now - st.last_progress_t,
+                 self.dead_air_s)
+            )
+        score = 0.0
+        if peers >= 2 and len(st.ttft) >= MIN_SLO_SAMPLES:
+            if med_ttft > 0:
+                score = _tail_q(st.ttft, 0.99) / med_ttft
+            if med_tbt > 0:
+                score = max(
+                    score, _tail_q(st.tbt, 0.99) / med_tbt
+                )
+        st.slo_score = round(score, 3)
+        if score >= self.slo_ratio:
+            out.append(("slo_straggler", score, self.slo_ratio))
+        if st.kv_utilization >= self.kv_pressure:
+            out.append(
+                ("kv_pressure", st.kv_utilization, self.kv_pressure)
+            )
+        if st.preempt_delta >= self.preempt_rate:
+            out.append(
+                ("preempt_storm", float(st.preempt_delta),
+                 self.preempt_rate)
+            )
+        return out
+
+    _PRIORITY = ("dead_air", "slo_straggler", "kv_pressure",
+                 "preempt_storm")
+
+    def evaluate(self, fleet: List[Dict]) -> List[dict]:
+        """One derivation pass over the dispatcher's live fleet view
+        (``[{idx, alive, drained, outstanding, ...stats}]``);
+        internally throttled to the derivation interval, so callers
+        may invoke it every dispatch pump.  Returns the ``slo_breach``
+        verdicts fired THIS pass."""
+        now = time.monotonic()
+        fired: List[dict] = []
+        instants: List[Tuple[str, Dict]] = []
+        with self._lock:
+            if now - self._last_eval < self.interval_s:
+                return []
+            self._last_eval = now
+            self.derivations += 1
+            live = []
+            for row in fleet:
+                st = self._state(int(row["idx"]))
+                st.alive = bool(row.get("alive", True))
+                st.drained = bool(row.get("drained", False))
+                st.outstanding = int(row.get("outstanding", 0))
+                if st.alive and not st.drained:
+                    live.append(st)
+            ttft_p99s = [
+                _tail_q(st.ttft, 0.99) for st in live
+                if len(st.ttft) >= MIN_SLO_SAMPLES
+            ]
+            tbt_p99s = [
+                _tail_q(st.tbt, 0.99) for st in live
+                if len(st.tbt) >= MIN_SLO_SAMPLES
+            ]
+            med_ttft = _tail_q(ttft_p99s, 0.5)
+            med_tbt = _tail_q(tbt_p99s, 0.5)
+            peers = len(ttft_p99s)
+            hit_rates = [st.prefix_hit_rate for st in live]
+            self._fleet = {
+                "ttft_p99_median_s": round(med_ttft, 4),
+                "tbt_p99_median_s": round(med_tbt, 4),
+                "prefix_hit_rate": round(
+                    sum(hit_rates) / len(hit_rates), 4
+                ) if hit_rates else 0.0,
+                "replicas_alive": len(live),
+            }
+            for st in self._replicas.values():
+                prev_verdict = st.verdict
+                if not st.alive or st.drained:
+                    st.verdict = "drained" if st.drained else "dead"
+                    st.why = st.verdict
+                    st.streaks.clear()
+                    st.preempt_delta = 0
+                    if st.verdict != prev_verdict:
+                        instants.append(
+                            (
+                                "serving_health",
+                                {
+                                    "replica": st.idx,
+                                    "verdict": st.verdict,
+                                    "reason": st.verdict,
+                                },
+                            )
+                        )
+                    continue
+                breaches = self._breaches(
+                    st, now, med_ttft, med_tbt, peers
+                )
+                st.preempt_delta = 0
+                current = {r for r, _v, _t in breaches}
+                for reason in list(st.streaks):
+                    if reason not in current:
+                        st.streaks.pop(reason)
+                sustained: Dict[str, Tuple[float, float]] = {}
+                for reason, value, threshold in breaches:
+                    streak = st.streaks.get(reason, 0) + 1
+                    st.streaks[reason] = streak
+                    if streak < self.sustain:
+                        continue
+                    sustained[reason] = (value, threshold)
+                    key = (st.idx, reason)
+                    last = self._last_fired.get(key, -1e18)
+                    if now - last < self.cooldown_s:
+                        continue
+                    self._last_fired[key] = now
+                    verdict = {
+                        "replica": st.idx,
+                        "reason": reason,
+                        "value": round(float(value), 4),
+                        "threshold": round(float(threshold), 4),
+                        "streak": streak,
+                        "t": time.time(),
+                    }
+                    fired.append(verdict)
+                    instants.append(("slo_breach", dict(verdict)))
+                st.verdict = next(
+                    (r for r in self._PRIORITY if r in sustained),
+                    "ok",
+                )
+                if st.verdict == "ok":
+                    st.why = "ok"
+                    st.slo_score = round(st.slo_score, 3)
+                else:
+                    value, threshold = sustained[st.verdict]
+                    st.why = (
+                        f"{st.verdict} {value:.3g} vs {threshold:.3g}"
+                    )
+                if st.verdict != prev_verdict:
+                    instants.append(
+                        (
+                            "serving_health",
+                            {
+                                "replica": st.idx,
+                                "verdict": st.verdict,
+                                "reason": (
+                                    st.verdict
+                                    if st.verdict != "ok"
+                                    else "recovered"
+                                ),
+                            },
+                        )
+                    )
+            gauge_rows = [
+                (st.idx, self._VERDICT_GAUGE.get(st.verdict, 0.0))
+                for st in self._replicas.values()
+                if st.alive and not st.drained
+            ]
+        for name, labels in instants:
+            try:
+                from dlrover_tpu.observability.events import (
+                    get_event_logger,
+                )
+
+                # literal names so the schema lint can see them;
+                # labels carry every required key (built above)
+                if name == "slo_breach":
+                    get_event_logger().instant("slo_breach", **labels)
+                else:
+                    get_event_logger().instant(
+                        "serving_health", **labels
+                    )
+            except Exception as e:  # noqa: BLE001 - telemetry only
+                logger.warning("%s instant emit failed: %s", name, e)
+        try:
+            from dlrover_tpu.observability.metrics import get_registry
+
+            reg = get_registry()
+            for idx, value in gauge_rows:
+                reg.set_gauge(
+                    "dlrover_tpu_serving_health",
+                    value,
+                    labels={"replica": str(idx)},
+                )
+        except Exception as e:  # noqa: BLE001 - telemetry only
+            logger.warning("serving health gauge export failed: %s", e)
+        return fired
+
+    def reset(self):
+        """Forget all derivation history — per-replica SLO windows,
+        streaks, verdicts, breach cooldowns.  For the moment a fleet's
+        past stops being representative: after warmup (compile-era
+        TTFTs would otherwise sit in the p99 windows for ~128
+        requests) or a redeploy."""
+        with self._lock:
+            self._replicas.clear()
+            self._last_fired.clear()
+            self._fleet = {}
+
+    # -------------------------------------------------------- readers
+    def snapshot(self) -> Dict:
+        """The ``health`` section of the serving status: per-replica
+        verdict + why + the numbers behind them, plus the fleet
+        medians."""
+        with self._lock:
+            return {
+                "replicas": [
+                    {
+                        "replica": st.idx,
+                        "verdict": st.verdict,
+                        "why": st.why,
+                        "slo_score": st.slo_score,
+                        "ttft_p99_s": round(
+                            _tail_q(st.ttft, 0.99), 4
+                        ),
+                        "tbt_p99_s": round(_tail_q(st.tbt, 0.99), 4),
+                        "e2e_p99_s": round(_tail_q(st.e2e, 0.99), 4),
+                        "kv_utilization": round(
+                            st.kv_utilization, 4
+                        ),
+                        "prefix_hit_rate": round(
+                            st.prefix_hit_rate, 4
+                        ),
+                        "outstanding": st.outstanding,
+                        "silent_s": round(
+                            max(
+                                time.monotonic()
+                                - st.last_progress_t,
+                                0.0,
+                            ),
+                            2,
+                        ),
+                        "streaks": dict(st.streaks),
+                    }
+                    for st in sorted(
+                        self._replicas.values(),
+                        key=lambda s: s.idx,
+                    )
+                ],
+                "fleet": dict(self._fleet),
+                "derivations": self.derivations,
+                "interval_s": self.interval_s,
+                "sustain": self.sustain,
+            }

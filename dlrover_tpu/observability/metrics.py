@@ -237,6 +237,37 @@ class MetricsRegistry:
                 if n == name
             }
 
+    def retire_series(self, labels: Dict) -> int:
+        """Drop every series — scalar gauges/counters AND histogram
+        series — carrying ALL of the given label pairs, and return
+        how many were dropped.  A dead or drained serving replica's
+        ``dlrover_tpu_serving_*{replica=...}`` gauges would otherwise
+        keep their last values on ``/metrics`` forever, reading as a
+        live-but-frozen replica; retiring the series makes the death
+        visible as absence."""
+        pairs = {
+            f'{self._NAME_RE.sub("_", str(k))}='
+            f'"{self._escape_label(v)}"'
+            for k, v in labels.items()
+        }
+        if not pairs:
+            return 0
+        dropped = 0
+        with self._lock:
+            for key in list(self._metrics):
+                if "{" not in key:
+                    continue
+                inner = key[key.index("{") + 1:key.rindex("}")]
+                if pairs <= set(inner.split(",")):
+                    del self._metrics[key]
+                    dropped += 1
+            for hkey in list(self._histograms):
+                if pairs <= set(hkey[1].split(",")):
+                    del self._histograms[hkey]
+                    dropped += 1
+        self._maybe_flush()
+        return dropped
+
     def _histogram_lines(self, stamp: str = "") -> list:
         """Caller holds the lock."""
         lines = []
@@ -431,6 +462,53 @@ def record_serving(
             )
     except Exception as e:  # noqa: BLE001
         logger.warning("serving metric export failed: %s", e)
+
+
+def record_serving_latency(
+    replica: str,
+    ttft_s=None,
+    tbt_p99_s=None,
+    e2e_s=None,
+    queue_wait_s=None,
+):
+    """Observe one completed request's SLO latencies into the
+    per-replica log-bucketed histograms
+    (``dlrover_tpu_serving_{ttft,tbt,e2e,queue_wait}_seconds``),
+    rendered as classic ``_bucket``/``_sum``/``_count`` exposition —
+    the quantile source for ``/status`` and the SLO-straggler
+    derivation.  ``tbt_p99_s`` observations are the request-level
+    per-token-gap p99 (one sample per request, not per token — the
+    series is a distribution over requests).  Inert when
+    ``DLROVER_TPU_SERVE_OBS=0`` (no series created).  Never raises."""
+    from dlrover_tpu.common.env import serve_obs_enabled
+
+    if not serve_obs_enabled():
+        return
+    try:
+        reg = get_registry()
+        labels = {"replica": replica}
+        if ttft_s is not None:
+            reg.observe_histogram(
+                "dlrover_tpu_serving_ttft_seconds",
+                float(ttft_s), labels=labels,
+            )
+        if tbt_p99_s is not None:
+            reg.observe_histogram(
+                "dlrover_tpu_serving_tbt_seconds",
+                float(tbt_p99_s), labels=labels,
+            )
+        if e2e_s is not None:
+            reg.observe_histogram(
+                "dlrover_tpu_serving_e2e_seconds",
+                float(e2e_s), labels=labels,
+            )
+        if queue_wait_s is not None:
+            reg.observe_histogram(
+                "dlrover_tpu_serving_queue_wait_seconds",
+                float(queue_wait_s), labels=labels,
+            )
+    except Exception as e:  # noqa: BLE001
+        logger.warning("serving latency export failed: %s", e)
 
 
 def record_offload_io(nbytes: int, seconds: float, buffered: bool):

@@ -40,6 +40,7 @@ class StatusServer:
         snapshot_fn: Optional[Callable[[], dict]] = None,
         health_engine=None,
         telemetry=None,
+        serving_refresh=None,
         host: str = "0.0.0.0",
     ):
         self._port = port
@@ -51,6 +52,10 @@ class StatusServer:
         #: off): its sweep gauges refresh at scrape time like the
         #: health engine's
         self._telemetry = telemetry
+        #: zero-arg serving-plane refresh hook (None = no co-located
+        #: serving engine or DLROVER_TPU_SERVE_OBS=0): lets a scrape
+        #: pull the replica gauges/health current before rendering
+        self._serving_refresh = serving_refresh
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -87,6 +92,8 @@ class StatusServer:
                             server._health.refresh_gauges()
                         if server._telemetry is not None:
                             server._telemetry.refresh_gauges()
+                        if server._serving_refresh is not None:
+                            server._serving_refresh()
                         text = (
                             server._registry.render_text()
                             if server._registry is not None

@@ -54,6 +54,7 @@ import numpy as np
 from dlrover_tpu.common.env import (
     gen_close_timeout_s,
     gen_timeout_s,
+    serve_obs_enabled,
     serving_enabled,
 )
 from dlrover_tpu.common.log import default_logger as logger
@@ -71,6 +72,49 @@ _KIND_STATS = 3
 _KIND_REJECT = 4
 _FINISH_CODES = {"length": 0, "eos": 1}
 _FINISH_NAMES = {v: k for k, v in _FINISH_CODES.items()}
+
+#: Explicit schema version of BOTH shm-ring payloads.  PR 14 silently
+#: widened the response ``times`` vector 4→8 floats — a mixed-width
+#: reader would have misparsed stats as garbage numbers instead of
+#: failing.  v2 (this layout): request meta carries
+#: [req_id, prompt_len, max_new, seed, schema_version, submit_wall_ns]
+#: and response meta carries
+#: [req_id, kind, total_len, new_tokens, finish_code, weights_version,
+#: schema_version].  Bump on ANY layout change.
+RING_SCHEMA_VERSION = 2
+
+
+class RingSchemaMismatch(RuntimeError):
+    """A ring message written under a different payload schema than
+    this reader understands (a mixed-version dispatcher/replica pair
+    — e.g. a rolling upgrade that restarted only one side)."""
+
+    def __init__(self, got: int, what: str):
+        self.got = int(got)
+        self.expected = RING_SCHEMA_VERSION
+        super().__init__(
+            f"{what} payload schema v{self.got} != reader schema "
+            f"v{self.expected} — dispatcher and replica were built "
+            "from different ring layouts; restart both sides on one "
+            "version"
+        )
+
+
+def _parse_stats(times, schema_version: int) -> Dict:
+    """Decode one replica STATS ``times`` vector into the stats dict
+    the serving pane renders.  Refuses (typed, naming both versions)
+    rather than misparse a different layout."""
+    if int(schema_version) != RING_SCHEMA_VERSION:
+        raise RingSchemaMismatch(int(schema_version), "replica STATS")
+    return {
+        "tokens_per_s": round(float(times[0]), 2),
+        "queue_depth": int(times[1]),
+        "kv_blocks_used": int(times[2]),
+        "kv_utilization": round(float(times[3]), 4),
+        "preemptions": int(times[4]),
+        "prefix_hit_rate": round(float(times[5]), 4),
+        "accepted_per_step": round(float(times[6]), 4),
+    }
 
 
 def _import_factory(path: str) -> Callable:
@@ -374,8 +418,10 @@ def _req_spec(max_prompt: int):
 
     return BatchSpec(
         {
-            # req_id, prompt_len, max_new, seed
-            "meta": ((4,), "<i8"),
+            # req_id, prompt_len, max_new, seed, schema_version,
+            # submit_wall_ns (the dispatcher's wall clock at submit —
+            # the request-trace anchor; same-host processes share it)
+            "meta": ((6,), "<i8"),
             "prompt": ((max_prompt,), "<i4"),
         }
     )
@@ -386,13 +432,15 @@ def _resp_spec(max_total: int):
 
     return BatchSpec(
         {
-            # req_id, kind, total_len, new_tokens, finish_code, version
-            "meta": ((6,), "<i8"),
+            # req_id, kind, total_len, new_tokens, finish_code,
+            # weights_version, schema_version
+            "meta": ((7,), "<i8"),
             "tokens": ((max_total,), "<i4"),
-            # RESULT: latency_s, ttft_s, worker_gen_s, tokens_per_s
+            # RESULT: latency_s, ttft_s, worker_gen_s, tokens_per_s,
+            #         tbt_p99_s, queue_wait_s (trailing 2 spare)
             # STATS:  tokens_per_s, queue_depth, kv_blocks_used,
             #         kv_utilization, preemptions, prefix_hit_rate,
-            #         accepted_tokens_per_step (trailing slot spare)
+            #         accepted_tokens_per_step, ttft_p99_s
             "times": ((8,), "<f8"),
         }
     )
@@ -516,7 +564,23 @@ def _serving_worker_loop(spec) -> int:
         paged_prefill_fn=parts.get("paged_prefill_fn"),
         paged_verify_fn=parts.get("paged_verify_fn"),
         events=get_event_logger(),
+        replica=tag,
     )
+    serve_obs = serve_obs_enabled()
+    ttft_hist = None
+    if serve_obs:
+        from dlrover_tpu.observability.metrics import Histogram
+
+        ttft_hist = Histogram()
+    # chaos seam for the observatory bench (spec["faults"], keyed by
+    # replica index): "sleep_s" stalls every scheduler iteration (an
+    # SLO straggler — slow but progressing), "wedge_after_tokens"
+    # freezes the loop outright once N tokens were sampled (dead air —
+    # outstanding work, a live process, no progress, no stats).
+    # Signals still land, so drain/close stay clean.
+    fault = (spec.get("faults") or {}).get(str(replica)) or {}
+    fault_sleep_s = float(fault.get("sleep_s", 0.0))
+    wedge_after = int(fault.get("wedge_after_tokens", 0))
     template = parts["params_template_fn"]()
     scheduler.sync_weights(template)
 
@@ -563,7 +627,8 @@ def _serving_worker_loop(spec) -> int:
         msg = {
             "meta": np.asarray(
                 [req_id, kind, total, new_tokens,
-                 _FINISH_CODES.get(finish, 0), version],
+                 _FINISH_CODES.get(finish, 0), version,
+                 RING_SCHEMA_VERSION],
                 np.int64,
             ),
             "tokens": buf,
@@ -588,6 +653,8 @@ def _serving_worker_loop(spec) -> int:
             )
 
     def _flush_result(res):
+        if ttft_hist is not None:
+            ttft_hist.observe(res.stats.get("ttft_s", 0.0))
         _respond(
             _KIND_RESULT,
             req_id=res.req_id,
@@ -599,6 +666,8 @@ def _serving_worker_loop(spec) -> int:
                 res.stats.get("ttft_s", 0.0),
                 res.latency_s,
                 res.new_tokens / max(res.latency_s, 1e-9),
+                res.stats.get("tbt_p99_s", 0.0),
+                res.stats.get("queue_wait_s", 0.0),
             ),
         )
 
@@ -610,22 +679,34 @@ def _serving_worker_loop(spec) -> int:
     while True:
         if drain["flag"]:
             break
+        if wedge_after and scheduler.total_new_tokens >= wedge_after:
+            # injected dead air: the process lives, its outstanding
+            # requests never progress, no stats ever flow again
+            time.sleep(0.05)
+            continue
         _adopt_weights()
+        if fault_sleep_s:
+            time.sleep(fault_sleep_s)  # injected SLO straggler
         # admit everything queued on the ring (token-level admission
         # happens inside the scheduler)
         while True:
             msg = req_ring.try_get()
             if msg is None:
                 break
-            req_id, plen, max_new, seed = (
+            req_id, plen, max_new, seed, ring_ver, wall_ns = (
                 int(v) for v in msg["meta"]
             )
+            if ring_ver != RING_SCHEMA_VERSION:
+                raise RingSchemaMismatch(ring_ver, "dispatch request")
             try:
                 scheduler.submit(
                     msg["prompt"][:plen],
                     max_new=max_new,
                     seed=seed,
                     req_id=req_id,
+                    submit_wall=(
+                        wall_ns / 1e9 if wall_ns > 0 else None
+                    ),
                 )
             except ValueError as e:
                 # belt-and-suspenders (the dispatcher validates at
@@ -671,6 +752,10 @@ def _serving_worker_loop(spec) -> int:
                     float(st["preemptions"]),
                     float(st["prefix_hit_rate"]),
                     float(st["accepted_per_step"]),
+                    (
+                        ttft_hist.quantile(0.99)
+                        if ttft_hist is not None else 0.0
+                    ),
                 ),
             )
             window_tokens = 0
@@ -722,6 +807,7 @@ class _InFlight:
     max_new: int
     seed: int
     submit_t: float
+    submit_wall: float = 0.0  # epoch seconds; rides the request ring
     done: threading.Event = field(default_factory=threading.Event)
     result: Optional[Dict] = None
     attempts: int = 0
@@ -772,6 +858,7 @@ class ServingEngine:
         eos_id: Optional[int] = None,
         start_timeout: float = 300.0,
         ring_slots: int = 8,
+        faults: Optional[Dict] = None,
     ):
         from dlrover_tpu.agent.ckpt_shm import SharedMemoryHandler
         from dlrover_tpu.common.multi_process import SOCKET_DIR_ENV
@@ -797,11 +884,26 @@ class ServingEngine:
         self._lock = threading.Lock()
         self._closed = False
         self._latency = Histogram()
+        # serving observatory (ISSUE 16), pinned at construction:
+        # per-request SLO histograms in the registry, mirrored
+        # per-replica gauges, and the ServingHealthEngine derivations
+        # — all absent under DLROVER_TPU_SERVE_OBS=0
+        self._serve_obs = serve_obs_enabled()
+        self._health = None
+        if self._serve_obs:
+            from dlrover_tpu.observability.health import (
+                ServingHealthEngine,
+            )
+
+            self._health = ServingHealthEngine()
         self._spec = {
             "mode": "serve",
             "name": self._name,
             "factory": factory,
             "factory_kwargs": factory_kwargs or {},
+            "faults": {
+                str(k): v for k, v in (faults or {}).items()
+            },
             "sched": {
                 "max_slots": int(max_slots),
                 "block_size": int(block_size),
@@ -951,6 +1053,7 @@ class ServingEngine:
                 max_new=max_new,
                 seed=int(seed),
                 submit_t=time.monotonic(),
+                submit_wall=time.time(),
             )
             self._reqs[req_id] = inflight
             self._dispatch_q.append(req_id)
@@ -1083,26 +1186,19 @@ class ServingEngine:
             if kind == _KIND_DRAINED:
                 rep.drained = True
                 rep.draining = True
+                self._retire_replica_series(rep)
                 continue
             if kind == _KIND_READY:
                 rep.ready = True
                 continue
             if kind == _KIND_STATS:
-                rep.stats = {
-                    "tokens_per_s": round(float(msg["times"][0]), 2),
-                    "queue_depth": int(msg["times"][1]),
-                    "kv_blocks_used": int(msg["times"][2]),
-                    "kv_utilization": round(
-                        float(msg["times"][3]), 4
-                    ),
-                    "preemptions": int(msg["times"][4]),
-                    "prefix_hit_rate": round(
-                        float(msg["times"][5]), 4
-                    ),
-                    "accepted_per_step": round(
-                        float(msg["times"][6]), 4
-                    ),
-                }
+                rep.stats = _parse_stats(msg["times"], meta[6])
+                if self._serve_obs:
+                    rep.stats["ttft_p99_s"] = round(
+                        float(msg["times"][7]), 4
+                    )
+                    if self._health is not None:
+                        self._health.note_stats(rep.idx, rep.stats)
                 continue
             if kind == _KIND_REJECT:
                 req_id = int(meta[0])
@@ -1142,9 +1238,47 @@ class ServingEngine:
                     "replica": rep.idx,
                 },
             )
+            if self._serve_obs:
+                from dlrover_tpu.observability.metrics import (
+                    record_serving_latency,
+                )
+
+                ttft = float(msg["times"][1])
+                tbt = float(msg["times"][4])
+                qwait = float(msg["times"][5])
+                record_serving_latency(
+                    replica=str(rep.idx),
+                    ttft_s=ttft,
+                    tbt_p99_s=tbt,
+                    e2e_s=latency,
+                    queue_wait_s=qwait,
+                )
+                if self._health is not None:
+                    self._health.note_result(
+                        rep.idx, ttft_s=ttft, tbt_p99_s=tbt,
+                        e2e_s=latency, queue_wait_s=qwait,
+                    )
+
+    def _retire_replica_series(self, rep: _Replica):
+        """Zero-and-drop a dead/drained replica's per-replica series
+        (the mirrored gauges AND the SLO histograms) from this
+        process's registry: a frozen last value on ``/metrics`` reads
+        as a live replica — absence reads as the death it is."""
+        if not self._serve_obs:
+            return
+        try:
+            from dlrover_tpu.observability.metrics import get_registry
+
+            get_registry().retire_series({"replica": str(rep.idx)})
+        except Exception as e:  # noqa: BLE001 - never block dispatch
+            logger.warning(
+                "serving series retirement failed for replica %d: %s",
+                rep.idx, e,
+            )
 
     def _handle_death(self, rep: _Replica):
         rep.alive = False
+        self._retire_replica_series(rep)
         rc = rep.proc.returncode
         requeue = [
             rid for rid in rep.outstanding
@@ -1216,7 +1350,8 @@ class ServingEngine:
                 {
                     "meta": np.asarray(
                         [req_id, req.prompt.size, req.max_new,
-                         req.seed],
+                         req.seed, RING_SCHEMA_VERSION,
+                         int(req.submit_wall * 1e9)],
                         np.int64,
                     ),
                     "prompt": np.pad(
@@ -1243,13 +1378,73 @@ class ServingEngine:
                 kv_blocks_used=None,
                 p99_latency_s=self._latency.quantile(0.99),
             )
+            if self._serve_obs:
+                # mirror each live replica's newest STATS into THIS
+                # process's registry so the engine's /metrics carries
+                # the fleet (the per-replica series retirement on
+                # death/drain acts here)
+                for rep in self._replicas:
+                    if not rep.alive or rep.drained or not rep.stats:
+                        continue
+                    st = rep.stats
+                    record_serving(
+                        replica=str(rep.idx),
+                        tokens_per_s=st.get("tokens_per_s"),
+                        queue_depth=st.get("queue_depth"),
+                        kv_blocks_used=st.get("kv_blocks_used"),
+                        kv_utilization=st.get("kv_utilization"),
+                        preemptions=st.get("preemptions"),
+                        prefix_hit_rate=st.get("prefix_hit_rate"),
+                        accepted_tokens_per_step=st.get(
+                            "accepted_per_step"
+                        ),
+                    )
+        if self._health is not None:
+            # internally throttled to the derivation interval
+            self._health.evaluate(
+                [
+                    {
+                        "idx": r.idx,
+                        "alive": r.alive,
+                        "drained": r.drained,
+                        "outstanding": len(r.outstanding),
+                        **r.stats,
+                    }
+                    for r in self._replicas
+                ]
+            )
         return moved
 
     # --------------------------------------------------------- status
+    def _slo_quantile(self, metric: str, q: float) -> float:
+        """Fleet quantile of one registry SLO histogram, merged across
+        every ``replica`` series (identical bucket bounds — counts
+        sum)."""
+        from dlrover_tpu.observability.metrics import (
+            Histogram,
+            get_registry,
+        )
+
+        series = get_registry().histogram_series(metric)
+        merged = None
+        for hist in series.values():
+            if merged is None:
+                merged = Histogram(hist.bounds)
+            if merged.bounds != hist.bounds:
+                continue  # foreign layout; never ours
+            for i, c in enumerate(hist.counts):
+                merged.counts[i] += c
+            merged.count += hist.count
+            merged.sum += hist.sum
+        return merged.quantile(q) if merged is not None else 0.0
+
     def status(self) -> Dict:
         """The serving pane: what ``scripts/top.py`` renders and the
-        bench snapshots."""
-        return {
+        bench snapshots.  With the observatory on, ``slo`` carries the
+        fleet quantiles off the registry histograms and ``health`` the
+        ServingHealthEngine's newest per-replica derivations; both
+        keys are ABSENT under DLROVER_TPU_SERVE_OBS=0 (pinned)."""
+        out = {
             "replicas": [
                 dict(
                     {
@@ -1268,6 +1463,24 @@ class ServingEngine:
             "p99_latency_s": round(self._latency.quantile(0.99), 4),
             "version": self._version,
         }
+        if self._serve_obs:
+            out["slo"] = {
+                "ttft_p99_s": round(self._slo_quantile(
+                    "dlrover_tpu_serving_ttft_seconds", 0.99
+                ), 4),
+                "tbt_p99_s": round(self._slo_quantile(
+                    "dlrover_tpu_serving_tbt_seconds", 0.99
+                ), 4),
+                "e2e_p99_s": round(self._slo_quantile(
+                    "dlrover_tpu_serving_e2e_seconds", 0.99
+                ), 4),
+                "queue_wait_p99_s": round(self._slo_quantile(
+                    "dlrover_tpu_serving_queue_wait_seconds", 0.99
+                ), 4),
+            }
+            if self._health is not None:
+                out["health"] = self._health.snapshot()
+        return out
 
     def close(self):
         if self._closed:

@@ -71,6 +71,7 @@ from dlrover_tpu.common.env import (
     kv_grow_blocks,
     kv_incremental_enabled,
     kv_prefix_cache_enabled,
+    serve_obs_enabled,
 )
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.rl.kv_cache import (
@@ -105,6 +106,17 @@ class GenRequest:
     seed: int = 0
     submit_t: float = field(default_factory=time.monotonic)
     resume_tokens: np.ndarray = field(default_factory=_empty_tokens)
+    # request-tracing state (ISSUE 16; inert when
+    # DLROVER_TPU_SERVE_OBS=0).  ``submit_wall`` is the wall-clock
+    # anchor that rode the dispatcher→replica ring (0 = in-process
+    # submit, fall back to this process's anchored clock); the rest
+    # survive preemption so the serve_request span tells the request's
+    # WHOLE life, not its last incarnation's
+    submit_wall: float = 0.0
+    preempts: int = 0
+    hit_blocks: int = 0
+    queue_wait_s: float = 0.0
+    token_times: List[float] = field(default_factory=list)
 
 
 @dataclass
@@ -167,6 +179,7 @@ class ContinuousBatchingScheduler:
         paged_prefill_fn: Optional[Callable] = None,
         paged_verify_fn: Optional[Callable] = None,
         events=None,
+        replica: str = "",
     ):
         import jax
         import jax.numpy as jnp
@@ -181,6 +194,13 @@ class ContinuousBatchingScheduler:
         if s.prefill_chunk < 1 or s.max_slots < 1:
             raise ValueError("prefill_chunk and max_slots must be >= 1")
         self._events = events
+        # request-lifecycle tracing (ISSUE 16): pinned at construction
+        # like the allocation discipline — a scheduler never changes
+        # observability personality mid-flight.  ``replica`` labels the
+        # serve_request spans with where the request actually ran.
+        self._serve_obs = serve_obs_enabled()
+        self.replica = replica
+        self._last_prefill_req = -1
         self._params = None
         self._decode_model = paged_decode_fn or partial(
             llama.paged_decode_step, cfg=model_cfg
@@ -349,8 +369,15 @@ class ContinuousBatchingScheduler:
         max_new: Optional[int] = None,
         seed: int = 0,
         req_id: Optional[int] = None,
+        submit_wall: Optional[float] = None,
     ) -> int:
-        """Queue one prompt; returns the request id results carry."""
+        """Queue one prompt; returns the request id results carry.
+
+        ``submit_wall`` is the submitter's wall-clock anchor (epoch
+        seconds) when the request crossed a process boundary — the
+        dispatcher stamps it onto the shm ring so the ``queue_wait``
+        and ``serve_request`` spans start at the TRUE submit time,
+        ring transit included."""
         if self.draining:
             raise RuntimeError(
                 "scheduler is draining: submissions belong on "
@@ -389,7 +416,8 @@ class ContinuousBatchingScheduler:
         self._next_req_id = max(self._next_req_id, req_id) + 1
         self._queue.append(
             GenRequest(req_id=req_id, prompt=prompt, max_new=max_new,
-                       seed=int(seed))
+                       seed=int(seed),
+                       submit_wall=float(submit_wall or 0.0))
         )
         return req_id
 
@@ -538,6 +566,7 @@ class ContinuousBatchingScheduler:
                 # FIFO head-of-line: later (smaller) requests must not
                 # starve the head forever
                 return
+            admit_t0 = time.monotonic()
             self._queue.pop(0)
             slot = free[0]
             hit_ids = (
@@ -581,6 +610,49 @@ class ContinuousBatchingScheduler:
             self._slots[slot] = sl
             self.block_pool.note_filled(req.req_id, sl.prefill_pos)
             self._window_hit_blocks += n_hit
+            req.hit_blocks += n_hit
+            if self._serve_obs:
+                self._trace_admit(req, admit_t0)
+
+    def _trace_admit(self, req: GenRequest, admit_t0: float):
+        """Close the request's queue phase: a fresh admission emits
+        ``queue_wait`` (from the submit wall anchor) + ``admit``; a
+        preempted request's re-admission emits ``resume`` with the
+        restored tail size instead."""
+        from dlrover_tpu.observability.events import anchored_now
+
+        t1 = time.monotonic()
+        end_wall = anchored_now(admit_t0)
+        fresh = not (req.resume_tokens.size or req.preempts)
+        if fresh:
+            start_wall = (
+                req.submit_wall if req.submit_wall > 0.0
+                else anchored_now(req.submit_t)
+            )
+            req.queue_wait_s = max(end_wall - start_wall, 0.0)
+        if self._events is None or not self._events.enabled:
+            return
+        if fresh:
+            self._events.complete(
+                "queue_wait",
+                start_wall,
+                max(end_wall - start_wall, 1e-9),
+                req_id=req.req_id,
+            )
+            self._events.complete(
+                "admit",
+                end_wall,
+                max(t1 - admit_t0, 1e-9),
+                req_id=req.req_id,
+            )
+        else:
+            self._events.complete(
+                "resume",
+                end_wall,
+                max(t1 - admit_t0, 1e-9),
+                req_id=req.req_id,
+                resume_tokens=int(req.resume_tokens.size),
+            )
 
     def _finish(self, slot: int, reason: str,
                 finished: List[GenResult]):
@@ -590,6 +662,49 @@ class ContinuousBatchingScheduler:
         tokens = np.concatenate(
             [req.prompt, np.asarray(sl.generated, np.int32)]
         )
+        stats = {
+            "ttft_s": round(
+                max(sl.first_token_t - req.submit_t, 0.0), 6
+            ),
+        }
+        if self._serve_obs:
+            gaps = [
+                req.token_times[i + 1] - req.token_times[i]
+                for i in range(len(req.token_times) - 1)
+            ]
+            tbt_p99 = (
+                float(np.percentile(gaps, 99)) if gaps else 0.0
+            )
+            stats.update(
+                tbt_p99_s=round(tbt_p99, 6),
+                queue_wait_s=round(req.queue_wait_s, 6),
+                preempts=req.preempts,
+                prefix_hit_blocks=req.hit_blocks,
+            )
+            if self._events is not None and self._events.enabled:
+                from dlrover_tpu.observability.events import (
+                    anchored_now,
+                )
+
+                end_wall = anchored_now(now)
+                start_wall = (
+                    req.submit_wall if req.submit_wall > 0.0
+                    else anchored_now(req.submit_t)
+                )
+                self._events.complete(
+                    "serve_request",
+                    start_wall,
+                    max(end_wall - start_wall, 1e-9),
+                    req_id=req.req_id,
+                    replica=self.replica,
+                    prompt_tokens=int(req.prompt.size),
+                    gen_tokens=len(sl.generated),
+                    ttft_s=stats["ttft_s"],
+                    tbt_p99_s=stats["tbt_p99_s"],
+                    preempts=req.preempts,
+                    prefix_hit_blocks=req.hit_blocks,
+                    finish_reason=reason,
+                )
         finished.append(
             GenResult(
                 req_id=req.req_id,
@@ -597,11 +712,7 @@ class ContinuousBatchingScheduler:
                 finish_reason=reason,
                 new_tokens=len(sl.generated),
                 latency_s=now - req.submit_t,
-                stats={
-                    "ttft_s": round(
-                        max(sl.first_token_t - req.submit_t, 0.0), 6
-                    ),
-                },
+                stats=stats,
             )
         )
         self.block_pool.free(req.req_id)
@@ -633,6 +744,11 @@ class ContinuousBatchingScheduler:
                 seed=req.seed,
                 submit_t=req.submit_t,
                 resume_tokens=resume,
+                submit_wall=req.submit_wall,
+                preempts=req.preempts + 1,
+                hit_blocks=req.hit_blocks,
+                queue_wait_s=req.queue_wait_s,
+                token_times=req.token_times,
             ),
         )
         self._tables[slot] = 0
@@ -644,12 +760,16 @@ class ContinuousBatchingScheduler:
             from dlrover_tpu.observability.events import anchored_now
 
             dur = max(time.monotonic() - t0, 1e-9)
+            extra = (
+                {"req_id": req.req_id} if self._serve_obs else {}
+            )
             self._events.complete(
                 "preempt",
                 anchored_now(t0),
                 dur,
                 blocks_freed=n_blocks,
                 tokens_generated=int(resume.size),
+                **extra,
             )
         logger.info(
             "preempted seq %d (pool dry): freed %d block(s), "
@@ -736,6 +856,10 @@ class ContinuousBatchingScheduler:
         if not sl.generated:
             sl.first_token_t = time.monotonic()
         sl.generated.append(int(token))
+        if self._serve_obs:
+            # per-token timestamps fold into ONE tbt_p99_s label at
+            # finish — the only per-token tracing cost
+            sl.req.token_times.append(time.monotonic())
         self.total_new_tokens += 1
         eos = self.sched.eos_id
         if eos is not None and int(token) == int(eos):
@@ -776,6 +900,7 @@ class ContinuousBatchingScheduler:
         self._prefill_rr += 1
         sl = self._slots[slot]
         req = sl.req
+        self._last_prefill_req = req.req_id
         plen = sl.prefill_len
         start = sl.prefill_pos
         chunk = sl.prefill_tokens[start:start + s.prefill_chunk]
@@ -952,12 +1077,20 @@ class ContinuousBatchingScheduler:
             from dlrover_tpu.observability.events import anchored_now
 
             if pre:
+                # request labels on the iteration-level prefill span
+                # (one chunk serves exactly one slot) — gated so
+                # SERVE_OBS=0 keeps the PR-14 record byte-for-byte
+                req_label = (
+                    {"req_id": self._last_prefill_req}
+                    if self._serve_obs else {}
+                )
                 self._events.complete(
                     "prefill",
                     anchored_now(pre_t0),
                     pre_t1 - pre_t0,
                     tokens=pre,
                     prefix_hit_blocks=hit_blocks,
+                    **req_label,
                 )
             if dec:
                 self._events.complete(

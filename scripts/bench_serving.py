@@ -570,6 +570,305 @@ def run_prefix(cfg, params, n_requests: int):
     return out
 
 
+def _scoped_env(env):
+    """Set ``env`` and return an undo callable."""
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+
+    def undo():
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    return undo
+
+
+def run_observatory_detection(n_requests: int):
+    """Observatory leg A (ISSUE 16): 4 replicas, one sleep-faulted
+    (SLO straggler — slow but progressing) and one wedged mid-decode
+    (dead air — outstanding work, live process, zero progress).  The
+    ServingHealthEngine must NAME both, with the RIGHT reason, within
+    3 derivation intervals of the first observed breach — and the
+    wedged replica's requests must still complete exactly once on the
+    survivors after the kill."""
+    from dlrover_tpu.rl.generation_service import ServingEngine
+
+    straggler, wedged = 2, 3
+    interval = 0.25
+    undo = _scoped_env(
+        {
+            "DLROVER_TPU_SERVE_OBS": "1",
+            "DLROVER_TPU_SERVING_DERIVE_S": str(interval),
+            "DLROVER_TPU_SERVING_DEAD_AIR_S": "1.0",
+            "DLROVER_TPU_SERVING_SUSTAIN": "2",
+            "DLROVER_TPU_SERVING_SLO_RATIO": "2.0",
+            "DLROVER_TPU_SERVING_COOLDOWN_S": "5",
+        }
+    )
+    try:
+        eng = ServingEngine(
+            factory=(
+                "dlrover_tpu.rl.generation_service:"
+                "tiny_llama_factory"
+            ),
+            factory_kwargs=CFG_KW,
+            max_new_tokens=MAX_NEW,
+            temperature=0.0,
+            name=f"bench-obs-{os.getpid()}",
+            num_replicas=4,
+            faults={
+                # sleep must be active during warmup too (the fault is
+                # pinned at worker start); the wedge trips only past
+                # the warmup token budget
+                straggler: {"sleep_s": 0.1},
+                wedged: {"wedge_after_tokens": 24},
+            },
+            **SCHED_KW,
+        )
+    finally:
+        undo()
+    workload = make_workload(n_requests, seed=17)
+    expect = {straggler: "slo_straggler", wedged: "dead_air"}
+    first_streak = {}  # replica -> derivations when breach appeared
+    named = {}  # replica -> {..detection record..}
+    try:
+        # warmup wave: get every replica's compile out of the SLO
+        # windows (8 requests x 2 tokens stays under the wedge budget
+        # even if routing lands them all on one replica), then drop
+        # the compile-era samples — steady state starts clean
+        warm = [
+            eng.submit(w["prompt"], max_new=2, seed=13000 + i)
+            for i, w in enumerate(workload[:8])
+        ]
+        for rid in warm:
+            eng.result(rid, timeout=300.0)
+        if eng._health is not None:
+            eng._health.reset()
+        t0 = time.monotonic()
+        ids = [
+            eng.submit(w["prompt"], max_new=w["max_new"],
+                       seed=w["seed"])
+            for w in workload
+        ]
+        deadline = t0 + 90.0
+        while (
+            len(named) < len(expect) and time.monotonic() < deadline
+        ):
+            health = eng.status().get("health") or {}
+            derivations = health.get("derivations", 0)
+            for row in health.get("replicas") or []:
+                idx = row.get("replica")
+                reason = expect.get(idx)
+                if reason is None or idx in named:
+                    continue
+                if (
+                    reason in (row.get("streaks") or {})
+                    and idx not in first_streak
+                ):
+                    first_streak[idx] = derivations
+                if row.get("verdict") == reason:
+                    named[idx] = {
+                        "replica": idx,
+                        "reason": reason,
+                        "why": row.get("why"),
+                        "detected_after_s": round(
+                            time.monotonic() - t0, 2
+                        ),
+                        "derivation_gap": derivations
+                        - first_streak.get(idx, derivations),
+                    }
+            time.sleep(0.05)
+        # recover the wedged replica's stranded requests, then the
+        # exactly-once contract must still hold on the survivors
+        eng.kill_replica(wedged)
+        results = [eng.result(rid, timeout=300.0) for rid in ids]
+        status = eng.status()
+        ok_results = [r for r in results if "error" not in r]
+        return {
+            "replicas": 4,
+            "requests": len(ids),
+            "completed": len(ok_results),
+            "named": sorted(named.values(),
+                            key=lambda d: d["replica"]),
+            "both_named": len(named) == len(expect),
+            "within_3_intervals": bool(named) and all(
+                d["derivation_gap"] <= 3 for d in named.values()
+            ),
+            "derive_interval_s": interval,
+            "slo": status.get("slo"),
+            "health": status.get("health"),
+        }
+    finally:
+        eng.close()
+
+
+def run_observatory_lifecycle(cfg, params, events_path: str,
+                              trace_path: str):
+    """Observatory leg B: an in-process scheduler under pool pressure
+    with the timeline on — the events file must contain at least one
+    COMPLETE preempted request lifecycle (queue_wait -> admit ->
+    preempt -> resume -> serve_request, all carrying the same req_id)
+    and it must survive the Perfetto export."""
+    from dlrover_tpu.observability.events import (
+        EventLogger,
+        export_chrome_trace,
+        read_events,
+    )
+    from dlrover_tpu.rl.scheduler import (
+        ContinuousBatchingScheduler,
+        SchedulerConfig,
+    )
+
+    # a pool at ~40% of worst-case demand under incremental
+    # allocation: growth WILL hit the wall mid-decode and preempt
+    undo = _scoped_env(
+        {
+            "DLROVER_TPU_SERVE_OBS": "1",
+            "DLROVER_TPU_KV_INCREMENTAL": "1",
+            "DLROVER_TPU_KV_GROW_BLOCKS": "1",
+        }
+    )
+    try:
+        sch = ContinuousBatchingScheduler(
+            cfg,
+            SchedulerConfig(
+                temperature=0.0,
+                max_new_default=24,
+                max_slots=8,
+                block_size=4,
+                num_blocks=26,
+                max_seq_len=64,
+                prefill_chunk=8,
+            ),
+            events=EventLogger(path=events_path, job="bench-obs"),
+            replica="obs-bench",
+        )
+    finally:
+        undo()
+    sch.sync_weights(params)
+    sch.submit(np.arange(4, dtype=np.int32), max_new=2, seed=0)
+    sch.run()
+    rng = np.random.default_rng(29)
+    for i in range(12):
+        sch.submit(
+            rng.integers(
+                0, CFG_KW["vocab_size"], (int(rng.integers(4, 10)),)
+            ).astype(np.int32),
+            max_new=24,
+            seed=4000 + i,
+        )
+    results = list(sch.run())
+    events = read_events(events_path)
+    by_req = {}
+    for e in events:
+        rid = (e.get("labels") or {}).get("req_id")
+        if rid is None:
+            continue
+        by_req.setdefault(rid, set()).add(e.get("name"))
+    complete = [
+        rid
+        for rid, names in sorted(by_req.items())
+        if {"queue_wait", "admit", "preempt", "resume",
+            "serve_request"} <= names
+    ]
+    trace_meta = export_chrome_trace(events, trace_path)
+    return {
+        "requests": len(results),
+        "preempted_requests": sum(
+            1
+            for r in results
+            if (r.stats or {}).get("preempts", 0) > 0
+        ),
+        "complete_lifecycles": len(complete),
+        "lifecycle_req_ids": complete[:8],
+        "events": len(events),
+        "trace": trace_meta,
+        "events_file": events_path,
+        "trace_file": trace_path,
+    }
+
+
+def run_observatory_overhead(cfg, params, workload):
+    """Observatory leg C: the tracing hot path (per-token timestamps
+    + per-request span assembly) ON vs OFF through the in-process
+    scheduler — overhead must stay under ~2% tokens/s (CPU timing
+    noise makes the bench record, and the tests assert, loosely)."""
+    from dlrover_tpu.rl.scheduler import SchedulerConfig
+
+    def build(obs_on: bool):
+        sch = _build_scheduler(
+            cfg,
+            SchedulerConfig(
+                temperature=0.0, max_new_default=MAX_NEW, **SCHED_KW
+            ),
+            {"DLROVER_TPU_SERVE_OBS": "1" if obs_on else "0"},
+        )
+        sch.sync_weights(params)
+        sch.submit(workload[0]["prompt"], max_new=2, seed=0)
+        sch.run()
+        return sch
+
+    def one_pass(sch):
+        t0 = time.monotonic()
+        for w in workload:
+            sch.submit(w["prompt"], max_new=w["max_new"],
+                       seed=w["seed"])
+        results = list(sch.run())
+        makespan = max(time.monotonic() - t0, 1e-9)
+        return sum(r.new_tokens for r in results) / makespan
+
+    # the per-pass makespan is fractions of a second on the tiny CPU
+    # model, so single measurements are noise; interleave repeated
+    # passes over the SAME two warmed schedulers and take each mode's
+    # best (overhead is a systematic slowdown — it survives best-of;
+    # scheduler/GC jitter does not)
+    off_sch, on_sch = build(False), build(True)
+    off_best = on_best = 0.0
+    for _ in range(6):
+        off_best = max(off_best, one_pass(off_sch))
+        on_best = max(on_best, one_pass(on_sch))
+    return {
+        "tokens_per_s_obs_off": round(off_best, 2),
+        "tokens_per_s_obs_on": round(on_best, 2),
+        "overhead_frac": round(
+            max(1.0 - on_best / max(off_best, 1e-9), 0.0), 4
+        ),
+    }
+
+
+def run_observatory(cfg, params, n_requests: int, out_dir: str,
+                    flush_fn=None):
+    """The full observatory leg (``--observatory``): fault naming,
+    Perfetto lifecycle, tracing overhead.  ``flush_fn`` (if given) is
+    called with the partial dict after every phase so a timeout never
+    loses a landed phase."""
+    out = {}
+    out["detection"] = run_observatory_detection(
+        min(n_requests, 24)
+    )
+    if flush_fn:
+        flush_fn(out)
+    out["lifecycle"] = run_observatory_lifecycle(
+        cfg,
+        params,
+        os.path.join(out_dir, "serving_obs_events.jsonl"),
+        os.path.join(out_dir, "serving_obs_trace.json"),
+    )
+    if flush_fn:
+        flush_fn(out)
+    # the overhead workload is larger than the detection one: each
+    # timed pass must be long enough that the ~% we are measuring
+    # clears scheduler/GC jitter
+    out["overhead"] = run_observatory_overhead(
+        cfg, params, make_workload(max(n_requests, 64), seed=19)
+    )
+    if flush_fn:
+        flush_fn(out)
+    return out
+
+
 def flush(out_file: str, payload):
     if not out_file:
         return
@@ -606,8 +905,13 @@ def main(argv=None) -> int:
         "--prefix", action="store_true",
         help="run ONLY the shared-prefix caching leg",
     )
+    parser.add_argument(
+        "--observatory", action="store_true",
+        help="run ONLY the serving-observatory leg (ISSUE 16): "
+        "fault naming, Perfetto lifecycle, tracing overhead",
+    )
     args = parser.parse_args(argv)
-    only = args.utilization or args.prefix
+    only = args.utilization or args.prefix or args.observatory
 
     payload = {
         "metric": "serving_continuous_vs_sequential_tokens_per_s",
@@ -645,6 +949,46 @@ def main(argv=None) -> int:
                 ]
             flush(args.out, payload)
             print(json.dumps(extras["prefix"], default=str))
+        if args.observatory:
+            out_dir = (
+                os.path.dirname(os.path.abspath(args.out))
+                if args.out
+                else os.getcwd()
+            )
+
+            def _flush_obs(partial):
+                extras["observatory"] = partial
+                flush(args.out, payload)
+
+            extras["observatory"] = run_observatory(
+                cfg, params, args.requests, out_dir,
+                flush_fn=_flush_obs,
+            )
+            obs = extras["observatory"]
+            if payload["value"] is None:
+                # headline: did the observatory name both faulted
+                # replicas in time (1.0) or not (0.0)
+                payload["value"] = float(
+                    obs["detection"]["both_named"]
+                    and obs["detection"]["within_3_intervals"]
+                )
+            flush(args.out, payload)
+            print(json.dumps(
+                {
+                    "detection": obs["detection"]["named"],
+                    "both_named": obs["detection"]["both_named"],
+                    "within_3_intervals": obs["detection"][
+                        "within_3_intervals"
+                    ],
+                    "complete_lifecycles": obs["lifecycle"][
+                        "complete_lifecycles"
+                    ],
+                    "overhead_frac": obs["overhead"][
+                        "overhead_frac"
+                    ],
+                },
+                default=str,
+            ))
         return 0
 
     # leg 1: closed-loop capacity (the headline)

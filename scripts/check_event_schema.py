@@ -146,6 +146,18 @@ DECLARED_METRICS = {
     "dlrover_tpu_serving_preemptions",
     "dlrover_tpu_serving_prefix_hit_rate",
     "dlrover_tpu_serving_accepted_tokens_per_step",
+    # per-request SLO histograms (ISSUE 16, record_serving_latency,
+    # behind DLROVER_TPU_SERVE_OBS): dispatcher-side
+    # time-to-first-token, request-level time-between-tokens p99,
+    # end-to-end latency, and scheduler queue wait — rendered as
+    # _bucket/_sum/_count families on /metrics
+    "dlrover_tpu_serving_ttft_seconds",
+    "dlrover_tpu_serving_tbt_seconds",
+    "dlrover_tpu_serving_e2e_seconds",
+    "dlrover_tpu_serving_queue_wait_seconds",
+    # per-replica health verdict gauge (ServingHealthEngine):
+    # 1 ok .. 0.1 dead_air, mirroring dlrover_tpu_node_health
+    "dlrover_tpu_serving_health",
 }
 METRIC_METHODS = {
     "set_gauge",

@@ -223,16 +223,35 @@ def render(status: dict) -> str:
             f" · p99 {serving.get('p99_latency_s', 0.0):.3f}s"
             f" · weights v{serving.get('version', 0)}"
         )
+        slo = serving.get("slo") or {}
+        if slo:
+            # the SLO histogram quantiles (ISSUE 16): what the
+            # dispatcher-side TTFT/TBT/e2e/queue-wait histograms say
+            lines.append(
+                f"slo: ttft p99 {slo.get('ttft_p99_s', 0.0):.3f}s"
+                f" · tbt p99 {slo.get('tbt_p99_s', 0.0):.4f}s"
+                f" · e2e p99 {slo.get('e2e_p99_s', 0.0):.3f}s"
+                f" · queue p99 {slo.get('queue_wait_p99_s', 0.0):.3f}s"
+            )
+        health = serving.get("health") or {}
+        why_by_idx = {
+            h.get("replica"): h
+            for h in (health.get("replicas") or [])
+        }
         reps = serving.get("replicas") or []
         if reps:
             # kvutil/preempt/hit% are the incremental-allocation
             # vitals (ISSUE 15): filled-cache share, pool-pressure
-            # preemptions, shared-prefix block hit rate
+            # preemptions, shared-prefix block hit rate; the `why`
+            # column (ISSUE 16, only when the serving observatory is
+            # on) is the health verdict that explains a sick row
             hdr = (
                 f"{'repl':>4} {'state':>8} {'inflight':>8} "
                 f"{'tok/s':>8} {'queue':>6} {'kvblk':>6} "
                 f"{'kvutil':>6} {'preempt':>7} {'hit%':>6}"
             )
+            if why_by_idx:
+                hdr += f"  {'why':<28}"
             lines.append(hdr)
             lines.append("-" * len(hdr))
             for r in reps:
@@ -240,7 +259,7 @@ def render(status: dict) -> str:
                     "ok" if r.get("alive")
                     else ("drained" if r.get("drained") else "DEAD")
                 )
-                lines.append(
+                row = (
                     f"{r.get('idx', '?'):>4} {state:>8} "
                     f"{r.get('outstanding', 0):>8} "
                     f"{r.get('tokens_per_s', 0.0):>8.1f} "
@@ -250,6 +269,10 @@ def render(status: dict) -> str:
                     f"{r.get('preemptions', 0):>7} "
                     f"{100.0 * r.get('prefix_hit_rate', 0.0):>5.1f}%"
                 )
+                if why_by_idx:
+                    h = why_by_idx.get(r.get("idx")) or {}
+                    row += f"  {h.get('why', ''):<28}"
+                lines.append(row)
     conclusions = status.get("conclusions") or []
     if conclusions:
         lines.append("")

@@ -48,6 +48,18 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "timeout(seconds): advisory per-test time budget"
     )
+    config.addinivalue_line(
+        "markers",
+        "heavy: multi-process / subprocess e2e test, scheduled after the "
+        "unit tests so fast feedback comes first",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    # Stable partition: everything keeps its collection order, but tests
+    # marked `heavy` (engine sessions, bench subprocesses) run after the
+    # unit tests, so an interrupted run still covers the cheap majority.
+    items.sort(key=lambda item: 1 if item.get_closest_marker("heavy") else 0)
 
 
 @pytest.fixture(autouse=True)

@@ -431,3 +431,101 @@ def test_lint_declares_autoscale_metrics():
         assert "dlrover_tpu_autoscale_decsions" in proc.stdout
     finally:
         os.unlink(probe)
+
+
+def test_lint_enforces_serve_request_lifecycle_labels(tmp_path):
+    """ISSUE-16 spans: a ``serve_request`` must answer "was THIS
+    request slow, and why" on its own — identity, placement, size,
+    SLO numbers and the efficiency story are all REQUIRED; the
+    children must at least carry the req_id that stitches the
+    lifecycle together."""
+    bad = tmp_path / "bad_serve_request.py"
+    bad.write_text(
+        "events = None\n"
+        "def f(events):\n"
+        "    events.complete('serve_request', 0.0, 1.0, req_id=4,\n"
+        "                    replica='r0', prompt_tokens=7,\n"
+        "                    gen_tokens=24, ttft_s=0.05,\n"
+        "                    tbt_p99_s=0.004)\n"
+        "    events.complete('serve_request', 0.0, 1.0, req_id=4,\n"
+        "                    replica='r0', prompt_tokens=7,\n"
+        "                    gen_tokens=24, ttft_s=0.05,\n"
+        "                    tbt_p99_s=0.004, preempts=1,\n"
+        "                    prefix_hit_blocks=2)\n"
+        "    events.complete('queue_wait', 0.0, 1.0)\n"
+        "    events.complete('queue_wait', 0.0, 1.0, req_id=4)\n"
+        "    events.complete('admit', 0.0, 1.0, req_id=4)\n"
+        "    events.complete('resume', 0.0, 1.0, req_id=4)\n"
+        "    events.complete('resume', 0.0, 1.0, req_id=4,\n"
+        "                    resume_tokens=9)\n"
+    )
+    proc = _run(str(bad))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "event_schema_violations=3" in proc.stdout, proc.stdout
+    assert (
+        "missing required label(s) ['preempts', "
+        "'prefix_hit_blocks']" in proc.stdout
+    )
+    assert "missing required label(s) ['req_id']" in proc.stdout
+    assert (
+        "missing required label(s) ['resume_tokens']" in proc.stdout
+    )
+
+
+def test_lint_enforces_serving_health_instant_labels(tmp_path):
+    """The observatory's verdict markers must name the replica and
+    the reason — an anonymous ``serving_health`` / ``slo_breach``
+    instant is exactly the "a replica is slow" blip the engine
+    exists to replace."""
+    bad = tmp_path / "bad_serving_health.py"
+    bad.write_text(
+        "events = None\n"
+        "def f(events):\n"
+        "    events.instant('serving_health', replica=2)\n"
+        "    events.instant('serving_health', replica=2,\n"
+        "                   verdict='dead_air', reason='dead_air')\n"
+        "    events.instant('slo_breach', replica=2,\n"
+        "                   reason='slo_straggler', value=4.2)\n"
+        "    events.instant('slo_breach', replica=2,\n"
+        "                   reason='slo_straggler', value=4.2,\n"
+        "                   threshold=2.0)\n"
+    )
+    proc = _run(str(bad))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "event_schema_violations=2" in proc.stdout, proc.stdout
+    assert (
+        "missing required label(s) ['verdict', 'reason']"
+        in proc.stdout
+    )
+    assert "missing required label(s) ['threshold']" in proc.stdout
+
+
+def test_lint_declares_slo_histograms():
+    """The four SLO histogram families and the serving-health verdict
+    gauge are declared vocabulary; an in-package near-miss typo
+    (``_secs``) is not."""
+    probe = os.path.join(
+        REPO, "dlrover_tpu", "_lint_probe_slo_delete_me.py"
+    )
+    with open(probe, "w") as f:
+        f.write(
+            "def f(reg):\n"
+            "    reg.observe_histogram("
+            "'dlrover_tpu_serving_ttft_seconds', 0.1)\n"
+            "    reg.observe_histogram("
+            "'dlrover_tpu_serving_tbt_seconds', 0.01)\n"
+            "    reg.observe_histogram("
+            "'dlrover_tpu_serving_e2e_seconds', 1.0)\n"
+            "    reg.observe_histogram("
+            "'dlrover_tpu_serving_queue_wait_seconds', 0.01)\n"
+            "    reg.set_gauge('dlrover_tpu_serving_health', 1.0)\n"
+            "    reg.observe_histogram("
+            "'dlrover_tpu_serving_ttft_secs', 0.1)\n"
+        )
+    try:
+        proc = _run(probe)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "event_schema_violations=1" in proc.stdout, proc.stdout
+        assert "dlrover_tpu_serving_ttft_secs" in proc.stdout
+    finally:
+        os.unlink(probe)
