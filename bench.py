@@ -760,6 +760,55 @@ def _run_serving_observatory(budget: "BenchBudget" = None) -> dict:
         return {"error": str(e)}
 
 
+def _run_serving_fleet(budget: "BenchBudget" = None) -> dict:
+    """Run the fleet leg (``bench_serving.py --fleet``) in a
+    subprocess: open-loop traffic with ``DLROVER_TPU_SERVE_FLEET``
+    on vs off — the affinity hit-rate delta, the SLO-class lane
+    improvement (interactive p99 down, batch throughput held) and
+    the disaggregation decode-flatness delta."""
+    if os.getenv("DLROVER_BENCH_SKIP_SERVING"):
+        return {"skipped": True}
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "scripts", "bench_serving.py",
+    )
+    out_file = os.path.join(
+        tempfile.mkdtemp(prefix="dlrover_bench_serving_fleet_"),
+        "out.json",
+    )
+    timeout_s = 600
+    env = dict(os.environ)
+    if budget is not None:
+        timeout_s = budget.cap_timeout(600, reserve_s=120)
+        # the leg scales its per-phase traffic duration from the
+        # budget env; hand it the time actually left for this leg
+        env[BUDGET_ENV] = str(int(max(30, timeout_s - 60)))
+    cmd = [sys.executable, script, "--fleet", "--out", out_file]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout_s,
+            env=env,
+        )
+        parsed = _read_result_file(out_file, proc.stdout)
+        if parsed is not None:
+            fleet = (parsed.get("extras") or {}).get("fleet")
+            if fleet is not None and "disagg" in fleet:
+                return fleet
+            return {
+                "error": f"incomplete run (rc={proc.returncode})",
+                "partial": fleet,
+                "stderr_tail": proc.stderr[-500:],
+            }
+        return {
+            "error": f"no JSON output (rc={proc.returncode})",
+            "stderr_tail": proc.stderr[-500:],
+        }
+    except subprocess.TimeoutExpired as e:
+        return {"error": str(e), "partial": _partial_extras(out_file)}
+    except Exception as e:  # noqa: BLE001
+        return {"error": str(e)}
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -898,6 +947,16 @@ def main(argv=None) -> int:
             extras["serving_observatory"] = _run_serving_observatory(
                 budget
             )
+        flush_partial(args.out, payload)
+
+        # fleet-level serving: prefix-affinity routing, SLO-class
+        # lanes and disaggregated prefill/decode, each measured as
+        # an on-vs-off delta on the same open-loop traffic
+        # (bench_serving.py --fleet owns the scenario)
+        if budget.tight(240):
+            extras["serving_fleet"] = {"skipped": "budget"}
+        else:
+            extras["serving_fleet"] = _run_serving_fleet(budget)
         flush_partial(args.out, payload)
 
         # continuous attribution leg's overhead: steady step time

@@ -283,6 +283,67 @@ def serve_obs_enabled() -> bool:
     )
 
 
+SERVE_FLEET_ENV = "DLROVER_TPU_SERVE_FLEET"
+FLEET_IMBALANCE_ENV = "DLROVER_TPU_FLEET_IMBALANCE_CAP"
+FLEET_INTERACTIVE_SLOTS_ENV = "DLROVER_TPU_FLEET_INTERACTIVE_SLOTS"
+FLEET_PREFILL_WORKERS_ENV = "DLROVER_TPU_FLEET_PREFILL_WORKERS"
+FLEET_SHIP_SLOTS_ENV = "DLROVER_TPU_FLEET_SHIP_SLOTS"
+FLEET_MIN_SHIP_PROMPT_ENV = "DLROVER_TPU_FLEET_MIN_SHIP_PROMPT"
+
+
+def serve_fleet_enabled() -> bool:
+    """Kill-switch for the fleet-level serving layer (ISSUE 17):
+    prefix-affinity routing in the dispatcher (per-replica shared-block
+    key index piggybacked on the STATS ring), SLO-class lanes with
+    per-tenant fair-share admission + class-aware preemption in the
+    scheduler, and the disaggregated prefill/decode split with shm KV
+    block shipping.  ``DLROVER_TPU_SERVE_FLEET=0`` reproduces the
+    PR-16 surfaces exactly: least-outstanding routing, single-class
+    FIFO admission, no ship spans, no fleet gauges (pinned by tests).
+    Default: enabled."""
+    return os.getenv(SERVE_FLEET_ENV, "1").lower() not in (
+        "0", "false", "off",
+    )
+
+
+def fleet_imbalance_cap() -> int:
+    """Affinity routing's load-imbalance cap: an affinity-preferred
+    replica is eligible only while its outstanding count stays within
+    this many requests of the least-loaded live replica — affinity may
+    bias placement but never starve a replica (>= 1)."""
+    return max(1, int(env_float(FLEET_IMBALANCE_ENV, 4)))
+
+
+def fleet_interactive_slots() -> int:
+    """Reserved decode-slot quota for the interactive SLO class: batch
+    admission leaves at least this many of ``max_slots`` free for
+    interactive lanes (clamped to ``max_slots - 1`` at use so batch
+    can always make progress; 0 = no reservation)."""
+    return max(0, int(env_float(FLEET_INTERACTIVE_SLOTS_ENV, 2)))
+
+
+def fleet_prefill_workers() -> int:
+    """How many replicas the dispatcher designates as PREFILL workers
+    (disaggregated prefill/decode).  They fill KV blocks and ship them
+    over shm to decode replicas; 0 (the default) keeps every replica
+    unified.  Clamped so at least one decode replica remains."""
+    return max(0, int(env_float(FLEET_PREFILL_WORKERS_ENV, 0)))
+
+
+def fleet_ship_slots() -> int:
+    """Slots in the dispatcher-owned shm ship arena (concurrent
+    in-flight prefill->decode block transfers; >= 1)."""
+    return max(1, int(env_float(FLEET_SHIP_SLOTS_ENV, 8)))
+
+
+def fleet_min_ship_prompt() -> int:
+    """Minimum prompt length (tokens) for a request to take the
+    disaggregated prefill->ship->decode path; shorter prompts go
+    straight to a decode replica (prefilling them locally costs less
+    than a block ship).  0 = ship everything."""
+    return max(0, int(env_float(FLEET_MIN_SHIP_PROMPT_ENV, 0)))
+
+
 KV_INCREMENTAL_ENV = "DLROVER_TPU_KV_INCREMENTAL"
 KV_GROW_BLOCKS_ENV = "DLROVER_TPU_KV_GROW_BLOCKS"
 KV_ADMIT_WATERMARK_ENV = "DLROVER_TPU_KV_ADMIT_WATERMARK"

@@ -143,6 +143,13 @@ PHASE_QUEUE_WAIT = "queue_wait"
 PHASE_ADMIT = "admit"
 PHASE_RESUME = "resume"
 PHASE_SERVE_REQUEST = "serve_request"
+# disaggregated prefill/decode (ISSUE 17): one ``kv_ship`` span per
+# prefill-worker handoff — the staged block regions' copy into the
+# ship arena, sized and timed like the checkpoint data-plane spans
+# (the shm transfer IS the disaggregation tax; a throughput
+# regression here shows up as decode-side TTFT, so it must be
+# attributable from the timeline alone).
+PHASE_KV_SHIP = "kv_ship"
 # client-side control-plane wait (a long-poll RPC parked on the
 # master, or the legacy polling loop it replaces).  LOWEST priority:
 # these waits are almost always nested inside rendezvous/restart
@@ -177,6 +184,7 @@ PHASES: Tuple[str, ...] = (
     PHASE_ADMIT,
     PHASE_RESUME,
     PHASE_SERVE_REQUEST,
+    PHASE_KV_SHIP,
     PHASE_CONTROL_WAIT,
 )
 
@@ -332,7 +340,12 @@ REQUIRED_SPAN_LABELS: Dict[str, Tuple[str, ...]] = {
     # its size, and the SLO numbers (TTFT, per-token-gap p99) plus the
     # efficiency story (preemptions suffered, prompt blocks served
     # from the prefix cache) — the serve_request span alone must
-    # answer "was THIS request slow, and why"
+    # answer "was THIS request slow, and why".  The fleet layer
+    # (ISSUE 17) adds the routing story: HOW the dispatcher picked
+    # the replica (least_outstanding / affinity / ship — "local" for
+    # in-process schedulers) and WHICH SLO lane the request rode —
+    # without them an affinity miss and a lane-starved batch request
+    # are indistinguishable blips
     PHASE_SERVE_REQUEST: (
         "req_id",
         "replica",
@@ -342,7 +355,13 @@ REQUIRED_SPAN_LABELS: Dict[str, Tuple[str, ...]] = {
         "tbt_p99_s",
         "preempts",
         "prefix_hit_blocks",
+        "route",
+        "slo_class",
     ),
+    # the disaggregation handoff, sized and timed like the
+    # checkpoint/offload data-plane spans: staged blocks, moved
+    # bytes, achieved shm throughput
+    PHASE_KV_SHIP: ("blocks", "bytes", "throughput_gbps"),
     PHASE_QUEUE_WAIT: ("req_id",),
     PHASE_ADMIT: ("req_id",),
     # a resume without the restored tail size can't distinguish a

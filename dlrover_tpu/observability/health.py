@@ -935,6 +935,7 @@ class _ServingReplicaState:
         "why",
         "slo_score",
         "streaks",
+        "role",
     )
 
     def __init__(self, idx: int, now: float):
@@ -956,6 +957,11 @@ class _ServingReplicaState:
         self.why = "ok"
         self.slo_score = 0.0
         self.streaks: Dict[str, int] = {}
+        # fleet role (ISSUE 17): a designated prefill worker is
+        # judged against PREFILL-fleet medians — it completes no
+        # requests itself (no TTFT/TBT series) and must never read
+        # as a decode straggler
+        self.role = "decode"
 
 
 class ServingHealthEngine:
@@ -1079,6 +1085,16 @@ class ServingHealthEngine:
             st.e2e.append(float(e2e_s))
             st.last_progress_t = time.monotonic()
 
+    def note_ship(self, idx: int):
+        """One shipped-KV manifest from prefill worker ``idx``
+        (dispatcher's SHIP path) — a ship IS the prefill worker's
+        completion, so it refreshes the progress clock the same way a
+        RESULT refreshes a decode replica's (without it a busy
+        prefill worker would read as dead air: it never answers
+        RESULT)."""
+        with self._lock:
+            self._state(idx).last_progress_t = time.monotonic()
+
     def note_stats(self, idx: int, stats: Dict):
         """One replica STATS window.  Tokens flowing refresh the
         progress clock; a zero-throughput window with work outstanding
@@ -1157,19 +1173,32 @@ class ServingHealthEngine:
                 st.alive = bool(row.get("alive", True))
                 st.drained = bool(row.get("drained", False))
                 st.outstanding = int(row.get("outstanding", 0))
+                st.role = str(row.get("role", "decode")) or "decode"
                 if st.alive and not st.drained:
                     live.append(st)
-            ttft_p99s = [
-                _tail_q(st.ttft, 0.99) for st in live
-                if len(st.ttft) >= MIN_SLO_SAMPLES
-            ]
-            tbt_p99s = [
-                _tail_q(st.tbt, 0.99) for st in live
-                if len(st.tbt) >= MIN_SLO_SAMPLES
-            ]
-            med_ttft = _tail_q(ttft_p99s, 0.5)
-            med_tbt = _tail_q(tbt_p99s, 0.5)
-            peers = len(ttft_p99s)
+            # straggler medians are ROLE-SPLIT (ISSUE 17): a prefill
+            # worker's peers are the other prefill workers — judging
+            # it against decode medians would convict it on series it
+            # cannot have (it never completes a request itself)
+            role_meds: Dict[str, Tuple[float, float, int]] = {}
+            for role in {st.role for st in live}:
+                pool = [st for st in live if st.role == role]
+                ttft_p99s = [
+                    _tail_q(st.ttft, 0.99) for st in pool
+                    if len(st.ttft) >= MIN_SLO_SAMPLES
+                ]
+                tbt_p99s = [
+                    _tail_q(st.tbt, 0.99) for st in pool
+                    if len(st.tbt) >= MIN_SLO_SAMPLES
+                ]
+                role_meds[role] = (
+                    _tail_q(ttft_p99s, 0.5),
+                    _tail_q(tbt_p99s, 0.5),
+                    len(ttft_p99s),
+                )
+            med_ttft, med_tbt, peers = role_meds.get(
+                "decode", (0.0, 0.0, 0)
+            )
             hit_rates = [st.prefix_hit_rate for st in live]
             self._fleet = {
                 "ttft_p99_median_s": round(med_ttft, 4),
@@ -1194,12 +1223,16 @@ class ServingHealthEngine:
                                     "replica": st.idx,
                                     "verdict": st.verdict,
                                     "reason": st.verdict,
+                                    "role": st.role,
                                 },
                             )
                         )
                     continue
+                r_ttft, r_tbt, r_peers = role_meds.get(
+                    st.role, (0.0, 0.0, 0)
+                )
                 breaches = self._breaches(
-                    st, now, med_ttft, med_tbt, peers
+                    st, now, r_ttft, r_tbt, r_peers
                 )
                 st.preempt_delta = 0
                 current = {r for r, _v, _t in breaches}
@@ -1224,6 +1257,7 @@ class ServingHealthEngine:
                         "value": round(float(value), 4),
                         "threshold": round(float(threshold), 4),
                         "streak": streak,
+                        "role": st.role,
                         "t": time.time(),
                     }
                     fired.append(verdict)
@@ -1252,11 +1286,13 @@ class ServingHealthEngine:
                                     if st.verdict != "ok"
                                     else "recovered"
                                 ),
+                                "role": st.role,
                             },
                         )
                     )
             gauge_rows = [
-                (st.idx, self._VERDICT_GAUGE.get(st.verdict, 0.0))
+                (st.idx, st.role,
+                 self._VERDICT_GAUGE.get(st.verdict, 0.0))
                 for st in self._replicas.values()
                 if st.alive and not st.drained
             ]
@@ -1280,11 +1316,13 @@ class ServingHealthEngine:
             from dlrover_tpu.observability.metrics import get_registry
 
             reg = get_registry()
-            for idx, value in gauge_rows:
+            for idx, role, value in gauge_rows:
+                # the role label rides along; per-replica retirement
+                # still matches (retire_series is a subset match)
                 reg.set_gauge(
                     "dlrover_tpu_serving_health",
                     value,
-                    labels={"replica": str(idx)},
+                    labels={"replica": str(idx), "role": role},
                 )
         except Exception as e:  # noqa: BLE001 - telemetry only
             logger.warning("serving health gauge export failed: %s", e)
@@ -1313,6 +1351,7 @@ class ServingHealthEngine:
                         "replica": st.idx,
                         "verdict": st.verdict,
                         "why": st.why,
+                        "role": st.role,
                         "slo_score": st.slo_score,
                         "ttft_p99_s": round(
                             _tail_q(st.ttft, 0.99), 4

@@ -52,8 +52,13 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from dlrover_tpu.common.env import (
+    fleet_imbalance_cap,
+    fleet_min_ship_prompt,
+    fleet_prefill_workers,
+    fleet_ship_slots,
     gen_close_timeout_s,
     gen_timeout_s,
+    serve_fleet_enabled,
     serve_obs_enabled,
     serving_enabled,
 )
@@ -70,18 +75,52 @@ _KIND_STATS = 3
 # the dispatcher must fail it to the caller immediately — silence
 # here would block result() for the whole request timeout
 _KIND_REJECT = 4
+# a prefill worker finished filling a request's KV blocks and staged
+# them in the ship arena: meta carries the slot + block count and
+# tokens[0] the first sampled token — the dispatcher relays the
+# manifest to a decode replica (disaggregated fleet only; never
+# emitted with DLROVER_TPU_SERVE_FLEET=0)
+_KIND_SHIP = 5
 _FINISH_CODES = {"length": 0, "eos": 1}
 _FINISH_NAMES = {v: k for k, v in _FINISH_CODES.items()}
 
 #: Explicit schema version of BOTH shm-ring payloads.  PR 14 silently
 #: widened the response ``times`` vector 4→8 floats — a mixed-width
 #: reader would have misparsed stats as garbage numbers instead of
-#: failing.  v2 (this layout): request meta carries
-#: [req_id, prompt_len, max_new, seed, schema_version, submit_wall_ns]
-#: and response meta carries
+#: failing.  v3 (this layout): request meta carries
+#: [req_id, prompt_len, max_new, seed, schema_version, submit_wall_ns,
+#: slo_class, tenant_hash, ship_mode, ship_slot, first_token,
+#: n_blocks, route] and response meta carries
 #: [req_id, kind, total_len, new_tokens, finish_code, weights_version,
-#: schema_version].  Bump on ANY layout change.
-RING_SCHEMA_VERSION = 2
+#: schema_version, ship_slot, n_blocks].  ship_mode: 0 = serve
+#: locally, 1 = prefill-and-ship (the replica fills the KV blocks,
+#: stages them in the ship arena slot and answers _KIND_SHIP),
+#: 2 = adopt-and-decode (the replica splices the staged blocks into
+#: its own pool and runs a pure token loop).  Bump on ANY layout
+#: change.
+RING_SCHEMA_VERSION = 3
+
+#: request ``route`` codes — how the dispatcher picked the replica;
+#: the scheduler stamps the name on the request's serve_request span
+_ROUTE_NAMES = {0: "least_outstanding", 1: "affinity", 2: "ship"}
+
+
+def _key_digest(hex_key: str) -> int:
+    """31-bit digest of one ``prefix_block_keys`` chain key — small
+    enough to piggyback dozens of them in a STATS message's otherwise
+    unused int32 ``tokens`` field (the per-replica shared-block index
+    the affinity router matches against)."""
+    return int(hex_key[:8], 16) & 0x7FFFFFFF
+
+
+def _tenant_hash(tenant: str) -> int:
+    """Stable cross-process tenant key (``hash()`` is salted per
+    interpreter — the fair-share lanes only need distinctness)."""
+    if not tenant:
+        return 0
+    import zlib
+
+    return zlib.crc32(tenant.encode("utf-8", "replace")) or 1
 
 
 class RingSchemaMismatch(RuntimeError):
@@ -420,8 +459,12 @@ def _req_spec(max_prompt: int):
         {
             # req_id, prompt_len, max_new, seed, schema_version,
             # submit_wall_ns (the dispatcher's wall clock at submit —
-            # the request-trace anchor; same-host processes share it)
-            "meta": ((6,), "<i8"),
+            # the request-trace anchor; same-host processes share it),
+            # slo_class (0 batch / 1 interactive), tenant_hash,
+            # ship_mode (0 local / 1 prefill-and-ship / 2 adopt),
+            # ship_slot (arena slot, -1 none), first_token (adopt
+            # only), n_blocks (adopt only), route (_ROUTE_NAMES code)
+            "meta": ((13,), "<i8"),
             "prompt": ((max_prompt,), "<i4"),
         }
     )
@@ -433,15 +476,21 @@ def _resp_spec(max_total: int):
     return BatchSpec(
         {
             # req_id, kind, total_len, new_tokens, finish_code,
-            # weights_version, schema_version
-            "meta": ((7,), "<i8"),
+            # weights_version, schema_version, ship_slot, n_blocks
+            "meta": ((9,), "<i8"),
+            # STATS additionally piggybacks the replica's shared-block
+            # key index here: tokens[0] = K, tokens[1..K] = 31-bit
+            # chain-key digests (the affinity router's per-replica
+            # view; SHIP carries first_token in tokens[0])
             "tokens": ((max_total,), "<i4"),
             # RESULT: latency_s, ttft_s, worker_gen_s, tokens_per_s,
-            #         tbt_p99_s, queue_wait_s (trailing 2 spare)
+            #         tbt_p99_s, queue_wait_s (trailing spare)
+            # READY:  block_region_nbytes (the ship-arena slot sizer)
             # STATS:  tokens_per_s, queue_depth, kv_blocks_used,
             #         kv_utilization, preemptions, prefix_hit_rate,
-            #         accepted_tokens_per_step, ttft_p99_s
-            "times": ((8,), "<f8"),
+            #         accepted_tokens_per_step, ttft_p99_s,
+            #         prefix_hits_total, prefix_lookups_total
+            "times": ((10,), "<f8"),
         }
     )
 
@@ -520,8 +569,10 @@ def _serving_worker_loop(spec) -> int:
         SharedMemoryHandler,
         restore_to_target,
     )
+    from dlrover_tpu.common.env import serve_fleet_enabled
     from dlrover_tpu.observability.events import get_event_logger
     from dlrover_tpu.observability.metrics import record_serving
+    from dlrover_tpu.rl.kv_cache import region_nbytes_per_block
     from dlrover_tpu.rl.scheduler import (
         ContinuousBatchingScheduler,
         SchedulerConfig,
@@ -530,6 +581,18 @@ def _serving_worker_loop(spec) -> int:
     name = spec["name"]
     replica = int(spec["replica"])
     tag = f"{name}-r{replica}"
+    fleet = serve_fleet_enabled()
+    role = str(spec.get("role", "unified")) if fleet else "unified"
+    if role == "prefill":
+        # prefill workers are throughput devices — on a host shared
+        # with decode replicas they must never steal CPU from a
+        # token-latency loop, so they deprioritize themselves (the
+        # decode replica preempts a mid-chunk prefill the moment it
+        # has a token to produce)
+        try:
+            os.nice(10)
+        except OSError:
+            pass
     drain = {"flag": False, "reason": ""}
 
     def _on_signal(signum, _frame):
@@ -565,7 +628,9 @@ def _serving_worker_loop(spec) -> int:
         paged_verify_fn=parts.get("paged_verify_fn"),
         events=get_event_logger(),
         replica=tag,
+        role=("prefill" if role == "prefill" else "unified"),
     )
+    events = get_event_logger()
     serve_obs = serve_obs_enabled()
     ttft_hist = None
     if serve_obs:
@@ -590,6 +655,50 @@ def _serving_worker_loop(spec) -> int:
     max_total = int(s["max_seq_len"])
     version = -1
 
+    # --- disaggregated prefill/decode plumbing (fleet layer) -------
+    # the ship arena is a dispatcher-owned shm segment of fixed-size
+    # slots; both sides derive the SAME slot geometry from the sched
+    # spec + this pool's per-block region size, so a staged [L,
+    # n_blocks, block_size, KV, head_dim] pair round-trips bitwise
+    block_bytes = region_nbytes_per_block(scheduler._pool)
+    import math as _math
+
+    ship_slot_bytes = 2 * block_bytes * _math.ceil(
+        int(s["max_seq_len"]) / int(s["block_size"])
+    )
+    ship_arena = None
+    pending_ship: Dict[int, int] = {}  # req_id -> arena slot
+
+    def _ship_buf():
+        nonlocal ship_arena
+        if ship_arena is None:
+            from multiprocessing import shared_memory
+
+            ship_arena = shared_memory.SharedMemory(
+                name=spec["ship_arena"]
+            )
+        return ship_arena.buf
+
+    def _read_shipped(slot: int, n_blocks: int):
+        """Splice source: reconstruct the staged k/v regions from the
+        arena slot (k in the first half, v in the second) using this
+        pool's own dtype/geometry."""
+        pool_k = scheduler._pool["k"]
+        lyr, _, bsz, kvh, hdim = pool_k.shape
+        dt = np.dtype(pool_k.dtype)
+        cnt = lyr * n_blocks * bsz * kvh * hdim
+        buf = _ship_buf()
+        base = slot * ship_slot_bytes
+        shape = (lyr, n_blocks, bsz, kvh, hdim)
+        k_r = np.frombuffer(
+            buf, dtype=dt, count=cnt, offset=base
+        ).reshape(shape).copy()
+        v_r = np.frombuffer(
+            buf, dtype=dt, count=cnt,
+            offset=base + ship_slot_bytes // 2,
+        ).reshape(shape).copy()
+        return k_r, v_r
+
     def _adopt_weights():
         nonlocal version, template
         try:
@@ -611,24 +720,25 @@ def _serving_worker_loop(spec) -> int:
 
     def _respond(kind: int, req_id: int = -1, tokens=None,
                  new_tokens: int = 0, finish: str = "length",
-                 times=()):
-        """Publish one message; a RESULT must never be silently
-        dropped (the dispatcher would block its caller for the full
-        request timeout on a request whose compute finished), so a
-        full ring WAITS for the dispatcher to drain — giving up only
-        when the dispatcher process itself is gone (we are orphaned
-        and about to exit anyway).  STATS are best-effort."""
+                 times=(), ship_slot: int = -1, n_blocks: int = 0):
+        """Publish one message; a RESULT (or SHIP — the request's
+        only path to a decode replica) must never be silently dropped
+        (the dispatcher would block its caller for the full request
+        timeout on a request whose compute finished), so a full ring
+        WAITS for the dispatcher to drain — giving up only when the
+        dispatcher process itself is gone (we are orphaned and about
+        to exit anyway).  STATS are best-effort."""
         total = 0 if tokens is None else int(tokens.size)
         buf = np.zeros((max_total,), np.int32)
         if tokens is not None:
             buf[:total] = tokens
-        padded = np.zeros((8,), np.float64)
+        padded = np.zeros((10,), np.float64)
         padded[: len(times)] = times
         msg = {
             "meta": np.asarray(
                 [req_id, kind, total, new_tokens,
                  _FINISH_CODES.get(finish, 0), version,
-                 RING_SCHEMA_VERSION],
+                 RING_SCHEMA_VERSION, ship_slot, n_blocks],
                 np.int64,
             ),
             "tokens": buf,
@@ -671,7 +781,9 @@ def _serving_worker_loop(spec) -> int:
             ),
         )
 
-    _respond(_KIND_READY)
+    # READY carries the per-block region size so the dispatcher can
+    # size the ship arena without instantiating the model itself
+    _respond(_KIND_READY, times=(float(block_bytes),))
     logger.info("serving replica %s ready (pid %d)", tag, os.getpid())
     served = 0
     window_tokens = 0
@@ -693,21 +805,39 @@ def _serving_worker_loop(spec) -> int:
             msg = req_ring.try_get()
             if msg is None:
                 break
-            req_id, plen, max_new, seed, ring_ver, wall_ns = (
-                int(v) for v in msg["meta"]
-            )
+            (req_id, plen, max_new, seed, ring_ver, wall_ns,
+             slo_i, tenant_h, ship_mode, ship_slot, first_tok,
+             n_ship, route_code) = (int(v) for v in msg["meta"])
             if ring_ver != RING_SCHEMA_VERSION:
                 raise RingSchemaMismatch(ring_ver, "dispatch request")
             try:
-                scheduler.submit(
-                    msg["prompt"][:plen],
+                kwargs = dict(
                     max_new=max_new,
                     seed=seed,
                     req_id=req_id,
                     submit_wall=(
                         wall_ns / 1e9 if wall_ns > 0 else None
                     ),
+                    slo_class=(
+                        "interactive" if slo_i == 1 else "batch"
+                    ),
+                    tenant=(str(tenant_h) if tenant_h else ""),
+                    route=_ROUTE_NAMES.get(route_code,
+                                           "least_outstanding"),
                 )
+                if ship_mode == 1:
+                    # prefill-and-ship: remember which arena slot the
+                    # dispatcher reserved; the blocks stage there when
+                    # the prefill completes
+                    pending_ship[req_id] = ship_slot
+                elif ship_mode == 2:
+                    k_r, v_r = _read_shipped(ship_slot, n_ship)
+                    kwargs["shipped"] = {
+                        "k": k_r,
+                        "v": v_r,
+                        "first_token": first_tok,
+                    }
+                scheduler.submit(msg["prompt"][:plen], **kwargs)
             except ValueError as e:
                 # belt-and-suspenders (the dispatcher validates at
                 # its own submit): a malformed ring message must not
@@ -718,6 +848,7 @@ def _serving_worker_loop(spec) -> int:
                     "replica %s rejected request %d: %s",
                     tag, req_id, e,
                 )
+                pending_ship.pop(req_id, None)
                 _respond(_KIND_REJECT, req_id=req_id)
         if scheduler.idle:
             time.sleep(0.002)
@@ -726,6 +857,52 @@ def _serving_worker_loop(spec) -> int:
             served += 1
             window_tokens += res.new_tokens
             _flush_result(res)
+        if scheduler.shipped:
+            # prefill worker: stage each completed prefill's KV
+            # blocks in its reserved arena slot and hand the manifest
+            # to the dispatcher; the decode replica splices them in
+            for rec in scheduler.shipped:
+                slot = pending_ship.pop(rec["req_id"], -1)
+                if slot < 0:
+                    continue  # locally-submitted on a prefill role
+                t0 = time.perf_counter()
+                k_b = rec["k"].tobytes()
+                v_b = rec["v"].tobytes()
+                buf = _ship_buf()
+                base = slot * ship_slot_bytes
+                buf[base:base + len(k_b)] = k_b
+                half = base + ship_slot_bytes // 2
+                buf[half:half + len(v_b)] = v_b
+                ship_s = max(time.perf_counter() - t0, 1e-9)
+                nbytes = len(k_b) + len(v_b)
+                events.complete(
+                    "kv_ship",
+                    time.time() - ship_s,
+                    ship_s,
+                    blocks=int(rec["n_blocks"]),
+                    bytes=nbytes,
+                    throughput_gbps=round(nbytes / ship_s / 1e9, 3),
+                )
+                from dlrover_tpu.observability.metrics import (
+                    get_registry,
+                )
+
+                get_registry().inc_counter(
+                    "dlrover_tpu_serving_kv_shipped_blocks_total",
+                    int(rec["n_blocks"]),
+                    labels={"replica": tag},
+                )
+                window_tokens += rec["prompt_len"]
+                _respond(
+                    _KIND_SHIP,
+                    req_id=rec["req_id"],
+                    tokens=np.asarray(
+                        [rec["first_token"]], np.int32
+                    ),
+                    ship_slot=slot,
+                    n_blocks=int(rec["n_blocks"]),
+                )
+            scheduler.shipped.clear()
         now = time.monotonic()
         if now - window_t0 >= 1.0:
             tps = window_tokens / (now - window_t0)
@@ -741,9 +918,24 @@ def _serving_worker_loop(spec) -> int:
                 accepted_tokens_per_step=st["accepted_per_step"],
             )
             # the dispatcher-side serving pane reads the same numbers
-            # off the response ring (best-effort)
+            # off the response ring (best-effort); with the fleet
+            # layer on, the replica's shared-block key index and its
+            # cumulative prefix counters ride along — the affinity
+            # router's whole view, no extra RPC
+            stats_tokens = None
+            if fleet:
+                digs = [
+                    _key_digest(k)
+                    for k in list(
+                        scheduler.block_pool._shared_by_key
+                    )[-(max_total - 1):]
+                ]
+                stats_tokens = np.asarray(
+                    [len(digs)] + digs, np.int32
+                )
             _respond(
                 _KIND_STATS,
+                tokens=stats_tokens,
                 times=(
                     tps,
                     float(scheduler.queue_depth),
@@ -756,6 +948,8 @@ def _serving_worker_loop(spec) -> int:
                         ttft_hist.quantile(0.99)
                         if ttft_hist is not None else 0.0
                     ),
+                    float(scheduler.block_pool.prefix_hits),
+                    float(scheduler.block_pool.prefix_queries),
                 ),
             )
             window_tokens = 0
@@ -781,6 +975,8 @@ def _serving_worker_loop(spec) -> int:
         "serving replica %s drained on %s: served %d, handed back %d",
         tag, drain["reason"], served, len(requeued),
     )
+    if ship_arena is not None:
+        ship_arena.close()
     req_ring.close()
     resp_ring.close()
     shm.close()
@@ -811,21 +1007,29 @@ class _InFlight:
     done: threading.Event = field(default_factory=threading.Event)
     result: Optional[Dict] = None
     attempts: int = 0
+    slo_class: str = "batch"
+    tenant: str = ""
+    digests: tuple = ()  # the prompt's chain-key digests (affinity)
+    ship_slot: int = -1  # arena slot reserved for this request
 
 
 class _Replica:
     def __init__(self, idx: int, proc, req_ring: _Ring,
-                 resp_ring: _Ring):
+                 resp_ring: _Ring, role: str = "decode"):
         self.idx = idx
         self.proc = proc
         self.req_ring = req_ring
         self.resp_ring = resp_ring
+        self.role = role  # "decode" serves end-to-end; "prefill" ships
         self.outstanding: Dict[int, _InFlight] = {}
         self.ready = False
         self.alive = True
         self.draining = False  # signaled; stop routing to it
         self.drained = False  # clean-handshake confirmation arrived
         self.stats: Dict = {}  # newest _KIND_STATS payload
+        self.block_bytes = 0  # per-block region size (READY payload)
+        self.prefix_keys: set = set()  # newest STATS key-index digest
+        self.last_prefix = (0.0, 0.0)  # cumulative (hits, lookups)
 
 
 class ServingEngine:
@@ -915,6 +1119,26 @@ class ServingEngine:
                 "eos_id": eos_id,
             },
         }
+        # fleet layer (ISSUE 17), pinned at construction: affinity
+        # routing + SLO lanes + optional prefill/decode split.  OFF
+        # (DLROVER_TPU_SERVE_FLEET=0) reproduces the PR-16 dispatcher
+        # exactly: least-outstanding, one class, no ship arena.
+        self._fleet = serve_fleet_enabled()
+        self._imbalance_cap = fleet_imbalance_cap()
+        n_pref = fleet_prefill_workers() if self._fleet else 0
+        # at least one decode replica must remain, whatever the env
+        self._n_prefill = max(0, min(n_pref, int(num_replicas) - 1))
+        self._min_ship_prompt = fleet_min_ship_prompt()
+        self._ship_nslots = fleet_ship_slots()
+        self._ship_arena = None
+        self._ship_slot_bytes = 0
+        self._ship_free: List[int] = []
+        self._adopt_q: deque = deque()  # staged manifests to relay
+        self._fleet_hits = 0.0  # current-window prefix hit deltas
+        self._fleet_lookups = 0.0
+        self._fleet_hit_rate = 0.0
+        if self._n_prefill:
+            self._spec["ship_arena"] = f"{self._name}-ship"
         self._next_id = 0
         self._replicas: List[_Replica] = []
         for i in range(int(num_replicas)):
@@ -966,7 +1190,11 @@ class ServingEngine:
                 num_slots=8,
                 create=True,
             )
-        spec = dict(self._spec, replica=idx)
+        role = (
+            "prefill"
+            if self._fleet and idx < self._n_prefill else "decode"
+        )
+        spec = dict(self._spec, replica=idx, role=role)
         env = dict(os.environ)
         env[WORKER_SPEC_ENV] = json.dumps(spec)
         if self._socket_dir:
@@ -979,13 +1207,41 @@ class ServingEngine:
             [sys.executable, "-m", "dlrover_tpu.rl.generation_service"],
             env=env,
         )
-        return _Replica(idx, proc, req_ring, resp_ring)
+        return _Replica(idx, proc, req_ring, resp_ring, role=role)
+
+    def _note_ready(self, rep: _Replica, msg):
+        """READY landed: record the replica's per-block region size
+        and (first READY of a disaggregated fleet) size + create the
+        ship arena every prefill worker stages into."""
+        rep.ready = True
+        try:
+            rep.block_bytes = int(float(msg["times"][0]))
+        except Exception:  # noqa: BLE001 - pre-v3 payload shape
+            rep.block_bytes = 0
+        if (
+            self._n_prefill
+            and self._ship_arena is None
+            and rep.block_bytes > 0
+        ):
+            import math
+            from multiprocessing import shared_memory
+
+            s = self._spec["sched"]
+            self._ship_slot_bytes = 2 * rep.block_bytes * math.ceil(
+                int(s["max_seq_len"]) / int(s["block_size"])
+            )
+            self._ship_arena = shared_memory.SharedMemory(
+                name=self._spec["ship_arena"],
+                create=True,
+                size=self._ship_slot_bytes * self._ship_nslots,
+            )
+            self._ship_free = list(range(self._ship_nslots))
 
     def _await_ready(self, rep: _Replica, deadline: float):
         while time.monotonic() < deadline:
             msg = rep.resp_ring.try_get()
             if msg is not None and int(msg["meta"][1]) == _KIND_READY:
-                rep.ready = True
+                self._note_ready(rep, msg)
                 return
             if rep.proc.poll() is not None:
                 raise RuntimeError(
@@ -1008,8 +1264,12 @@ class ServingEngine:
         return self.publish_s
 
     def submit(self, prompt, max_new: Optional[int] = None,
-               seed: int = 0) -> int:
-        """Queue one prompt; returns the request id."""
+               seed: int = 0, slo_class: str = "batch",
+               tenant: str = "") -> int:
+        """Queue one prompt; returns the request id.  ``slo_class``
+        ("interactive" gets the reserved decode-slot lanes and
+        preempts last) and ``tenant`` (the fair-share key within a
+        class) only act with the fleet layer on."""
         if self._closed:
             raise RuntimeError("serving engine is closed")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -1044,6 +1304,18 @@ class ServingEngine:
                 f"the replica pool of {int(s['num_blocks']) - 1} "
                 "blocks"
             )
+        digests: tuple = ()
+        if self._fleet:
+            # the prompt's chain-key digests are the affinity
+            # router's match input — computed once, at the front door
+            from dlrover_tpu.rl.kv_cache import prefix_block_keys
+
+            digests = tuple(
+                _key_digest(k)
+                for k in prefix_block_keys(
+                    prompt, int(s["block_size"])
+                )[:64]
+            )
         with self._lock:
             req_id = self._next_id
             self._next_id += 1
@@ -1054,6 +1326,12 @@ class ServingEngine:
                 seed=int(seed),
                 submit_t=time.monotonic(),
                 submit_wall=time.time(),
+                slo_class=(
+                    "interactive"
+                    if slo_class == "interactive" else "batch"
+                ),
+                tenant=str(tenant),
+                digests=digests,
             )
             self._reqs[req_id] = inflight
             self._dispatch_q.append(req_id)
@@ -1161,11 +1439,20 @@ class ServingEngine:
         return sum(1 for r in self._replicas if r.alive)
 
     # ------------------------------------------------------ dispatcher
+    def _free_ship_slot(self, req_id: int):
+        """Return a request's arena slot to the free list (completion,
+        rejection, or a death-requeue that re-dispatches it fresh)."""
+        req = self._reqs.get(req_id)
+        if req is not None and req.ship_slot >= 0:
+            self._ship_free.append(req.ship_slot)
+            req.ship_slot = -1
+
     def _complete(self, req_id: int, result: Dict):
         with self._lock:
             if req_id in self._completed:
                 return  # dedup: drain/crash races can answer twice
             self._completed.add(req_id)
+        self._free_ship_slot(req_id)
         req = self._reqs.get(req_id)
         if req is None:
             return
@@ -1189,16 +1476,44 @@ class ServingEngine:
                 self._retire_replica_series(rep)
                 continue
             if kind == _KIND_READY:
-                rep.ready = True
+                self._note_ready(rep, msg)
                 continue
             if kind == _KIND_STATS:
                 rep.stats = _parse_stats(msg["times"], meta[6])
+                if self._fleet:
+                    # the piggybacked shared-block key index + the
+                    # fleet hit-rate deltas (cumulative counters so a
+                    # dropped STATS window loses nothing)
+                    k = int(msg["tokens"][0])
+                    rep.prefix_keys = {
+                        int(x) for x in msg["tokens"][1:1 + k]
+                    }
+                    hits = float(msg["times"][8])
+                    looks = float(msg["times"][9])
+                    ph, pl = rep.last_prefix
+                    if hits >= ph and looks >= pl:
+                        self._fleet_hits += hits - ph
+                        self._fleet_lookups += looks - pl
+                    rep.last_prefix = (hits, looks)
                 if self._serve_obs:
                     rep.stats["ttft_p99_s"] = round(
                         float(msg["times"][7]), 4
                     )
                     if self._health is not None:
                         self._health.note_stats(rep.idx, rep.stats)
+                continue
+            if kind == _KIND_SHIP:
+                # a prefill worker staged this request's KV blocks:
+                # hand the manifest to a decode replica (next pump)
+                req_id = int(meta[0])
+                rep.outstanding.pop(req_id, None)
+                self._adopt_q.append(
+                    (req_id, int(meta[7]), int(meta[8]),
+                     int(msg["tokens"][0]))
+                )
+                if self._health is not None:
+                    # a ship IS the prefill worker's completion
+                    self._health.note_ship(rep.idx)
                 continue
             if kind == _KIND_REJECT:
                 req_id = int(meta[0])
@@ -1235,6 +1550,8 @@ class ServingEngine:
                     "latency_s": latency,
                     "worker_latency_s": float(msg["times"][0]),
                     "ttft_s": float(msg["times"][1]),
+                    "tbt_p99_s": float(msg["times"][4]),
+                    "queue_wait_s": float(msg["times"][5]),
                     "replica": rep.idx,
                 },
             )
@@ -1290,9 +1607,59 @@ class ServingEngine:
                 "serving replica %d exited (rc=%s): requeueing %d "
                 "in-flight request(s)", rep.idx, rc, len(requeue),
             )
+        for rid in requeue:
+            # a requeued request re-dispatches fresh; its staged
+            # blocks (if any) die with the reservation
+            self._free_ship_slot(rid)
         with self._lock:
             for rid in reversed(requeue):
                 self._dispatch_q.appendleft(rid)
+
+    def _req_msg(self, req: _InFlight, ship_mode: int = 0,
+                 ship_slot: int = -1, first_token: int = -1,
+                 n_blocks: int = 0, route: int = 0) -> Dict:
+        """One v3 request-ring payload."""
+        return {
+            "meta": np.asarray(
+                [req.req_id, req.prompt.size, req.max_new, req.seed,
+                 RING_SCHEMA_VERSION, int(req.submit_wall * 1e9),
+                 1 if req.slo_class == "interactive" else 0,
+                 _tenant_hash(req.tenant), ship_mode, ship_slot,
+                 first_token, n_blocks, route],
+                np.int64,
+            ),
+            "prompt": np.pad(
+                req.prompt,
+                (0, self._max_seq_len - req.prompt.size),
+            ),
+        }
+
+    def _route(self, req: _InFlight, targets: List[_Replica]):
+        """Pick the serving replica: deepest matching prefix chain
+        (each replica's shared-block key index rides its STATS
+        piggyback) among replicas within ``imbalance_cap`` of the
+        least-loaded — affinity must never starve a replica — else
+        the PR-13 least-outstanding rule.  Returns ``(replica,
+        route_code)``."""
+        if not self._fleet or not req.digests or len(targets) < 2:
+            return least_outstanding(targets), 0
+        floor = min(len(r.outstanding) for r in targets)
+        best, best_depth = None, 0
+        for r in sorted(
+            targets, key=lambda r: (len(r.outstanding), r.idx)
+        ):
+            if len(r.outstanding) > floor + self._imbalance_cap:
+                continue
+            depth = 0
+            for d in req.digests:
+                if d not in r.prefix_keys:
+                    break
+                depth += 1
+            if depth > best_depth:
+                best, best_depth = r, depth
+        if best is not None:
+            return best, 1
+        return least_outstanding(targets), 0
 
     def _dispatch_loop(self):
         from dlrover_tpu.observability.metrics import record_serving
@@ -1324,7 +1691,36 @@ class ServingEngine:
             r for r in self._replicas
             if r.alive and r.ready and not r.draining
         ]
-        while self._dispatch_q and alive:
+        if self._fleet and self._n_prefill:
+            prefill_alive = [r for r in alive if r.role == "prefill"]
+            targets = [r for r in alive if r.role != "prefill"]
+        else:
+            prefill_alive = []
+            targets = alive
+        # relay staged manifests first: a parked manifest holds an
+        # arena slot and its request's clock has been running since
+        # submit — the decode replica splices the blocks and starts a
+        # pure token loop
+        while self._adopt_q and targets:
+            req_id, slot, n_blocks, first = self._adopt_q[0]
+            if req_id in self._completed or req_id not in self._reqs:
+                self._adopt_q.popleft()
+                self._free_ship_slot(req_id)
+                continue
+            req = self._reqs[req_id]
+            rep = least_outstanding(targets)
+            ok = rep.req_ring.try_put(
+                self._req_msg(req, ship_mode=2, ship_slot=slot,
+                              first_token=first, n_blocks=n_blocks,
+                              route=2),
+                timeout=0.02,
+            )
+            if not ok:
+                break  # ring full; retry next pump
+            self._adopt_q.popleft()
+            rep.outstanding[req_id] = req
+            moved += 1
+        while self._dispatch_q and targets:
             with self._lock:
                 if not self._dispatch_q:
                     break
@@ -1345,27 +1741,37 @@ class ServingEngine:
                     },
                 )
                 continue
-            rep = least_outstanding(alive)
-            ok = rep.req_ring.try_put(
-                {
-                    "meta": np.asarray(
-                        [req_id, req.prompt.size, req.max_new,
-                         req.seed, RING_SCHEMA_VERSION,
-                         int(req.submit_wall * 1e9)],
-                        np.int64,
-                    ),
-                    "prompt": np.pad(
-                        req.prompt,
-                        (0, self._max_seq_len - req.prompt.size),
-                    ),
-                },
-                timeout=0.02,
+            use_ship = (
+                prefill_alive
+                and self._ship_arena is not None
+                and self._ship_free
+                and req.prompt.size >= self._min_ship_prompt
             )
-            if not ok:
-                req.attempts -= 1  # ring full is not a failure
-                with self._lock:
-                    self._dispatch_q.appendleft(req_id)
-                break
+            if use_ship:
+                slot = self._ship_free.pop()
+                rep = least_outstanding(prefill_alive)
+                ok = rep.req_ring.try_put(
+                    self._req_msg(req, ship_mode=1, ship_slot=slot,
+                                  route=2),
+                    timeout=0.02,
+                )
+                if not ok:
+                    self._ship_free.append(slot)
+                    req.attempts -= 1  # ring full is not a failure
+                    with self._lock:
+                        self._dispatch_q.appendleft(req_id)
+                    break
+                req.ship_slot = slot
+            else:
+                rep, route = self._route(req, targets)
+                ok = rep.req_ring.try_put(
+                    self._req_msg(req, route=route), timeout=0.02,
+                )
+                if not ok:
+                    req.attempts -= 1  # ring full is not a failure
+                    with self._lock:
+                        self._dispatch_q.appendleft(req_id)
+                    break
             rep.outstanding[req_id] = req
             moved += 1
         now = time.monotonic()
@@ -1378,6 +1784,24 @@ class ServingEngine:
                 kv_blocks_used=None,
                 p99_latency_s=self._latency.quantile(0.99),
             )
+            if self._fleet:
+                # fleet-level prefix hit rate: windowed over the
+                # STATS deltas accumulated since the last tick with
+                # lookups in it (an idle window keeps the last value
+                # instead of flapping to 0)
+                if self._fleet_lookups > 0:
+                    self._fleet_hit_rate = (
+                        self._fleet_hits / self._fleet_lookups
+                    )
+                    self._fleet_hits = 0.0
+                    self._fleet_lookups = 0.0
+                record_serving(
+                    replica="fleet",
+                    tokens_per_s=None,
+                    queue_depth=None,
+                    kv_blocks_used=None,
+                    prefix_hit_rate=self._fleet_hit_rate,
+                )
             if self._serve_obs:
                 # mirror each live replica's newest STATS into THIS
                 # process's registry so the engine's /metrics carries
@@ -1408,6 +1832,7 @@ class ServingEngine:
                         "alive": r.alive,
                         "drained": r.drained,
                         "outstanding": len(r.outstanding),
+                        "role": r.role,
                         **r.stats,
                     }
                     for r in self._replicas
@@ -1447,12 +1872,18 @@ class ServingEngine:
         out = {
             "replicas": [
                 dict(
-                    {
-                        "idx": r.idx,
-                        "alive": r.alive,
-                        "drained": r.drained,
-                        "outstanding": len(r.outstanding),
-                    },
+                    dict(
+                        {
+                            "idx": r.idx,
+                            "alive": r.alive,
+                            "drained": r.drained,
+                            "outstanding": len(r.outstanding),
+                        },
+                        # the role column only exists when the fleet
+                        # layer could have split roles (OFF pins the
+                        # PR-16 row shape exactly)
+                        **({"role": r.role} if self._fleet else {}),
+                    ),
                     **r.stats,
                 )
                 for r in self._replicas
@@ -1478,6 +1909,10 @@ class ServingEngine:
                     "dlrover_tpu_serving_queue_wait_seconds", 0.99
                 ), 4),
             }
+            if self._fleet:
+                out["slo"]["fleet_prefix_hit_rate"] = round(
+                    self._fleet_hit_rate, 4
+                )
             if self._health is not None:
                 out["health"] = self._health.snapshot()
         return out
@@ -1502,6 +1937,12 @@ class ServingEngine:
         for rep in self._replicas:
             rep.req_ring.close(unlink=True)
             rep.resp_ring.close(unlink=True)
+        if self._ship_arena is not None:
+            try:
+                self._ship_arena.close()
+                self._ship_arena.unlink()
+            except Exception:  # noqa: BLE001 - already gone is fine
+                pass
         self._shm.close(unlink=True)
 
 

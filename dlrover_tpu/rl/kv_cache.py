@@ -125,6 +125,90 @@ def prefix_block_keys(tokens, block_size: int) -> List[str]:
     return keys
 
 
+def region_nbytes_per_block(pool: Dict[str, jnp.ndarray]) -> int:
+    """Bytes one block occupies in ONE stream (k or v) across all
+    layers — the unit the ship-arena slot sizing is quoted in.  Both
+    ends of a ship must agree on this number (same model config =>
+    same pool shape), and it is derived from the pool itself so a
+    dtype or head-dim change can never desynchronize them."""
+    return int(pool["k"].nbytes // pool["k"].shape[1])
+
+
+def extract_block_regions(
+    pool: Dict[str, jnp.ndarray], block_ids: Sequence[int]
+):
+    """Pull the contiguous ``[L, n_blocks, block_size, KV, head_dim]``
+    tiles for ``block_ids`` out of the device pool as host numpy
+    arrays (k and v) — the prefill side of a KV block ship.  Full
+    blocks are immutable, so the copy is a consistent snapshot; the
+    bytes are bit-exact pool content (no dtype round trip).
+
+    Blocks are pulled one at a time with a *traced* index
+    (``dynamic_index_in_dim``) so the gather compiles once per pool
+    shape and is reused for every block id and every region length —
+    a fancy-index gather would recompile per distinct ``len(block_ids)``
+    and stall the prefill worker's loop mid-ship."""
+    import numpy as np
+    from jax import lax
+
+    tiles = [
+        (
+            np.asarray(
+                lax.dynamic_index_in_dim(
+                    pool["k"], jnp.int32(b), axis=1, keepdims=False
+                )
+            ),
+            np.asarray(
+                lax.dynamic_index_in_dim(
+                    pool["v"], jnp.int32(b), axis=1, keepdims=False
+                )
+            ),
+        )
+        for b in block_ids
+    ]
+    return (
+        np.stack([t[0] for t in tiles], axis=1),
+        np.stack([t[1] for t in tiles], axis=1),
+    )
+
+
+def insert_block_regions(
+    pool: Dict[str, jnp.ndarray],
+    block_ids: Sequence[int],
+    k_region,
+    v_region,
+) -> Dict[str, jnp.ndarray]:
+    """Splice shipped block tiles into the receiving pool at
+    ``block_ids`` (freshly allocated there) — the decode side of a KV
+    block ship.  Returns the updated pool dict.  The regions must be
+    the ``[L, n, block_size, KV, head_dim]`` layout
+    :func:`extract_block_regions` produced; dtype is preserved so the
+    inserted blocks are bitwise-identical attention inputs.
+
+    Blocks are spliced one at a time with a *traced* index
+    (``dynamic_update_index_in_dim``) so the scatter compiles once per
+    pool shape and is reused for every block id and region length — a
+    fancy-index ``.at[ids].set`` recompiles per distinct
+    ``len(block_ids)``, which stalls the decode replica's token loop
+    (seconds of XLA compile) the first time each prompt length adopts."""
+    import numpy as np
+    from jax import lax
+
+    k = pool["k"]
+    v = pool["v"]
+    kr = np.asarray(k_region)
+    vr = np.asarray(v_region)
+    for j, bid in enumerate(block_ids):
+        i = jnp.int32(bid)
+        k = lax.dynamic_update_index_in_dim(
+            k, jnp.asarray(kr[:, j], k.dtype), i, axis=1
+        )
+        v = lax.dynamic_update_index_in_dim(
+            v, jnp.asarray(vr[:, j], v.dtype), i, axis=1
+        )
+    return {"k": k, "v": v}
+
+
 class OutOfBlocksError(RuntimeError):
     """The pool cannot satisfy an allocation — admission control
     should have checked :meth:`BlockPool.can_allocate` first (or, in

@@ -67,10 +67,12 @@ import numpy as np
 
 from dlrover_tpu.common.env import (
     decode_steps,
+    fleet_interactive_slots,
     kv_admit_watermark,
     kv_grow_blocks,
     kv_incremental_enabled,
     kv_prefix_cache_enabled,
+    serve_fleet_enabled,
     serve_obs_enabled,
 )
 from dlrover_tpu.common.log import default_logger as logger
@@ -78,10 +80,15 @@ from dlrover_tpu.rl.kv_cache import (
     BlockPool,
     OutOfBlocksError,
     PagedCacheConfig,
+    extract_block_regions,
     init_block_pool,
+    insert_block_regions,
     pool_can_ever_hold,
     prefix_block_keys,
 )
+
+SLO_INTERACTIVE = "interactive"
+SLO_BATCH = "batch"
 
 FINISH_EOS = "eos"
 FINISH_LENGTH = "length"
@@ -117,6 +124,21 @@ class GenRequest:
     hit_blocks: int = 0
     queue_wait_s: float = 0.0
     token_times: List[float] = field(default_factory=list)
+    # fleet-serving lanes (ISSUE 17; inert when
+    # DLROVER_TPU_SERVE_FLEET=0): the SLO class steers admission
+    # order, the reserved-slot quota, and preemption rank; the tenant
+    # key drives weighted fair-share within a class.  ``shipped`` is
+    # the disaggregated-decode adoption payload (prefilled KV block
+    # regions + the first sampled token) — consumed at admission,
+    # never carried through a preempt/requeue (the resume path
+    # re-prefills deterministically instead).
+    slo_class: str = SLO_BATCH
+    tenant: str = ""
+    shipped: Optional[Dict] = None
+    # how the dispatcher picked this replica (least_outstanding /
+    # affinity / ship); "local" for in-process submits — stamped on
+    # the serve_request span so routing decisions are auditable
+    route: str = "local"
 
 
 @dataclass
@@ -180,6 +202,7 @@ class ContinuousBatchingScheduler:
         paged_verify_fn: Optional[Callable] = None,
         events=None,
         replica: str = "",
+        role: str = "unified",
     ):
         import jax
         import jax.numpy as jnp
@@ -221,6 +244,25 @@ class ContinuousBatchingScheduler:
             self.incremental and kv_prefix_cache_enabled()
         )
         self.decode_k = decode_steps()
+        # fleet lanes (ISSUE 17) — pinned at construction like the
+        # allocation discipline.  ``role``: "unified" (default) serves
+        # prefill+decode in place; "prefill" stops at prefill
+        # completion and parks the filled block regions + first token
+        # on ``self.shipped`` for the worker loop to ship out.
+        self.fleet = serve_fleet_enabled()
+        if role not in ("unified", "prefill"):
+            raise ValueError(f"unknown scheduler role {role!r}")
+        self.role = role if self.fleet else "unified"
+        self.interactive_slots = (
+            min(fleet_interactive_slots(), s.max_slots - 1)
+            if self.fleet else 0
+        )
+        self.shipped: List[Dict] = []
+        self.shipped_out = 0
+        self.shipped_in = 0
+        # results of adoptions that finished on their first token when
+        # no finished-list was threaded in (drained by step())
+        self._adopt_finished: List[GenResult] = []
 
         cache_cfg = PagedCacheConfig(
             n_layers=model_cfg.n_layers,
@@ -243,6 +285,11 @@ class ContinuousBatchingScheduler:
         self._keys = np.zeros((S, 2), np.uint32)
         self._slots = [_Slot() for _ in range(S)]
         self._queue: List[GenRequest] = []
+        # queued interactive requests, maintained at every queue
+        # mutation: admission is per-step hot-loop work and a
+        # saturated queue runs hundreds deep, so the common case
+        # ("is anything interactive waiting?") must not scan it
+        self._queued_interactive = 0
         # full-prompt block keys memoized per req_id: _admit probes
         # the blocked queue head every iteration, and SHA-1-hashing a
         # long system prompt 3x per step is hot-loop host work
@@ -370,6 +417,10 @@ class ContinuousBatchingScheduler:
         seed: int = 0,
         req_id: Optional[int] = None,
         submit_wall: Optional[float] = None,
+        slo_class: str = SLO_BATCH,
+        tenant: str = "",
+        shipped: Optional[Dict] = None,
+        route: str = "local",
     ) -> int:
         """Queue one prompt; returns the request id results carry.
 
@@ -377,7 +428,11 @@ class ContinuousBatchingScheduler:
         seconds) when the request crossed a process boundary — the
         dispatcher stamps it onto the shm ring so the ``queue_wait``
         and ``serve_request`` spans start at the TRUE submit time,
-        ring transit included."""
+        ring transit included.  ``slo_class``/``tenant`` steer the
+        fleet admission lanes (any class other than "interactive"
+        normalizes to "batch"); ``shipped`` carries a disaggregated
+        prefill's KV block regions (``{"k", "v", "first_token"}``) —
+        the request then admits straight into the decode phase."""
         if self.draining:
             raise RuntimeError(
                 "scheduler is draining: submissions belong on "
@@ -414,11 +469,18 @@ class ContinuousBatchingScheduler:
         if req_id is None:
             req_id = self._next_req_id
         self._next_req_id = max(self._next_req_id, req_id) + 1
+        if slo_class != SLO_INTERACTIVE:
+            slo_class = SLO_BATCH
         self._queue.append(
             GenRequest(req_id=req_id, prompt=prompt, max_new=max_new,
                        seed=int(seed),
-                       submit_wall=float(submit_wall or 0.0))
+                       submit_wall=float(submit_wall or 0.0),
+                       slo_class=slo_class, tenant=str(tenant),
+                       shipped=shipped if self.fleet else None,
+                       route=str(route))
         )
+        if slo_class == SLO_INTERACTIVE:
+            self._queued_interactive += 1
         return req_id
 
     @property
@@ -516,7 +578,7 @@ class ContinuousBatchingScheduler:
             }
         keys: List[str] = []
         peek = peek_lru = 0
-        if self.prefix_cache:
+        if self.prefix_cache and req.shipped is None:
             # only blocks fully inside the ORIGINAL prompt are ever
             # registered, and at least one token must remain to
             # prefill (its logits seed the first sampled token)
@@ -530,6 +592,10 @@ class ContinuousBatchingScheduler:
             self.grow_blocks,
             max(cfgp.blocks_for(total) - cfgp.blocks_for(plen), 0),
         )
+        if self.role == "prefill":
+            # a prefill worker never decodes: no growth headroom, so
+            # more concurrent prefills pack into the same pool
+            headroom = 0
         need = cfgp.blocks_for(plen) - peek + headroom
         watermark_blocks = int(
             np.ceil(self.admit_watermark * cfgp.usable_blocks)
@@ -551,7 +617,71 @@ class ContinuousBatchingScheduler:
             "peek_hits": peek,
         }
 
-    def _admit(self):
+    def _pick_next_index(self) -> Optional[int]:
+        """Which queued request admits next.  Fleet OFF: index 0 —
+        the PR-14 FIFO head-of-line rule exactly (pinned by tests).
+        Fleet ON (SLO-class lanes): interactive before batch; while
+        interactive work is in flight, batch admission is capped so
+        ``interactive_slots`` decode slots stay reserved for the
+        interactive lane (an idle interactive lane does NOT strand
+        slots — batch fills every slot until the next interactive
+        arrival, which admission then favors and which class-aware
+        preemption can make room for); within a class the tenant with
+        the fewest active slots wins (weighted fair share), FIFO
+        breaking tenant ties."""
+        if not self._queue:
+            return None
+        if not self.fleet:
+            return 0
+        active_cls: Dict[str, int] = {}
+        active_tenant: Dict = {}
+        for sl in self._slots:
+            if sl.req is None:
+                continue
+            c = sl.req.slo_class
+            active_cls[c] = active_cls.get(c, 0) + 1
+            k = (c, sl.req.tenant)
+            active_tenant[k] = active_tenant.get(k, 0) + 1
+        if self._queued_interactive > 0:
+            # interactive first — the O(queue) scan only runs while
+            # an interactive request is actually waiting (the counter
+            # keeps the saturated-queue common case scan-free)
+            idxs = [
+                i for i, r in enumerate(self._queue)
+                if r.slo_class == SLO_INTERACTIVE
+            ]
+            return min(
+                idxs,
+                key=lambda i: (
+                    active_tenant.get(
+                        (SLO_INTERACTIVE, self._queue[i].tenant), 0
+                    ),
+                    i,
+                ),
+            )
+        # batch only from here: while interactive work is in flight,
+        # keep ``interactive_slots`` decode slots reserved for it
+        if (
+            active_cls.get(SLO_INTERACTIVE, 0) > 0
+            and active_cls.get(SLO_BATCH, 0)
+            >= self.sched.max_slots - self.interactive_slots
+        ):
+            return None
+        # everything queued is batch; arbitrate tenant fair share
+        # over a bounded FIFO window so a hundreds-deep saturated
+        # queue costs O(window), not O(queue), per admission
+        window = min(len(self._queue), 32)
+        return min(
+            range(window),
+            key=lambda i: (
+                active_tenant.get(
+                    (SLO_BATCH, self._queue[i].tenant), 0
+                ),
+                i,
+            ),
+        )
+
+    def _admit(self, finished: Optional[List[GenResult]] = None):
         s = self.sched
         while self._queue and not self.draining:
             free = [
@@ -560,15 +690,23 @@ class ContinuousBatchingScheduler:
             ]
             if not free:
                 return
-            req = self._queue[0]
+            qi = self._pick_next_index()
+            if qi is None:
+                return  # lane caps leave nothing admissible
+            req = self._queue[qi]
             plan = self._admissible(req)
             if plan is None:
-                # FIFO head-of-line: later (smaller) requests must not
-                # starve the head forever
+                # head-of-line (and, fleet on, pool-blocked pick):
+                # later (smaller) requests must not starve it forever
                 return
             admit_t0 = time.monotonic()
-            self._queue.pop(0)
+            self._queue.pop(qi)
+            if req.slo_class == SLO_INTERACTIVE:
+                self._queued_interactive -= 1
             slot = free[0]
+            if req.shipped is not None:
+                self._adopt(slot, req, plan, admit_t0, finished)
+                continue
             hit_ids = (
                 self.block_pool.acquire_prefix(plan["keys"])
                 if plan["keys"] else []
@@ -613,6 +751,60 @@ class ContinuousBatchingScheduler:
             req.hit_blocks += n_hit
             if self._serve_obs:
                 self._trace_admit(req, admit_t0)
+
+    def _adopt(self, slot: int, req: GenRequest, plan: Dict,
+               admit_t0: float,
+               finished: Optional[List[GenResult]]):
+        """Admit a disaggregated prefill straight into DECODE: splice
+        the shipped block regions into freshly allocated pool blocks,
+        point the slot's table at them, and run a pure token loop from
+        the first token the prefill worker already sampled.  The
+        shipped tiles are bitwise the prefill worker's pool content,
+        so decode over them equals decode over a local prefill (pinned
+        by test); a later preemption drops nothing — the payload is
+        consumed here and resume re-prefills deterministically."""
+        s = self.sched
+        payload, req.shipped = req.shipped, None
+        plen = int(req.prompt.size)
+        n_ship = self.pool_cfg.blocks_for(plen)
+        self.block_pool.allocate(
+            req.req_id, plan["n_tokens"], extra_blocks=plan["extra"]
+        )
+        ids = self.block_pool.blocks_of(req.req_id)[:n_ship]
+        self._pool = insert_block_regions(
+            self._pool, ids, payload["k"], payload["v"]
+        )
+        self._tables[slot] = self.block_pool.table_row(
+            req.req_id, s.max_blocks_per_seq
+        )
+        self._positions[slot] = plen
+        self._active[slot] = True
+        key = self._jax.random.PRNGKey(req.seed)
+        self._keys[slot] = np.asarray(
+            self._jax.random.key_data(key), np.uint32
+        ).reshape(-1)[:2]
+        self._admit_counter += 1
+        sl = _Slot(req=req, phase="decode", prefill_len=plen,
+                   admit_seq=self._admit_counter)
+        self._slots[slot] = sl
+        self.block_pool.note_filled(req.req_id, plen)
+        self.shipped_in += 1
+        if self.prefix_cache:
+            # shipped FULL prompt blocks are immutable content — index
+            # them so later local prompts with the same prefix share
+            keys = self._full_prompt_keys(req)
+            for idx in range(min(len(keys), n_ship)):
+                self.block_pool.share_block(
+                    req.req_id, idx, keys[idx]
+                )
+        if self._serve_obs:
+            self._trace_admit(req, admit_t0)
+        first = int(payload["first_token"])
+        self._next_token[slot] = first
+        self._append_token(
+            slot, first,
+            self._adopt_finished if finished is None else finished,
+        )
 
     def _trace_admit(self, req: GenRequest, admit_t0: float):
         """Close the request's queue phase: a fresh admission emits
@@ -703,6 +895,8 @@ class ContinuousBatchingScheduler:
                     tbt_p99_s=stats["tbt_p99_s"],
                     preempts=req.preempts,
                     prefix_hit_blocks=req.hit_blocks,
+                    route=req.route,
+                    slo_class=req.slo_class,
                     finish_reason=reason,
                 )
         finished.append(
@@ -749,8 +943,13 @@ class ContinuousBatchingScheduler:
                 hit_blocks=req.hit_blocks,
                 queue_wait_s=req.queue_wait_s,
                 token_times=req.token_times,
+                slo_class=req.slo_class,
+                tenant=req.tenant,
+                route=req.route,
             ),
         )
+        if req.slo_class == SLO_INTERACTIVE:
+            self._queued_interactive += 1
         self._tables[slot] = 0
         self._positions[slot] = 0
         self._active[slot] = False
@@ -779,13 +978,27 @@ class ContinuousBatchingScheduler:
 
     def _pick_victim(self, exclude: int) -> Optional[int]:
         """Lowest-priority live sequence: fewest tokens generated,
-        tie broken youngest-admission-first."""
+        tie broken youngest-admission-first.  Fleet on, the rule is
+        CLASS-AWARE first: every batch lane outranks every interactive
+        lane as a victim (batch preempts before interactive, never the
+        reverse at equal KV pressure — pinned by test); within a class
+        the PR-14 rule applies unchanged."""
         candidates = [
             i for i, sl in enumerate(self._slots)
             if sl.req is not None and i != exclude
         ]
         if not candidates:
             return None
+        if self.fleet:
+            return min(
+                candidates,
+                key=lambda i: (
+                    0 if self._slots[i].req.slo_class
+                    != SLO_INTERACTIVE else 1,
+                    len(self._slots[i].generated),
+                    -self._slots[i].admit_seq,
+                ),
+            )
         return min(
             candidates,
             key=lambda i: (
@@ -929,6 +1142,35 @@ class ContinuousBatchingScheduler:
                 jnp.int32(plen),
             )
             self.dispatches += 1
+            if self.role == "prefill":
+                # disaggregated split: the first token is sampled HERE
+                # (same (seed, position) rule as a local prefill, so
+                # the decode continuation is bit-identical), then the
+                # filled block tiles ship out and the slot frees — a
+                # prefill worker never decodes
+                n_ship = self.pool_cfg.blocks_for(plen)
+                ids = self.block_pool.blocks_of(req.req_id)[:n_ship]
+                k_region, v_region = extract_block_regions(
+                    self._pool, ids
+                )
+                self.shipped.append(
+                    {
+                        "req_id": req.req_id,
+                        "first_token": int(tok),
+                        "n_blocks": n_ship,
+                        "prompt_len": plen,
+                        "k": k_region,
+                        "v": v_region,
+                    }
+                )
+                self.shipped_out += 1
+                self.block_pool.free(req.req_id)
+                self._prompt_keys.pop(req.req_id, None)
+                self._tables[slot] = 0
+                self._positions[slot] = 0
+                self._active[slot] = False
+                self._slots[slot] = _Slot()
+                return real
             sl.phase = "decode"
             self._positions[slot] = plen
             self._active[slot] = True
@@ -1057,13 +1299,16 @@ class ContinuousBatchingScheduler:
         t0 = time.monotonic()
         emit = self._events is not None and self._events.enabled
         finished: List[GenResult] = []
-        self._admit()
+        if self._adopt_finished:
+            finished.extend(self._adopt_finished)
+            self._adopt_finished.clear()
+        self._admit(finished)
         pre_t0 = time.monotonic()
         hit_blocks = self._window_hit_blocks
         self._window_hit_blocks = 0
         pre = self._prefill_one(finished)
         pre_t1 = time.monotonic()
-        self._admit()  # a first-token EOS may have freed a slot
+        self._admit(finished)  # a first-token EOS may have freed a slot
         self._ensure_blocks()
         dec_t0 = time.monotonic()
         if self._decode_multi_jit is not None:
@@ -1071,7 +1316,7 @@ class ContinuousBatchingScheduler:
         else:
             dec = self._decode_once(finished)
         dec_t1 = time.monotonic()
-        self._admit()
+        self._admit(finished)
         self.iterations += 1
         if emit and (pre or dec):
             from dlrover_tpu.observability.events import anchored_now
@@ -1131,6 +1376,11 @@ class ContinuousBatchingScheduler:
         self.draining = True
         requeue: List[GenRequest] = list(self._queue)
         self._queue.clear()
+        self._queued_interactive = 0
+        for req in requeue:
+            # a handed-back ship payload would outlive the weights it
+            # was prefilled under — the dispatcher re-prefills instead
+            req.shipped = None
         for slot, sl in enumerate(self._slots):
             if sl.req is None:
                 continue

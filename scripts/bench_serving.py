@@ -32,6 +32,13 @@ lands, so a timeout can never lose an already-measured point):
 6. **prefix** (``--prefix`` to run alone): a shared-system-prompt
    workload with the ref-counted shared-block prefix cache on vs the
    PR-13 baseline — tokens/s + block hit rate.
+7. **fleet** (``--fleet`` to run alone, ISSUE 17): an open-loop
+   traffic simulator (Poisson arrivals with a diurnal ramp, mixed
+   prompt lengths/SLO classes/tenants, a flash crowd on shared
+   system prompts) replayed with ``DLROVER_TPU_SERVE_FLEET`` on and
+   off — affinity hit-rate delta, interactive TTFT/TBT p99 vs batch
+   throughput, decode-TBT flatness under disaggregation.
+   ``DLROVER_TPU_BENCH_BUDGET_S`` scales the traffic duration.
 
 Usage::
 
@@ -869,6 +876,468 @@ def run_observatory(cfg, params, n_requests: int, out_dir: str,
     return out
 
 
+# --------------------------------------------------------------- fleet
+# ISSUE 17: an open-loop traffic simulator (Poisson arrivals with a
+# diurnal ramp, mixed prompt lengths, mixed SLO classes/tenants, a
+# flash crowd on shared system prompts) runs the same traffic with
+# `DLROVER_TPU_SERVE_FLEET` on and off, and records the three fleet
+# deltas the ISSUE promises: affinity hit rate, interactive
+# TTFT/TBT p99 with batch throughput held, decode-TBT flatness under
+# disaggregation.
+
+FLEET_BLOCK = 8  # block size every fleet phase uses
+
+
+def _fleet_run_s(default_s: float = 10.0) -> float:
+    """Per-engine-run traffic duration; ``DLROVER_TPU_BENCH_BUDGET_S``
+    scales it (6 engine runs — 3 phases x on/off — share ~60% of the
+    budget; the rest is engine startup + result drain)."""
+    raw = os.getenv("DLROVER_TPU_BENCH_BUDGET_S", "")
+    if raw:
+        try:
+            return max(3.0, min(60.0, float(raw) * 0.6 / 6.0))
+        except ValueError:
+            pass
+    return default_s
+
+
+def _diurnal_poisson(rng, duration_s: float, base_qps: float):
+    """Inhomogeneous Poisson arrival offsets via thinning: the rate
+    ramps ``0.5x -> 1.5x -> 0.5x`` of ``base_qps`` over the run (one
+    'day')."""
+    import math
+
+    peak = 1.5 * base_qps
+    t, out = 0.0, []
+    while True:
+        t += float(rng.exponential(1.0 / peak))
+        if t >= duration_s:
+            return out
+        rate = base_qps * (
+            0.5 + math.sin(math.pi * t / duration_s) ** 2
+        )
+        if float(rng.random()) < rate / peak:
+            out.append(t)
+
+
+def _fleet_traffic(kind: str, duration_s: float, seed: int):
+    """A list of ``(t, prompt, max_new, slo_class, tenant)`` sorted by
+    arrival time.  Shared system prompts are whole-block multiples so
+    the prefix cache (and affinity routing) can act on them."""
+    rng = np.random.default_rng(seed)
+    vocab = CFG_KW["vocab_size"]
+    sys_prompts = [
+        rng.integers(0, vocab, (4 * FLEET_BLOCK,)).astype(np.int32)
+        for _ in range(6)
+    ]
+
+    def _with_prefix(tenant_i, tail_lo, tail_hi):
+        tail = rng.integers(
+            0, vocab, (int(rng.integers(tail_lo, tail_hi)),)
+        ).astype(np.int32)
+        return np.concatenate([sys_prompts[tenant_i], tail])
+
+    out = []
+    if kind == "flash_crowd":
+        # diurnal background over 6 tenant system prompts + a flash
+        # crowd on tenant 0 in the middle third of the run; the six
+        # prefixes deliberately exceed what one replica's pool can
+        # keep resident, so the routing policy decides between a
+        # stable residency and churn
+        for t in _diurnal_poisson(rng, duration_s, base_qps=8.0):
+            ten = int(rng.integers(0, 6))
+            out.append(
+                (t, _with_prefix(ten, 3, 9), 6, "batch", f"t{ten}")
+            )
+        for t in _diurnal_poisson(rng, duration_s / 3.0, 10.0):
+            out.append(
+                (
+                    duration_s / 3.0 + t,
+                    _with_prefix(0, 3, 9),
+                    6,
+                    "interactive",
+                    "t0",
+                )
+            )
+    elif kind == "lanes":
+        # heavy batch lanes + sparse interactive lanes, two tenants
+        # per class (fair share has something to arbitrate); batch
+        # offered load is sized to saturate the fleet so FIFO really
+        # queues interactive requests behind a batch backlog
+        for t in _diurnal_poisson(rng, duration_s, base_qps=100.0):
+            ten = int(rng.integers(0, 2))
+            plen = int(rng.integers(8, 17))
+            out.append(
+                (
+                    t,
+                    rng.integers(0, vocab, (plen,)).astype(np.int32),
+                    24,
+                    "batch",
+                    f"bulk{ten}",
+                )
+            )
+        for t in _diurnal_poisson(rng, duration_s, base_qps=3.0):
+            plen = int(rng.integers(4, 9))
+            out.append(
+                (
+                    t,
+                    rng.integers(0, vocab, (plen,)).astype(np.int32),
+                    5,
+                    "interactive",
+                    "chat",
+                )
+            )
+    elif kind == "long_prompt":
+        # the disaggregation story: long prompts whose prefill stalls
+        # co-batched decode lanes, mixed with decode-heavy requests.
+        # Load is deliberately BELOW fleet capacity — the metric is
+        # tail flatness of an unsaturated decode plane, not
+        # throughput under overload
+        for t in _diurnal_poisson(rng, duration_s, base_qps=2.0):
+            plen = int(rng.integers(56, 89))
+            out.append(
+                (
+                    t,
+                    rng.integers(0, vocab, (plen,)).astype(np.int32),
+                    8,
+                    "batch",
+                    "bulk0",
+                )
+            )
+        for t in _diurnal_poisson(rng, duration_s, base_qps=2.0):
+            plen = int(rng.integers(4, 9))
+            out.append(
+                (
+                    t,
+                    rng.integers(0, vocab, (plen,)).astype(np.int32),
+                    12,
+                    "interactive",
+                    "chat",
+                )
+            )
+    else:
+        raise ValueError(kind)
+    out.sort(key=lambda x: x[0])
+    return out
+
+
+def _run_fleet_traffic(traffic, n_replicas, sched_kw, env,
+                       name_tag: str, cfg_override=None):
+    """Open-loop: submit each request at its arrival offset (never
+    waiting for completions), then drain.  Returns per-class SLO
+    percentiles, throughput, and the prefix/role story from the final
+    engine status."""
+    from dlrover_tpu.rl.generation_service import ServingEngine
+
+    undo = _scoped_env(env)
+    max_new_cap = max(w[2] for w in traffic)
+    cfg = dict(CFG_KW)
+    cfg.update(cfg_override or {})
+    eng = ServingEngine(
+        factory="dlrover_tpu.rl.generation_service:"
+                "tiny_llama_factory",
+        factory_kwargs=cfg,
+        max_new_tokens=max_new_cap,
+        temperature=0.0,
+        name=f"bench-fleet-{os.getpid()}-{name_tag}",
+        num_replicas=n_replicas,
+        **sched_kw,
+    )
+    try:
+        # warm every replica's prefill/decode programs before the
+        # clock starts — a first-compile stall inside the measured
+        # window would dominate every p99 in both modes.  Warmup
+        # prompts stay SHORTER than one block, so they add zero
+        # full-block prefix queries and leave the hit-rate counters
+        # clean.
+        wrng = np.random.default_rng(997)
+        warm = [
+            eng.submit(
+                wrng.integers(
+                    0, CFG_KW["vocab_size"], (FLEET_BLOCK - 1,)
+                ).astype(np.int32),
+                max_new=4,
+                seed=17 + i,
+                slo_class=("interactive" if i % 2 else "batch"),
+            )
+            for i in range(2 * n_replicas)
+        ]
+        for rid in warm:
+            eng.result(rid, timeout=300.0)
+        if int(env.get("DLROVER_TPU_FLEET_PREFILL_WORKERS", "0")):
+            # warm the ship path too (extract/adopt/splice + arena
+            # attach): a few long prompts that clear the min-ship
+            # threshold.  Random tokens share no prefix, and this
+            # phase's metric is TBT flatness, not hit rate, so the
+            # extra full-block queries are harmless.
+            warm = [
+                eng.submit(
+                    wrng.integers(
+                        0, CFG_KW["vocab_size"], (5 * FLEET_BLOCK,)
+                    ).astype(np.int32),
+                    max_new=4,
+                    seed=91 + i,
+                )
+                for i in range(2 * n_replicas)
+            ]
+            for rid in warm:
+                eng.result(rid, timeout=300.0)
+        ids = []
+        t0 = time.monotonic()
+        for i, (at, prompt, max_new, slo, tenant) in enumerate(
+            traffic
+        ):
+            delay = at - (time.monotonic() - t0)
+            if delay > 0:
+                time.sleep(delay)
+            ids.append(
+                (
+                    eng.submit(
+                        prompt,
+                        max_new=max_new,
+                        seed=5000 + i,
+                        slo_class=slo,
+                        tenant=tenant,
+                    ),
+                    slo,
+                )
+            )
+        results = [
+            (eng.result(rid, timeout=600.0), slo)
+            for rid, slo in ids
+        ]
+        makespan = time.monotonic() - t0
+        time.sleep(1.3)  # let a STATS window land for the gauges
+        status = eng.status()
+        ok = [
+            (r, slo) for r, slo in results if "error" not in r
+        ]
+        per_class = {}
+        for cls in ("interactive", "batch"):
+            rows = [r for r, slo in ok if slo == cls]
+            per_class[cls] = {
+                "requests": len(rows),
+                "ttft_p99_s": round(
+                    _percentile([r["ttft_s"] for r in rows], 99), 4
+                ),
+                "tbt_p99_s": round(
+                    _percentile(
+                        [r["tbt_p99_s"] for r in rows], 99
+                    ),
+                    5,
+                ),
+                "e2e_p99_s": round(
+                    _percentile(
+                        [r["latency_s"] for r in rows], 99
+                    ),
+                    4,
+                ),
+                "new_tokens": sum(r["new_tokens"] for r in rows),
+                "tokens_per_s": round(
+                    sum(r["new_tokens"] for r in rows)
+                    / max(makespan, 1e-9),
+                    2,
+                ),
+            }
+        reps = status.get("replicas") or []
+        # fleet-wide hit rate, query-weighted: each replica's
+        # cumulative hit rate weighted by its share of full-block
+        # prefix lookups (approximated from the prompt blocks of the
+        # requests it served).  The unweighted mean would PUNISH the
+        # concentration affinity routing exists to create — a replica
+        # that served 3 scattered requests at a 0.1 rate must not
+        # count like the one that served 60 at 0.95.
+        bs = sched_kw.get("block_size", FLEET_BLOCK)
+        q_weight = {}
+        for (r, _slo), w in zip(results, traffic):
+            if "error" in r or r.get("replica") is None:
+                continue
+            q_weight[r["replica"]] = (
+                q_weight.get(r["replica"], 0) + w[1].size // bs
+            )
+        rate_by_idx = {
+            int(r["idx"]): float(
+                (r.get("stats") or r).get("prefix_hit_rate", 0.0)
+            )
+            for r in reps
+            if "prefix_hit_rate" in (r.get("stats") or r)
+        }
+        tot_w = sum(
+            w for i, w in q_weight.items() if i in rate_by_idx
+        )
+        fleet_hit = (
+            sum(
+                rate_by_idx[i] * w
+                for i, w in q_weight.items()
+                if i in rate_by_idx
+            )
+            / tot_w
+            if tot_w > 0
+            else 0.0
+        )
+        decode_tbt = [
+            r["tbt_p99_s"]
+            for r, _slo in ok
+            if r.get("replica") is not None
+        ]
+        return {
+            "requests": len(traffic),
+            "completed": len(ok),
+            "errors": len(results) - len(ok),
+            "makespan_s": round(makespan, 3),
+            "tokens_per_s": round(
+                sum(r["new_tokens"] for r, _ in ok)
+                / max(makespan, 1e-9),
+                2,
+            ),
+            "per_class": per_class,
+            "mean_prefix_hit_rate": round(fleet_hit, 4),
+            "fleet_prefix_hit_rate": (status.get("slo") or {}).get(
+                "fleet_prefix_hit_rate"
+            ),
+            "request_tbt_p99_s": round(
+                _percentile(decode_tbt, 99), 5
+            ),
+            "roles": {
+                str(r["idx"]): r.get("role", "decode")
+                for r in reps
+            },
+            "slo": status.get("slo"),
+        }
+    finally:
+        eng.close()
+        undo()
+
+
+def run_fleet(flush_fn=None):
+    """The ``--fleet`` leg: three traffic phases, each replayed with
+    the fleet flag on and off; partial JSON lands after every phase."""
+    run_s = _fleet_run_s()
+    out = {"run_s_per_engine": run_s}
+
+    # phase A — flash crowd: affinity routing vs scatter.  A small
+    # pool (the 6 shared system prompts do not all fit) makes the
+    # routing policy the difference between a stable prefix residency
+    # and churn.
+    kw = dict(max_slots=4, block_size=FLEET_BLOCK, num_blocks=36,
+              max_seq_len=64, prefill_chunk=8)
+    traffic = _fleet_traffic("flash_crowd", run_s, seed=23)
+    on = _run_fleet_traffic(
+        traffic,
+        3,
+        kw,
+        {
+            "DLROVER_TPU_SERVE_FLEET": "1",
+            # open-loop bursts push outstanding past the default cap
+            # exactly when affinity matters; loosen it a little so
+            # the router can stay sticky through the flash crowd
+            "DLROVER_TPU_FLEET_IMBALANCE_CAP": "6",
+        },
+        "affon",
+    )
+    off = _run_fleet_traffic(
+        traffic, 3, kw, {"DLROVER_TPU_SERVE_FLEET": "0"}, "affoff"
+    )
+    out["affinity"] = {
+        "on": on,
+        "off": off,
+        "prefix_hit_rate_delta": round(
+            on["mean_prefix_hit_rate"]
+            - off["mean_prefix_hit_rate"],
+            4,
+        ),
+    }
+    if flush_fn:
+        flush_fn(out)
+
+    # phase B — SLO-class lanes: reserved interactive decode slots +
+    # fair-share admission + class-aware preemption vs single-class
+    # FIFO, under batch saturation
+    kw = dict(max_slots=4, block_size=FLEET_BLOCK, num_blocks=48,
+              max_seq_len=64, prefill_chunk=8)
+    traffic = _fleet_traffic("lanes", run_s, seed=29)
+    # one replica: the lanes story is per-replica admission order
+    # under saturation, and a single saturated scheduler shows it
+    # without burning fleet-sized compute
+    # one reserved slot: at ~3 qps of short interactive requests a
+    # single reserved lane bounds TTFT; reserving more just idles
+    # slots the batch lane could fill
+    on = _run_fleet_traffic(
+        traffic, 1, kw,
+        {
+            "DLROVER_TPU_SERVE_FLEET": "1",
+            "DLROVER_TPU_FLEET_INTERACTIVE_SLOTS": "1",
+        },
+        "laneon",
+    )
+    off = _run_fleet_traffic(
+        traffic, 1, kw, {"DLROVER_TPU_SERVE_FLEET": "0"}, "laneoff"
+    )
+    on_i = on["per_class"]["interactive"]
+    off_i = off["per_class"]["interactive"]
+    out["lanes"] = {
+        "on": on,
+        "off": off,
+        "interactive_ttft_p99_improvement_s": round(
+            off_i["ttft_p99_s"] - on_i["ttft_p99_s"], 4
+        ),
+        "interactive_tbt_p99_improvement_s": round(
+            off_i["tbt_p99_s"] - on_i["tbt_p99_s"], 5
+        ),
+        "batch_tokens_per_s_ratio": round(
+            on["per_class"]["batch"]["tokens_per_s"]
+            / max(off["per_class"]["batch"]["tokens_per_s"], 1e-9),
+            3,
+        ),
+    }
+    if flush_fn:
+        flush_fn(out)
+
+    # phase C — disaggregated prefill/decode: long-prompt prefill
+    # moved off the decode replicas vs everyone prefilling inline.
+    # A heavier model + coarse prefill chunks make each inline
+    # prefill step a real decode stall (the production shape of the
+    # problem) — the toy CFG_KW model prefills so fast the stall
+    # drowns in scheduler noise.  With the ship path on, decode
+    # replicas run pure token loops (everything ships), which is
+    # exactly the stall disaggregation removes.
+    heavy = dict(dim=96, n_layers=4, mlp_dim=192)
+    kw = dict(max_slots=4, block_size=FLEET_BLOCK, num_blocks=128,
+              max_seq_len=96, prefill_chunk=64)
+    traffic = _fleet_traffic("long_prompt", run_s, seed=31)
+    # two replicas: ON splits them into 1 prefill worker + 1 pure
+    # decode replica, OFF runs 2 replicas prefilling inline.  Every
+    # OFF decode lane therefore shares a step loop with long-prompt
+    # prefills, while the ON decode replica never runs one — the
+    # cleanest contrast of the stall disaggregation removes
+    on = _run_fleet_traffic(
+        traffic,
+        2,
+        kw,
+        {
+            "DLROVER_TPU_SERVE_FLEET": "1",
+            "DLROVER_TPU_FLEET_PREFILL_WORKERS": "1",
+            "DLROVER_TPU_FLEET_SHIP_SLOTS": "16",
+        },
+        "disaggon",
+        cfg_override=heavy,
+    )
+    off = _run_fleet_traffic(
+        traffic, 2, kw, {"DLROVER_TPU_SERVE_FLEET": "0"},
+        "disaggoff", cfg_override=heavy,
+    )
+    out["disagg"] = {
+        "on": on,
+        "off": off,
+        "decode_tbt_p99_flatness_improvement_s": round(
+            off["request_tbt_p99_s"] - on["request_tbt_p99_s"], 5
+        ),
+    }
+    if flush_fn:
+        flush_fn(out)
+    return out
+
+
 def flush(out_file: str, payload):
     if not out_file:
         return
@@ -910,8 +1379,17 @@ def main(argv=None) -> int:
         help="run ONLY the serving-observatory leg (ISSUE 16): "
         "fault naming, Perfetto lifecycle, tracing overhead",
     )
+    parser.add_argument(
+        "--fleet", action="store_true",
+        help="run ONLY the fleet leg (ISSUE 17): open-loop traffic "
+        "with DLROVER_TPU_SERVE_FLEET on vs off — affinity hit "
+        "rate, SLO-class lanes, disaggregated prefill/decode",
+    )
     args = parser.parse_args(argv)
-    only = args.utilization or args.prefix or args.observatory
+    only = (
+        args.utilization or args.prefix or args.observatory
+        or args.fleet
+    )
 
     payload = {
         "metric": "serving_continuous_vs_sequential_tokens_per_s",
@@ -986,6 +1464,38 @@ def main(argv=None) -> int:
                     "overhead_frac": obs["overhead"][
                         "overhead_frac"
                     ],
+                },
+                default=str,
+            ))
+        if args.fleet:
+
+            def _flush_fleet(partial):
+                extras["fleet"] = partial
+                flush(args.out, payload)
+
+            extras["fleet"] = run_fleet(flush_fn=_flush_fleet)
+            fl = extras["fleet"]
+            if payload["value"] is None:
+                # headline: the affinity routing delta — fleet-wide
+                # prefix hit rate gained under the flash crowd
+                payload["value"] = fl["affinity"][
+                    "prefix_hit_rate_delta"
+                ]
+            flush(args.out, payload)
+            print(json.dumps(
+                {
+                    "prefix_hit_rate_delta": fl["affinity"][
+                        "prefix_hit_rate_delta"
+                    ],
+                    "interactive_ttft_p99_improvement_s": fl[
+                        "lanes"
+                    ]["interactive_ttft_p99_improvement_s"],
+                    "batch_tokens_per_s_ratio": fl["lanes"][
+                        "batch_tokens_per_s_ratio"
+                    ],
+                    "decode_tbt_p99_flatness_improvement_s": fl[
+                        "disagg"
+                    ]["decode_tbt_p99_flatness_improvement_s"],
                 },
                 default=str,
             ))

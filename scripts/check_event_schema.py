@@ -158,6 +158,11 @@ DECLARED_METRICS = {
     # per-replica health verdict gauge (ServingHealthEngine):
     # 1 ok .. 0.1 dead_air, mirroring dlrover_tpu_node_health
     "dlrover_tpu_serving_health",
+    # disaggregated prefill/decode (ISSUE 17, DLROVER_TPU_SERVE_FLEET
+    # + DLROVER_TPU_FLEET_PREFILL_WORKERS): KV blocks a prefill worker
+    # filled and shipped through the shm block arena for a decode
+    # replica to adopt — each increment pairs with a kv_ship span
+    "dlrover_tpu_serving_kv_shipped_blocks_total",
 }
 METRIC_METHODS = {
     "set_gauge",

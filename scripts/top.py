@@ -227,12 +227,20 @@ def render(status: dict) -> str:
         if slo:
             # the SLO histogram quantiles (ISSUE 16): what the
             # dispatcher-side TTFT/TBT/e2e/queue-wait histograms say
-            lines.append(
+            slo_line = (
                 f"slo: ttft p99 {slo.get('ttft_p99_s', 0.0):.3f}s"
                 f" · tbt p99 {slo.get('tbt_p99_s', 0.0):.4f}s"
                 f" · e2e p99 {slo.get('e2e_p99_s', 0.0):.3f}s"
                 f" · queue p99 {slo.get('queue_wait_p99_s', 0.0):.3f}s"
             )
+            if "fleet_prefix_hit_rate" in slo:
+                # fleet-wide shared-prefix hit rate (ISSUE 17): what
+                # affinity routing is actually buying across replicas
+                slo_line += (
+                    " · fleet hit "
+                    f"{100.0 * slo['fleet_prefix_hit_rate']:.1f}%"
+                )
+            lines.append(slo_line)
         health = serving.get("health") or {}
         why_by_idx = {
             h.get("replica"): h
@@ -245,8 +253,15 @@ def render(status: dict) -> str:
             # preemptions, shared-prefix block hit rate; the `why`
             # column (ISSUE 16, only when the serving observatory is
             # on) is the health verdict that explains a sick row
-            hdr = (
-                f"{'repl':>4} {'state':>8} {'inflight':>8} "
+            # the role column (ISSUE 17) only appears under fleet
+            # mode, where prefill workers and decode replicas are
+            # judged against different peer pools
+            has_roles = any("role" in r for r in reps)
+            hdr = f"{'repl':>4} "
+            if has_roles:
+                hdr += f"{'role':>8} "
+            hdr += (
+                f"{'state':>8} {'inflight':>8} "
                 f"{'tok/s':>8} {'queue':>6} {'kvblk':>6} "
                 f"{'kvutil':>6} {'preempt':>7} {'hit%':>6}"
             )
@@ -259,8 +274,11 @@ def render(status: dict) -> str:
                     "ok" if r.get("alive")
                     else ("drained" if r.get("drained") else "DEAD")
                 )
-                row = (
-                    f"{r.get('idx', '?'):>4} {state:>8} "
+                row = f"{r.get('idx', '?'):>4} "
+                if has_roles:
+                    row += f"{r.get('role', 'decode'):>8} "
+                row += (
+                    f"{state:>8} "
                     f"{r.get('outstanding', 0):>8} "
                     f"{r.get('tokens_per_s', 0.0):>8.1f} "
                     f"{r.get('queue_depth', 0):>6} "

@@ -446,12 +446,14 @@ def test_lint_enforces_serve_request_lifecycle_labels(tmp_path):
         "    events.complete('serve_request', 0.0, 1.0, req_id=4,\n"
         "                    replica='r0', prompt_tokens=7,\n"
         "                    gen_tokens=24, ttft_s=0.05,\n"
-        "                    tbt_p99_s=0.004)\n"
+        "                    tbt_p99_s=0.004, route='affinity',\n"
+        "                    slo_class='batch')\n"
         "    events.complete('serve_request', 0.0, 1.0, req_id=4,\n"
         "                    replica='r0', prompt_tokens=7,\n"
         "                    gen_tokens=24, ttft_s=0.05,\n"
         "                    tbt_p99_s=0.004, preempts=1,\n"
-        "                    prefix_hit_blocks=2)\n"
+        "                    prefix_hit_blocks=2, route='local',\n"
+        "                    slo_class='interactive')\n"
         "    events.complete('queue_wait', 0.0, 1.0)\n"
         "    events.complete('queue_wait', 0.0, 1.0, req_id=4)\n"
         "    events.complete('admit', 0.0, 1.0, req_id=4)\n"
@@ -470,6 +472,70 @@ def test_lint_enforces_serve_request_lifecycle_labels(tmp_path):
     assert (
         "missing required label(s) ['resume_tokens']" in proc.stdout
     )
+
+
+def test_lint_enforces_fleet_routing_labels(tmp_path):
+    """ISSUE-17 labels: a ``serve_request`` that does not say how it
+    was routed or which SLO class it ran in cannot explain a fleet
+    latency regression, and a ``kv_ship`` without its block/byte/
+    throughput accounting is an invisible data-plane hop."""
+    bad = tmp_path / "bad_fleet.py"
+    bad.write_text(
+        "events = None\n"
+        "def f(events):\n"
+        "    events.complete('serve_request', 0.0, 1.0, req_id=4,\n"
+        "                    replica='r0', prompt_tokens=7,\n"
+        "                    gen_tokens=24, ttft_s=0.05,\n"
+        "                    tbt_p99_s=0.004, preempts=0,\n"
+        "                    prefix_hit_blocks=2)\n"
+        "    events.complete('serve_request', 0.0, 1.0, req_id=4,\n"
+        "                    replica='r0', prompt_tokens=7,\n"
+        "                    gen_tokens=24, ttft_s=0.05,\n"
+        "                    tbt_p99_s=0.004, preempts=0,\n"
+        "                    prefix_hit_blocks=2, route='ship',\n"
+        "                    slo_class='batch')\n"
+        "    events.complete('kv_ship', 0.0, 1.0, blocks=3,\n"
+        "                    bytes=4096)\n"
+        "    events.complete('kv_ship', 0.0, 1.0, blocks=3,\n"
+        "                    bytes=4096, throughput_gbps=1.5)\n"
+    )
+    proc = _run(str(bad))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "event_schema_violations=2" in proc.stdout, proc.stdout
+    assert (
+        "missing required label(s) ['route', 'slo_class']"
+        in proc.stdout
+    )
+    assert (
+        "missing required label(s) ['throughput_gbps']"
+        in proc.stdout
+    )
+
+
+def test_lint_declares_kv_ship_counter():
+    """The shipped-blocks counter is declared vocabulary; an
+    in-package near-miss typo is not."""
+    probe = os.path.join(
+        REPO, "dlrover_tpu", "_lint_probe_ship_delete_me.py"
+    )
+    with open(probe, "w") as f:
+        f.write(
+            "def f(reg):\n"
+            "    reg.inc_counter("
+            "'dlrover_tpu_serving_kv_shipped_blocks_total', 3)\n"
+            "    reg.inc_counter("
+            "'dlrover_tpu_serving_kv_shiped_blocks_total', 3)\n"
+        )
+    try:
+        proc = _run(probe)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "event_schema_violations=1" in proc.stdout, proc.stdout
+        assert (
+            "dlrover_tpu_serving_kv_shiped_blocks_total"
+            in proc.stdout
+        )
+    finally:
+        os.unlink(probe)
 
 
 def test_lint_enforces_serving_health_instant_labels(tmp_path):

@@ -1,0 +1,304 @@
+"""Fleet-level serving (ISSUE 17): SLO-class lanes, disaggregated
+KV block shipping, and the `DLROVER_TPU_SERVE_FLEET=0` kill-switch.
+
+The contracts pinned here (ISSUE 17 acceptance):
+
+- class-aware preemption evicts batch lanes before interactive ones
+  at equal KV pressure, never the reverse; fleet OFF keeps the exact
+  PR-14 victim rule;
+- shipped block regions are bitwise the prefill worker's pool
+  content, so a decode continuation over an adopted prefill equals
+  the lone-scheduler reference token for token;
+- adoption never retraces the decode program
+  (``compile_counts()["decode"] == 1`` stays true across it);
+- `DLROVER_TPU_SERVE_FLEET=0` reproduces the PR-16 surfaces exactly:
+  FIFO head-of-line admission, single class, no roles, shipped
+  payloads dropped at submit.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from dlrover_tpu.models import llama  # noqa: E402
+from dlrover_tpu.rl.kv_cache import (  # noqa: E402
+    BlockPool,
+    PagedCacheConfig,
+    extract_block_regions,
+    init_block_pool,
+    insert_block_regions,
+)
+from dlrover_tpu.rl.scheduler import (  # noqa: E402
+    ContinuousBatchingScheduler,
+    SchedulerConfig,
+)
+
+CFG = llama.LlamaConfig.tiny(
+    vocab_size=97, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+    mlp_dim=64, remat="none", dtype=jnp.float32,
+)
+PARAMS = llama.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def unbatched_reference(prompt, max_new):
+    """Greedy lone-sequence full-forward loop — the ground truth any
+    scheduling/shipping path must be invisible against."""
+    toks = list(int(t) for t in prompt)
+    for _ in range(max_new):
+        logits = llama.forward(
+            params=PARAMS,
+            tokens=jnp.asarray([toks], jnp.int32),
+            cfg=CFG,
+            attention_fn=llama.dot_product_attention,
+        )[0, -1]
+        toks.append(int(jnp.argmax(logits)))
+    return np.asarray(toks, np.int32)
+
+
+def _scheduler(role="unified", max_slots=4, num_blocks=64,
+               prefill_chunk=3, block_size=4):
+    sch = ContinuousBatchingScheduler(
+        CFG,
+        SchedulerConfig(
+            max_slots=max_slots, block_size=block_size,
+            num_blocks=num_blocks, max_seq_len=64,
+            prefill_chunk=prefill_chunk, temperature=0.0,
+        ),
+        role=role,
+    )
+    sch.sync_weights(PARAMS)
+    return sch
+
+
+def _slot_of(sch, slo_class):
+    for i, sl in enumerate(sch._slots):
+        if sl.req is not None and sl.req.slo_class == slo_class:
+            return i
+    raise AssertionError(f"no active {slo_class} slot")
+
+
+class TestClassAwarePreemption:
+    """The victim rule: fleet ON is class-aware, OFF is PR-14."""
+
+    def _age_batch_then_admit_interactive(self):
+        """Batch lane with a long generated tail, interactive lane
+        freshly admitted — the configuration where the PR-14 rule
+        (fewest generated) and the class-aware rule disagree."""
+        sch = _scheduler(max_slots=2)
+        sch.submit(np.array([5, 9, 2], np.int32), max_new=12,
+                   seed=1, slo_class="batch", tenant="bulk")
+        for _ in range(6):  # prefill + grow the batch tail
+            sch.step()
+        sch.submit(np.array([7, 1], np.int32), max_new=12,
+                   seed=2, slo_class="interactive", tenant="chat")
+        for _ in range(2):  # admit + first tokens
+            sch.step()
+        assert sch._slots[_slot_of(sch, "batch")].generated
+        return sch
+
+    def test_fleet_on_victim_is_batch_not_interactive(
+        self, monkeypatch
+    ):
+        """Fleet ON: the interactive lane has FEWER generated tokens
+        (the PR-14 victim), but the batch lane must be evicted —
+        batch outranks interactive as a victim, never the reverse."""
+        monkeypatch.setenv("DLROVER_TPU_SERVE_FLEET", "1")
+        sch = self._age_batch_then_admit_interactive()
+        b, i = _slot_of(sch, "batch"), _slot_of(sch, "interactive")
+        assert len(sch._slots[i].generated) < len(
+            sch._slots[b].generated
+        )
+        assert sch._pick_victim(exclude=-1) == b
+
+    def test_fleet_off_pins_pr14_victim_rule(self, monkeypatch):
+        """Fleet OFF: same traffic, and the fewest-generated lane
+        (here the younger request) is the victim again — the PR-16
+        behavior byte for byte."""
+        monkeypatch.setenv("DLROVER_TPU_SERVE_FLEET", "0")
+        sch = self._age_batch_then_admit_interactive()
+        slots = [
+            (i, sl) for i, sl in enumerate(sch._slots)
+            if sl.req is not None
+        ]
+        expect = min(
+            slots,
+            key=lambda t: (len(t[1].generated), -t[1].admit_seq),
+        )[0]
+        assert sch._pick_victim(exclude=-1) == expect
+
+    def test_fleet_on_preemption_churn_matches_reference(
+        self, monkeypatch
+    ):
+        """Mixed-class traffic through a pool small enough to force
+        preemption: every tail still equals the lone-sequence greedy
+        reference (restart-from-prompt is deterministic), and batch
+        lanes actually got preempted."""
+        monkeypatch.setenv("DLROVER_TPU_SERVE_FLEET", "1")
+        monkeypatch.setenv("DLROVER_TPU_KV_INCREMENTAL", "1")
+        monkeypatch.setenv("DLROVER_TPU_KV_GROW_BLOCKS", "1")
+        monkeypatch.setenv("DLROVER_TPU_KV_ADMIT_WATERMARK", "0")
+        sch = _scheduler(max_slots=4, num_blocks=9)
+        rng = np.random.default_rng(3)
+        prompts = [
+            rng.integers(0, 97, (int(rng.integers(2, 8)),)).astype(
+                np.int32
+            )
+            for _ in range(6)
+        ]
+        ids = [
+            sch.submit(
+                p, max_new=12, seed=60 + i,
+                slo_class=("interactive" if i % 3 == 0 else "batch"),
+                tenant=f"t{i % 2}",
+            )
+            for i, p in enumerate(prompts)
+        ]
+        res = {r.req_id: r for r in sch.run()}
+        assert sch.preemptions > 0
+        for rid, p in zip(ids, prompts):
+            np.testing.assert_array_equal(
+                res[rid].tokens, unbatched_reference(p, 12)
+            )
+
+
+class TestKVBlockShipping:
+    def test_extract_insert_roundtrip_bitwise(self):
+        """Tiles pulled from one pool and spliced into another at
+        DIFFERENT block ids are bit-exact, and untouched blocks of
+        the receiving pool keep their bytes."""
+        cache_cfg = PagedCacheConfig(
+            n_layers=2, n_kv_heads=2, head_dim=8, num_blocks=10,
+            block_size=4, dtype=jnp.float32,
+        )
+        rng = np.random.default_rng(7)
+        shape = init_block_pool(cache_cfg)["k"].shape
+        src = {
+            "k": jnp.asarray(rng.normal(size=shape), jnp.float32),
+            "v": jnp.asarray(rng.normal(size=shape), jnp.float32),
+        }
+        dst = {
+            "k": jnp.asarray(rng.normal(size=shape), jnp.float32),
+            "v": jnp.asarray(rng.normal(size=shape), jnp.float32),
+        }
+        before = {n: np.asarray(a) for n, a in dst.items()}
+        for src_ids, dst_ids in (
+            ([3], [7]),                      # single block
+            ([1, 4, 5], [2, 8, 9]),          # multi, non-contiguous
+        ):
+            k, v = extract_block_regions(src, src_ids)
+            np.testing.assert_array_equal(
+                k, np.asarray(src["k"])[:, src_ids]
+            )
+            out = insert_block_regions(dst, dst_ids, k, v)
+            for name, region in (("k", k), ("v", v)):
+                got = np.asarray(out[name])
+                assert (
+                    got[:, dst_ids].tobytes() == region.tobytes()
+                ), "shipped tiles must be bitwise-identical"
+                untouched = [
+                    b for b in range(10) if b not in dst_ids
+                ]
+                np.testing.assert_array_equal(
+                    got[:, untouched], before[name][:, untouched]
+                )
+
+    def test_adopted_decode_matches_reference_compile_once(
+        self, monkeypatch
+    ):
+        """End-to-end disaggregation in-process: a prefill-role
+        scheduler fills and ships the KV blocks, a second scheduler
+        adopts them and decodes.  The adopted tail equals the
+        lone-scheduler greedy reference (the ship is invisible), and
+        the decode program of the adopting scheduler stays at ONE
+        compile even while local requests interleave."""
+        monkeypatch.setenv("DLROVER_TPU_SERVE_FLEET", "1")
+        prompt = np.array(
+            [11, 3, 7, 8, 1, 2, 9, 30, 31], np.int32
+        )
+        pre = _scheduler(role="prefill", max_slots=2)
+        rid = pre.submit(prompt, max_new=6, seed=5)
+        for _ in range(20):
+            pre.step()
+            if pre.shipped:
+                break
+        assert len(pre.shipped) == 1
+        payload = pre.shipped.pop()
+        assert payload["req_id"] == rid
+        assert payload["n_blocks"] == len(prompt) // 4 + 1
+
+        dec = _scheduler(role="unified", max_slots=2)
+        # a local request first, so adoption lands in a scheduler
+        # whose decode program is already compiled and batched
+        local = dec.submit(
+            np.array([5, 9, 2], np.int32), max_new=6, seed=50
+        )
+        dec.step()
+        adopted = dec.submit(
+            prompt, max_new=6, seed=5,
+            shipped={
+                "k": payload["k"],
+                "v": payload["v"],
+                "first_token": payload["first_token"],
+            },
+        )
+        res = {r.req_id: r for r in dec.run()}
+        assert dec.shipped_in == 1
+        np.testing.assert_array_equal(
+            res[adopted].tokens, unbatched_reference(prompt, 6)
+        )
+        np.testing.assert_array_equal(
+            res[local].tokens,
+            unbatched_reference(np.array([5, 9, 2], np.int32), 6),
+        )
+        assert dec.compile_counts()["decode"] == 1
+
+
+class TestFleetKillSwitch:
+    """`DLROVER_TPU_SERVE_FLEET=0` pins the PR-16 scheduler surfaces."""
+
+    def test_off_pins_fifo_admission_and_drops_fleet_state(
+        self, monkeypatch
+    ):
+        monkeypatch.setenv("DLROVER_TPU_SERVE_FLEET", "0")
+        sch = _scheduler(role="prefill")  # role request is IGNORED
+        assert sch.role == "unified"
+        assert sch.interactive_slots == 0
+        sch.submit(np.array([5, 9, 2], np.int32), max_new=2, seed=1,
+                   slo_class="batch")
+        sch.submit(np.array([7, 1], np.int32), max_new=2, seed=2,
+                   slo_class="interactive")
+        # head-of-line FIFO: the interactive request does NOT jump
+        assert sch._pick_next_index() == 0
+        # a shipped payload is dropped at submit — no adoption path
+        sch.submit(
+            np.array([1, 2, 3], np.int32), max_new=2, seed=3,
+            shipped={"k": None, "v": None, "first_token": 0},
+        )
+        assert all(r.shipped is None for r in sch._queue)
+        res = sch.run()
+        assert len(res) == 3 and sch.shipped_in == 0
+
+    def test_on_admits_interactive_first(self, monkeypatch):
+        monkeypatch.setenv("DLROVER_TPU_SERVE_FLEET", "1")
+        sch = _scheduler()
+        sch.submit(np.array([5, 9, 2], np.int32), max_new=2, seed=1,
+                   slo_class="batch", tenant="bulk")
+        sch.submit(np.array([8, 4], np.int32), max_new=2, seed=2,
+                   slo_class="batch", tenant="bulk")
+        sch.submit(np.array([7, 1], np.int32), max_new=2, seed=3,
+                   slo_class="interactive", tenant="chat")
+        assert sch._queue[2].slo_class == "interactive"
+        assert sch._pick_next_index() == 2
+        assert sch._queued_interactive == 1
+        sch.run()
+        assert sch._queued_interactive == 0

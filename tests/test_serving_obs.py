@@ -428,7 +428,7 @@ class TestRingSchemaVersioning:
         assert stats["kv_utilization"] == 0.66
         assert stats["preemptions"] == 2
 
-    @pytest.mark.parametrize("bad_version", [1, 3])
+    @pytest.mark.parametrize("bad_version", [2, 4])
     def test_mismatch_is_typed_and_names_both_versions(
         self, bad_version
     ):
@@ -706,7 +706,7 @@ class TestServingEngineObservatory:
         slo = status["slo"]
         assert set(slo) == {
             "ttft_p99_s", "tbt_p99_s", "e2e_p99_s",
-            "queue_wait_p99_s",
+            "queue_wait_p99_s", "fleet_prefix_hit_rate",
         }
         assert slo["ttft_p99_s"] > 0
         assert slo["e2e_p99_s"] >= slo["ttft_p99_s"]
