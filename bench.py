@@ -707,6 +707,62 @@ def _run_serving_bench(budget: "BenchBudget" = None) -> dict:
         return {"error": str(e)}
 
 
+def _run_paged_kernels_bench(budget: "BenchBudget" = None) -> dict:
+    """Run scripts/bench_paged_attention.py in a subprocess: decode +
+    verify timings under both paged-attention backends (jnp gather
+    reference vs streamed Pallas kernels) across ≥3 context lengths,
+    with the pallas/jnp speedup ratio as the headline.  Informational
+    on CPU CI (interpret mode measures plumbing, not kernels); the
+    ≥1x bar applies on TPU."""
+    if os.getenv("DLROVER_BENCH_SKIP_SERVING"):
+        return {"skipped": True}
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "scripts", "bench_paged_attention.py",
+    )
+    out_file = os.path.join(
+        tempfile.mkdtemp(prefix="dlrover_bench_paged_"), "out.json"
+    )
+    timeout_s = 300
+    if budget is not None:
+        timeout_s = budget.cap_timeout(300, reserve_s=90)
+    env = dict(os.environ)
+    env[BUDGET_ENV] = str(int(max(30, timeout_s - 30)))
+    try:
+        proc = subprocess.run(
+            [sys.executable, script, "--out", out_file, "--reps", "3"],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=env,
+        )
+        parsed = _read_result_file(out_file, proc.stdout)
+        if parsed is not None:
+            out = {
+                k: v for k, v in parsed.items() if k != "points"
+            }
+            out["n_points"] = len(parsed.get("points", []))
+            # per-point summary: context -> (decode, verify) speedups
+            out["speedups"] = {
+                f"b{p['batch']}_c{p['context']}_bs{p['block_size']}": [
+                    p.get("decode_speedup"),
+                    p.get("verify_speedup"),
+                ]
+                for p in parsed.get("points", [])
+            }
+            return out
+        return {
+            "error": f"no JSON output (rc={proc.returncode})",
+            "stderr_tail": proc.stderr[-500:],
+        }
+    except subprocess.TimeoutExpired as e:
+        # the killed child flushed a partial payload per sweep point
+        # (run_sweep calls flush_fn after each point, not at the end)
+        return {"error": str(e), "partial": _read_result_file(out_file, "")}
+    except Exception as e:  # noqa: BLE001
+        return {"error": str(e)}
+
+
 def _run_serving_observatory(budget: "BenchBudget" = None) -> dict:
     """Run the serving-observatory leg (``bench_serving.py
     --observatory``) in a subprocess: the ServingHealthEngine must
@@ -935,6 +991,16 @@ def main(argv=None) -> int:
             extras["serving"] = {"skipped": "budget"}
         else:
             extras["serving"] = _run_serving_bench(budget)
+        flush_partial(args.out, payload)
+
+        # paged-attention kernel micro-bench: decode + verify, jnp
+        # gather reference vs streamed Pallas kernels, ≥3 context
+        # lengths; speedup ratio informational on CPU CI
+        # (scripts/bench_paged_attention.py)
+        if budget.tight(120):
+            extras["paged_kernels"] = {"skipped": "budget"}
+        else:
+            extras["paged_kernels"] = _run_paged_kernels_bench(budget)
         flush_partial(args.out, payload)
 
         # serving observatory: injected straggler + wedge must be

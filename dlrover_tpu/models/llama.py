@@ -625,7 +625,13 @@ def paged_decode_step(
     Inactive lanes write to the null block (id 0) and read garbage
     that callers discard; their table rows must be zeroed on eviction
     so a freed block re-issued to another sequence is never gathered
-    through a stale table."""
+    through a stale table.
+
+    The attention call dispatches per ``DLROVER_TPU_PAGED_KERNEL``
+    (``ops/paged_attention.paged_kernel_backend``): the streamed Pallas
+    decode kernel or the gather-based jnp reference.  The choice is
+    resolved at trace time, so the compile-once contract above holds
+    under either backend."""
     from dlrover_tpu.ops.paged_attention import (
         paged_decode_attention,
         write_block_kv,
@@ -723,7 +729,11 @@ def paged_verify_step(
     never touched, which keeps the drafted cache bit-identical whether
     or not verification ran.  Returns logits ``[B, C, vocab]`` (fp32);
     row ``i`` predicts the token at position ``positions[b] + i + 1``.
-    Inactive lanes compute on garbage their caller discards."""
+    Inactive lanes compute on garbage their caller discards.
+
+    The attention call dispatches per ``DLROVER_TPU_PAGED_KERNEL``:
+    the fused Pallas verify kernel shares one paged-prefix pass across
+    the window's C positions; the jnp reference re-gathers the pool."""
     from dlrover_tpu.ops.paged_attention import paged_verify_attention
 
     dt = cfg.dtype

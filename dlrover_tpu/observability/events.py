@@ -158,6 +158,12 @@ PHASE_KV_SHIP = "kv_ship"
 # unattributed time.
 PHASE_CONTROL_WAIT = "control_wait"
 
+# one paged-kernel autotune sweep (ops/autotune.py): the tuner timed
+# every legal (q-block, kv-block) candidate for one shape key and
+# persisted the winner — the span is the audit record of WHY the
+# cached config is what it is
+PHASE_KERNEL_AUTOTUNE = "kernel_autotune"
+
 PHASES: Tuple[str, ...] = (
     PHASE_DATA_STALL,
     PHASE_STEP,
@@ -186,6 +192,7 @@ PHASES: Tuple[str, ...] = (
     PHASE_SERVE_REQUEST,
     PHASE_KV_SHIP,
     PHASE_CONTROL_WAIT,
+    PHASE_KERNEL_AUTOTUNE,
 )
 
 #: Phases that count as useful training time in the ledger.
@@ -367,6 +374,16 @@ REQUIRED_SPAN_LABELS: Dict[str, Tuple[str, ...]] = {
     # a resume without the restored tail size can't distinguish a
     # cheap re-admission from re-prefilling hundreds of tokens
     PHASE_RESUME: ("req_id", "resume_tokens"),
+    # an autotune event without the shape's winner and the sweep size
+    # is unauditable: which kernel, what config won, out of how many
+    # legal candidates, at what best time — the four numbers let a
+    # later regression be traced to "the cache picked THIS because"
+    PHASE_KERNEL_AUTOTUNE: (
+        "kernel",
+        "best_config",
+        "candidates",
+        "best_us",
+    ),
 }
 
 

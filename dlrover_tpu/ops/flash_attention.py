@@ -144,7 +144,11 @@ def _flash_fwd_kernel(
 
 
 def _use_interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    # Hoisted to ops/pallas_utils.py so the paged kernels share one
+    # policy and one override env (DLROVER_TPU_PALLAS_INTERPRET).
+    from dlrover_tpu.ops.pallas_utils import use_interpret
+
+    return use_interpret()
 
 
 @functools.partial(

@@ -45,12 +45,58 @@ touch a sequence's private tail blocks (``rl/kv_cache.py`` enforces
 the ownership discipline).
 """
 
-from typing import Tuple
+import os
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
+
+#: Backend selector for the decode-hot ops (decode + verify; prefill
+#: stays jnp).  ``auto`` picks the Pallas kernels whenever they can run
+#: (compiled on TPU, interpret mode elsewhere); ``jnp`` is the
+#: kill-switch that pins the original gather-based reference
+#: byte-for-byte; ``pallas`` forces the kernels even if import fails
+#: (loudly).
+PAGED_KERNEL_ENV = "DLROVER_TPU_PAGED_KERNEL"
+
+_VALID_BACKENDS = ("auto", "pallas", "jnp")
+
+
+def paged_kernel_backend() -> str:
+    """Resolve the active decode/verify backend: ``pallas`` or ``jnp``.
+
+    ``auto`` picks the Pallas kernels where they compile to metal (a
+    TPU host), and on other hosts only when interpret mode is
+    explicitly forced (``DLROVER_TPU_PALLAS_INTERPRET=1`` — the
+    run-the-real-kernel-slowly debug/CI switch); otherwise the jnp
+    reference, which XLA fuses well enough on CPU that interpret mode
+    would only burn CI wall-clock.  ``DLROVER_TPU_PAGED_KERNEL=pallas``
+    forces the kernels anywhere (interpret off-TPU).
+
+    Read at trace time: the scheduler's jitted decode step bakes the
+    choice into its one compiled executable, so
+    ``compile_counts()["decode"] == 1`` holds under either backend.
+    """
+    env = os.getenv(PAGED_KERNEL_ENV, "auto").strip().lower() or "auto"
+    if env not in _VALID_BACKENDS:
+        raise ValueError(
+            f"{PAGED_KERNEL_ENV}={env!r}: expected one of {_VALID_BACKENDS}"
+        )
+    if env != "auto":
+        return env
+    on_tpu = jax.default_backend() == "tpu"
+    if not on_tpu:
+        from dlrover_tpu.ops.pallas_utils import INTERPRET_ENV, _TRUE
+
+        if os.getenv(INTERPRET_ENV, "").strip().lower() not in _TRUE:
+            return "jnp"
+    try:
+        from dlrover_tpu.ops import paged_kernels  # noqa: F401
+    except Exception:  # pragma: no cover - pallas unavailable
+        return "jnp"
+    return "pallas"
 
 
 def _gather_pool(pool: jnp.ndarray, tables: jnp.ndarray) -> jnp.ndarray:
@@ -68,14 +114,21 @@ def paged_decode_attention(
     v_pool: jnp.ndarray,  # [num_blocks, block_size, KV, D]
     block_tables: jnp.ndarray,  # [B, max_blocks] int32 block ids
     seq_lens: jnp.ndarray,  # [B] int32: valid positions per sequence
+    backend: Optional[str] = None,  # None -> DLROVER_TPU_PAGED_KERNEL
 ) -> jnp.ndarray:
     """Single-token GQA attention over each sequence's paged prefix.
 
     Returns ``[B, H, D]``.  fp32 logits/softmax accumulation (the MXU
     contract the dense kernels follow); masked lanes contribute
     exactly zero weight, so garbage in unallocated/null blocks can
-    never leak into the output.
+    never leak into the output.  Lanes with ``seq_lens == 0`` return
+    exact zeros.  Dispatches to the streamed Pallas kernel or this jnp
+    reference per ``backend`` / :func:`paged_kernel_backend`.
     """
+    if (backend or paged_kernel_backend()) == "pallas":
+        from dlrover_tpu.ops.paged_kernels import paged_decode_kernel
+
+        return paged_decode_kernel(q, k_pool, v_pool, block_tables, seq_lens)
     b, nh, d = q.shape
     nkv = k_pool.shape[2]
     group = nh // nkv
@@ -89,6 +142,9 @@ def paged_decode_attention(
     valid = jnp.arange(t)[None] < seq_lens[:, None]  # [B, T]
     logits = jnp.where(valid[:, None, None], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
+    # Empty lanes (seq_lens == 0) have every key masked; softmax over
+    # an all-NEG_INF row is uniform-over-garbage, so zero it outright.
+    probs = jnp.where(seq_lens[:, None, None, None] > 0, probs, 0.0)
     out = jnp.einsum(
         "bkgt,btkd->bkgd",
         probs.astype(v.dtype),
@@ -138,6 +194,7 @@ def paged_verify_attention(
     v_pool: jnp.ndarray,  # [num_blocks, block_size, KV, D]
     block_tables: jnp.ndarray,  # [B, max_blocks] int32 block ids
     positions: jnp.ndarray,  # [B] int32: lane's first window position
+    backend: Optional[str] = None,  # None -> DLROVER_TPU_PAGED_KERNEL
 ) -> jnp.ndarray:
     """Batched-lane windowed attention: query ``i`` of lane ``b`` (at
     position ``positions[b] + i``) attends keys at positions
@@ -146,7 +203,13 @@ def paged_verify_attention(
     draft loop wrote it); this op never writes.  Returns
     ``[B, C, H, D]``.  The decode-hot verify forward of speculative
     multi-token decode: one call scores a K-token draft for every
-    lane."""
+    lane.  Dispatches like :func:`paged_decode_attention`: the fused
+    Pallas verify kernel shares one prefix pass across the K window
+    positions; this jnp reference re-gathers the pool."""
+    if (backend or paged_kernel_backend()) == "pallas":
+        from dlrover_tpu.ops.paged_kernels import paged_verify_kernel
+
+        return paged_verify_kernel(q, k_pool, v_pool, block_tables, positions)
     b, c, nh, d = q.shape
     nkv = k_pool.shape[2]
     group = nh // nkv

@@ -1,0 +1,452 @@
+"""Pallas/Mosaic kernels for paged attention (decode + K-step verify).
+
+The jnp reference path in ``ops/paged_attention.py`` services one
+decode token by *gathering* the sequence's entire paged prefix into a
+dense ``[B, max_blocks*block_size, KV, D]`` tensor — O(context) HBM
+traffic for O(1) new work.  The kernels here stream the K/V pool
+block-by-block through the Pallas grid instead:
+
+- the block table and sequence lengths ride in as **scalar-prefetch**
+  operands (``pltpu.PrefetchScalarGridSpec``), so the BlockSpec index
+  maps dereference ``tables[b, j]`` *before* each grid step and the
+  pipeline fetches exactly one pool page per step — the gather never
+  materializes;
+- softmax runs **online** per lane (running ``(m, l, acc)`` in VMEM
+  scratch, the flash-attention recipe from ``ops/flash_attention.py``)
+  with fp32 logits and accumulation;
+- lanes past ``seq_lens`` and null-block-0 reads contribute exactly
+  zero weight: out-of-window columns are masked to ``NEG_INF`` *and*
+  their probability rows are zeroed explicitly, so a fully-masked lane
+  (``seq_lens == 0``) returns exact zeros rather than uniform weights
+  over garbage;
+- the per-lane **early exit** is in the index map: page indices are
+  clamped to the lane's last valid block, so consecutive grid steps
+  past a short sequence re-request the same page and the pipeline
+  elides the copy — short lanes in a mixed batch don't pay the longest
+  lane's traffic — while ``pl.when`` skips their FLOPs.
+
+Layout contract (established in PR 13, unchanged): pools are
+``[num_blocks, block_size, KV, head_dim]`` with ``head_dim`` minormost
+and ``block_size`` on the sublane axis; block 0 is the null block and
+is garbage by design.  One grid step fetches one whole page —
+``[block_size, KV, head_dim]`` — and a static Python loop over the KV
+heads runs each head's GQA row-block against its slice, so a single
+page fetch serves every head.
+
+Tunables per kernel (see ``ops/autotune.py``): ``q_rows`` (padded
+query rows per KV head, a legal Mosaic sublane tile) and ``kv_span``
+(pool pages streamed per grid step; the pool is passed ``kv_span``
+times with staggered index maps, which is how a Pallas kernel widens
+its KV block without regathering).
+
+CPU CI runs these kernels in interpret mode
+(``ops/pallas_utils.use_interpret``); on TPU the same bodies lower to
+Mosaic unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dlrover_tpu.ops.pallas_utils import use_interpret
+
+NEG_INF = -1e30
+
+
+def sublane_tile(dtype) -> int:
+    """Minimum legal Mosaic sublane tile for ``dtype`` (lane is 128)."""
+    itemsize = np.dtype(dtype).itemsize
+    if itemsize >= 4:
+        return 8
+    if itemsize == 2:
+        return 16
+    return 32
+
+
+def _round_up(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def _iota_rows(n: int) -> jnp.ndarray:
+    return lax.broadcasted_iota(jnp.int32, (n, 1), 0)
+
+
+def _iota_cols(n: int) -> jnp.ndarray:
+    return lax.broadcasted_iota(jnp.int32, (1, n), 1)
+
+
+def _online_update(m_scr, l_scr, acc_scr, rows, s_log, v, keep):
+    """One online-softmax step for scratch rows ``rows`` (static slice).
+
+    ``s_log`` is fp32 ``[R, bs]`` raw logits, ``keep`` a bool mask of
+    the same shape, ``v`` fp32 ``[bs, D]`` with garbage rows already
+    zeroed.  Probabilities are re-zeroed after the exp so a row with no
+    visible keys accumulates ``l == 0`` (→ exact-zero output at
+    finalize) instead of the uniform-over-garbage a plain softmax
+    produces.
+    """
+    s_log = jnp.where(keep, s_log, NEG_INF)
+    m_prev = m_scr[rows, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s_log, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.where(keep, jnp.exp(s_log - m_new), 0.0)
+    l_new = l_scr[rows, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_scr[rows] = acc_scr[rows] * alpha + lax.dot_general(
+        p,
+        v,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_scr[rows] = jnp.broadcast_to(m_new, m_scr[rows].shape)
+    l_scr[rows] = jnp.broadcast_to(l_new, l_scr[rows].shape)
+
+
+def _init_state(m_scr, l_scr, acc_scr):
+    m_scr[...] = jnp.full(m_scr.shape, NEG_INF, dtype=m_scr.dtype)
+    l_scr[...] = jnp.zeros(l_scr.shape, dtype=l_scr.dtype)
+    acc_scr[...] = jnp.zeros(acc_scr.shape, dtype=acc_scr.dtype)
+
+
+def _finalize(o_ref, m_scr, l_scr, acc_scr):
+    denom = jnp.maximum(l_scr[:, :1], 1e-30)
+    o_ref[0] = (acc_scr[...] / denom).astype(o_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# decode: one query token per lane
+# ---------------------------------------------------------------------------
+
+
+def _decode_kernel(
+    tables_ref,  # scalar prefetch [B, MB] — unused in body (index maps only)
+    lens_ref,  # scalar prefetch [B]
+    q_ref,  # [1, KV*GP, D]
+    *rest,
+    span: int,
+    block_size: int,
+    n_kv: int,
+    gp: int,
+    scale: float,
+):
+    k_refs = rest[:span]
+    v_refs = rest[span : 2 * span]
+    o_ref = rest[2 * span]
+    m_scr, l_scr, acc_scr = rest[2 * span + 1 :]
+    del tables_ref
+
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+    seq_len = lens_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        _init_state(m_scr, l_scr, acc_scr)
+
+    # Early exit: lanes whose prefix ended before this span of pages do
+    # no work (their pages were index-clamped, so no fresh copy either).
+    @pl.when(j * span * block_size < seq_len)
+    def _compute():
+        for s in range(span):
+            start = (j * span + s) * block_size
+            k_page = k_refs[s][0].astype(jnp.float32)  # [bs, KV, D]
+            v_page = v_refs[s][0].astype(jnp.float32)
+            col = start + _iota_cols(block_size)  # [1, bs]
+            keep = col < seq_len  # [1, bs]
+            # Zero garbage V rows: 0 * NaN would poison the accumulator.
+            v_page = jnp.where(keep.T[:, :, None], v_page, 0.0)
+            for h in range(n_kv):
+                rows = slice(h * gp, (h + 1) * gp)
+                s_log = (
+                    lax.dot_general(
+                        q_ref[0, rows].astype(jnp.float32),
+                        k_page[:, h, :],
+                        (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                    * scale
+                )  # [GP, bs]
+                _online_update(
+                    m_scr,
+                    l_scr,
+                    acc_scr,
+                    rows,
+                    s_log,
+                    v_page[:, h, :],
+                    jnp.broadcast_to(keep, s_log.shape),
+                )
+
+    @pl.when(j == nj - 1)
+    def _done():
+        _finalize(o_ref, m_scr, l_scr, acc_scr)
+
+
+def paged_decode_kernel(
+    q: jnp.ndarray,  # [B, H, D]
+    k_pool: jnp.ndarray,  # [N, bs, KV, D]
+    v_pool: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, MB] int32
+    seq_lens: jnp.ndarray,  # [B] int32
+    *,
+    config: Optional[Dict[str, Any]] = None,
+) -> jnp.ndarray:
+    """Streamed paged GQA decode attention. Drop-in for the jnp path."""
+    from dlrover_tpu.ops import autotune
+
+    batch, n_heads, head_dim = q.shape
+    _, block_size, n_kv, _ = k_pool.shape
+    group = n_heads // n_kv
+    max_blocks = block_tables.shape[1]
+    if config is None:
+        config = autotune.get_config(
+            "decode",
+            group=group,
+            head_dim=head_dim,
+            block_size=block_size,
+            max_blocks=max_blocks,
+            dtype=q.dtype,
+        )
+    span = max(1, min(int(config.get("kv_span", 1)), max_blocks))
+    gp = max(int(config.get("q_rows", group)), group)
+    nj = -(-max_blocks // span)
+
+    qg = q.reshape(batch, n_kv, group, head_dim)
+    if gp > group:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - group), (0, 0)))
+    qg = qg.reshape(batch, n_kv * gp, head_dim)
+
+    def _q_index(b, j, tables, lens):
+        del j, tables, lens
+        return (b, 0, 0)
+
+    def _page_index(b, j, tables, lens, s=0):
+        # Clamp to the lane's last valid block: grid steps past a short
+        # sequence re-request the same page, and the pipeline elides
+        # the copy (the per-lane early exit for traffic).
+        last = jnp.maximum(lax.div(lens[b] + block_size - 1, block_size) - 1, 0)
+        idx = jnp.minimum(j * span + s, jnp.minimum(last, max_blocks - 1))
+        return (tables[b, idx], 0, 0, 0)
+
+    kv_specs = [
+        pl.BlockSpec(
+            (1, block_size, n_kv, head_dim),
+            functools.partial(_page_index, s=s),
+        )
+        for s in range(span)
+    ]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(batch, nj),
+        in_specs=[pl.BlockSpec((1, n_kv * gp, head_dim), _q_index)]
+        + kv_specs
+        + kv_specs,
+        out_specs=pl.BlockSpec((1, n_kv * gp, head_dim), _q_index),
+        scratch_shapes=[
+            pltpu.VMEM((n_kv * gp, 128), jnp.float32),
+            pltpu.VMEM((n_kv * gp, 128), jnp.float32),
+            pltpu.VMEM((n_kv * gp, head_dim), jnp.float32),
+        ],
+    )
+
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_kernel,
+            span=span,
+            block_size=block_size,
+            n_kv=n_kv,
+            gp=gp,
+            scale=head_dim**-0.5,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((batch, n_kv * gp, head_dim), q.dtype),
+        interpret=use_interpret(),
+    )(
+        block_tables.astype(jnp.int32),
+        seq_lens.astype(jnp.int32),
+        qg,
+        *([k_pool] * span),
+        *([v_pool] * span),
+    )
+
+    out = out.reshape(batch, n_kv, gp, head_dim)[:, :, :group]
+    return out.reshape(batch, n_heads, head_dim)
+
+
+# ---------------------------------------------------------------------------
+# verify: K speculative query positions per lane share one prefix pass
+# ---------------------------------------------------------------------------
+
+
+def _verify_kernel(
+    tables_ref,
+    pos_ref,  # scalar prefetch [B] — position of each lane's first query
+    q_ref,  # [1, KV*WP, D]
+    *rest,
+    span: int,
+    block_size: int,
+    n_kv: int,
+    group: int,
+    window: int,
+    wp: int,
+    scale: float,
+):
+    k_refs = rest[:span]
+    v_refs = rest[span : 2 * span]
+    o_ref = rest[2 * span]
+    m_scr, l_scr, acc_scr = rest[2 * span + 1 :]
+    del tables_ref
+
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+    pos = pos_ref[b]
+    horizon = pos + window - 1  # last key any of the K queries may see
+
+    @pl.when(j == 0)
+    def _init():
+        _init_state(m_scr, l_scr, acc_scr)
+
+    @pl.when(j * span * block_size <= horizon)
+    def _compute():
+        # Row r of a head's WP-row block is query offset r // group
+        # (rows r >= window*group are padding and fully masked).
+        row = _iota_rows(wp)  # [WP, 1]
+        q_pos = pos + row // group
+        row_ok = row < window * group
+        for s in range(span):
+            start = (j * span + s) * block_size
+            k_page = k_refs[s][0].astype(jnp.float32)
+            v_page = v_refs[s][0].astype(jnp.float32)
+            col = start + _iota_cols(block_size)  # [1, bs]
+            # A key is garbage unless visible to at least the last query.
+            v_page = jnp.where((col <= horizon).T[:, :, None], v_page, 0.0)
+            keep = (col <= q_pos) & row_ok  # [WP, bs] causal window
+            for h in range(n_kv):
+                rows = slice(h * wp, (h + 1) * wp)
+                s_log = (
+                    lax.dot_general(
+                        q_ref[0, rows].astype(jnp.float32),
+                        k_page[:, h, :],
+                        (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                    * scale
+                )
+                _online_update(
+                    m_scr, l_scr, acc_scr, rows, s_log, v_page[:, h, :], keep
+                )
+
+    @pl.when(j == nj - 1)
+    def _done():
+        _finalize(o_ref, m_scr, l_scr, acc_scr)
+
+
+def paged_verify_kernel(
+    q: jnp.ndarray,  # [B, C, H, D] — C = draft window (K steps)
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, MB] int32
+    positions: jnp.ndarray,  # [B] int32 — position of q[:, 0]
+    *,
+    config: Optional[Dict[str, Any]] = None,
+) -> jnp.ndarray:
+    """Fused K-step speculative verify: one paged-prefix pass serves all
+    K query positions of a lane, window mask applied in-kernel."""
+    from dlrover_tpu.ops import autotune
+
+    batch, window, n_heads, head_dim = q.shape
+    _, block_size, n_kv, _ = k_pool.shape
+    group = n_heads // n_kv
+    max_blocks = block_tables.shape[1]
+    rows = window * group
+    if config is None:
+        config = autotune.get_config(
+            "verify",
+            group=group,
+            head_dim=head_dim,
+            block_size=block_size,
+            max_blocks=max_blocks,
+            dtype=q.dtype,
+            window=window,
+        )
+    span = max(1, min(int(config.get("kv_span", 1)), max_blocks))
+    wp = max(int(config.get("q_rows", rows)), rows)
+    nj = -(-max_blocks // span)
+
+    # [B, C, KV, G, D] -> [B, KV, C*G, D]: a head's K windows are
+    # contiguous rows, padded to wp per head.
+    qg = q.reshape(batch, window, n_kv, group, head_dim)
+    qg = qg.transpose(0, 2, 1, 3, 4).reshape(batch, n_kv, rows, head_dim)
+    if wp > rows:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, wp - rows), (0, 0)))
+    qg = qg.reshape(batch, n_kv * wp, head_dim)
+
+    def _q_index(b, j, tables, pos):
+        del j, tables, pos
+        return (b, 0, 0)
+
+    def _page_index(b, j, tables, pos, s=0):
+        last = jnp.maximum(
+            lax.div(pos[b] + window - 1 + block_size, block_size) - 1, 0
+        )
+        idx = jnp.minimum(j * span + s, jnp.minimum(last, max_blocks - 1))
+        return (tables[b, idx], 0, 0, 0)
+
+    kv_specs = [
+        pl.BlockSpec(
+            (1, block_size, n_kv, head_dim),
+            functools.partial(_page_index, s=s),
+        )
+        for s in range(span)
+    ]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(batch, nj),
+        in_specs=[pl.BlockSpec((1, n_kv * wp, head_dim), _q_index)]
+        + kv_specs
+        + kv_specs,
+        out_specs=pl.BlockSpec((1, n_kv * wp, head_dim), _q_index),
+        scratch_shapes=[
+            pltpu.VMEM((n_kv * wp, 128), jnp.float32),
+            pltpu.VMEM((n_kv * wp, 128), jnp.float32),
+            pltpu.VMEM((n_kv * wp, head_dim), jnp.float32),
+        ],
+    )
+
+    out = pl.pallas_call(
+        functools.partial(
+            _verify_kernel,
+            span=span,
+            block_size=block_size,
+            n_kv=n_kv,
+            group=group,
+            window=window,
+            wp=wp,
+            scale=head_dim**-0.5,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((batch, n_kv * wp, head_dim), q.dtype),
+        interpret=use_interpret(),
+    )(
+        block_tables.astype(jnp.int32),
+        positions.astype(jnp.int32),
+        qg,
+        *([k_pool] * span),
+        *([v_pool] * span),
+    )
+
+    out = out.reshape(batch, n_kv, wp, head_dim)[:, :, :rows]
+    out = out.reshape(batch, n_kv, window, group, head_dim)
+    return out.transpose(0, 2, 1, 3, 4).reshape(
+        batch, window, n_heads, head_dim
+    )

@@ -1,0 +1,47 @@
+"""Shared plumbing for the repo's Pallas/Mosaic kernel families.
+
+Both kernel families (``ops/flash_attention.py`` dense flash and
+``ops/paged_kernels.py`` paged decode/verify) compile to Mosaic on TPU
+and fall back to Pallas *interpret mode* everywhere else, so CPU CI
+exercises the exact same kernel bodies the TPU runs — just slowly.
+That policy used to live as a private ``_use_interpret`` helper inside
+``flash_attention.py``; it is hoisted here so every kernel family
+answers the question the same way and honors the same override.
+
+Env contract (one env for all kernels):
+
+- ``DLROVER_TPU_PALLAS_INTERPRET=1|true|on``  -> force interpret mode,
+  even on a TPU host (useful for printf-debugging a kernel body).
+- ``DLROVER_TPU_PALLAS_INTERPRET=0|false|off`` -> force compiled mode;
+  on a non-TPU host Mosaic will refuse to lower and the call fails
+  loudly — this is a "prove I am on metal" switch, not a fast path.
+- unset -> interpret exactly when the default JAX backend is not TPU
+  (the original ``flash_attention._use_interpret`` behavior, preserved
+  byte-for-byte).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+INTERPRET_ENV = "DLROVER_TPU_PALLAS_INTERPRET"
+
+_TRUE = ("1", "true", "on", "yes")
+_FALSE = ("0", "false", "off", "no")
+
+
+def use_interpret() -> bool:
+    """Should Pallas kernels run in interpret mode on this host?
+
+    Read at trace time (the value is baked into each compiled
+    executable), so flipping the env between jits takes effect on the
+    next trace, not retroactively.
+    """
+    raw = os.getenv(INTERPRET_ENV, "").strip().lower()
+    if raw in _TRUE:
+        return True
+    if raw in _FALSE:
+        return False
+    return jax.default_backend() != "tpu"

@@ -519,8 +519,11 @@ class ContinuousBatchingScheduler:
         }
 
     def stats(self) -> Dict:
+        from dlrover_tpu.ops.paged_attention import paged_kernel_backend
+
         st = dict(self.block_pool.stats())
         st.update(
+            kernel_backend=paged_kernel_backend(),
             queue_depth=self.queue_depth,
             active=self.active_count,
             iterations=self.iterations,

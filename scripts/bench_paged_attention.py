@@ -1,0 +1,254 @@
+#!/usr/bin/env python
+"""Micro-bench: paged-attention kernels, jnp reference vs Pallas.
+
+Times the two decode-hot ops (single-token decode, K-step verify)
+under both backends across a sweep of (batch, context, block_size)
+points, on whatever backend is live — compiled Mosaic on TPU,
+interpret mode on CPU CI (where the Pallas numbers are *informational*:
+interpret mode measures correctness plumbing, not kernel speed; the
+speedup bar applies on metal).
+
+Output (``--out``): JSON with one record per sweep point carrying
+``decode_us`` / ``verify_us`` per backend and the pallas/jnp speedup
+ratios, flushed atomically **after every sweep point** so a budget
+kill never loses completed measurements.  Honors
+``DLROVER_TPU_BENCH_BUDGET_S`` (stops sweeping, never mid-point).
+
+``--autotune`` additionally runs the shape-keyed tuner
+(``ops/autotune.py``) on each sweep point's decode/verify shape before
+timing, so the pallas numbers reflect the tuned config and the tuning
+events land on the timeline (``kernel_autotune`` spans).
+
+Wired into ``bench.py`` as the ``extras.paged_kernels`` leg.
+"""
+
+import argparse
+import functools
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+BUDGET_ENV = "DLROVER_TPU_BENCH_BUDGET_S"
+
+#: (batch, context, block_size) sweep — ≥3 context lengths
+DEFAULT_SWEEP = (
+    (4, 64, 8),
+    (4, 128, 8),
+    (8, 256, 8),
+    (8, 256, 16),
+)
+VERIFY_WINDOW = 4
+
+
+def _time_call(call, reps: int) -> float:
+    """Best-of-reps wall microseconds for an already-warm callable."""
+    best = float("inf")
+    for _ in range(max(1, reps)):
+        t0 = time.perf_counter()
+        call()
+        best = min(best, (time.perf_counter() - t0) * 1e6)
+    return best
+
+
+def _make_point(batch, context, block_size, *, heads=4, kv_heads=2, head_dim=8,
+                seed=0):
+    """Concrete arrays for one sweep point: a pool with every lane's
+    prefix at ``context`` tokens (plus one ragged short lane, the mixed
+    batch the early-exit path exists for)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    max_blocks = -(-context // block_size)
+    num_blocks = batch * max_blocks + 1  # + null block 0
+    q = jnp.asarray(
+        rng.standard_normal((batch, heads, head_dim)), jnp.float32
+    )
+    qv = jnp.asarray(
+        rng.standard_normal((batch, VERIFY_WINDOW, heads, head_dim)),
+        jnp.float32,
+    )
+    k_pool = jnp.asarray(
+        rng.standard_normal((num_blocks, block_size, kv_heads, head_dim)),
+        jnp.float32,
+    )
+    v_pool = jnp.asarray(
+        rng.standard_normal((num_blocks, block_size, kv_heads, head_dim)),
+        jnp.float32,
+    )
+    tables = jnp.asarray(
+        1 + np.arange(batch * max_blocks).reshape(batch, max_blocks),
+        jnp.int32,
+    )
+    seq_lens = np.full((batch,), context, np.int64)
+    seq_lens[-1] = max(context // 4, 1)  # one short lane in the mix
+    seq_lens = jnp.asarray(seq_lens, jnp.int32)
+    positions = jnp.maximum(seq_lens - VERIFY_WINDOW, 0)
+    return dict(
+        q=q, qv=qv, k_pool=k_pool, v_pool=v_pool, tables=tables,
+        seq_lens=seq_lens, positions=positions,
+    )
+
+
+def _bench_point(point, reps: int, autotune: bool):
+    """Time decode + verify under both backends for one sweep point."""
+    import jax
+
+    from dlrover_tpu.ops import autotune as at
+    from dlrover_tpu.ops import paged_attention as pa
+
+    a = _make_point(*point)
+    shape_kw = dict(
+        group=a["q"].shape[1] // a["k_pool"].shape[2],
+        head_dim=a["q"].shape[2],
+        block_size=a["k_pool"].shape[1],
+        max_blocks=a["tables"].shape[1],
+        dtype=a["q"].dtype,
+    )
+
+    def decode_fn(backend, config=None):
+        if backend == "pallas" and config is not None:
+            from dlrover_tpu.ops.paged_kernels import paged_decode_kernel
+
+            fn = jax.jit(functools.partial(paged_decode_kernel, config=config))
+        else:
+            fn = jax.jit(
+                functools.partial(pa.paged_decode_attention, backend=backend)
+            )
+
+        def call():
+            fn(
+                a["q"], a["k_pool"], a["v_pool"], a["tables"], a["seq_lens"]
+            ).block_until_ready()
+
+        return call
+
+    def verify_fn(backend, config=None):
+        if backend == "pallas" and config is not None:
+            from dlrover_tpu.ops.paged_kernels import paged_verify_kernel
+
+            fn = jax.jit(functools.partial(paged_verify_kernel, config=config))
+        else:
+            fn = jax.jit(
+                functools.partial(pa.paged_verify_attention, backend=backend)
+            )
+
+        def call():
+            fn(
+                a["qv"], a["k_pool"], a["v_pool"], a["tables"], a["positions"]
+            ).block_until_ready()
+
+        return call
+
+    rec = {
+        "batch": point[0],
+        "context": point[1],
+        "block_size": point[2],
+        "verify_window": VERIFY_WINDOW,
+    }
+    if autotune:
+        for kernel, make in (("decode", decode_fn), ("verify", verify_fn)):
+            kw = dict(shape_kw)
+            if kernel == "verify":
+                kw["window"] = VERIFY_WINDOW
+            best, report = at.tune_kernel(
+                kernel,
+                lambda cfg, make=make: make("pallas", cfg),
+                at.candidates(kernel, **kw),
+                key=at.shape_key(kernel, **kw),
+                reps=reps,
+            )
+            rec[f"{kernel}_tuned_config"] = best
+            rec[f"{kernel}_tuned_report"] = report
+    for kernel, make in (("decode", decode_fn), ("verify", verify_fn)):
+        for backend in ("jnp", "pallas"):
+            call = make(backend)
+            call()  # warmup: compile outside the clock
+            rec[f"{kernel}_{backend}_us"] = round(_time_call(call, reps), 3)
+        rec[f"{kernel}_speedup"] = round(
+            rec[f"{kernel}_jnp_us"] / max(rec[f"{kernel}_pallas_us"], 1e-9), 4
+        )
+    return rec
+
+
+def run_sweep(sweep=DEFAULT_SWEEP, reps: int = 5, autotune: bool = False,
+              flush_fn=None, budget_s=None):
+    """Bench every sweep point, calling ``flush_fn(payload)`` after each
+    (the per-point flush tier-1 smoke-tests).  Stops early — between
+    points, never mid-point — when the wall budget runs low."""
+    import jax
+
+    if budget_s is None:
+        raw = os.getenv(BUDGET_ENV, "")
+        budget_s = float(raw) if raw else None
+    t0 = time.monotonic()
+    payload = {
+        "bench": "paged_attention",
+        "backend": jax.default_backend(),
+        "interpret": _interpret(),
+        "points": [],
+        "skipped_points": 0,
+        "complete": False,
+    }
+    for i, point in enumerate(sweep):
+        if budget_s is not None and (time.monotonic() - t0) > budget_s * 0.8:
+            payload["skipped_points"] = len(sweep) - i
+            break
+        payload["points"].append(_bench_point(point, reps, autotune))
+        if flush_fn is not None:
+            flush_fn(payload)
+    payload["complete"] = payload["skipped_points"] == 0
+    payload["elapsed_s"] = round(time.monotonic() - t0, 3)
+    if payload["points"]:
+        payload["decode_speedup_best"] = max(
+            p["decode_speedup"] for p in payload["points"]
+        )
+        payload["verify_speedup_best"] = max(
+            p["verify_speedup"] for p in payload["points"]
+        )
+    if flush_fn is not None:
+        flush_fn(payload)
+    return payload
+
+
+def _interpret() -> bool:
+    from dlrover_tpu.ops.pallas_utils import use_interpret
+
+    return use_interpret()
+
+
+def _flush(out_file: str, payload) -> None:
+    tmp = out_file + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2)
+    os.replace(tmp, out_file)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="bench_paged_attention.json")
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument(
+        "--autotune",
+        action="store_true",
+        help="run the shape-keyed tuner per sweep point before timing",
+    )
+    args = ap.parse_args(argv)
+
+    payload = run_sweep(
+        reps=args.reps,
+        autotune=args.autotune,
+        flush_fn=lambda p: _flush(args.out, p),
+    )
+    print(json.dumps({k: v for k, v in payload.items() if k != "points"}))
+    print(f"wrote {args.out} ({len(payload['points'])} points)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -577,6 +577,40 @@ def run_prefix(cfg, params, n_requests: int):
     return out
 
 
+def run_kernel_compare(cfg, params, n_requests: int):
+    """End-to-end tokens/s through the continuous-batching scheduler
+    under BOTH paged-attention backends (ISSUE 18): the same closed-
+    loop workload once with the jnp gather reference, once with the
+    streamed Pallas kernels.  Each run builds a fresh scheduler, so
+    the trace-time backend dispatch re-resolves cleanly — and each
+    run's compiled-program census must still report one decode
+    program.  On CPU CI pallas runs in interpret mode, so the ratio
+    is informational there (the ≥1x bar applies on TPU); the token
+    *count* equality is load-bearing everywhere."""
+    workload = make_workload(n_requests, seed=11)
+    out = {}
+    for be in ("jnp", "pallas"):
+        undo = _scoped_env({"DLROVER_TPU_PAGED_KERNEL": be})
+        try:
+            res = run_continuous(cfg, params, workload)
+        finally:
+            undo()
+        out[be] = {
+            "tokens_per_s": res["tokens_per_s"],
+            "new_tokens": res["new_tokens"],
+            "requests": res["requests"],
+        }
+    out["tokens_per_s_ratio"] = round(
+        out["pallas"]["tokens_per_s"]
+        / max(out["jnp"]["tokens_per_s"], 1e-9),
+        4,
+    )
+    out["same_token_count"] = bool(
+        out["pallas"]["new_tokens"] == out["jnp"]["new_tokens"]
+    )
+    return out
+
+
 def _scoped_env(env):
     """Set ``env`` and return an undo callable."""
     old = {k: os.environ.get(k) for k in env}
@@ -1385,10 +1419,16 @@ def main(argv=None) -> int:
         "with DLROVER_TPU_SERVE_FLEET on vs off — affinity hit "
         "rate, SLO-class lanes, disaggregated prefill/decode",
     )
+    parser.add_argument(
+        "--kernel-compare", action="store_true",
+        help="run ONLY the paged-kernel backend leg (ISSUE 18): "
+        "end-to-end tokens/s with DLROVER_TPU_PAGED_KERNEL=jnp vs "
+        "pallas on the same workload",
+    )
     args = parser.parse_args(argv)
     only = (
         args.utilization or args.prefix or args.observatory
-        or args.fleet
+        or args.fleet or args.kernel_compare
     )
 
     payload = {
@@ -1467,6 +1507,16 @@ def main(argv=None) -> int:
                 },
                 default=str,
             ))
+        if args.kernel_compare:
+            extras["kernel_compare"] = run_kernel_compare(
+                cfg, params, min(args.requests, 16)
+            )
+            if payload["value"] is None:
+                payload["value"] = extras["kernel_compare"][
+                    "tokens_per_s_ratio"
+                ]
+            flush(args.out, payload)
+            print(json.dumps(extras["kernel_compare"], default=str))
         if args.fleet:
 
             def _flush_fleet(partial):

@@ -163,6 +163,11 @@ DECLARED_METRICS = {
     # filled and shipped through the shm block arena for a decode
     # replica to adopt — each increment pairs with a kv_ship span
     "dlrover_tpu_serving_kv_shipped_blocks_total",
+    # paged-attention kernel autotuner (ops/autotune.py): the winning
+    # candidate's best-of-reps wall time for one (kernel, shape) key,
+    # labeled {kernel, backend} — each sample pairs with a
+    # kernel_autotune span on the timeline
+    "dlrover_tpu_paged_kernel_us",
 }
 METRIC_METHODS = {
     "set_gauge",

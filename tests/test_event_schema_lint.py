@@ -595,3 +595,49 @@ def test_lint_declares_slo_histograms():
         assert "dlrover_tpu_serving_ttft_secs" in proc.stdout
     finally:
         os.unlink(probe)
+
+
+def test_lint_enforces_kernel_autotune_labels(tmp_path):
+    """A kernel_autotune span without the winner + sweep provenance
+    (kernel/best_config/candidates/best_us) is unauditable — the
+    lint must reject the bare span and accept the full one."""
+    bad = tmp_path / "bad_autotune.py"
+    bad.write_text(
+        "events = None\n"
+        "def f(events):\n"
+        "    events.complete('kernel_autotune', 0.0, 1.0,\n"
+        "                    kernel='decode', candidates=4)\n"
+        "    events.complete('kernel_autotune', 0.0, 1.0,\n"
+        "                    kernel='decode', best_config='{}',\n"
+        "                    candidates=4, best_us=12.5)\n"
+    )
+    proc = _run(str(bad))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "event_schema_violations=1" in proc.stdout, proc.stdout
+    assert (
+        "missing required label(s) ['best_config', 'best_us']"
+        in proc.stdout
+    ), proc.stdout
+
+
+def test_lint_declares_paged_kernel_metric():
+    """The autotuner's best-time gauge is declared; a typo'd variant
+    of it is not.  Package-scoped, so the probe lives in-tree."""
+    probe = os.path.join(
+        REPO, "dlrover_tpu", "_lint_probe_paged_delete_me.py"
+    )
+    with open(probe, "w") as f:
+        f.write(
+            "def f(reg):\n"
+            "    reg.set_gauge('dlrover_tpu_paged_kernel_us', 42.0,\n"
+            "                  labels={'kernel': 'decode',\n"
+            "                          'backend': 'pallas'})\n"
+            "    reg.set_gauge('dlrover_tpu_paged_kernel_usec', 42.0)\n"
+        )
+    try:
+        proc = _run(probe)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "event_schema_violations=1" in proc.stdout, proc.stdout
+        assert "dlrover_tpu_paged_kernel_usec" in proc.stdout
+    finally:
+        os.unlink(probe)
