@@ -865,6 +865,53 @@ def _run_serving_fleet(budget: "BenchBudget" = None) -> dict:
         return {"error": str(e)}
 
 
+def _run_flywheel_bench(budget: "BenchBudget" = None) -> dict:
+    """Run scripts/bench_flywheel.py in a subprocess: the zero-copy
+    RLHF loop — in-place publish stall vs the pickle hop (and vs the
+    training step), streamed rollout rounds with exactly-once
+    trajectory accounting, Brain-arbitrated device lending vs the
+    static split, and the replica+publisher chaos kill."""
+    if os.getenv("DLROVER_BENCH_SKIP_SERVING"):
+        return {"skipped": True}
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "scripts", "bench_flywheel.py",
+    )
+    out_file = os.path.join(
+        tempfile.mkdtemp(prefix="dlrover_bench_flywheel_"),
+        "out.json",
+    )
+    timeout_s = 600
+    env = dict(os.environ)
+    if budget is not None:
+        timeout_s = budget.cap_timeout(600, reserve_s=120)
+        # the child scales request counts / skips late legs from the
+        # budget env; hand it the time actually left for this leg
+        env[BUDGET_ENV] = str(int(max(30, timeout_s - 60)))
+    cmd = [sys.executable, script, "--out", out_file]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout_s,
+            env=env,
+        )
+        parsed = _read_result_file(out_file, proc.stdout)
+        if parsed is not None:
+            out = dict(parsed.get("extras", {}))
+            out["publish_speedup_vs_pickle_hop"] = parsed.get("value")
+            if proc.returncode != 0:
+                out["error"] = f"incomplete run (rc={proc.returncode})"
+                out["stderr_tail"] = proc.stderr[-500:]
+            return out
+        return {
+            "error": f"no JSON output (rc={proc.returncode})",
+            "stderr_tail": proc.stderr[-500:],
+        }
+    except subprocess.TimeoutExpired as e:
+        return {"error": str(e), "partial": _partial_extras(out_file)}
+    except Exception as e:  # noqa: BLE001
+        return {"error": str(e)}
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -1023,6 +1070,16 @@ def main(argv=None) -> int:
             extras["serving_fleet"] = {"skipped": "budget"}
         else:
             extras["serving_fleet"] = _run_serving_fleet(budget)
+        flush_partial(args.out, payload)
+
+        # RLHF flywheel: in-place publish stall vs the pickle hop,
+        # streamed rollout rounds, Brain device lending and the
+        # replica+publisher chaos kill
+        # (scripts/bench_flywheel.py owns the scenario)
+        if budget.tight(240):
+            extras["flywheel"] = {"skipped": "budget"}
+        else:
+            extras["flywheel"] = _run_flywheel_bench(budget)
         flush_partial(args.out, payload)
 
         # continuous attribution leg's overhead: steady step time

@@ -27,6 +27,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from dlrover_tpu.common import parallel_io
+from dlrover_tpu.common.fault_injection import maybe_crash
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.common.multi_process import (
     SharedDict,
@@ -36,6 +37,8 @@ from dlrover_tpu.common.multi_process import (
 
 SHM_PREFIX = "dlrover_tpu_ckpt"
 _HDR = struct.Struct("<Q")
+#: generation side-segment payload: published step + 1 (0 = none)
+_GEN = struct.Struct("<q")
 
 
 def _flatten_keyed(tree) -> List[Tuple[str, object]]:
@@ -119,6 +122,8 @@ class SharedMemoryHandler:
         self._name = name
         self._shm_name = f"{SHM_PREFIX}_{name}_{rank}"
         self._shm: Optional[SharedMemory] = None
+        self._gen_name = f"{SHM_PREFIX}_gen_{name}_{rank}"
+        self._gen: Optional[SharedMemory] = None
         self.meta = SharedDict(f"ckpt_meta_{name}_{rank}", create=host)
 
     # -- writer (training process) ----------------------------------------
@@ -210,6 +215,11 @@ class SharedMemoryHandler:
         self._ensure_shm(self.NUM_SLOTS * stride)
         self._drain_leaves(pairs, specs, base)
 
+        # torn-publish chaos hook: a kill landing here leaves the new
+        # slot fully written but the meta still pointing at the OTHER
+        # valid slot — readers keep serving the previous generation
+        maybe_crash("mid_weight_publish")
+
         slot_meta = {
             "step": step,
             "specs": specs,
@@ -273,6 +283,42 @@ class SharedMemoryHandler:
 
     def mark_invalid(self):
         self.meta.update({"valid": False, "slots": {}})
+
+    # -- generation side-segment (flywheel weight publish) ----------------
+    # One little-endian int64 in its own tiny shm segment holding the
+    # last PUBLISHED step + 1 (0 = nothing published).  Readers poll it
+    # with a single shared-memory load — no SharedDict RPC — so a
+    # replica can skip all adopt work when the generation hasn't moved.
+    # The writer bumps it only AFTER ``save_state`` returns (meta flipped
+    # valid), so a torn publish never advances the generation.
+
+    def _attach_gen(self, create: bool = False) -> Optional[SharedMemory]:
+        if self._gen is None:
+            try:
+                self._gen = SharedMemory(
+                    self._gen_name, create=create, size=_GEN.size
+                )
+            except FileNotFoundError:
+                return None
+            except FileExistsError:
+                # a restarted publisher re-attaches the live segment
+                self._gen = SharedMemory(self._gen_name, create=False)
+        return self._gen
+
+    def publish_generation(self, step: int):
+        """Stamp ``step`` as the published generation (writer side;
+        call after a successful ``save_state``)."""
+        seg = self._attach_gen(create=True)
+        _GEN.pack_into(seg.buf, 0, int(step) + 1)
+
+    def peek_generation(self) -> int:
+        """Last published generation, or -1 when the writer has never
+        published (segment absent / zero).  One atomic-width load —
+        safe to call every scheduler iteration."""
+        seg = self._attach_gen(create=False)
+        if seg is None:
+            return -1
+        return int(_GEN.unpack_from(seg.buf, 0)[0]) - 1
 
     def steps_available(self):
         """Steps restorable from this segment, newest first (the active
@@ -523,6 +569,14 @@ class SharedMemoryHandler:
             if unlink:
                 self._shm.unlink()
             self._shm = None
+        if self._gen is not None:
+            self._gen.close()
+            if unlink:
+                try:
+                    self._gen.unlink()
+                except FileNotFoundError:
+                    pass
+            self._gen = None
         self.meta.close()
 
 

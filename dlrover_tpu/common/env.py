@@ -582,6 +582,89 @@ def control_snapshot_interval_s() -> float:
         return 20.0
 
 
+FLYWHEEL_ENV = "DLROVER_TPU_FLYWHEEL"
+FLYWHEEL_STALENESS_ENV = "DLROVER_TPU_FLYWHEEL_STALENESS"
+FLYWHEEL_MAX_LAG_ENV = "DLROVER_TPU_FLYWHEEL_MAX_LAG"
+FLYWHEEL_PUBLISH_EVERY_ENV = "DLROVER_TPU_FLYWHEEL_PUBLISH_EVERY"
+FLYWHEEL_DRAFT_ENV = "DLROVER_TPU_FLYWHEEL_DRAFT"
+FLYWHEEL_LEND_QUEUE_ENV = "DLROVER_TPU_FLYWHEEL_LEND_QUEUE"
+FLYWHEEL_RECLAIM_QUEUE_ENV = "DLROVER_TPU_FLYWHEEL_RECLAIM_QUEUE"
+FLYWHEEL_MIN_TRAIN_ENV = "DLROVER_TPU_FLYWHEEL_MIN_TRAIN_WORLD"
+
+
+def flywheel_enabled() -> bool:
+    """Kill-switch for the zero-copy RLHF flywheel (ISSUE 20): the
+    in-place K-step weight publish into the shm snapshot segment
+    (generation-stamped header + replica-side adopt-if-changed), the
+    shm trajectory ring feeding rollouts back as ready training
+    batches, the separate published DRAFT model for speculative
+    decode, and the Brain's ``FlywheelOperator`` train/serve device
+    arbitration.  ``DLROVER_TPU_FLYWHEEL=0`` reproduces today's
+    separate planes byte-for-byte: unconditional ``get_step()``
+    adoption polling, self-drafting speculative decode, no trajectory
+    ring, no plane-labeled scale decisions (pinned by tests).
+    Default: enabled."""
+    return os.getenv(FLYWHEEL_ENV, "1").lower() not in (
+        "0", "false", "off",
+    )
+
+
+def flywheel_staleness_policy() -> str:
+    """What happens to a trajectory whose generation lags the current
+    published weights by more than ``flywheel_max_lag()``: ``drop``
+    (the default — off-policy beyond the lag bound is discarded and
+    counted in ``dlrover_tpu_flywheel_staleness_dropped``) or ``tag``
+    (kept, with the lag recorded so the learner can importance-weight
+    it)."""
+    val = os.getenv(FLYWHEEL_STALENESS_ENV, "drop").lower()
+    return val if val in ("drop", "tag") else "drop"
+
+
+def flywheel_max_lag() -> int:
+    """Maximum generations a trajectory may lag the published weights
+    before the staleness policy applies (>= 0; 0 = only on-policy
+    trajectories pass untouched)."""
+    return max(0, int(env_float(FLYWHEEL_MAX_LAG_ENV, 1)))
+
+
+def flywheel_publish_every() -> int:
+    """K: the trainer publishes policy (and draft) weights into the
+    shm snapshot segment every K optimizer steps (>= 1)."""
+    return max(1, int(env_float(FLYWHEEL_PUBLISH_EVERY_ENV, 4)))
+
+
+def flywheel_draft_enabled() -> bool:
+    """Whether the flywheel trains + publishes a separate small DRAFT
+    model for K-step speculative decode (the PR-14 residual; today
+    the model drafts with itself).  Inert unless the serving factory
+    supplies draft-model parts.  Default: enabled (under
+    ``flywheel_enabled()``)."""
+    return os.getenv(FLYWHEEL_DRAFT_ENV, "1").lower() not in (
+        "0", "false", "off",
+    )
+
+
+def flywheel_lend_queue_depth() -> float:
+    """Rollout-bound threshold: sustained serving queue depth (per
+    live replica) at or above this marks the round rollout-bound and
+    eligible for a train->serve chip lend."""
+    return env_float(FLYWHEEL_LEND_QUEUE_ENV, 4.0)
+
+
+def flywheel_reclaim_queue_depth() -> float:
+    """Learner-bound threshold: sustained serving queue depth (per
+    live replica) at or below this, with a lend outstanding, triggers
+    the reclaim (drain a replica, rank rejoins the mesh)."""
+    return env_float(FLYWHEEL_RECLAIM_QUEUE_ENV, 0.5)
+
+
+def flywheel_min_train_world() -> int:
+    """Floor on the trainer world size during arbitration: the
+    FlywheelOperator never lends a chip that would shrink the mesh
+    below this (>= 1)."""
+    return max(1, int(env_float(FLYWHEEL_MIN_TRAIN_ENV, 1)))
+
+
 def get_free_port(host: str = "127.0.0.1") -> int:
     import socket
 

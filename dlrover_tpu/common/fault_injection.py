@@ -8,7 +8,8 @@ it on the children it spawns).  Two fault families:
 
 - ``kill`` — SIGKILL the current process when execution reaches a
   named phase hook (:func:`maybe_crash` call sites: mid_rendezvous,
-  mid_long_poll, mid_report_flush, mid_checkpoint_persist) and the
+  mid_long_poll, mid_report_flush, mid_checkpoint_persist,
+  mid_weight_publish) and the
   spec's role/occurrence filters match.  This is how "the master dies
   mid-rendezvous" is reproduced deterministically instead of by
   racing a timer against the serve loop.
@@ -45,6 +46,7 @@ KILL_PHASES = (
     "mid_long_poll",
     "mid_report_flush",
     "mid_checkpoint_persist",
+    "mid_weight_publish",
 )
 
 

@@ -359,6 +359,7 @@ class BrainAutoScaler(_DecisionLoop):
             reason=decision.reason,
             from_world=decision.from_world,
             to_world=decision.to_world,
+            plane="train",
             target_node=decision.node,
             decision_id=decision.decision_id,
         )
@@ -379,6 +380,7 @@ class BrainAutoScaler(_DecisionLoop):
             reason=decision.reason,
             from_world=decision.from_world,
             to_world=decision.to_world,
+            plane="train",
             target_node=decision.node,
             decision_id=decision.decision_id,
             outcome=outcome,

@@ -1,0 +1,372 @@
+"""Brain-arbitrated train/serve device lending (ISSUE 20).
+
+The RLHF flywheel runs two resource planes off one chip pool: the
+learner (data-parallel trainer ranks) and the rollout fleet (serving
+replicas).  Whichever plane is the bottleneck, the other is idle
+capital — so the Brain arbitrates:
+
+- **lend** (train -> serve): the rollout plane is the bottleneck
+  (sustained dispatch-queue depth per replica above
+  ``DLROVER_TPU_FLYWHEEL_LEND_Q``).  One trainer rank drains (the
+  PR-9 preemption-drain discipline — a mid-step drain loses nothing),
+  the survivors reshard, and the freed host spawns a serving replica
+  (``ServingEngine.add_replica``).
+- **reclaim** (serve -> train): the learner is the bottleneck
+  (rollouts idle: sustained queue depth at or below
+  ``DLROVER_TPU_FLYWHEEL_RECLAIM_Q`` while lent chips are out).  One
+  replica drains (its in-flight requests requeue onto survivors
+  exactly-once), and the rank rejoins the training mesh at the next
+  rendezvous.
+
+Decisions ride the PR-10 Brain discipline wholesale: sustain streaks
+(one noisy snapshot is not a verdict), a post-execution cooldown,
+2x-cooldown hysteresis against lend/reclaim flapping, at most one
+in-flight action, and full ``export_state``/``restore_state``
+round-tripping so a master failover resumes (or safely abandons) the
+action instead of re-deciding it.  Every decision/execution emits the
+``scale_decision``/``scale_execute`` instants with ``plane="serve"``
+— the classic Brain loop emits ``plane="train"`` — so one chaos trace
+shows both planes' verdicts side by side.
+"""
+
+import threading
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Callable, Dict, Optional
+
+from dlrover_tpu.common.env import (
+    flywheel_lend_queue_depth,
+    flywheel_min_train_world,
+    flywheel_reclaim_queue_depth,
+)
+from dlrover_tpu.common.log import default_logger as logger
+
+ACTION_LEND = "lend"
+ACTION_RECLAIM = "reclaim"
+
+
+@dataclass
+class FlywheelSignals:
+    """One arbitration cycle's view of both planes."""
+
+    #: serving dispatch-queue depth (requests parked waiting for a
+    #: replica slot) — the rollout-bound signal
+    queue_depth: int = 0
+    #: live serving replicas
+    serve_replicas: int = 1
+    #: trainer data-parallel world size
+    train_world: int = 1
+    #: trajectories waiting in the trainer's replay buffer (a starved
+    #: learner has compute parked on an empty buffer)
+    buffer_ready: int = 0
+
+
+@dataclass
+class FlywheelDecision:
+    action: str
+    reason: str
+    from_world: int
+    to_world: int
+    from_replicas: int
+    to_replicas: int
+    decision_id: int = 0
+    made_at: float = field(default_factory=time.time)
+
+    def to_dict(self) -> Dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "FlywheelDecision":
+        known = {
+            k: v for k, v in d.items()
+            if k in cls.__dataclass_fields__
+        }
+        return cls(**known)
+
+
+class FlywheelArbiter:
+    """The rule engine: ``decide()`` turns one cycle's signals into at
+    most ONE lend/reclaim decision, under sustain/cooldown/hysteresis.
+    All mutable state round-trips through ``export_state`` /
+    ``restore_state`` (the journal component contract)."""
+
+    def __init__(
+        self,
+        lend_q: Optional[float] = None,
+        reclaim_q: Optional[float] = None,
+        min_train_world: Optional[int] = None,
+        sustain_cycles: int = 3,
+        cooldown_s: float = 30.0,
+        hysteresis_factor: float = 2.0,
+    ):
+        self.lend_q = (
+            flywheel_lend_queue_depth() if lend_q is None else lend_q
+        )
+        self.reclaim_q = (
+            flywheel_reclaim_queue_depth()
+            if reclaim_q is None else reclaim_q
+        )
+        self.min_train_world = (
+            flywheel_min_train_world()
+            if min_train_world is None else max(int(min_train_world), 1)
+        )
+        self.sustain_cycles = max(int(sustain_cycles), 1)
+        self.cooldown_s = float(cooldown_s)
+        self.hysteresis_factor = float(hysteresis_factor)
+        self._lend_streak = 0
+        self._reclaim_streak = 0
+        #: replicas currently running on lent trainer chips — reclaim
+        #: only ever takes back what lend gave
+        self._lent = 0
+        self._last: Optional[FlywheelDecision] = None
+        self._in_flight: Optional[FlywheelDecision] = None
+        self._next_id = 1
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------ state
+    @property
+    def in_flight(self) -> Optional[FlywheelDecision]:
+        with self._lock:
+            return self._in_flight
+
+    @property
+    def lent(self) -> int:
+        with self._lock:
+            return self._lent
+
+    def complete(self, outcome: str, now: Optional[float] = None):
+        """The executor finished (or abandoned) the in-flight action;
+        it becomes the cooldown anchor, and the lent-chip ledger
+        moves only on a DONE outcome."""
+        with self._lock:
+            if self._in_flight is None:
+                return
+            done = self._in_flight
+            if outcome == "done":
+                if done.action == ACTION_LEND:
+                    self._lent += 1
+                elif done.action == ACTION_RECLAIM:
+                    self._lent = max(self._lent - 1, 0)
+            # cooldown runs from COMPLETION, not decision time
+            done.made_at = now if now is not None else time.time()
+            self._last = done
+            self._in_flight = None
+
+    def _cooled_down(self, action: str, now: float) -> bool:
+        if self._last is None:
+            return True
+        quiet = self.cooldown_s
+        if self._last.action != action:
+            # direction flip pays the hysteresis surcharge
+            quiet *= self.hysteresis_factor
+        return now - self._last.made_at >= quiet
+
+    # ----------------------------------------------------------- decide
+    def decide(self, signals: FlywheelSignals,
+               now: Optional[float] = None,
+               ) -> Optional[FlywheelDecision]:
+        now = time.time() if now is None else now
+        with self._lock:
+            if self._in_flight is not None:
+                return None  # one planned action at a time
+            per_replica = signals.queue_depth / max(
+                signals.serve_replicas, 1
+            )
+            if per_replica > self.lend_q:
+                self._lend_streak += 1
+            else:
+                self._lend_streak = 0
+            if (
+                per_replica <= self.reclaim_q
+                and self._lent > 0
+            ):
+                self._reclaim_streak += 1
+            else:
+                self._reclaim_streak = 0
+            decision = None
+            if (
+                self._lend_streak >= self.sustain_cycles
+                and signals.train_world > self.min_train_world
+                and self._cooled_down(ACTION_LEND, now)
+            ):
+                decision = FlywheelDecision(
+                    action=ACTION_LEND,
+                    reason=(
+                        f"rollout_bound queue/replica "
+                        f"{per_replica:.1f} > {self.lend_q:g} "
+                        f"x{self._lend_streak}"
+                    ),
+                    from_world=signals.train_world,
+                    to_world=signals.train_world - 1,
+                    from_replicas=signals.serve_replicas,
+                    to_replicas=signals.serve_replicas + 1,
+                )
+            elif (
+                self._reclaim_streak >= self.sustain_cycles
+                and signals.serve_replicas > 1
+                and self._cooled_down(ACTION_RECLAIM, now)
+            ):
+                decision = FlywheelDecision(
+                    action=ACTION_RECLAIM,
+                    reason=(
+                        f"learner_bound queue/replica "
+                        f"{per_replica:.1f} <= {self.reclaim_q:g} "
+                        f"x{self._reclaim_streak}"
+                    ),
+                    from_world=signals.train_world,
+                    to_world=signals.train_world + 1,
+                    from_replicas=signals.serve_replicas,
+                    to_replicas=signals.serve_replicas - 1,
+                )
+            if decision is None:
+                return None
+            decision.decision_id = self._next_id
+            decision.made_at = now
+            self._next_id += 1
+            self._in_flight = decision
+            self._lend_streak = 0
+            self._reclaim_streak = 0
+            return decision
+
+    # ---------------------------------------------------------- journal
+    def export_state(self) -> Dict:
+        with self._lock:
+            return {
+                "lend_streak": self._lend_streak,
+                "reclaim_streak": self._reclaim_streak,
+                "lent": self._lent,
+                "next_id": self._next_id,
+                "last": (
+                    self._last.to_dict() if self._last else None
+                ),
+                "in_flight": (
+                    self._in_flight.to_dict()
+                    if self._in_flight else None
+                ),
+            }
+
+    def restore_state(self, state: Dict):
+        with self._lock:
+            self._lend_streak = int(state.get("lend_streak", 0))
+            self._reclaim_streak = int(
+                state.get("reclaim_streak", 0)
+            )
+            self._lent = int(state.get("lent", 0))
+            self._next_id = int(state.get("next_id", 1))
+            last = state.get("last")
+            self._last = (
+                FlywheelDecision.from_dict(last) if last else None
+            )
+            inflight = state.get("in_flight")
+            self._in_flight = (
+                FlywheelDecision.from_dict(inflight)
+                if inflight else None
+            )
+
+
+class FlywheelOperator:
+    """The executing shell around :class:`FlywheelArbiter`: consumes
+    both planes' gauges, executes at most one decision per
+    ``evaluate`` through caller-supplied ``lend_fn`` / ``reclaim_fn``
+    (the harness wires these to the actual drain + ``add_replica`` /
+    ``drain_replica`` + rejoin machinery), journals every transition,
+    and emits the plane-labeled timeline instants."""
+
+    def __init__(
+        self,
+        lend_fn: Callable[[FlywheelDecision], bool],
+        reclaim_fn: Callable[[FlywheelDecision], bool],
+        arbiter: Optional[FlywheelArbiter] = None,
+    ):
+        self._lend_fn = lend_fn
+        self._reclaim_fn = reclaim_fn
+        self.arbiter = arbiter or FlywheelArbiter()
+        self._journal_cb: Optional[Callable[[str, Dict], None]] = None
+
+    def set_journal(self, cb: Optional[Callable[[str, Dict], None]]):
+        """Journal sink (the PR-7 ControlPlaneJournal contract): every
+        decision/outcome appends a row, and the current arbiter state
+        snapshots so a failed-over master resumes mid-action."""
+        self._journal_cb = cb
+
+    def _journal(self, kind: str, payload: Dict):
+        if self._journal_cb is not None:
+            self._journal_cb(kind, payload)
+            self._journal_cb("state", self.arbiter.export_state())
+
+    def export_state(self) -> Dict:
+        return self.arbiter.export_state()
+
+    def restore_state(self, state: Dict):
+        self.arbiter.restore_state(state)
+
+    @staticmethod
+    def _labels(decision: FlywheelDecision) -> Dict:
+        return dict(
+            action=decision.action,
+            reason=decision.reason,
+            from_world=decision.from_world,
+            to_world=decision.to_world,
+            plane="serve",
+            from_replicas=decision.from_replicas,
+            to_replicas=decision.to_replicas,
+            decision_id=decision.decision_id,
+        )
+
+    def _emit_decision(self, decision: FlywheelDecision):
+        from dlrover_tpu.observability.events import get_event_logger
+
+        get_event_logger().instant(
+            "scale_decision", **self._labels(decision)
+        )
+
+    def _emit_execute(self, decision: FlywheelDecision, outcome: str):
+        from dlrover_tpu.observability.events import get_event_logger
+
+        get_event_logger().instant(
+            "scale_execute", outcome=outcome, **self._labels(decision)
+        )
+
+    def resume_in_flight(self) -> Optional[str]:
+        """A failed-over master found an in-flight action in the
+        restored state: re-execute it under the SAME decision id (the
+        lend/reclaim callbacks are idempotent drains) instead of
+        re-deciding."""
+        decision = self.arbiter.in_flight
+        if decision is None:
+            return None
+        return self._execute(decision)
+
+    def _execute(self, decision: FlywheelDecision) -> str:
+        fn = (
+            self._lend_fn
+            if decision.action == ACTION_LEND else self._reclaim_fn
+        )
+        try:
+            ok = bool(fn(decision))
+            outcome = "done" if ok else "abandoned"
+        except Exception as e:  # noqa: BLE001 - an executor crash
+            # must not wedge arbitration forever
+            logger.error(
+                "flywheel %s execution failed: %s",
+                decision.action, e,
+            )
+            outcome = "abandoned"
+        self.arbiter.complete(outcome)
+        self._emit_execute(decision, outcome)
+        self._journal(
+            "execute",
+            {**decision.to_dict(), "outcome": outcome},
+        )
+        return outcome
+
+    def evaluate(self, signals: FlywheelSignals,
+                 now: Optional[float] = None) -> Optional[str]:
+        """One arbitration cycle: decide (maybe), execute, journal.
+        Returns the execution outcome or None (no action)."""
+        decision = self.arbiter.decide(signals, now=now)
+        if decision is None:
+            return None
+        self._emit_decision(decision)
+        self._journal("decision", decision.to_dict())
+        return self._execute(decision)

@@ -779,6 +779,99 @@ def paged_verify_step(
     return logits
 
 
+def paged_verify_write_step(
+    params: Dict,
+    tokens: jnp.ndarray,  # [B, C]: window of C tokens per lane
+    pool: Dict,  # {"k","v"}: [L, num_blocks, block_size, KV, D]
+    block_tables: jnp.ndarray,  # [B, max_blocks] int32
+    positions: jnp.ndarray,  # [B] int32: lane's first window position
+    active: jnp.ndarray,  # [B] bool: lane holds a live sequence
+    cfg: LlamaConfig,
+) -> Tuple[jnp.ndarray, Dict]:
+    """Verify a C-token draft window AND write its K/V into the pool.
+
+    The separate-drafter flywheel path: a small DRAFT model ran the
+    draft loop against its OWN pool, so — unlike the self-drafting
+    ``paged_verify_step`` — the POLICY's K/V for the window positions
+    does not exist yet.  This forward scores the window exactly like
+    ``paged_verify_step`` while also projecting k/v and scattering
+    them at positions ``positions[b] + i`` (null-block routing for
+    inactive lanes and past-table positions, the ``paged_decode_step``
+    discipline), so the policy cache ends the step as if the policy
+    had decoded the window itself.  Rejected draft tail positions are
+    overwritten by later decode/draft writes before they become
+    attendable — same garbage discipline as padded prefill tails.
+    Returns (logits [B, C, vocab] fp32, pool)."""
+    from dlrover_tpu.ops.paged_attention import (
+        paged_verify_attention,
+        write_block_kv,
+    )
+
+    dt = cfg.dtype
+    b, c = tokens.shape
+    nh, nkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    bs = pool["k"].shape[2]
+    mb = block_tables.shape[1]
+    pos_grid = positions[:, None] + jnp.arange(c)[None]  # [B, C]
+    x = params["embed"].astype(dt)[tokens]  # [B, C, D]
+    cos, sin = rope_frequencies(cfg, pos_grid.reshape(-1))
+    cos = cos.reshape(b, c, -1)
+    sin = sin.reshape(b, c, -1)
+    safe_pos = jnp.where(active, positions, 0)
+    # per-(lane, offset) write routing — flattened to [B*C] for the
+    # scatter; inactive lanes and past-table positions hit block 0
+    blk_idx = pos_grid // bs  # [B, C]
+    blks = jnp.where(
+        active[:, None] & (blk_idx < mb),
+        jnp.take_along_axis(
+            block_tables, jnp.minimum(blk_idx, mb - 1), axis=1
+        ),
+        0,
+    ).reshape(-1)
+    offs = jnp.where(active[:, None], pos_grid % bs, 0).reshape(-1)
+
+    def body(x, layer_in):
+        lp, k_pool, v_pool = layer_in
+
+        def proj(a, w):
+            return jnp.matmul(
+                a, w.astype(dt), preferred_element_type=jnp.float32
+            ).astype(dt)
+
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = _apply_rope_grid(
+            proj(h, lp["wq"]).reshape(b, c, nh, hd), cos, sin
+        )
+        k = _apply_rope_grid(
+            proj(h, lp["wk"]).reshape(b, c, nkv, hd), cos, sin
+        )
+        v = proj(h, lp["wv"]).reshape(b, c, nkv, hd)
+        k_pool, v_pool = write_block_kv(
+            k_pool, v_pool,
+            k.reshape(b * c, nkv, hd), v.reshape(b * c, nkv, hd),
+            blks, offs,
+        )
+        attn = paged_verify_attention(
+            q, k_pool, v_pool, block_tables, safe_pos
+        )
+        x = x + proj(attn.reshape(b, c, nh * hd), lp["wo"])
+        h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(proj(h, lp["w_gate"]))
+        up = proj(h, lp["w_up"])
+        x = x + proj(gate * up, lp["w_down"])
+        return x, (k_pool, v_pool)
+
+    x, (new_k, new_v) = lax.scan(
+        body, x, (params["layers"], pool["k"], pool["v"])
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, params["lm_head"].astype(dt),
+        preferred_element_type=jnp.float32,
+    )
+    return logits, {"k": new_k, "v": new_v}
+
+
 def paged_prefill_chunk(
     params: Dict,
     tokens: jnp.ndarray,  # [1, C] one sequence's prompt chunk

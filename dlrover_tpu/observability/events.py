@@ -164,6 +164,20 @@ PHASE_CONTROL_WAIT = "control_wait"
 # cached config is what it is
 PHASE_KERNEL_AUTOTUNE = "kernel_autotune"
 
+# the flywheel's train->serve weight hop (rl/flywheel.py): one
+# in-place publish of the policy (+ drafter) into the double-buffered
+# shm snapshot segment — the span's duration IS the trainer stall the
+# zero-copy path is supposed to bound
+PHASE_WEIGHT_PUBLISH = "weight_publish"
+
+# one rollout round of the RLHF flywheel: prompts submitted, every
+# trajectory streamed back, the round's staleness verdicts settled
+PHASE_ROLLOUT_ROUND = "rollout_round"
+
+# one completed rollout crossing the serve->train boundary as a ready
+# training sample (the shm trajectory stream's unit of account)
+PHASE_TRAJECTORY = "trajectory"
+
 PHASES: Tuple[str, ...] = (
     PHASE_DATA_STALL,
     PHASE_STEP,
@@ -193,6 +207,9 @@ PHASES: Tuple[str, ...] = (
     PHASE_KV_SHIP,
     PHASE_CONTROL_WAIT,
     PHASE_KERNEL_AUTOTUNE,
+    PHASE_WEIGHT_PUBLISH,
+    PHASE_ROLLOUT_ROUND,
+    PHASE_TRAJECTORY,
 )
 
 #: Phases that count as useful training time in the ledger.
@@ -258,8 +275,14 @@ REQUIRED_INSTANT_LABELS: Dict[str, Tuple[str, ...]] = {
     # a scale record without the rule that fired and the world
     # transition it planned is unauditable — "drain_replace node 2,
     # straggler 3.9x, 3→2" is the whole story of a Brain action
-    "scale_decision": ("action", "reason", "from_world", "to_world"),
-    "scale_execute": ("action", "reason", "from_world", "to_world"),
+    # ``plane`` names WHICH side of the train/serve boundary the
+    # action moved capacity on ("train" for the classic Brain loop,
+    # "serve" for flywheel device lending) — without it a lend and a
+    # straggler drain-replace read as the same world transition
+    "scale_decision": ("action", "reason", "from_world", "to_world",
+                       "plane"),
+    "scale_execute": ("action", "reason", "from_world", "to_world",
+                      "plane"),
     # one deep capture fired at a node (the agent's xpu_timer
     # hang-dump analog): the trace must show WHICH node was captured
     # and WHY (hang / straggler / operator request), next to the
@@ -384,6 +407,18 @@ REQUIRED_SPAN_LABELS: Dict[str, Tuple[str, ...]] = {
         "candidates",
         "best_us",
     ),
+    # a publish without its generation, its moved bytes and the stall
+    # it charged the trainer is unauditable — stall_s vs the step time
+    # IS the flywheel's acceptance criterion
+    PHASE_WEIGHT_PUBLISH: ("generation", "bytes", "stall_s"),
+    # the round's scoreboard: how many trajectories came back and how
+    # many the staleness policy refused — together they are the
+    # on-policy/off-policy budget actually spent
+    PHASE_ROLLOUT_ROUND: ("round", "trajectories",
+                          "staleness_dropped"),
+    # identity + provenance of one streamed sample: which request,
+    # which policy generation sampled it, how many tokens it carries
+    PHASE_TRAJECTORY: ("req_id", "generation", "tokens"),
 }
 
 

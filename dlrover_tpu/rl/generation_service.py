@@ -81,24 +81,35 @@ _KIND_REJECT = 4
 # manifest to a decode replica (disaggregated fleet only; never
 # emitted with DLROVER_TPU_SERVE_FLEET=0)
 _KIND_SHIP = 5
+# a DRAINING replica hands one unfinished request back WITH its
+# generated-so-far tail (+ per-token logprobs): the dispatcher stores
+# the tail and re-dispatches with ``resume_tokens`` so the survivor
+# re-prefills the whole [prompt|tail] prefix through the block-hash
+# cache instead of regenerating it (flywheel layer; a SIGKILL'd
+# replica can't send these — its requests redispatch fresh)
+_KIND_REQUEUE = 6
 _FINISH_CODES = {"length": 0, "eos": 1}
 _FINISH_NAMES = {v: k for k, v in _FINISH_CODES.items()}
 
 #: Explicit schema version of BOTH shm-ring payloads.  PR 14 silently
 #: widened the response ``times`` vector 4→8 floats — a mixed-width
 #: reader would have misparsed stats as garbage numbers instead of
-#: failing.  v3 (this layout): request meta carries
+#: failing.  v4 (this layout): request meta carries
 #: [req_id, prompt_len, max_new, seed, schema_version, submit_wall_ns,
 #: slo_class, tenant_hash, ship_mode, ship_slot, first_token,
-#: n_blocks, route] and response meta carries
+#: n_blocks, route, resume_len] — the prompt buffer holds
+#: [prompt|resume tail] and ``resume_lp`` the tail's per-token
+#: logprobs (NaN where unknown) — and response meta carries
 #: [req_id, kind, total_len, new_tokens, finish_code, weights_version,
-#: schema_version, ship_slot, n_blocks].  ship_mode: 0 = serve
+#: schema_version, ship_slot, n_blocks] plus a ``logprobs`` f4 vector
+#: (per sampled token, flywheel capture mode only; zeros otherwise).
+#: ship_mode: 0 = serve
 #: locally, 1 = prefill-and-ship (the replica fills the KV blocks,
 #: stages them in the ship arena slot and answers _KIND_SHIP),
 #: 2 = adopt-and-decode (the replica splices the staged blocks into
 #: its own pool and runs a pure token loop).  Bump on ANY layout
 #: change.
-RING_SCHEMA_VERSION = 3
+RING_SCHEMA_VERSION = 4
 
 #: request ``route`` codes — how the dispatcher picked the replica;
 #: the scheduler stamps the name on the request's serve_request span
@@ -153,6 +164,13 @@ def _parse_stats(times, schema_version: int) -> Dict:
         "preemptions": int(times[4]),
         "prefix_hit_rate": round(float(times[5]), 4),
         "accepted_per_step": round(float(times[6]), 4),
+        # flywheel adoption accounting (cumulative): how many weight
+        # generations this replica actually adopted, and how many
+        # SharedDict meta RPCs its adopt probe burned — the
+        # generation side-segment keeps the second flat while the
+        # first only moves when a publish lands
+        "adoptions": int(times[10]),
+        "meta_rpcs": int(times[11]),
     }
 
 
@@ -173,7 +191,10 @@ def tiny_llama_factory(**cfg_kwargs):
     spec (tests / example).  Returns the worker contract:
     ``forward_fn``, ``params_template_fn`` (inference-sharded params
     the shm snapshot restores ONTO) and ``cfg`` (the model config the
-    serving scheduler builds its paged decode programs from)."""
+    serving scheduler builds its paged decode programs from).  A
+    ``draft`` sub-dict (flywheel speculative decode) adds
+    ``draft_cfg`` + ``draft_template_fn`` for the separately-published
+    drafter the scheduler runs K cheap steps of per verify."""
     import jax
     import jax.numpy as jnp
 
@@ -183,11 +204,14 @@ def tiny_llama_factory(**cfg_kwargs):
         init_params,
     )
 
-    if isinstance(cfg_kwargs.get("dtype"), str):
-        # the spec rides through JSON: dtype arrives as a name
-        cfg_kwargs = dict(
-            cfg_kwargs, dtype=jnp.dtype(cfg_kwargs["dtype"])
-        )
+    def _undtype(kw):
+        if isinstance(kw.get("dtype"), str):
+            # the spec rides through JSON: dtype arrives as a name
+            kw = dict(kw, dtype=jnp.dtype(kw["dtype"]))
+        return kw
+
+    cfg_kwargs = _undtype(dict(cfg_kwargs))
+    draft_kwargs = cfg_kwargs.pop("draft", None)
     cfg = LlamaConfig(**cfg_kwargs)
 
     def forward_fn(params, tokens):
@@ -199,11 +223,18 @@ def tiny_llama_factory(**cfg_kwargs):
         # mesh would device_put leaves onto its NamedShardings here.
         return init_params(jax.random.PRNGKey(0), cfg)
 
-    return {
+    parts = {
         "forward_fn": forward_fn,
         "params_template_fn": params_template_fn,
         "cfg": cfg,
     }
+    if draft_kwargs:
+        draft_cfg = LlamaConfig(**_undtype(dict(draft_kwargs)))
+        parts["draft_cfg"] = draft_cfg
+        parts["draft_template_fn"] = lambda: init_params(
+            jax.random.PRNGKey(1), draft_cfg
+        )
+    return parts
 
 
 # --------------------------------------------------------------------------
@@ -463,9 +494,15 @@ def _req_spec(max_prompt: int):
             # slo_class (0 batch / 1 interactive), tenant_hash,
             # ship_mode (0 local / 1 prefill-and-ship / 2 adopt),
             # ship_slot (arena slot, -1 none), first_token (adopt
-            # only), n_blocks (adopt only), route (_ROUTE_NAMES code)
-            "meta": ((13,), "<i8"),
+            # only), n_blocks (adopt only), route (_ROUTE_NAMES code),
+            # resume_len (generated tail carried back from a drained
+            # replica; the tail rides the prompt buffer at
+            # [prompt_len : prompt_len + resume_len])
+            "meta": ((14,), "<i8"),
             "prompt": ((max_prompt,), "<i4"),
+            # the resume tail's per-token logprobs (NaN = unknown);
+            # only the first resume_len entries are meaningful
+            "resume_lp": ((max_prompt,), "<f4"),
         }
     )
 
@@ -483,14 +520,18 @@ def _resp_spec(max_total: int):
             # chain-key digests (the affinity router's per-replica
             # view; SHIP carries first_token in tokens[0])
             "tokens": ((max_total,), "<i4"),
+            # RESULT/REQUEUE: per-token logprobs for the sampled tail
+            # (flywheel capture mode; zeros when capture is off)
+            "logprobs": ((max_total,), "<f4"),
             # RESULT: latency_s, ttft_s, worker_gen_s, tokens_per_s,
             #         tbt_p99_s, queue_wait_s (trailing spare)
             # READY:  block_region_nbytes (the ship-arena slot sizer)
             # STATS:  tokens_per_s, queue_depth, kv_blocks_used,
             #         kv_utilization, preemptions, prefix_hit_rate,
             #         accepted_tokens_per_step, ttft_p99_s,
-            #         prefix_hits_total, prefix_lookups_total
-            "times": ((10,), "<f8"),
+            #         prefix_hits_total, prefix_lookups_total,
+            #         adoptions_total, meta_rpcs_total
+            "times": ((12,), "<f8"),
         }
     )
 
@@ -611,6 +652,12 @@ def _serving_worker_loop(spec) -> int:
             "model config the paged decode programs build from)"
         )
     s = spec["sched"]
+    # flywheel layer (ISSUE 20): logprob capture (the trajectory
+    # stream's old_logp source) and the separately-published draft
+    # model — both absent from the spec under DLROVER_TPU_FLYWHEEL=0,
+    # so the scheduler compiles exactly the pre-flywheel programs
+    fly = spec.get("flywheel") or {}
+    draft_cfg = parts.get("draft_cfg")
     scheduler = ContinuousBatchingScheduler(
         cfg,
         SchedulerConfig(
@@ -629,6 +676,8 @@ def _serving_worker_loop(spec) -> int:
         events=get_event_logger(),
         replica=tag,
         role=("prefill" if role == "prefill" else "unified"),
+        capture_logprobs=bool(fly.get("capture")),
+        draft_cfg=draft_cfg,
     )
     events = get_event_logger()
     serve_obs = serve_obs_enabled()
@@ -646,14 +695,29 @@ def _serving_worker_loop(spec) -> int:
     fault = (spec.get("faults") or {}).get(str(replica)) or {}
     fault_sleep_s = float(fault.get("sleep_s", 0.0))
     wedge_after = int(fault.get("wedge_after_tokens", 0))
-    template = parts["params_template_fn"]()
-    scheduler.sync_weights(template)
+    if draft_cfg is not None:
+        # draft mode: the publish segment carries ONE combined
+        # {"policy", "draft"} tree, restored onto a combined template.
+        # Until the first publish adopts, the scheduler self-drafts
+        # (sync_weights without draft params) — the random-init draft
+        # template is never decoded with.
+        template = {
+            "policy": parts["params_template_fn"](),
+            "draft": parts["draft_template_fn"](),
+        }
+        scheduler.sync_weights(template["policy"])
+    else:
+        template = parts["params_template_fn"]()
+        scheduler.sync_weights(template)
 
     shm = SharedMemoryHandler(rank=0, name=name)
     req_ring = _Ring(f"{tag}-req")
     resp_ring = _Ring(f"{tag}-resp")
     max_total = int(s["max_seq_len"])
     version = -1
+    gen_seen = -1  # newest generation-segment value acted on
+    adoptions = 0  # cumulative weight adoptions (STATS payload)
+    meta_rpcs = 0  # cumulative get_step meta RPCs (STATS payload)
 
     # --- disaggregated prefill/decode plumbing (fleet layer) -------
     # the ship arena is a dispatcher-owned shm segment of fixed-size
@@ -700,27 +764,56 @@ def _serving_worker_loop(spec) -> int:
         return k_r, v_r
 
     def _adopt_weights():
-        nonlocal version, template
+        nonlocal version, template, gen_seen, adoptions, meta_rpcs
+        # fast path: one atomic-width load off the generation
+        # side-segment.  The publisher bumps it AFTER save_state
+        # completes, so an unchanged value means there is nothing new
+        # to adopt — zero SharedDict RPCs, zero snapshot reads.  A
+        # torn publish (publisher died mid-save) never bumps it, so
+        # replicas keep serving the previous generation.
+        gen = shm.peek_generation()
+        if gen >= 0:
+            if gen <= gen_seen:
+                return
+        else:
+            # no generation segment (pre-flywheel publisher, or
+            # DLROVER_TPU_FLYWHEEL=0): the legacy meta-RPC probe
+            meta_rpcs += 1
+            try:
+                step = shm.get_step()
+            except Exception:  # noqa: BLE001 - nothing published yet
+                return
+            if step <= version:
+                return
         try:
-            step = shm.get_step()
-        except Exception:  # noqa: BLE001 - nothing published yet
+            step, arrays = shm.load_state(copy=False)
+        except Exception:  # noqa: BLE001 - gen raced ahead of meta
             return
+        if gen >= 0:
+            gen_seen = gen
         if step <= version:
             return
-        step, arrays = shm.load_state(copy=False)
         template = restore_to_target(
             template, arrays, to_device=True, copy_host=True
         )
         jax.block_until_ready(template)
-        scheduler.sync_weights(template)
+        if draft_cfg is not None and isinstance(template, dict) \
+                and "draft" in template:
+            scheduler.sync_weights(
+                template["policy"], template["draft"]
+            )
+        else:
+            scheduler.sync_weights(template)
         version = step
+        adoptions += 1
         del arrays
 
     parent_pid = os.getppid()
 
     def _respond(kind: int, req_id: int = -1, tokens=None,
                  new_tokens: int = 0, finish: str = "length",
-                 times=(), ship_slot: int = -1, n_blocks: int = 0):
+                 times=(), ship_slot: int = -1, n_blocks: int = 0,
+                 logprobs=None):
         """Publish one message; a RESULT (or SHIP — the request's
         only path to a decode replica) must never be silently dropped
         (the dispatcher would block its caller for the full request
@@ -732,7 +825,11 @@ def _serving_worker_loop(spec) -> int:
         buf = np.zeros((max_total,), np.int32)
         if tokens is not None:
             buf[:total] = tokens
-        padded = np.zeros((10,), np.float64)
+        lp_buf = np.zeros((max_total,), np.float32)
+        if logprobs is not None:
+            lp = np.asarray(logprobs, np.float32).reshape(-1)
+            lp_buf[: lp.size] = lp[:max_total]
+        padded = np.zeros((12,), np.float64)
         padded[: len(times)] = times
         msg = {
             "meta": np.asarray(
@@ -742,6 +839,7 @@ def _serving_worker_loop(spec) -> int:
                 np.int64,
             ),
             "tokens": buf,
+            "logprobs": lp_buf,
             "times": padded,
         }
         while True:
@@ -771,6 +869,7 @@ def _serving_worker_loop(spec) -> int:
             tokens=res.tokens,
             new_tokens=res.new_tokens,
             finish=res.finish_reason,
+            logprobs=res.logprobs,
             times=(
                 res.latency_s,
                 res.stats.get("ttft_s", 0.0),
@@ -807,7 +906,9 @@ def _serving_worker_loop(spec) -> int:
                 break
             (req_id, plen, max_new, seed, ring_ver, wall_ns,
              slo_i, tenant_h, ship_mode, ship_slot, first_tok,
-             n_ship, route_code) = (int(v) for v in msg["meta"])
+             n_ship, route_code, resume_len) = (
+                int(v) for v in msg["meta"]
+            )
             if ring_ver != RING_SCHEMA_VERSION:
                 raise RingSchemaMismatch(ring_ver, "dispatch request")
             try:
@@ -825,6 +926,16 @@ def _serving_worker_loop(spec) -> int:
                     route=_ROUTE_NAMES.get(route_code,
                                            "least_outstanding"),
                 )
+                if resume_len > 0:
+                    # a drained replica's hand-back: the tail rides
+                    # the prompt buffer past the prompt; re-prefill
+                    # reuses every cached [prompt|tail] block
+                    kwargs["resume_tokens"] = msg["prompt"][
+                        plen:plen + resume_len
+                    ]
+                    kwargs["resume_logprobs"] = msg["resume_lp"][
+                        :resume_len
+                    ]
                 if ship_mode == 1:
                     # prefill-and-ship: remember which arena slot the
                     # dispatcher reserved; the blocks stage there when
@@ -950,6 +1061,8 @@ def _serving_worker_loop(spec) -> int:
                     ),
                     float(scheduler.block_pool.prefix_hits),
                     float(scheduler.block_pool.prefix_queries),
+                    float(adoptions),
+                    float(meta_rpcs),
                 ),
             )
             window_tokens = 0
@@ -970,6 +1083,19 @@ def _serving_worker_loop(spec) -> int:
             served += 1
             _flush_result(res)
     requeued = scheduler.drain()
+    for r in requeued:
+        # hand each unfinished request back WITH its generated tail
+        # so the survivor resumes (re-prefilling the cached prefix)
+        # instead of regenerating; the dispatcher falls back to a
+        # fresh dispatch for anything these messages don't cover
+        tail = np.asarray(r.resume_tokens, np.int32).reshape(-1)
+        _respond(
+            _KIND_REQUEUE,
+            req_id=r.req_id,
+            tokens=tail,
+            new_tokens=int(tail.size),
+            logprobs=r.resume_logprobs,
+        )
     _respond(_KIND_DRAINED, new_tokens=len(requeued))
     logger.info(
         "serving replica %s drained on %s: served %d, handed back %d",
@@ -1011,6 +1137,15 @@ class _InFlight:
     tenant: str = ""
     digests: tuple = ()  # the prompt's chain-key digests (affinity)
     ship_slot: int = -1  # arena slot reserved for this request
+    # generated-so-far tail handed back by a draining replica (or
+    # supplied at submit): the next dispatch resumes instead of
+    # regenerating; logprobs ride along NaN-padded where unknown
+    resume_tokens: np.ndarray = field(
+        default_factory=lambda: np.zeros((0,), np.int32)
+    )
+    resume_logprobs: np.ndarray = field(
+        default_factory=lambda: np.zeros((0,), np.float32)
+    )
 
 
 class _Replica:
@@ -1063,8 +1198,10 @@ class ServingEngine:
         start_timeout: float = 300.0,
         ring_slots: int = 8,
         faults: Optional[Dict] = None,
+        capture_logprobs: bool = False,
     ):
         from dlrover_tpu.agent.ckpt_shm import SharedMemoryHandler
+        from dlrover_tpu.common.env import flywheel_enabled
         from dlrover_tpu.common.multi_process import SOCKET_DIR_ENV
         from dlrover_tpu.observability.metrics import Histogram
 
@@ -1100,11 +1237,23 @@ class ServingEngine:
             )
 
             self._health = ServingHealthEngine()
+        # flywheel layer (ISSUE 20), pinned at construction: logprob
+        # capture (the trajectory stream's old_logp), the co-published
+        # draft model (a "draft" sub-dict in factory_kwargs) and the
+        # generation side-segment fast path.  DLROVER_TPU_FLYWHEEL=0
+        # strips all three, reproducing the pre-flywheel plane.
+        self._flywheel = flywheel_enabled()
+        factory_kwargs = dict(factory_kwargs or {})
+        if not self._flywheel:
+            capture_logprobs = False
+            factory_kwargs.pop("draft", None)
+        self._capture = bool(capture_logprobs)
+        self._draft_mode = bool(factory_kwargs.get("draft"))
         self._spec = {
             "mode": "serve",
             "name": self._name,
             "factory": factory,
-            "factory_kwargs": factory_kwargs or {},
+            "factory_kwargs": factory_kwargs,
             "faults": {
                 str(k): v for k, v in (faults or {}).items()
             },
@@ -1119,6 +1268,8 @@ class ServingEngine:
                 "eos_id": eos_id,
             },
         }
+        if self._flywheel and (self._capture or self._draft_mode):
+            self._spec["flywheel"] = {"capture": self._capture}
         # fleet layer (ISSUE 17), pinned at construction: affinity
         # routing + SLO lanes + optional prefill/decode split.  OFF
         # (DLROVER_TPU_SERVE_FLEET=0) reproduces the PR-16 dispatcher
@@ -1254,22 +1405,52 @@ class ServingEngine:
         )
 
     # ----------------------------------------------------------- API
-    def sync_weights(self, params) -> float:
+    def sync_weights(self, params, draft_params=None) -> float:
         """One shm publish; every replica adopts it between scheduler
-        iterations (fan-out by attach — N readers, one segment)."""
+        iterations (fan-out by attach — N readers, one segment).  In
+        draft mode (a ``draft`` sub-dict in ``factory_kwargs``) the
+        policy and the drafter co-publish as ONE combined tree —
+        ``draft_params`` is then required every call, since replicas
+        restore onto a combined template.  With the flywheel layer on
+        the generation side-segment is bumped AFTER the save
+        completes, so replicas detect the new snapshot with one
+        atomic-width load instead of a meta RPC per iteration — and a
+        publisher killed mid-save never bumps it (replicas keep the
+        previous generation)."""
+        if self._draft_mode:
+            if draft_params is None:
+                raise ValueError(
+                    "draft mode: sync_weights needs draft_params "
+                    "(replicas restore a combined {'policy', "
+                    "'draft'} tree)"
+                )
+            params = {"policy": params, "draft": draft_params}
+        elif draft_params is not None:
+            raise ValueError(
+                "draft_params given but the engine was not built "
+                "with a 'draft' factory sub-config"
+            )
         self._version += 1
         t0 = time.perf_counter()
         self._shm.save_state(self._version, params)
+        if self._flywheel:
+            self._shm.publish_generation(self._version)
         self.publish_s = time.perf_counter() - t0
         return self.publish_s
 
     def submit(self, prompt, max_new: Optional[int] = None,
                seed: int = 0, slo_class: str = "batch",
-               tenant: str = "") -> int:
+               tenant: str = "", resume_tokens=None,
+               resume_logprobs=None) -> int:
         """Queue one prompt; returns the request id.  ``slo_class``
         ("interactive" gets the reserved decode-slot lanes and
         preempts last) and ``tenant`` (the fair-share key within a
-        class) only act with the fleet layer on."""
+        class) only act with the fleet layer on.  ``resume_tokens``
+        (a previously generated tail — e.g. carried across an engine
+        restart) makes the replica re-prefill [prompt|tail] through
+        its block-hash cache and continue from there instead of
+        regenerating; ``resume_logprobs`` optionally carries the
+        tail's captured logprobs (NaN-padded where unknown)."""
         if self._closed:
             raise RuntimeError("serving engine is closed")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -1280,6 +1461,22 @@ class ServingEngine:
         )
         if max_new < 1:
             raise ValueError("max_new must be >= 1")
+        resume = (
+            np.asarray(resume_tokens, np.int32).reshape(-1)
+            if resume_tokens is not None
+            else np.zeros((0,), np.int32)
+        )
+        if resume.size >= max_new:
+            raise ValueError(
+                f"resume tail of {resume.size} leaves no room under "
+                f"max_new {max_new}"
+            )
+        rlp = np.full((resume.size,), np.nan, np.float32)
+        if resume_logprobs is not None and resume.size:
+            got = np.asarray(
+                resume_logprobs, np.float32
+            ).reshape(-1)[: resume.size]
+            rlp[: got.size] = got
         if prompt.size + max_new > self._max_seq_len:
             raise ValueError(
                 f"prompt {prompt.size} + max_new {max_new} exceeds "
@@ -1305,7 +1502,7 @@ class ServingEngine:
                 "blocks"
             )
         digests: tuple = ()
-        if self._fleet:
+        if getattr(self, "_fleet", False):
             # the prompt's chain-key digests are the affinity
             # router's match input — computed once, at the front door
             from dlrover_tpu.rl.kv_cache import prefix_block_keys
@@ -1332,6 +1529,8 @@ class ServingEngine:
                 ),
                 tenant=str(tenant),
                 digests=digests,
+                resume_tokens=resume,
+                resume_logprobs=rlp,
             )
             self._reqs[req_id] = inflight
             self._dispatch_q.append(req_id)
@@ -1515,6 +1714,28 @@ class ServingEngine:
                     # a ship IS the prefill worker's completion
                     self._health.note_ship(rep.idx)
                 continue
+            if kind == _KIND_REQUEUE:
+                # a draining replica handed this request back with
+                # its generated tail: store the tail and requeue —
+                # the next dispatch resumes from it.  Popping the
+                # request from ``outstanding`` here keeps the later
+                # death-requeue from double-queueing it.
+                req_id = int(meta[0])
+                rep.outstanding.pop(req_id, None)
+                req = self._reqs.get(req_id)
+                if req is None or req_id in self._completed:
+                    continue
+                n_tail = int(meta[3])
+                req.resume_tokens = (
+                    msg["tokens"][:n_tail].astype(np.int32).copy()
+                )
+                req.resume_logprobs = (
+                    msg["logprobs"][:n_tail].copy()
+                )
+                self._free_ship_slot(req_id)
+                with self._lock:
+                    self._dispatch_q.appendleft(req_id)
+                continue
             if kind == _KIND_REJECT:
                 req_id = int(meta[0])
                 rep.outstanding.pop(req_id, None)
@@ -1538,15 +1759,22 @@ class ServingEngine:
             latency = (
                 time.monotonic() - req.submit_t if req else 0.0
             )
+            result = {
+                "tokens": msg["tokens"][:total].copy(),
+                "new_tokens": int(meta[3]),
+                "finish_reason": _FINISH_NAMES.get(
+                    int(meta[4]), "length"
+                ),
+                "version": int(meta[5]),
+            }
+            if self._capture:
+                result["logprobs"] = (
+                    msg["logprobs"][: int(meta[3])].copy()
+                )
             self._complete(
                 req_id,
                 {
-                    "tokens": msg["tokens"][:total].copy(),
-                    "new_tokens": int(meta[3]),
-                    "finish_reason": _FINISH_NAMES.get(
-                        int(meta[4]), "length"
-                    ),
-                    "version": int(meta[5]),
+                    **result,
                     "latency_s": latency,
                     "worker_latency_s": float(msg["times"][0]),
                     "ttft_s": float(msg["times"][1]),
@@ -1618,20 +1846,31 @@ class ServingEngine:
     def _req_msg(self, req: _InFlight, ship_mode: int = 0,
                  ship_slot: int = -1, first_token: int = -1,
                  n_blocks: int = 0, route: int = 0) -> Dict:
-        """One v3 request-ring payload."""
+        """One v4 request-ring payload."""
+        resume = req.resume_tokens
+        n_resume = int(resume.size)
+        prompt_buf = np.zeros((self._max_seq_len,), np.int32)
+        prompt_buf[: req.prompt.size] = req.prompt
+        lp_buf = np.zeros((self._max_seq_len,), np.float32)
+        if n_resume:
+            prompt_buf[
+                req.prompt.size:req.prompt.size + n_resume
+            ] = resume
+            lp = np.full((n_resume,), np.nan, np.float32)
+            got = req.resume_logprobs[:n_resume]
+            lp[: got.size] = got
+            lp_buf[:n_resume] = lp
         return {
             "meta": np.asarray(
                 [req.req_id, req.prompt.size, req.max_new, req.seed,
                  RING_SCHEMA_VERSION, int(req.submit_wall * 1e9),
                  1 if req.slo_class == "interactive" else 0,
                  _tenant_hash(req.tenant), ship_mode, ship_slot,
-                 first_token, n_blocks, route],
+                 first_token, n_blocks, route, n_resume],
                 np.int64,
             ),
-            "prompt": np.pad(
-                req.prompt,
-                (0, self._max_seq_len - req.prompt.size),
-            ),
+            "prompt": prompt_buf,
+            "resume_lp": lp_buf,
         }
 
     def _route(self, req: _InFlight, targets: List[_Replica]):
@@ -1746,6 +1985,9 @@ class ServingEngine:
                 and self._ship_arena is not None
                 and self._ship_free
                 and req.prompt.size >= self._min_ship_prompt
+                # a resumed request's tail predates any shipped
+                # blocks; serve it end-to-end on a decode replica
+                and not req.resume_tokens.size
             )
             if use_ship:
                 slot = self._ship_free.pop()

@@ -98,6 +98,10 @@ def _empty_tokens() -> np.ndarray:
     return np.zeros((0,), np.int32)
 
 
+def _empty_logprobs() -> np.ndarray:
+    return np.zeros((0,), np.float32)
+
+
 @dataclass
 class GenRequest:
     """One generation request (prompt in, sampled tail out).
@@ -113,6 +117,12 @@ class GenRequest:
     seed: int = 0
     submit_t: float = field(default_factory=time.monotonic)
     resume_tokens: np.ndarray = field(default_factory=_empty_tokens)
+    # per-token logprobs of the resume tail (logprob capture mode;
+    # same length as ``resume_tokens`` when known, NaN-padded when the
+    # tail crossed a boundary that could not carry them)
+    resume_logprobs: np.ndarray = field(
+        default_factory=_empty_logprobs
+    )
     # request-tracing state (ISSUE 16; inert when
     # DLROVER_TPU_SERVE_OBS=0).  ``submit_wall`` is the wall-clock
     # anchor that rode the dispatcher→replica ring (0 = in-process
@@ -149,6 +159,11 @@ class GenResult:
     new_tokens: int
     latency_s: float
     stats: Dict = field(default_factory=dict)
+    # per-generated-token actor logprobs (length == new_tokens) when
+    # the scheduler runs with ``capture_logprobs``; empty otherwise —
+    # the flywheel's streamed ``old_logp``, eliminating the trainer's
+    # recompute forward over the rollout
+    logprobs: np.ndarray = field(default_factory=_empty_logprobs)
 
 
 @dataclass(frozen=True)
@@ -182,6 +197,7 @@ class _Slot:
     shared_upto: int = 0  # prompt blocks registered in the index
     admit_seq: int = 0  # monotonic admission order (victim policy)
     generated: List[int] = field(default_factory=list)
+    logprobs: List[float] = field(default_factory=list)
     first_token_t: float = 0.0
 
 
@@ -203,6 +219,11 @@ class ContinuousBatchingScheduler:
         events=None,
         replica: str = "",
         role: str = "unified",
+        capture_logprobs: bool = False,
+        draft_cfg=None,
+        draft_decode_fn: Optional[Callable] = None,
+        draft_prefill_fn: Optional[Callable] = None,
+        verify_write_fn: Optional[Callable] = None,
     ):
         import jax
         import jax.numpy as jnp
@@ -234,6 +255,37 @@ class ContinuousBatchingScheduler:
         self._verify_model = paged_verify_fn or partial(
             llama.paged_verify_step, cfg=model_cfg
         )
+        # flywheel extensions (ISSUE 20): both OFF by default — the
+        # no-flag construction compiles exactly the closures above, so
+        # DLROVER_TPU_FLYWHEEL=0 callers reproduce today's programs.
+        # ``capture_logprobs``: every sampled token also returns its
+        # actor logprob (log-softmax of the RAW fp32 logits — the
+        # trainer's ``token_logprobs`` semantics, so streamed tails
+        # replace the old_logp recompute forward bit-for-bit).
+        # ``draft_cfg``: a separate small DRAFT model runs the K-step
+        # draft loop against its OWN pool while the policy verifies
+        # (and writes its K/V) in one ``paged_verify_write_step``.
+        self.capture_logprobs = bool(capture_logprobs)
+        self._draft_cfg = draft_cfg
+        self._draft_params = None
+        self._draft_decode_model = (
+            draft_decode_fn
+            or (
+                partial(llama.paged_decode_step, cfg=draft_cfg)
+                if draft_cfg is not None else None
+            )
+        )
+        self._draft_prefill_model = (
+            draft_prefill_fn
+            or (
+                partial(llama.paged_prefill_chunk, cfg=draft_cfg)
+                if draft_cfg is not None else None
+            )
+        )
+        self._verify_write_model = (
+            verify_write_fn
+            or partial(llama.paged_verify_write_step, cfg=model_cfg)
+        )
 
         # allocation/decode discipline (env-pinned at construction so
         # a scheduler never changes personality mid-flight)
@@ -260,6 +312,16 @@ class ContinuousBatchingScheduler:
         self.shipped: List[Dict] = []
         self.shipped_out = 0
         self.shipped_in = 0
+        # separate-drafter speculative decode needs a K>1 window and a
+        # lane that both prefills and decodes locally (a prefill-role
+        # worker never drafts; shipped adoptions degrade draft quality
+        # for that prompt, never correctness — emission is always the
+        # policy's verify stream in draft mode)
+        self.draft = (
+            draft_cfg is not None
+            and self.decode_k > 1
+            and self.role == "unified"
+        )
         # results of adoptions that finished on their first token when
         # no finished-list was threaded in (drained by step())
         self._adopt_finished: List[GenResult] = []
@@ -275,6 +337,21 @@ class ContinuousBatchingScheduler:
         self.pool_cfg = cache_cfg
         self.block_pool = BlockPool(cache_cfg)
         self._pool = init_block_pool(cache_cfg)
+        # the draft pool mirrors the policy pool's GEOMETRY (same
+        # block ids, tables, block size) with the DRAFT model's shapes
+        # — one host-side allocator drives both
+        self._draft_pool = None
+        if self.draft:
+            self._draft_pool = init_block_pool(
+                PagedCacheConfig(
+                    n_layers=draft_cfg.n_layers,
+                    n_kv_heads=draft_cfg.n_kv_heads,
+                    head_dim=draft_cfg.head_dim,
+                    num_blocks=s.num_blocks,
+                    block_size=s.block_size,
+                    dtype=draft_cfg.dtype,
+                )
+            )
 
         # host mirrors of the fixed-shape device inputs
         S, MB = s.max_slots, s.max_blocks_per_seq
@@ -395,20 +472,143 @@ class ContinuousBatchingScheduler:
                 logits_row[None], key[None], sample_pos[None]
             )[0]
 
-        self._decode_jit = jax.jit(_decode, donate_argnums=(1,))
+        CAP = self.capture_logprobs
+
+        def _lp_rows(logits, toks):
+            """Actor logprob of each sampled token: log-softmax of
+            the RAW fp32 logits (temperature-free — the trainer's
+            ``token_logprobs`` contract), gathered at the token."""
+            lp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            return jnp.take_along_axis(
+                lp, toks[..., None].astype(jnp.int32), axis=-1
+            )[..., 0]
+
+        def _decode_lp(params, pool, tokens, tables, positions,
+                       active, keys):
+            logits, pool = self._decode_model(
+                params, tokens, pool, tables, positions, active
+            )
+            nxt = _sample_rows(logits, keys, positions + 1)
+            return pool, nxt, _lp_rows(logits, nxt)
+
+        def _decode_multi_lp(params, pool, tokens, tables, positions,
+                             active, keys):
+            """``_decode_multi`` + per-token logprobs: lp of each
+            draft under its draft-step logits (the temp<=0 emission)
+            and of each verify sample under the verify logits (the
+            temp>0 emission)."""
+            drafts, lps = [], []
+            tok, pos = tokens, positions
+            for _ in range(K):
+                logits, pool = self._decode_model(
+                    params, tok, pool, tables, pos, active
+                )
+                d = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                drafts.append(d)
+                lps.append(_lp_rows(logits, d))
+                tok, pos = d, pos + 1
+            drafts = jnp.stack(drafts, axis=1)  # [S, K]
+            lp_drafts = jnp.stack(lps, axis=1)  # [S, K]
+            vin = jnp.concatenate(
+                [tokens[:, None], drafts[:, :-1]], axis=1
+            )
+            vlogits = self._verify_model(
+                params, vin, pool, tables, positions, active
+            )
+            steps = jnp.arange(K, dtype=positions.dtype)
+            ver = _sample_grid(
+                vlogits, keys, positions[:, None] + 1 + steps[None]
+            )
+            lp_ver = _lp_rows(vlogits, ver)
+            eq = (ver == drafts).astype(jnp.int32)
+            n_match = jnp.sum(jnp.cumprod(eq, axis=1), axis=1)
+            return pool, drafts, ver, n_match, lp_drafts, lp_ver
+
+        def _decode_multi_draft(params, draft_params, pool, dpool,
+                                tokens, tables, positions, active,
+                                keys):
+            """Separate-drafter window: the DRAFT model runs the
+            K-step greedy draft loop against its OWN pool; the policy
+            scores the window with ONE ``paged_verify_write_step``
+            that also writes the policy K/V the drafter no longer
+            produces.  Emission is ALWAYS the verify stream (``ver``
+            is the policy's true conditioned sample at every
+            temperature — the drafts only gate how far the window is
+            trusted)."""
+            drafts = []
+            tok, pos = tokens, positions
+            for _ in range(K):
+                dlogits, dpool = self._draft_decode_model(
+                    draft_params, tok, dpool, tables, pos, active
+                )
+                d = jnp.argmax(dlogits, axis=-1).astype(jnp.int32)
+                drafts.append(d)
+                tok, pos = d, pos + 1
+            drafts = jnp.stack(drafts, axis=1)  # [S, K]
+            vin = jnp.concatenate(
+                [tokens[:, None], drafts[:, :-1]], axis=1
+            )
+            vlogits, pool = self._verify_write_model(
+                params, vin, pool, tables, positions, active
+            )
+            steps = jnp.arange(K, dtype=positions.dtype)
+            ver = _sample_grid(
+                vlogits, keys, positions[:, None] + 1 + steps[None]
+            )
+            lp_ver = (
+                _lp_rows(vlogits, ver) if CAP
+                else jnp.zeros(ver.shape, jnp.float32)
+            )
+            eq = (ver == drafts).astype(jnp.int32)
+            n_match = jnp.sum(jnp.cumprod(eq, axis=1), axis=1)
+            return pool, dpool, drafts, ver, n_match, lp_ver
+
+        def _draft_prefill(dparams, dpool, chunk, table, start):
+            logits, dpool = self._draft_prefill_model(
+                dparams, chunk, dpool, table, start
+            )
+            return dpool, logits
+
+        def _sample_one_lp(logits_row, key, sample_pos):
+            tok = _sample_rows(
+                logits_row[None], key[None], sample_pos[None]
+            )
+            return tok[0], _lp_rows(logits_row[None], tok)[0]
+
+        self._decode_jit = jax.jit(
+            _decode_lp if CAP else _decode, donate_argnums=(1,)
+        )
         self._decode_multi_jit = (
-            jax.jit(_decode_multi, donate_argnums=(1,))
+            jax.jit(
+                _decode_multi_lp if CAP else _decode_multi,
+                donate_argnums=(1,),
+            )
             if K > 1 else None
         )
+        self._decode_multi_draft_jit = (
+            jax.jit(_decode_multi_draft, donate_argnums=(2, 3))
+            if self.draft else None
+        )
+        self._draft_prefill_jit = (
+            jax.jit(_draft_prefill, donate_argnums=(1,))
+            if self.draft else None
+        )
         self._prefill_jit = jax.jit(_prefill, donate_argnums=(1,))
-        self._sample_jit = jax.jit(_sample_one)
+        self._sample_jit = jax.jit(
+            _sample_one_lp if CAP else _sample_one
+        )
 
     # ------------------------------------------------------------- API
-    def sync_weights(self, params):
+    def sync_weights(self, params, draft_params=None):
         """Adopt the trainer's / publisher's current params (reference
         swap; in-flight sequences continue on the new weights — the
-        vLLM-backend weight-refresh semantics)."""
+        vLLM-backend weight-refresh semantics).  ``draft_params`` is
+        the co-published DRAFT model (flywheel separate-drafter mode);
+        until the first draft publish arrives the scheduler falls back
+        to self-drafting."""
         self._params = params
+        if draft_params is not None:
+            self._draft_params = draft_params
 
     def submit(
         self,
@@ -421,6 +621,8 @@ class ContinuousBatchingScheduler:
         tenant: str = "",
         shipped: Optional[Dict] = None,
         route: str = "local",
+        resume_tokens: Optional[np.ndarray] = None,
+        resume_logprobs: Optional[np.ndarray] = None,
     ) -> int:
         """Queue one prompt; returns the request id results carry.
 
@@ -432,7 +634,17 @@ class ContinuousBatchingScheduler:
         fleet admission lanes (any class other than "interactive"
         normalizes to "batch"); ``shipped`` carries a disaggregated
         prefill's KV block regions (``{"k", "v", "first_token"}``) —
-        the request then admits straight into the decode phase."""
+        the request then admits straight into the decode phase.
+
+        ``resume_tokens`` re-admits a partially-generated sequence
+        that crossed a PROCESS boundary (a drained / killed replica's
+        hand-back): the scheduler re-prefills prompt+tail, reusing
+        the prompt's cached prefix blocks via ``peek_prefix``, and
+        resumes sampling at the next position — (seed, position)-
+        purity makes the continuation identical to the uninterrupted
+        run instead of regenerating the tail from scratch.
+        ``resume_logprobs`` optionally carries the tail's captured
+        logprobs alongside."""
         if self.draining:
             raise RuntimeError(
                 "scheduler is draining: submissions belong on "
@@ -471,12 +683,43 @@ class ContinuousBatchingScheduler:
         self._next_req_id = max(self._next_req_id, req_id) + 1
         if slo_class != SLO_INTERACTIVE:
             slo_class = SLO_BATCH
+        resume = (
+            np.asarray(resume_tokens, np.int32).reshape(-1)
+            if resume_tokens is not None else _empty_tokens()
+        )
+        if resume.size >= max_new:
+            raise ValueError(
+                f"resume tail of {resume.size} token(s) already "
+                f"meets max_new {max_new} — nothing left to generate"
+            )
+        if resume.size:
+            rlp = (
+                np.asarray(resume_logprobs, np.float32).reshape(-1)
+                if resume_logprobs is not None else _empty_logprobs()
+            )
+            # a tail whose logprobs did not survive the boundary is
+            # NaN-padded so consumers can tell "unknown" from 0.0
+            if rlp.size < resume.size:
+                rlp = np.concatenate(
+                    [rlp,
+                     np.full(resume.size - rlp.size, np.nan,
+                             np.float32)]
+                )
+            rlp = rlp[: resume.size]
+        else:
+            rlp = _empty_logprobs()
         self._queue.append(
             GenRequest(req_id=req_id, prompt=prompt, max_new=max_new,
                        seed=int(seed),
                        submit_wall=float(submit_wall or 0.0),
+                       resume_tokens=resume, resume_logprobs=rlp,
                        slo_class=slo_class, tenant=str(tenant),
-                       shipped=shipped if self.fleet else None,
+                       # a shipped prefill predates the tail — resumes
+                       # re-prefill deterministically instead
+                       shipped=(
+                           shipped
+                           if self.fleet and not resume.size else None
+                       ),
                        route=str(route))
         )
         if slo_class == SLO_INTERACTIVE:
@@ -507,11 +750,15 @@ class ContinuousBatchingScheduler:
             except Exception:  # noqa: BLE001 - jax-version specific
                 return -1
 
-        active_decode = (
-            self._decode_multi_jit
-            if self._decode_multi_jit is not None
-            else self._decode_jit
-        )
+        if (
+            self._decode_multi_draft_jit is not None
+            and self._draft_params is not None
+        ):
+            active_decode = self._decode_multi_draft_jit
+        elif self._decode_multi_jit is not None:
+            active_decode = self._decode_multi_jit
+        else:
+            active_decode = self._decode_jit
         return {
             "decode": n(active_decode),
             "prefill": n(self._prefill_jit),
@@ -538,6 +785,9 @@ class ContinuousBatchingScheduler:
             lane_windows=self.lane_windows,
             accepted_per_step=round(
                 self.accepted_tokens / max(self.lane_windows, 1), 4
+            ),
+            draft_active=int(
+                self.draft and self._draft_params is not None
             ),
         )
         return st
@@ -748,6 +998,12 @@ class ContinuousBatchingScheduler:
             # past them
             sl.prefill_pos = n_hit * s.block_size
             sl.generated = [int(t) for t in req.resume_tokens]
+            if self.capture_logprobs and sl.generated:
+                rlp = req.resume_logprobs
+                sl.logprobs = [
+                    float(rlp[i]) if i < rlp.size else float("nan")
+                    for i in range(len(sl.generated))
+                ]
             self._slots[slot] = sl
             self.block_pool.note_filled(req.req_id, sl.prefill_pos)
             self._window_hit_blocks += n_hit
@@ -910,6 +1166,10 @@ class ContinuousBatchingScheduler:
                 new_tokens=len(sl.generated),
                 latency_s=now - req.submit_t,
                 stats=stats,
+                logprobs=(
+                    np.asarray(sl.logprobs, np.float32)
+                    if self.capture_logprobs else _empty_logprobs()
+                ),
             )
         )
         self.block_pool.free(req.req_id)
@@ -941,6 +1201,7 @@ class ContinuousBatchingScheduler:
                 seed=req.seed,
                 submit_t=req.submit_t,
                 resume_tokens=resume,
+                resume_logprobs=np.asarray(sl.logprobs, np.float32),
                 submit_wall=req.submit_wall,
                 preempts=req.preempts + 1,
                 hit_blocks=req.hit_blocks,
@@ -1065,13 +1326,18 @@ class ContinuousBatchingScheduler:
                 )
 
     def _append_token(self, slot: int, token: int,
-                      finished: List[GenResult]) -> bool:
+                      finished: List[GenResult],
+                      lp: Optional[float] = None) -> bool:
         """Append one sampled token; returns True when the sequence
         finished (EOS / budget) and left its slot."""
         sl = self._slots[slot]
         if not sl.generated:
             sl.first_token_t = time.monotonic()
         sl.generated.append(int(token))
+        if self.capture_logprobs:
+            sl.logprobs.append(
+                float(lp) if lp is not None else float("nan")
+            )
         if self._serve_obs:
             # per-token timestamps fold into ONE tbt_p99_s label at
             # finish — the only per-token tracing cost
@@ -1132,6 +1398,19 @@ class ContinuousBatchingScheduler:
             jnp.int32(start),
         )
         self.dispatches += 1
+        if self.draft and self._draft_params is not None:
+            # mirror the chunk into the DRAFT pool (same table/blocks,
+            # draft shapes) so the drafter decodes over a real prompt
+            # cache; a drafter adopted mid-prefill just drafts worse
+            # until the next prompt — emission never depends on it
+            self._draft_pool, _ = self._draft_prefill_jit(
+                self._draft_params,
+                self._draft_pool,
+                jnp.asarray(chunk[None], jnp.int32),
+                jnp.asarray(self._tables[slot]),
+                jnp.int32(start),
+            )
+            self.dispatches += 1
         sl.prefill_pos += real
         self.total_prefill_tokens += real
         self.block_pool.note_filled(req.req_id, sl.prefill_pos)
@@ -1139,11 +1418,15 @@ class ContinuousBatchingScheduler:
         if sl.prefill_pos >= plen:
             # sample the first new token from the last REAL prefill
             # position's logits (it lives inside this chunk)
+            first_lp = None
             tok = self._sample_jit(
                 logits[0, plen - 1 - start],
                 jnp.asarray(self._keys[slot]),
                 jnp.int32(plen),
             )
+            if self.capture_logprobs:
+                tok, first_lp = tok
+                first_lp = float(first_lp)
             self.dispatches += 1
             if self.role == "prefill":
                 # disaggregated split: the first token is sampled HERE
@@ -1178,7 +1461,8 @@ class ContinuousBatchingScheduler:
             self._positions[slot] = plen
             self._active[slot] = True
             self._next_token[slot] = int(tok)
-            if self._append_token(slot, int(tok), finished):
+            if self._append_token(slot, int(tok), finished,
+                                  lp=first_lp):
                 pass  # finished on its very first token
         return real
 
@@ -1192,7 +1476,7 @@ class ContinuousBatchingScheduler:
         if not decoding:
             return 0
         jnp = self._jnp
-        self._pool, nxt = self._decode_jit(
+        out = self._decode_jit(
             self._params,
             self._pool,
             jnp.asarray(self._next_token),
@@ -1201,6 +1485,12 @@ class ContinuousBatchingScheduler:
             jnp.asarray(self._active),
             jnp.asarray(self._keys),
         )
+        if self.capture_logprobs:
+            self._pool, nxt, lps = out
+            lps = np.asarray(lps)
+        else:
+            self._pool, nxt = out
+            lps = None
         self.dispatches += 1
         nxt = np.asarray(nxt)
         sampled = 0
@@ -1212,7 +1502,8 @@ class ContinuousBatchingScheduler:
             )
             tok = int(nxt[slot])
             sampled += 1
-            if not self._append_token(slot, tok, finished):
+            lp = float(lps[slot]) if lps is not None else None
+            if not self._append_token(slot, tok, finished, lp=lp):
                 self._next_token[slot] = tok
         return sampled
 
@@ -1230,15 +1521,48 @@ class ContinuousBatchingScheduler:
         temp = float(self.sched.temperature)
         jnp = self._jnp
         t0 = time.monotonic()
-        self._pool, drafts, ver, n_match = self._decode_multi_jit(
-            self._params,
-            self._pool,
-            jnp.asarray(self._next_token),
-            jnp.asarray(self._tables),
-            jnp.asarray(self._positions),
-            jnp.asarray(self._active),
-            jnp.asarray(self._keys),
+        draft_mode = (
+            self._decode_multi_draft_jit is not None
+            and self._draft_params is not None
         )
+        lp_drafts = lp_ver = None
+        if draft_mode:
+            (self._pool, self._draft_pool, drafts, ver, n_match,
+             lp_ver) = self._decode_multi_draft_jit(
+                self._params,
+                self._draft_params,
+                self._pool,
+                self._draft_pool,
+                jnp.asarray(self._next_token),
+                jnp.asarray(self._tables),
+                jnp.asarray(self._positions),
+                jnp.asarray(self._active),
+                jnp.asarray(self._keys),
+            )
+            lp_ver = np.asarray(lp_ver)
+        elif self.capture_logprobs:
+            (self._pool, drafts, ver, n_match, lp_drafts,
+             lp_ver) = self._decode_multi_jit(
+                self._params,
+                self._pool,
+                jnp.asarray(self._next_token),
+                jnp.asarray(self._tables),
+                jnp.asarray(self._positions),
+                jnp.asarray(self._active),
+                jnp.asarray(self._keys),
+            )
+            lp_drafts = np.asarray(lp_drafts)
+            lp_ver = np.asarray(lp_ver)
+        else:
+            self._pool, drafts, ver, n_match = self._decode_multi_jit(
+                self._params,
+                self._pool,
+                jnp.asarray(self._next_token),
+                jnp.asarray(self._tables),
+                jnp.asarray(self._positions),
+                jnp.asarray(self._active),
+                jnp.asarray(self._keys),
+            )
         self.dispatches += 1
         drafts = np.asarray(drafts)
         ver = np.asarray(ver)
@@ -1247,18 +1571,28 @@ class ContinuousBatchingScheduler:
         for slot in decoding:
             sl = self._slots[slot]
             remaining = sl.req.max_new - len(sl.generated)
-            if temp <= 0:
+            if draft_mode:
+                # separate drafter: ``ver`` is the policy's true
+                # conditioned stream at EVERY temperature (at temp 0
+                # it's the policy argmax); drafts only bound how far
+                # the window stays conditioned on matched prefixes
+                acc = min(int(n_match[slot]) + 1, K)
+                emitted = ver[slot]
+                emitted_lp = lp_ver
+            elif temp <= 0:
                 # drafts ARE the K=1 greedy stream (each draft step
                 # is the K=1 computation); the verify pass gates how
                 # far we trust the window, never what we emit
                 acc = max(1, int(n_match[slot]))
                 emitted = drafts[slot]
+                emitted_lp = lp_drafts
             else:
                 # rejection-style: every emitted token is the
                 # real-rule sample conditioned on a prefix that
                 # matched the drafts it was scored against
                 acc = min(int(n_match[slot]) + 1, K)
                 emitted = ver[slot]
+                emitted_lp = lp_ver
             acc = min(acc, remaining, K)
             self.lane_windows += 1
             kept_last = None
@@ -1272,7 +1606,11 @@ class ContinuousBatchingScheduler:
                 sampled += 1
                 self.accepted_tokens += 1
                 kept_last = tok
-                if self._append_token(slot, tok, finished):
+                lp = (
+                    float(emitted_lp[slot, j])
+                    if emitted_lp is not None else None
+                )
+                if self._append_token(slot, tok, finished, lp=lp):
                     done = True
                     break
             if not done and kept_last is not None:
@@ -1392,6 +1730,9 @@ class ContinuousBatchingScheduler:
             self._positions[slot] = 0
             self._active[slot] = False
             sl.req.resume_tokens = np.asarray(sl.generated, np.int32)
+            sl.req.resume_logprobs = np.asarray(
+                sl.logprobs, np.float32
+            )
             requeue.append(sl.req)
             self._slots[slot] = _Slot()
         self._prompt_keys.clear()  # handed-back requests left us

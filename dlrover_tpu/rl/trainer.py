@@ -164,6 +164,87 @@ class RLHFTrainer:
             ),
         }
 
+    def experience_from_trajectories(self, trajectories) -> Dict:
+        """Flywheel intake (ISSUE 20): build PPO experience straight
+        from streamed :class:`dlrover_tpu.rl.flywheel.Trajectory`
+        samples, using each trajectory's CAPTURED per-token logprobs
+        as ``old_logp`` — the actor recompute forward of
+        :meth:`make_experience` disappears (the reference and value
+        forwards remain; the frozen ref policy never sampled and the
+        critic never saw the rollout).  Captured logprobs are
+        ``log_softmax`` of the sampling policy's raw fp32 logits —
+        exactly what ``token_logprobs`` would recompute — so the two
+        paths are numerically identical; NaN entries (positions a
+        resume hop could not carry) fall back to one recompute pass
+        for the whole batch."""
+        ppo = self.config.ppo
+        if not trajectories:
+            return {"mean_reward": 0.0, "mean_kl": 0.0, "samples": 0}
+        b = len(trajectories)
+        total = max(int(t.tokens.size) for t in trajectories)
+        tokens = np.zeros((b, total), np.int32)
+        mask_t = np.zeros((b, total - 1), np.float32)
+        old_logp = np.zeros((b, total - 1), np.float32)
+        for i, t in enumerate(trajectories):
+            n = int(t.tokens.size)
+            tokens[i, :n] = t.tokens
+            lo = int(t.prompt_len)
+            hi = lo + int(t.new_tokens)
+            # the response token at position p pairs with next-token
+            # logprob row p-1
+            mask_t[i, lo - 1:hi - 1] = 1.0
+            lp = np.asarray(t.logprobs, np.float32).reshape(-1)
+            row = np.full((hi - lo,), np.nan, np.float32)
+            row[: min(lp.size, hi - lo)] = lp[: hi - lo]
+            old_logp[i, lo - 1:hi - 1] = row
+        actor_params = self.engine.states["actor"]["params"]
+        if np.isnan(old_logp[mask_t > 0]).any():
+            recomputed = np.asarray(
+                self._logp_fn(actor_params, tokens)
+            )
+            old_logp = np.where(
+                np.isnan(old_logp), recomputed, old_logp
+            )
+        else:
+            old_logp = np.nan_to_num(old_logp)
+        ref_logp = np.asarray(self._logp_fn(self._ref_params, tokens))
+        values = np.asarray(
+            self._value_fn(
+                self.engine.states["critic"]["params"], tokens
+            )
+        )
+        seq_reward = np.asarray(self._reward_fn(tokens))
+        r = -ppo.kl_coef * (old_logp - ref_logp) * mask_t
+        has_resp = mask_t.any(axis=1)
+        last = np.where(
+            has_resp,
+            (mask_t * np.arange(total - 1)[None]).argmax(axis=1),
+            total - 2,
+        )
+        r[np.arange(b), last] += seq_reward
+        adv, ret = self._gae_fn(jnp.asarray(r), jnp.asarray(values))
+        adv, ret = np.asarray(adv), np.asarray(ret)
+        for i in range(b):
+            self.buffer.add(
+                {
+                    "tokens": tokens[i],
+                    "mask": mask_t[i],
+                    "old_logp": old_logp[i],
+                    "ref_logp": ref_logp[i],
+                    "old_values": values[i, :-1],
+                    "advantages": adv[i],
+                    "returns": ret[i],
+                }
+            )
+        return {
+            "mean_reward": float(seq_reward.mean()),
+            "mean_kl": float(
+                ((old_logp - ref_logp) * mask_t).sum()
+                / max(mask_t.sum(), 1.0)
+            ),
+            "samples": b,
+        }
+
     # -- optimization ----------------------------------------------------
     def train_on_buffer(self, batch_size: int) -> Dict:
         """PPO epochs over the buffered experience through each role's

@@ -1,0 +1,80 @@
+"""Shared bench-model factory (ISSUE 20, satellite 2).
+
+``bench_serving.py``, ``bench_flywheel.py`` and the chaos harness all
+need "the tiny llama the benches run" — and three hand-copied config
+dicts drift (a vocab bump in one file silently changes another leg's
+tokens/s baseline).  This module is the single source of truth: every
+bench builds its model through ``bench_cfg_kwargs()`` /
+``bench_model()``, with knobs for the few axes legs legitimately vary
+(vocab for EOS-modal workloads, dtype for memory-shape studies, size
+for the drafter).
+
+Import as ``from _bench_models import ...`` (the scripts directory is
+on ``sys.path`` when any bench runs) — this is bench plumbing, not
+library surface, hence the underscore.
+"""
+
+from typing import Dict, Optional, Tuple
+
+#: the canonical bench model — identical across every bench leg that
+#: does not explicitly override a knob
+BASE_CFG_KW: Dict = dict(
+    vocab_size=128,
+    dim=32,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=2,
+    mlp_dim=64,
+    max_seq_len=128,
+    remat="none",
+)
+
+#: the co-published drafter (flywheel draft mode): one layer, half
+#: width — genuinely cheaper than the policy, same vocab so the
+#: verify step is well-defined
+DRAFT_OVERRIDES: Dict = dict(dim=16, n_layers=1, mlp_dim=32)
+
+
+def bench_cfg_kwargs(
+    vocab_size: Optional[int] = None,
+    dim: Optional[int] = None,
+    n_layers: Optional[int] = None,
+    mlp_dim: Optional[int] = None,
+    max_seq_len: Optional[int] = None,
+    dtype: Optional[str] = None,
+    **overrides,
+) -> Dict:
+    """The bench model's ``LlamaConfig`` kwargs, with knob overrides.
+    Returns a fresh dict each call — callers mutate freely."""
+    kw = dict(BASE_CFG_KW)
+    for key, val in dict(
+        vocab_size=vocab_size, dim=dim, n_layers=n_layers,
+        mlp_dim=mlp_dim, max_seq_len=max_seq_len, dtype=dtype,
+    ).items():
+        if val is not None:
+            kw[key] = val
+    kw.update(overrides)
+    return kw
+
+
+def draft_cfg_kwargs(**overrides) -> Dict:
+    """Kwargs for the small drafter published alongside the policy."""
+    return bench_cfg_kwargs(**{**DRAFT_OVERRIDES, **overrides})
+
+
+def bench_model(seed: int = 0, **overrides) -> Tuple[object, object]:
+    """Build (cfg, params) for the bench model; ``overrides`` are
+    ``bench_cfg_kwargs`` knobs.  Same (seed, overrides) -> bitwise
+    identical params, so two processes that each call this agree."""
+    import jax
+    import jax.numpy as jnp
+
+    from dlrover_tpu.models.llama import LlamaConfig, init_params
+
+    kw = bench_cfg_kwargs(**overrides)
+    if isinstance(kw.get("dtype"), str):
+        # same name->dtype hop the cross-process factory spec makes
+        kw["dtype"] = jnp.dtype(kw["dtype"])
+    cfg = LlamaConfig(**kw)
+    params = init_params(jax.random.PRNGKey(seed), cfg)
+    return cfg, params

@@ -53,19 +53,14 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
 
 import numpy as np  # noqa: E402
 
-CFG_KW = dict(
-    vocab_size=128,
-    dim=32,
-    n_layers=2,
-    n_heads=4,
-    n_kv_heads=2,
-    mlp_dim=64,
-    max_seq_len=128,
-    remat="none",
-)
+from _bench_models import bench_cfg_kwargs, bench_model  # noqa: E402
+
+# the one bench model, shared with bench_flywheel (scripts/_bench_models)
+CFG_KW = bench_cfg_kwargs()
 MAX_NEW = 12
 SCHED_KW = dict(
     max_slots=8,
@@ -100,13 +95,7 @@ def make_workload(n: int, seed: int):
 
 
 def _model():
-    import jax
-
-    from dlrover_tpu.models.llama import LlamaConfig, init_params
-
-    cfg = LlamaConfig(**CFG_KW)
-    params = init_params(jax.random.PRNGKey(0), cfg)
-    return cfg, params
+    return bench_model(seed=0)
 
 
 def _sequential_backend(cfg):
@@ -412,13 +401,7 @@ def run_utilization(n_requests: int):
     most sequences — with a 128-token vocabulary no single EOS id is
     ever likely inside a 32-token budget and the workload shape the
     leg exists to measure never materializes."""
-    import jax
-
-    from dlrover_tpu.models.llama import LlamaConfig, init_params
-
-    cfg_kw = dict(CFG_KW, vocab_size=24)
-    cfg = LlamaConfig(**cfg_kw)
-    params = init_params(jax.random.PRNGKey(3), cfg)
+    cfg, params = bench_model(seed=3, vocab_size=24)
     rng = np.random.default_rng(23)
     # budget >> typical EOS-length: exactly the shape that starves
     # reservation admission (it reserves all 64 for lanes that will
@@ -430,7 +413,7 @@ def run_utilization(n_requests: int):
         workload.append(
             {
                 "prompt": rng.integers(
-                    0, cfg_kw["vocab_size"], (plen,)
+                    0, cfg.vocab_size, (plen,)
                 ).astype(np.int32),
                 "max_new": max_new,
                 "seed": 5000 + i,

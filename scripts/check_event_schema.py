@@ -168,6 +168,15 @@ DECLARED_METRICS = {
     # labeled {kernel, backend} — each sample pairs with a
     # kernel_autotune span on the timeline
     "dlrover_tpu_paged_kernel_us",
+    # the RLHF flywheel (ISSUE 20, rl/flywheel.py): the policy
+    # generation last published, the trainer stall one in-place
+    # publish charged (pairs with a weight_publish span), the
+    # serve->train trajectory stream rate, and how many trajectories
+    # the staleness policy refused
+    "dlrover_tpu_flywheel_generation",
+    "dlrover_tpu_flywheel_publish_stall_s",
+    "dlrover_tpu_flywheel_trajectories_per_s",
+    "dlrover_tpu_flywheel_staleness_dropped",
 }
 METRIC_METHODS = {
     "set_gauge",

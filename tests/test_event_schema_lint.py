@@ -327,21 +327,52 @@ def test_lint_enforces_scale_event_labels(tmp_path):
         "    events.instant('scale_decision', action='grow')\n"
         "    events.instant('scale_decision', action='grow',\n"
         "                   reason='linear', from_world=2,\n"
-        "                   to_world=3)\n"
-        "    events.instant('scale_execute', action='grow',\n"
-        "                   reason='linear', from_world=2)\n"
+        "                   to_world=3, plane='train')\n"
         "    events.instant('scale_execute', action='grow',\n"
         "                   reason='linear', from_world=2,\n"
-        "                   to_world=3, outcome='done')\n"
+        "                   plane='train')\n"
+        "    events.instant('scale_execute', action='grow',\n"
+        "                   reason='linear', from_world=2,\n"
+        "                   to_world=3, plane='train',\n"
+        "                   outcome='done')\n"
     )
     proc = _run(str(bad))
     assert proc.returncode == 1, proc.stdout + proc.stderr
     assert "event_schema_violations=2" in proc.stdout, proc.stdout
     assert (
         "missing required label(s) "
-        "['reason', 'from_world', 'to_world']" in proc.stdout
+        "['reason', 'from_world', 'to_world', 'plane']"
+        in proc.stdout
     )
     assert "missing required label(s) ['to_world']" in proc.stdout
+
+
+def test_lint_enforces_scale_plane_label(tmp_path):
+    """ISSUE-20: with the flywheel lending capacity across the
+    train/serve boundary, an unlabeled scale instant cannot say WHICH
+    plane moved — ``plane`` is required on both markers."""
+    bad = tmp_path / "bad_plane.py"
+    bad.write_text(
+        "events = None\n"
+        "def f(events):\n"
+        "    events.instant('scale_decision', action='lend',\n"
+        "                   reason='rollout_bound', from_world=4,\n"
+        "                   to_world=3)\n"
+        "    events.instant('scale_decision', action='lend',\n"
+        "                   reason='rollout_bound', from_world=4,\n"
+        "                   to_world=3, plane='serve')\n"
+        "    events.instant('scale_execute', action='reclaim',\n"
+        "                   reason='learner_bound', from_world=3,\n"
+        "                   to_world=4, outcome='done')\n"
+        "    events.instant('scale_execute', action='reclaim',\n"
+        "                   reason='learner_bound', from_world=3,\n"
+        "                   to_world=4, plane='serve',\n"
+        "                   outcome='done')\n"
+    )
+    proc = _run(str(bad))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "event_schema_violations=2" in proc.stdout, proc.stdout
+    assert "missing required label(s) ['plane']" in proc.stdout
 
 
 def test_lint_enforces_step_profile_labels(tmp_path):
@@ -639,5 +670,70 @@ def test_lint_declares_paged_kernel_metric():
         assert proc.returncode == 1, proc.stdout + proc.stderr
         assert "event_schema_violations=1" in proc.stdout, proc.stdout
         assert "dlrover_tpu_paged_kernel_usec" in proc.stdout
+    finally:
+        os.unlink(probe)
+
+
+def test_lint_enforces_flywheel_span_labels(tmp_path):
+    """ISSUE-20 spans: a ``weight_publish`` without its
+    generation/bytes/stall accounting cannot prove the zero-copy
+    stall bound, a ``rollout_round`` without its scoreboard hides the
+    staleness budget, and a ``trajectory`` without provenance is an
+    unattributable sample — the lint refuses all three."""
+    bad = tmp_path / "bad_flywheel.py"
+    bad.write_text(
+        "events = None\n"
+        "def f(events):\n"
+        "    events.complete('weight_publish', 0.0, 1.0,\n"
+        "                    generation=3, bytes=1024)\n"
+        "    events.complete('weight_publish', 0.0, 1.0,\n"
+        "                    generation=3, bytes=1024,\n"
+        "                    stall_s=0.002)\n"
+        "    events.complete('rollout_round', 0.0, 1.0, round=2,\n"
+        "                    trajectories=16)\n"
+        "    events.complete('rollout_round', 0.0, 1.0, round=2,\n"
+        "                    trajectories=16, staleness_dropped=1)\n"
+        "    events.complete('trajectory', 0.0, 0.0, req_id=7,\n"
+        "                    generation=3)\n"
+        "    events.complete('trajectory', 0.0, 0.0, req_id=7,\n"
+        "                    generation=3, tokens=24)\n"
+    )
+    proc = _run(str(bad))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "event_schema_violations=3" in proc.stdout, proc.stdout
+    assert "missing required label(s) ['stall_s']" in proc.stdout
+    assert (
+        "missing required label(s) ['staleness_dropped']"
+        in proc.stdout
+    )
+    assert "missing required label(s) ['tokens']" in proc.stdout
+
+
+def test_lint_declares_flywheel_metrics():
+    """The four flywheel gauges are declared vocabulary; an
+    in-package near-miss typo is not.  Package-scoped, so the probe
+    lives in-tree."""
+    probe = os.path.join(
+        REPO, "dlrover_tpu", "_lint_probe_flywheel_delete_me.py"
+    )
+    with open(probe, "w") as f:
+        f.write(
+            "def f(reg):\n"
+            "    reg.set_gauge("
+            "'dlrover_tpu_flywheel_generation', 3)\n"
+            "    reg.set_gauge("
+            "'dlrover_tpu_flywheel_publish_stall_s', 0.002)\n"
+            "    reg.set_gauge("
+            "'dlrover_tpu_flywheel_trajectories_per_s', 40.0)\n"
+            "    reg.set_gauge("
+            "'dlrover_tpu_flywheel_staleness_dropped', 1)\n"
+            "    reg.set_gauge("
+            "'dlrover_tpu_flywheel_publish_stalls', 0.002)\n"
+        )
+    try:
+        proc = _run(probe)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "event_schema_violations=1" in proc.stdout, proc.stdout
+        assert "dlrover_tpu_flywheel_publish_stalls" in proc.stdout
     finally:
         os.unlink(probe)

@@ -420,15 +420,17 @@ class TestRingSchemaVersioning:
         )
 
         stats = _parse_stats(
-            [120.5, 3, 17, 0.66, 2, 0.25, 1.5, 0.08],
+            [120.5, 3, 17, 0.66, 2, 0.25, 1.5, 0.08, 5, 9, 4, 2],
             RING_SCHEMA_VERSION,
         )
         assert stats["tokens_per_s"] == 120.5
         assert stats["queue_depth"] == 3
         assert stats["kv_utilization"] == 0.66
         assert stats["preemptions"] == 2
+        assert stats["adoptions"] == 4
+        assert stats["meta_rpcs"] == 2
 
-    @pytest.mark.parametrize("bad_version", [2, 4])
+    @pytest.mark.parametrize("bad_version", [3, 5])
     def test_mismatch_is_typed_and_names_both_versions(
         self, bad_version
     ):
